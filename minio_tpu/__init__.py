@@ -1,0 +1,8 @@
+"""minio_tpu — a TPU-native object-storage framework with MinIO's capabilities.
+
+Compute plane (GF(2^8) Reed-Solomon erasure coding + HighwayHash bitrot
+verification) runs as batched XLA/Pallas kernels on TPU; the control plane
+(S3 API, quorum logic, storage, locks, healing) is host-side.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,163 @@
+"""CPU-reference Reed-Solomon codec (numpy) — the correctness oracle.
+
+Byte-for-byte compatible with the reference's codec
+(klauspost/reedsolomon behind /root/reference/cmd/erasure-coding.go): same
+field, same systematic Vandermonde coding matrix, same Split padding rules.
+Validated against the reference's startup self-test golden xxhash table
+(/root/reference/cmd/erasure-coding.go:169) in tests/test_erasure_golden.py.
+
+This module is also the fallback codec when no TPU is available, and the
+oracle that the JAX/Pallas device codecs are differential-tested against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf256
+
+
+class ReedSolomonCPU:
+    """Systematic RS(data, parity) codec over GF(2^8).
+
+    Shards are numpy uint8 arrays of equal length. Mirrors the narrow seam of
+    the reference's `Erasure` struct (Split/Encode/Reconstruct), cf.
+    /root/reference/cmd/erasure-coding.go:35.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("data and parity shard counts must be positive")
+        if data_shards + parity_shards > 256:
+            raise ValueError("data+parity must be <= 256")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = gf256.build_matrix(data_shards, self.total_shards)
+        self.parity_rows = self.matrix[data_shards:, :]
+
+    # -- Split ----------------------------------------------------------------
+
+    def split(self, data: bytes | np.ndarray) -> list[np.ndarray]:
+        """Split a byte buffer into data_shards equal shards, zero-padded.
+
+        per_shard = ceil(len/data_shards), matching klauspost Split as used by
+        EncodeData (/root/reference/cmd/erasure-coding.go:81).
+        """
+        buf = np.frombuffer(bytes(data), dtype=np.uint8) if not isinstance(
+            data, np.ndarray) else data.astype(np.uint8, copy=False).ravel()
+        if buf.size == 0:
+            raise ValueError("cannot split empty buffer")
+        per_shard = -(-buf.size // self.data_shards)
+        padded = np.zeros(per_shard * self.data_shards, dtype=np.uint8)
+        padded[:buf.size] = buf
+        return [padded[i * per_shard:(i + 1) * per_shard]
+                for i in range(self.data_shards)]
+
+    # -- Encode ---------------------------------------------------------------
+
+    def encode(self, data_shards_list: list[np.ndarray]) -> list[np.ndarray]:
+        """Compute parity shards; returns full shard list [data..., parity...]."""
+        assert len(data_shards_list) == self.data_shards
+        d = np.stack([np.asarray(s, dtype=np.uint8) for s in data_shards_list])
+        parity = gf256.gf_matmul(self.parity_rows, d)
+        return list(d) + [parity[i] for i in range(self.parity_shards)]
+
+    def encode_data(self, data: bytes | np.ndarray) -> list[np.ndarray]:
+        """Split + encode in one call (reference EncodeData)."""
+        return self.encode(self.split(data))
+
+    # -- Verify ---------------------------------------------------------------
+
+    def verify(self, shards: list[np.ndarray]) -> bool:
+        d = np.stack(shards[:self.data_shards])
+        expect = gf256.gf_matmul(self.parity_rows, d)
+        got = np.stack(shards[self.data_shards:])
+        return bool(np.array_equal(expect, got))
+
+    # -- Reconstruct ----------------------------------------------------------
+
+    def _decode_matrix_for(self, available: list[int]) -> np.ndarray:
+        """Inverse of the coding-matrix rows for the first data_shards
+        available shards; maps those shards back to the original data."""
+        rows = available[:self.data_shards]
+        sub = self.matrix[rows, :]
+        return gf256.gf_mat_invert(sub)
+
+    def reconstruct(self, shards: list[np.ndarray | None],
+                    data_only: bool = False) -> list[np.ndarray]:
+        """Fill in missing (None/empty) shards in place; returns the list.
+
+        Mirrors klauspost Reconstruct/ReconstructData as driven by
+        DecodeDataBlocks (/root/reference/cmd/erasure-coding.go:96).
+        """
+        if len(shards) != self.total_shards:
+            raise ValueError("wrong number of shards")
+        # Normalize: accept bytes or uint8 arrays; None/empty means missing.
+        shards = [None if s is None else
+                  (np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray))
+                   else np.asarray(s, dtype=np.uint8))
+                  for s in shards]
+        available = [i for i, s in enumerate(shards) if s is not None and s.size > 0]
+        if len(available) == self.total_shards:
+            return list(shards)  # nothing to do
+        if len(available) < self.data_shards:
+            raise ValueError("too few shards to reconstruct")
+        sizes = {shards[i].size for i in available}
+        if len(sizes) != 1:
+            raise ValueError(f"available shards have unequal sizes: {sorted(sizes)}")
+
+        use = available[:self.data_shards]
+        sub_shards = np.stack([shards[i] for i in use])
+        dec = self._decode_matrix_for(available)
+        # Recover the original data shards.
+        data = gf256.gf_matmul(dec, sub_shards)
+
+        out: list[np.ndarray] = []
+        for i in range(self.total_shards):
+            s = shards[i]
+            if s is not None and s.size > 0:
+                out.append(s)
+            elif i < self.data_shards:
+                out.append(data[i].copy())
+            elif data_only:
+                out.append(np.zeros(0, dtype=np.uint8))
+            else:
+                row = self.matrix[i][None, :]
+                out.append(gf256.gf_matmul(row, data)[0])
+        return out
+
+    def reconstruct_data(self, shards: list[np.ndarray | None]) -> list[np.ndarray]:
+        return self.reconstruct(shards, data_only=True)
+
+    # -- Geometry (reference ShardSize/ShardFileSize math) --------------------
+
+    @staticmethod
+    def ceil_frac(num: int, den: int) -> int:
+        return -(-num // den)
+
+    def shard_size(self, block_size: int) -> int:
+        """ceil(block_size / data_shards) — cf. erasure-coding.go:122."""
+        return self.ceil_frac(block_size, self.data_shards)
+
+    def shard_file_size(self, total_length: int, block_size: int) -> int:
+        """Size of one shard file for an object of total_length bytes
+        erasure-coded in block_size blocks — cf. erasure-coding.go:127."""
+        if total_length == 0:
+            return 0
+        if total_length < 0:
+            return -1
+        num_blocks = total_length // block_size
+        last = total_length % block_size
+        return (num_blocks * self.shard_size(block_size)
+                + self.ceil_frac(last, self.data_shards))
+
+    def shard_file_offset(self, start_offset: int, length: int,
+                          total_length: int, block_size: int) -> int:
+        """Effective end offset within a shard file for a ranged read —
+        cf. erasure-coding.go:141."""
+        shard_size = self.shard_size(block_size)
+        shard_file_size = self.shard_file_size(total_length, block_size)
+        end_block = (start_offset + length) // block_size
+        till = (end_block + 1) * shard_size
+        return min(till, shard_file_size)

@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax init.
+
+Multi-chip sharding logic is tested on virtual CPU devices (no multi-chip TPU
+hardware in CI); bench.py runs on the real chip outside pytest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
