@@ -86,7 +86,8 @@ class ReedSolomonCPU:
 
     def reconstruct(self, shards: list[np.ndarray | None],
                     data_only: bool = False) -> list[np.ndarray]:
-        """Fill in missing (None/empty) shards in place; returns the list.
+        """Return a new full shard list with missing (None/empty) entries
+        recomputed; the input list is not mutated.
 
         Mirrors klauspost Reconstruct/ReconstructData as driven by
         DecodeDataBlocks (/root/reference/cmd/erasure-coding.go:96).
@@ -99,13 +100,13 @@ class ReedSolomonCPU:
                    else np.asarray(s, dtype=np.uint8))
                   for s in shards]
         available = [i for i, s in enumerate(shards) if s is not None and s.size > 0]
-        if len(available) == self.total_shards:
-            return list(shards)  # nothing to do
         if len(available) < self.data_shards:
             raise ValueError("too few shards to reconstruct")
         sizes = {shards[i].size for i in available}
         if len(sizes) != 1:
             raise ValueError(f"available shards have unequal sizes: {sorted(sizes)}")
+        if len(available) == self.total_shards:
+            return list(shards)  # nothing to do
 
         use = available[:self.data_shards]
         sub_shards = np.stack([shards[i] for i in use])

@@ -1,10 +1,14 @@
-"""Test configuration: force an 8-device virtual CPU mesh before jax init.
+"""Test configuration: force an 8-device virtual CPU mesh before jax use.
 
 Multi-chip sharding logic is tested on virtual CPU devices (no multi-chip TPU
 hardware in CI); bench.py runs on the real chip outside pytest.
+
+Note: the env var JAX_PLATFORMS alone is not enough here — the axon TPU
+plugin registers itself regardless — so we also override via jax.config.
 """
 
 import os
+import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
@@ -12,5 +16,8 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,30 @@
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#define HH_TARGET_NAME Portable
+#include "highwayhash/hh_portable.h"
+using namespace highwayhash;
+using namespace highwayhash::Portable;
+int main() {
+  // minio magic key (cmd/bitrot.go:37), little-endian u64 lanes
+  const unsigned char keyb[32] = {
+    0x4b,0xe7,0x34,0xfa,0x8e,0x23,0x8a,0xcd,0x26,0x3e,0x83,0xe6,0xbb,0x96,0x85,0x52,
+    0x04,0x0f,0x93,0x5d,0xa3,0x9f,0x44,0x14,0x97,0xe0,0x9d,0x13,0x22,0xde,0x36,0xa0};
+  HHKey key;
+  memcpy(&key, keyb, 32);
+  char data[128];
+  for (int i = 0; i < 128; i++) data[i] = (char)i;
+  for (int len = 0; len <= 64; len++) {
+    HHStatePortable st(key);
+    // process whole packets then remainder, like HighwayHashT
+    int done = 0;
+    while (len - done >= 32) { HHPacket p; memcpy(&p, data + done, 32); st.Update(p); done += 32; }
+    if (len - done > 0) st.UpdateRemainder(data + done, len - done);
+    HHResult256 r;
+    st.Finalize(&r);
+    printf("%d: %016llx %016llx %016llx %016llx\n", len,
+           (unsigned long long)r[0], (unsigned long long)r[1],
+           (unsigned long long)r[2], (unsigned long long)r[3]);
+  }
+  return 0;
+}

@@ -71,6 +71,24 @@ def test_vectorized_matches_scalar(length):
         assert got[i].tobytes() == want, f"stream {i} length {length}"
 
 
+def test_independent_cxx_vectors_all_lengths():
+    """Known-answer vectors for every length 0..64 (covers the remainder
+    path, which the reference self-test chain — all multiples of 32 — does
+    not). Generated from the C++ portable reference implementation; see
+    tests/data_gen_highwayhash_vectors.cc (compile with -O0: the vendored
+    header miscompiles under -O2)."""
+    from tests.highwayhash_vectors import GOLDEN_LENGTHS
+
+    data = bytes(range(128))
+    for n, want_hex in GOLDEN_LENGTHS.items():
+        want = bytes.fromhex(want_hex)
+        assert highwayhash256(data[:n]) == want, f"scalar length {n}"
+        if n:
+            arr = np.frombuffer(data[:n], dtype=np.uint8)[None, :]
+            assert highwayhash256_batch(arr)[0].tobytes() == want, \
+                f"vectorized length {n}"
+
+
 def test_vectorized_golden_chain():
     # Run the same golden chain through the vectorized path (multiple-of-32
     # messages only, which the chain is).
