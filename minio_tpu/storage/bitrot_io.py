@@ -1,0 +1,131 @@
+"""Streaming bitrot framing: [32-byte HighwayHash256 | shard block] per block.
+
+Same on-disk frame layout as the reference's streaming bitrot writer/reader
+(/root/reference/cmd/bitrot-streaming.go:35-189): a shard file of logical
+size L with shard block size `shard_size` is stored as
+ceil(L/shard_size) frames, each `32 + min(shard_size, remaining)` bytes.
+`bitrot_shard_file_size` mirrors cmd/bitrot.go:146.
+
+Hashing is vectorized across all blocks of a batch (HighwayHashVec) — the
+multi-stream layout that maps onto the device hash kernel later.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.highwayhash import HighwayHash256, highwayhash256_batch
+from .errors import ErrFileCorrupt
+
+HASH_SIZE = 32
+
+
+def ceil_frac(num: int, den: int) -> int:
+    return -(-num // den)
+
+
+def bitrot_shard_file_size(size: int, shard_size: int) -> int:
+    """On-disk size of a shard file of logical size `size`."""
+    if size == 0:
+        return 0
+    return ceil_frac(size, shard_size) * HASH_SIZE + size
+
+
+def bitrot_logical_size(disk_size: int, shard_size: int) -> int:
+    """Inverse of bitrot_shard_file_size."""
+    if disk_size == 0:
+        return 0
+    frame = HASH_SIZE + shard_size
+    full = disk_size // frame
+    rest = disk_size % frame
+    if rest:
+        rest -= HASH_SIZE
+    return full * shard_size + rest
+
+
+def frame_shard(shard: np.ndarray, shard_size: int) -> bytes:
+    """Frame one shard file's bytes into [hash|block] frames."""
+    shard = np.asarray(shard, dtype=np.uint8).ravel()
+    out = bytearray()
+    n_full = shard.size // shard_size
+    # Vectorized hash over all the full-size blocks at once.
+    if n_full:
+        blocks = shard[:n_full * shard_size].reshape(n_full, shard_size)
+        digests = highwayhash256_batch(blocks)
+        for i in range(n_full):
+            out += digests[i].tobytes()
+            out += blocks[i].tobytes()
+    tail = shard[n_full * shard_size:]
+    if tail.size:
+        h = HighwayHash256()
+        h.update(tail.tobytes())
+        out += h.digest()
+        out += tail.tobytes()
+    return bytes(out)
+
+
+def frame_shards_batch(shards: np.ndarray) -> list[bytes]:
+    """Frame a batch at once: (n_shards, n_blocks, shard_size) -> one framed
+    byte string per shard file, hashing all n_shards*n_blocks streams in a
+    single vectorized pass (the hot PUT path)."""
+    n_shards, n_blocks, shard_size = shards.shape
+    flat = shards.reshape(n_shards * n_blocks, shard_size)
+    digests = highwayhash256_batch(flat).reshape(n_shards, n_blocks, HASH_SIZE)
+    out = []
+    for i in range(n_shards):
+        buf = bytearray()
+        for b in range(n_blocks):
+            buf += digests[i, b].tobytes()
+            buf += shards[i, b].tobytes()
+        out.append(bytes(buf))
+    return out
+
+
+def unframe_shard(data: bytes, shard_size: int, verify: bool = True,
+                  logical_size: int | None = None) -> np.ndarray:
+    """Parse and (optionally) verify a framed shard file back to raw bytes.
+
+    Raises ErrFileCorrupt on hash mismatch or size inconsistency — the same
+    condition the reference's verifying ReadAt surfaces
+    (cmd/bitrot-streaming.go:142).
+    """
+    if logical_size is not None and len(data) != bitrot_shard_file_size(
+            logical_size, shard_size):
+        raise ErrFileCorrupt("framed size mismatch")
+    buf = np.frombuffer(data, dtype=np.uint8)
+    frame = HASH_SIZE + shard_size
+    n_full = buf.size // frame
+    rest = buf.size % frame
+    pieces = []
+    if n_full:
+        frames = buf[:n_full * frame].reshape(n_full, frame)
+        hashes = frames[:, :HASH_SIZE]
+        blocks = frames[:, HASH_SIZE:]
+        if verify:
+            got = highwayhash256_batch(blocks)
+            if not np.array_equal(got, hashes):
+                raise ErrFileCorrupt("bitrot hash mismatch")
+        pieces.append(blocks.reshape(-1))
+    if rest:
+        tail = buf[n_full * frame:]
+        if tail.size <= HASH_SIZE:
+            raise ErrFileCorrupt("truncated bitrot frame")
+        h, block = tail[:HASH_SIZE], tail[HASH_SIZE:]
+        if verify:
+            hh = HighwayHash256()
+            hh.update(block.tobytes())
+            if hh.digest() != h.tobytes():
+                raise ErrFileCorrupt("bitrot hash mismatch (tail)")
+        pieces.append(block)
+    if not pieces:
+        return np.zeros(0, dtype=np.uint8)
+    return np.concatenate(pieces)
+
+
+def read_frames_range(data: bytes, shard_size: int, block_start: int,
+                      block_end: int, verify: bool = True) -> np.ndarray:
+    """Read shard blocks [block_start, block_end) from a framed file —
+    the ranged-read fast path (no need to touch earlier frames)."""
+    frame = HASH_SIZE + shard_size
+    sub = data[block_start * frame:block_end * frame]
+    return unframe_shard(sub, shard_size, verify=verify)

@@ -1,0 +1,63 @@
+"""Storage error taxonomy — mirrors the reference's typed errors
+(/root/reference/cmd/storage-errors.go) so quorum reduction can classify
+failures the same way."""
+
+
+class StorageError(Exception):
+    pass
+
+
+class ErrDiskNotFound(StorageError):
+    pass
+
+
+class ErrFaultyDisk(StorageError):
+    pass
+
+
+class ErrDiskFull(StorageError):
+    pass
+
+
+class ErrVolumeNotFound(StorageError):
+    pass
+
+
+class ErrVolumeExists(StorageError):
+    pass
+
+
+class ErrVolumeNotEmpty(StorageError):
+    pass
+
+
+class ErrFileNotFound(StorageError):
+    pass
+
+
+class ErrFileVersionNotFound(StorageError):
+    pass
+
+
+class ErrFileCorrupt(StorageError):
+    pass
+
+
+class ErrFileAccessDenied(StorageError):
+    pass
+
+
+class ErrIsNotRegular(StorageError):
+    pass
+
+
+class ErrPathNotFound(StorageError):
+    pass
+
+
+class ErrMethodNotAllowed(StorageError):
+    pass
+
+
+class ErrDoneForNow(StorageError):
+    """Listing pagination sentinel."""
