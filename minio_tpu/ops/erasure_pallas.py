@@ -37,7 +37,7 @@ FORCE_INTERPRET = False
 
 def _choose_tile_s(s: int) -> int | None:
     """Largest multiple-of-128 tile <= DEFAULT_TILE_S that divides s."""
-    for t in range(min(DEFAULT_TILE_S, s), 127, -128):
+    for t in range(min(DEFAULT_TILE_S, s - s % 128), 0, -128):
         if s % t == 0:
             return t
     return None

@@ -59,6 +59,7 @@ class ShardedCodec:
         self.m = parity_shards
         self.mesh = mesh
         self.n_total = data_shards + parity_shards
+        self._reconstruct_cache: dict[tuple, object] = {}
 
     # -- encode: dp over blocks, sp over shard bytes -------------------------
 
@@ -89,6 +90,16 @@ class ShardedCodec:
 
     def make_reconstruct_jit(self, sources: tuple[int, ...],
                              targets: tuple[int, ...]):
+        key = (sources, targets)
+        cached = self._reconstruct_cache.get(key)
+        if cached is not None:
+            return cached
+        fn = self._build_reconstruct_jit(sources, targets)
+        self._reconstruct_cache[key] = fn
+        return fn
+
+    def _build_reconstruct_jit(self, sources: tuple[int, ...],
+                               targets: tuple[int, ...]):
         """Build an SPMD step where shard rows are device-local and the K
         source rows are all-gathered over the "lanes" axis.
 

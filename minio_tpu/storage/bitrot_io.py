@@ -39,6 +39,10 @@ def bitrot_logical_size(disk_size: int, shard_size: int) -> int:
     full = disk_size // frame
     rest = disk_size % frame
     if rest:
+        if rest <= HASH_SIZE:
+            # A trailing fragment that can't hold a hash + >=1 data byte
+            # only occurs on a corrupt/truncated file.
+            raise ErrFileCorrupt("truncated bitrot frame")
         rest -= HASH_SIZE
     return full * shard_size + rest
 
