@@ -1,0 +1,426 @@
+"""Local drive backend — the xlStorage equivalent.
+
+One `LocalDrive` owns one directory tree and implements the per-drive
+contract the engine fans out to (cf. StorageAPI,
+/root/reference/cmd/storage-interface.go:27, and xlStorage,
+/root/reference/cmd/xl-storage.go:90):
+
+- volumes (buckets) are top-level directories,
+- an object is a directory holding ``xl.meta`` plus one subdirectory per
+  version data-dir containing bitrot-framed shard files (``part.N``),
+- writes land in a per-drive tmp area and are published atomically by
+  renaming the whole data-dir + rewriting xl.meta (RenameData,
+  /root/reference/cmd/xl-storage.go:1830),
+- deletes first rename into the tmp trash area so visibility is atomic
+  (moveToTrash, /root/reference/cmd/xl-storage.go:838).
+
+Python file I/O here plays the role of the reference's O_DIRECT+fdatasync
+Go paths; durability is fsync-on-publish.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import shutil
+import threading
+import uuid
+
+from . import bitrot_io
+from .errors import (ErrDiskNotFound, ErrFileAccessDenied, ErrFileCorrupt,
+                     ErrFileNotFound, ErrFileVersionNotFound, ErrIsNotRegular,
+                     ErrPathNotFound, ErrVolumeExists, ErrVolumeNotEmpty,
+                     ErrVolumeNotFound)
+from .xlmeta import FileInfo, XLMeta
+
+# Reserved system namespace on every drive (reference: .minio.sys).
+SYS_VOL = ".mtpu.sys"
+TMP_DIR = "tmp"
+MULTIPART_DIR = "multipart"
+BUCKET_META_DIR = "buckets"
+XL_META_FILE = "xl.meta"
+FORMAT_FILE = "format.json"
+
+# Objects <= this are stored inline in xl.meta (cf. smallFileThreshold,
+# /root/reference/cmd/xl-storage.go:59).
+SMALL_FILE_THRESHOLD = 128 * 1024
+
+
+def _is_valid_volname(vol: str) -> bool:
+    return bool(vol) and "/" not in vol and vol not in (".", "..")
+
+
+class LocalDrive:
+    """One local drive rooted at `root`."""
+
+    def __init__(self, root: str, create: bool = True):
+        self.root = os.path.abspath(root)
+        if create:
+            os.makedirs(self.root, exist_ok=True)
+        elif not os.path.isdir(self.root):
+            raise ErrDiskNotFound(root)
+        for sub in (TMP_DIR, MULTIPART_DIR, BUCKET_META_DIR):
+            os.makedirs(os.path.join(self.root, SYS_VOL, sub), exist_ok=True)
+        self._meta_lock = threading.Lock()
+        self.disk_id: str = ""
+        self.endpoint = root
+
+    # -- path helpers --------------------------------------------------------
+
+    def _vol_path(self, vol: str) -> str:
+        # Volumes are single path components directly under the root.
+        if not _is_valid_volname(vol):
+            raise ErrVolumeNotFound(vol)
+        return os.path.join(self.root, vol)
+
+    def _file_path(self, vol: str, path: str) -> str:
+        base = self._vol_path(vol)
+        p = os.path.normpath(os.path.join(base, path))
+        # Confine to the volume, not just the drive root — '..' must not
+        # reach sibling volumes or the reserved system namespace.
+        if not (p + os.sep).startswith(base + os.sep):
+            raise ErrFileAccessDenied(f"{vol}/{path}")
+        return p
+
+    def _check_vol(self, vol: str) -> str:
+        p = self._vol_path(vol)
+        if not os.path.isdir(p):
+            raise ErrVolumeNotFound(vol)
+        return p
+
+    # -- volume ops ----------------------------------------------------------
+
+    def make_volume(self, vol: str) -> None:
+        p = self._vol_path(vol)
+        if os.path.isdir(p):
+            raise ErrVolumeExists(vol)
+        os.makedirs(p)
+
+    def list_volumes(self) -> list[str]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if name == SYS_VOL or name.startswith("."):
+                continue
+            if os.path.isdir(os.path.join(self.root, name)):
+                out.append(name)
+        return out
+
+    def stat_volume(self, vol: str) -> dict:
+        p = self._check_vol(vol)
+        st = os.stat(p)
+        return {"name": vol, "created_ns": int(st.st_mtime_ns)}
+
+    def delete_volume(self, vol: str, force: bool = False) -> None:
+        p = self._check_vol(vol)
+        if force:
+            self._move_to_trash(p)
+            return
+        try:
+            os.rmdir(p)
+        except OSError as e:
+            if e.errno == errno.ENOTEMPTY:
+                raise ErrVolumeNotEmpty(vol) from e
+            raise
+
+    # -- small-file ops (metadata, config) -----------------------------------
+
+    def write_all(self, vol: str, path: str, data: bytes) -> None:
+        """Atomic small-file write (tmp + rename + fsync)."""
+        self._check_vol(vol)
+        p = self._file_path(vol, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = os.path.join(self.root, SYS_VOL, TMP_DIR,
+                           f"wa-{uuid.uuid4().hex}")
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def read_all(self, vol: str, path: str) -> bytes:
+        p = self._file_path(vol, path)
+        try:
+            with open(p, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise ErrFileNotFound(f"{vol}/{path}") from None
+        except IsADirectoryError:
+            raise ErrFileNotFound(f"{vol}/{path}") from None
+        except PermissionError:
+            raise ErrFileAccessDenied(f"{vol}/{path}") from None
+
+    def delete(self, vol: str, path: str, recursive: bool = False) -> None:
+        p = self._file_path(vol, path)
+        if not os.path.exists(p):
+            raise ErrFileNotFound(f"{vol}/{path}")
+        if os.path.isdir(p):
+            if recursive:
+                self._move_to_trash(p)
+            else:
+                try:
+                    os.rmdir(p)
+                except OSError as e:
+                    raise ErrFileAccessDenied(str(e)) from e
+        else:
+            os.remove(p)
+
+    # -- shard-file ops ------------------------------------------------------
+
+    def create_file(self, vol: str, path: str, data: bytes) -> None:
+        """Write a (bitrot-framed) shard file; parents auto-created.
+
+        The engine stages shard files under the tmp volume and publishes
+        them via rename_data — so this write itself needs no tmp hop.
+        """
+        self._check_vol(vol)
+        p = self._file_path(vol, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read_file(self, vol: str, path: str, offset: int = 0,
+                  length: int = -1) -> bytes:
+        p = self._file_path(vol, path)
+        try:
+            with open(p, "rb") as f:
+                if offset:
+                    f.seek(offset)
+                return f.read() if length < 0 else f.read(length)
+        except FileNotFoundError:
+            raise ErrFileNotFound(f"{vol}/{path}") from None
+        except IsADirectoryError:
+            raise ErrIsNotRegular(f"{vol}/{path}") from None
+
+    def file_size(self, vol: str, path: str) -> int:
+        p = self._file_path(vol, path)
+        try:
+            st = os.stat(p)
+        except FileNotFoundError:
+            raise ErrFileNotFound(f"{vol}/{path}") from None
+        if not os.path.isfile(p):
+            raise ErrIsNotRegular(f"{vol}/{path}")
+        return st.st_size
+
+    # -- versioned metadata ops ---------------------------------------------
+
+    def _meta_path(self, vol: str, obj: str) -> str:
+        return self._file_path(vol, os.path.join(obj, XL_META_FILE))
+
+    def _read_xlmeta(self, vol: str, obj: str) -> XLMeta:
+        try:
+            buf = self.read_all(vol, os.path.join(obj, XL_META_FILE))
+        except ErrFileNotFound:
+            raise ErrFileNotFound(f"{vol}/{obj}") from None
+        return XLMeta.from_bytes(buf)
+
+    def _write_xlmeta(self, vol: str, obj: str, meta: XLMeta) -> None:
+        if not meta.versions:
+            # Last version gone: remove the whole object dir.
+            obj_dir = self._file_path(vol, obj)
+            self._move_to_trash(obj_dir)
+            return
+        self.write_all(vol, os.path.join(obj, XL_META_FILE), meta.to_bytes())
+
+    def read_version(self, vol: str, obj: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo:
+        """ReadVersion (cf. /root/reference/cmd/xl-storage.go:1183):
+        returns FileInfo; inline data always included when present."""
+        self._check_vol(vol)
+        meta = self._read_xlmeta(vol, obj)
+        fi = meta.get(version_id, vol, obj)
+        return fi
+
+    def write_metadata(self, vol: str, obj: str, fi: FileInfo) -> None:
+        """Add/replace one version in xl.meta (WriteMetadata)."""
+        self._check_vol(vol)
+        with self._meta_lock:
+            try:
+                meta = self._read_xlmeta(vol, obj)
+            except ErrFileNotFound:
+                meta = XLMeta()
+            meta.add_version(fi)
+            self._write_xlmeta(vol, obj, meta)
+
+    def update_metadata(self, vol: str, obj: str, fi: FileInfo) -> None:
+        with self._meta_lock:
+            meta = self._read_xlmeta(vol, obj)
+            meta.find_version(fi.version_id)  # must exist
+            meta.add_version(fi)
+            self._write_xlmeta(vol, obj, meta)
+
+    def rename_data(self, src_vol: str, src_dir: str, fi: FileInfo,
+                    dst_vol: str, dst_obj: str) -> None:
+        """Atomic publish: move staged data-dir into place + add version
+        to xl.meta (cf. RenameData, /root/reference/cmd/xl-storage.go:1830).
+
+        src_dir is the staging dir whose *contents* are the part files;
+        they are moved to <dst_obj>/<fi.data_dir>/.
+        """
+        self._check_vol(dst_vol)
+        with self._meta_lock:
+            try:
+                meta = self._read_xlmeta(dst_vol, dst_obj)
+            except ErrFileNotFound:
+                meta = XLMeta()
+            except ErrFileCorrupt:
+                meta = XLMeta()  # heal path will rewrite; don't block PUT
+            # Non-versioned overwrite of the null version: free old datadir.
+            old_dd = ""
+            if fi.version_id == "":
+                try:
+                    old_dd = meta.delete_version("")
+                except ErrFileVersionNotFound:
+                    pass
+            if fi.uses_data_dir():
+                src = self._file_path(src_vol, src_dir)
+                if not os.path.isdir(src):
+                    raise ErrFileNotFound(f"{src_vol}/{src_dir}")
+                dst = self._file_path(dst_vol,
+                                      os.path.join(dst_obj, fi.data_dir))
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                if os.path.isdir(dst):
+                    self._move_to_trash(dst)
+                os.replace(src, dst)
+            meta.add_version(fi)
+            self._write_xlmeta(dst_vol, dst_obj, meta)
+            if old_dd:
+                self._remove_data_dir(dst_vol, dst_obj, old_dd)
+
+    def delete_version(self, vol: str, obj: str, version_id: str = "",
+                       mark_delete: bool = False,
+                       fi: FileInfo | None = None) -> None:
+        """Remove one version (or write a delete marker when mark_delete).
+
+        cf. DeleteVersion, /root/reference/cmd/xl-storage.go and the
+        xlMetaV2 state machine (xl-storage-format-v2.go:1132).
+        """
+        self._check_vol(vol)
+        with self._meta_lock:
+            meta = self._read_xlmeta(vol, obj)
+            if mark_delete:
+                assert fi is not None and fi.deleted
+                meta.add_version(fi)
+                self._write_xlmeta(vol, obj, meta)
+                return
+            dd = meta.delete_version(version_id)
+            self._write_xlmeta(vol, obj, meta)
+            if dd:
+                self._remove_data_dir(vol, obj, dd)
+            if not meta.versions:
+                self._cleanup_empty_parents(vol, obj)
+
+    def _remove_data_dir(self, vol: str, obj: str, data_dir: str) -> None:
+        p = self._file_path(vol, os.path.join(obj, data_dir))
+        if os.path.isdir(p):
+            self._move_to_trash(p)
+
+    def _cleanup_empty_parents(self, vol: str, obj: str) -> None:
+        """Remove now-empty parent dirs up to the volume root."""
+        base = self._check_vol(vol)
+        p = os.path.dirname(self._file_path(vol, obj))
+        while p.startswith(base + os.sep):
+            try:
+                os.rmdir(p)
+            except OSError:
+                break
+            p = os.path.dirname(p)
+
+    # -- listing / walking ---------------------------------------------------
+
+    def list_dir(self, vol: str, path: str = "") -> list[str]:
+        """Entries directly under a prefix dir; directories get a trailing
+        slash. Object dirs (containing xl.meta) count as file entries."""
+        self._check_vol(vol)
+        p = self._file_path(vol, path) if path else self._vol_path(vol)
+        try:
+            names = sorted(os.listdir(p))
+        except FileNotFoundError:
+            raise ErrPathNotFound(f"{vol}/{path}") from None
+        except NotADirectoryError:
+            raise ErrPathNotFound(f"{vol}/{path}") from None
+        out = []
+        for name in names:
+            full = os.path.join(p, name)
+            if os.path.isdir(full):
+                if os.path.isfile(os.path.join(full, XL_META_FILE)):
+                    out.append(name)
+                else:
+                    out.append(name + "/")
+            elif name == XL_META_FILE:
+                continue
+        return out
+
+    def walk_dir(self, vol: str, prefix: str = ""):
+        """Yield (object_name, xl.meta bytes) depth-first in lexical order
+        (cf. WalkDir, /root/reference/cmd/metacache-walk.go:60)."""
+        base = self._check_vol(vol)
+        start = self._file_path(vol, prefix) if prefix else base
+        # The prefix may be a partial name: walk its parent and filter.
+        walk_root = start if os.path.isdir(start) else os.path.dirname(start)
+        if not os.path.isdir(walk_root):
+            return
+        for dirpath, dirnames, filenames in os.walk(walk_root):
+            dirnames.sort()
+            if XL_META_FILE in filenames:
+                rel = os.path.relpath(dirpath, base).replace(os.sep, "/")
+                if rel.startswith(prefix) or not prefix:
+                    try:
+                        with open(os.path.join(dirpath, XL_META_FILE),
+                                  "rb") as f:
+                            yield rel, f.read()
+                    except OSError:
+                        pass
+                dirnames[:] = []  # don't descend into data dirs
+
+    # -- bitrot verify -------------------------------------------------------
+
+    def verify_file(self, vol: str, path: str, shard_size: int,
+                    expected_logical: int | None = None) -> None:
+        """Full-file bitrot verification (cf. VerifyFile,
+        /root/reference/cmd/xl-storage.go:2194). Raises ErrFileCorrupt."""
+        data = self.read_file(vol, path)
+        if expected_logical is not None:
+            want = bitrot_io.bitrot_shard_file_size(expected_logical,
+                                                    shard_size)
+            if len(data) != want:
+                raise ErrFileCorrupt(
+                    f"size mismatch: {len(data)} != {want}")
+        bitrot_io.unframe_shard(data, shard_size, verify=True)
+
+    # -- disk info / format --------------------------------------------------
+
+    def disk_info(self) -> dict:
+        st = os.statvfs(self.root)
+        return {
+            "total": st.f_blocks * st.f_frsize,
+            "free": st.f_bavail * st.f_frsize,
+            "used": (st.f_blocks - st.f_bfree) * st.f_frsize,
+            "endpoint": self.endpoint,
+            "id": self.disk_id,
+            "online": True,
+        }
+
+    def get_disk_id(self) -> str:
+        return self.disk_id
+
+    # -- internals -----------------------------------------------------------
+
+    def _move_to_trash(self, path: str) -> None:
+        """Atomic disappearance: rename into tmp trash, then remove."""
+        trash = os.path.join(self.root, SYS_VOL, TMP_DIR,
+                             f"trash-{uuid.uuid4().hex}")
+        try:
+            os.replace(path, trash)
+        except FileNotFoundError:
+            return
+        shutil.rmtree(trash, ignore_errors=True)
+
+    def clear_tmp(self) -> None:
+        tmp = os.path.join(self.root, SYS_VOL, TMP_DIR)
+        for name in os.listdir(tmp):
+            shutil.rmtree(os.path.join(tmp, name), ignore_errors=True)
+
+    def __repr__(self) -> str:
+        return f"LocalDrive({self.root!r})"

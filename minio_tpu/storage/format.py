@@ -1,0 +1,106 @@
+"""Cluster format bootstrap — the format.json equivalent.
+
+Each drive carries ``.mtpu.sys/format.json`` binding it into the topology:
+deployment id, its own drive id, and the full sets layout (cf.
+formatErasureV3, /root/reference/cmd/format-erasure.go:111). On startup the
+topology layer loads formats from all drives, creates them on fresh drives,
+and verifies every drive sits where the layout says it should
+(cf. waitForFormatErasure, /root/reference/cmd/prepare-storage.go:298).
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+
+from .drive import FORMAT_FILE, SYS_VOL, LocalDrive
+from .errors import ErrDiskNotFound, ErrFileCorrupt, ErrFileNotFound
+
+FORMAT_VERSION = 1
+DIST_ALGO = "SIPMOD+PARITY"  # cf. formatErasureVersionV3DistributionAlgoV3
+
+
+def new_format(deployment_id: str, sets: list[list[str]], this: str) -> dict:
+    return {
+        "version": FORMAT_VERSION,
+        "format": "xl",
+        "id": deployment_id,
+        "xl": {
+            "version": 3,
+            "this": this,
+            "sets": sets,
+            "distributionAlgo": DIST_ALGO,
+        },
+    }
+
+
+def load_format(drive: LocalDrive) -> dict | None:
+    """Read a drive's format.json; None if the drive is unformatted."""
+    try:
+        buf = drive.read_all(SYS_VOL, FORMAT_FILE)
+    except ErrFileNotFound:
+        return None
+    try:
+        fmt = json.loads(buf.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ErrFileCorrupt(f"format.json: {e}") from e
+    if fmt.get("format") != "xl" or "xl" not in fmt:
+        raise ErrFileCorrupt("format.json: not an xl format")
+    return fmt
+
+
+def save_format(drive: LocalDrive, fmt: dict) -> None:
+    drive.write_all(SYS_VOL, FORMAT_FILE,
+                    json.dumps(fmt, indent=1).encode("utf-8"))
+    drive.disk_id = fmt["xl"]["this"]
+
+
+def init_format_sets(drives: list[list[LocalDrive]],
+                     deployment_id: str | None = None) -> dict:
+    """Format a fresh deployment: drives[s][d] -> set s, position d.
+
+    Returns the reference format (with "this" cleared). Existing formatted
+    drives are verified against their recorded position instead.
+    """
+    deployment_id = deployment_id or str(uuid.uuid4())
+    existing = [[load_format(d) if d is not None else None for d in row]
+                for row in drives]
+    ref = next((f for row in existing for f in row if f), None)
+    if ref is None:
+        sets = [[str(uuid.uuid4()) for _ in row] for row in drives]
+        for s, row in enumerate(drives):
+            for d, drive in enumerate(row):
+                fmt = new_format(deployment_id, sets, sets[s][d])
+                save_format(drive, fmt)
+        out = new_format(deployment_id, sets, "")
+        return out
+
+    # Partially/fully formatted: adopt the reference layout, heal fresh
+    # drives into their slots (cf. formatErasureFixLosingDisks).
+    sets = ref["xl"]["sets"]
+    deployment_id = ref["id"]
+    for s, row in enumerate(drives):
+        for d, drive in enumerate(row):
+            if drive is None:
+                continue
+            fmt = existing[s][d]
+            if fmt is None:
+                # Unformatted drive in a formatted cluster: heal format.
+                save_format(drive,
+                            new_format(deployment_id, sets, sets[s][d]))
+                continue
+            if fmt["id"] != deployment_id:
+                raise ErrFileCorrupt(
+                    f"drive {drive.root}: deployment id mismatch")
+            this = fmt["xl"]["this"]
+            if this != sets[s][d]:
+                raise ErrFileCorrupt(
+                    f"drive {drive.root}: drive id {this} not at expected "
+                    f"position set={s} disk={d}")
+            drive.disk_id = this
+    return new_format(deployment_id, sets, "")
+
+
+def quorum_formatted(formats: list[dict | None]) -> bool:
+    ok = sum(1 for f in formats if f)
+    return ok >= len(formats) // 2 + 1
