@@ -1,0 +1,658 @@
+"""One erasure set: quorum CRUD over a stripe of N drives.
+
+The erasureObjects equivalent (/root/reference/cmd/erasure-object.go:748) with
+the streaming encode/decode drivers (/root/reference/cmd/erasure-encode.go:36,
+cmd/erasure-decode.go:101) redesigned TPU-first:
+
+- data is staged in batches of 1 MiB blocks and erasure-coded as ONE batched
+  device dispatch per batch — (B, K, S) uint8 through the bit-plane MXU
+  matmul — instead of the reference's per-block synchronous SIMD calls
+  (SURVEY.md §5: blocks are the natural batch dimension);
+- shard fan-out to drives runs on a thread pool with write-quorum reduce
+  (the parallelWriter analogue);
+- reads fetch exactly K shards, verify bitrot frames, trigger spare reads
+  on failure (the parallelReader analogue), and reconstruct missing rows
+  with the same device matmul;
+- small objects (<= 128 KiB) inline their framed shards into xl.meta and
+  bypass the device (SURVEY.md §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..ops.erasure_cpu import ReedSolomonCPU
+from ..ops.erasure_jax import ReedSolomonTPU
+from ..ops.highwayhash import highwayhash256_batch
+from ..storage import bitrot_io
+from ..storage.drive import (SMALL_FILE_THRESHOLD, SYS_VOL, TMP_DIR,
+                             LocalDrive)
+from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
+                              ErrDiskNotFound, ErrErasureReadQuorum,
+                              ErrErasureWriteQuorum, ErrFileCorrupt,
+                              ErrFileNotFound, ErrFileVersionNotFound,
+                              ErrObjectNotFound, ErrVersionNotFound,
+                              ErrVolumeExists, ErrVolumeNotFound,
+                              StorageError)
+from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo,
+                              new_uuid, normalize_version_id)
+from . import quorum as Q
+
+BLOCK_SIZE = 1 << 20          # blockSizeV2, cmd/object-api-common.go:40
+BATCH_BLOCKS = 32             # 1 MiB blocks per device dispatch (32 MiB data)
+
+
+def _etag(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()
+
+
+def _now_ns() -> int:
+    return time.time_ns()
+
+
+class ErasureSet:
+    """Object CRUD on one stripe of `n` drives (entries may be None when a
+    drive is offline)."""
+
+    def __init__(self, drives: list[LocalDrive | None],
+                 default_parity: int | None = None,
+                 set_index: int = 0):
+        self.drives = list(drives)
+        self.n = len(drives)
+        if self.n < 2:
+            raise ValueError("an erasure set needs >= 2 drives")
+        self.default_parity = (self.n // 2 if default_parity is None
+                               else default_parity)
+        self.set_index = set_index
+        self.pool = ThreadPoolExecutor(max_workers=max(self.n, 4))
+        self._codec_cache: dict[tuple[int, int], ReedSolomonTPU] = {}
+        self._cpu_cache: dict[tuple[int, int], ReedSolomonCPU] = {}
+
+    # -- codec helpers -------------------------------------------------------
+
+    def _codec(self, k: int, m: int) -> ReedSolomonTPU:
+        if (k, m) not in self._codec_cache:
+            self._codec_cache[k, m] = ReedSolomonTPU(k, m)
+        return self._codec_cache[k, m]
+
+    def _cpu(self, k: int, m: int) -> ReedSolomonCPU:
+        if (k, m) not in self._cpu_cache:
+            self._cpu_cache[k, m] = ReedSolomonCPU(k, m)
+        return self._cpu_cache[k, m]
+
+    # -- drive fan-out helpers ----------------------------------------------
+
+    def _map_drives(self, fn, drives=None) -> list:
+        """Run fn(drive) on every drive in parallel; exceptions captured.
+
+        Returns list of (result, error) per drive position.
+        """
+        drives = self.drives if drives is None else drives
+
+        def call(d):
+            if d is None:
+                return None, ErrDiskNotFound("offline")
+            try:
+                return fn(d), None
+            except Exception as e:  # noqa: BLE001 — quorum layer classifies
+                return None, e
+
+        return list(self.pool.map(call, drives))
+
+    # -- bucket ops ----------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        res = self._map_drives(lambda d: d.make_volume(bucket))
+        errs = [e for _, e in res]
+        # Already present on every drive -> the bucket truly exists.
+        if errs and all(isinstance(e, ErrVolumeExists) for e in errs):
+            raise ErrBucketExists(bucket)
+        # Partial existence is the heal case: treat as success.
+        errs = [None if isinstance(e, ErrVolumeExists) else e for e in errs]
+        err = Q.reduce_write_quorum_errs(errs, self.n // 2 + 1)
+        if err is not None:
+            raise err
+
+    def bucket_exists(self, bucket: str) -> bool:
+        res = self._map_drives(lambda d: d.stat_volume(bucket))
+        ok = sum(1 for _, e in res if e is None)
+        return ok >= self._live_quorum()
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        res = self._map_drives(lambda d: d.delete_volume(bucket, force=force))
+        errs = [e for _, e in res]
+        if errs and all(isinstance(e, ErrVolumeNotFound) for e in errs):
+            raise ErrBucketNotFound(bucket)
+        errs = [None if isinstance(e, ErrVolumeNotFound) else e for e in errs]
+        err = Q.reduce_write_quorum_errs(errs, self.n // 2 + 1)
+        if err is not None:
+            raise err
+
+    def list_buckets(self) -> list[str]:
+        res = self._map_drives(lambda d: d.list_volumes())
+        counts: dict[str, int] = {}
+        for vols, e in res:
+            if e is None:
+                for v in vols:
+                    counts[v] = counts.get(v, 0) + 1
+        quorum = self._live_quorum()
+        return sorted(v for v, c in counts.items() if c >= quorum)
+
+    def _live_quorum(self) -> int:
+        live = sum(1 for d in self.drives if d is not None)
+        return max(1, live // 2)
+
+    # -- put -----------------------------------------------------------------
+
+    def put_object(self, bucket: str, obj: str, data: bytes, *,
+                   metadata: dict | None = None,
+                   versioned: bool = False,
+                   parity: int | None = None) -> FileInfo:
+        """Erasure-code and store one object (single part).
+
+        cf. erasureObjects.putObject, /root/reference/cmd/erasure-object.go:748.
+        """
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        parity = self.default_parity if parity is None else parity
+        # Parity upgrade: offline drives become parity so the write keeps
+        # full reconstruction capability (cf. erasure-object.go:766-800).
+        offline = sum(1 for d in self.drives if d is None)
+        upgraded = False
+        if offline and parity < self.n // 2:
+            parity = min(parity + offline, self.n // 2)
+            upgraded = True
+        k = self.n - parity
+        write_quorum = k + (1 if k == parity else 0)
+
+        distribution = Q.hash_order(f"{bucket}/{obj}", self.n)
+        meta = dict(metadata or {})
+        meta.setdefault("etag", _etag(data))
+        if upgraded:
+            meta["x-mtpu-internal-erasure-upgraded"] = f"{offline}-offline"
+        version_id = new_uuid() if versioned else ""
+        mod_time = _now_ns()
+        ec_base = ErasureInfo(
+            data_blocks=k, parity_blocks=parity, block_size=BLOCK_SIZE,
+            index=0, distribution=distribution,
+            checksums=[{"part": 1, "algo": "highwayhash256S", "hash": b""}])
+
+        def fi_for(drive_pos: int, data_dir: str,
+                   inline: bytes | None) -> FileInfo:
+            ec = ErasureInfo(
+                data_blocks=k, parity_blocks=parity, block_size=BLOCK_SIZE,
+                index=distribution[drive_pos], distribution=distribution,
+                checksums=ec_base.checksums)
+            return FileInfo(
+                volume=bucket, name=obj, version_id=version_id,
+                data_dir=data_dir, mod_time_ns=mod_time, size=len(data),
+                metadata=meta,
+                parts=[ObjectPartInfo(1, len(data), len(data))],
+                erasure=ec, inline_data=inline)
+
+        if len(data) <= SMALL_FILE_THRESHOLD:
+            return self._put_inline(bucket, obj, data, fi_for, k, parity,
+                                    distribution, write_quorum)
+
+        # Streaming path: encode batches of blocks on device, append framed
+        # shards to per-drive staging files, publish with rename_data.
+        data_dir = new_uuid()
+        tmp_id = f"put-{uuid.uuid4().hex}"
+        failed = [d is None for d in self.drives]
+
+        for batch_shards in self._encode_stream(data, k, parity):
+            # batch_shards: list of n framed byte strings in SHARD order.
+            per_drive = Q.unshuffle_to_drives(batch_shards, distribution)
+
+            def write_one(pos):
+                d = self.drives[pos]
+                if d is None or failed[pos]:
+                    return
+                d.append_file(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.1",
+                              per_drive[pos])
+
+            futures = [self.pool.submit(write_one, pos)
+                       for pos in range(self.n)]
+            for pos, fut in enumerate(futures):
+                try:
+                    fut.result()
+                except Exception:  # noqa: BLE001
+                    failed[pos] = True
+            if sum(1 for f in failed if not f) < write_quorum:
+                self._cleanup_tmp(tmp_id)
+                raise ErrErasureWriteQuorum(
+                    f"{self.n - sum(failed)} < {write_quorum}")
+
+        def publish(pos):
+            d = self.drives[pos]
+            if d is None or failed[pos]:
+                raise ErrDiskNotFound("offline/failed")
+            d.rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}", fi_for(pos, data_dir, None),
+                          bucket, obj)
+
+        res = self._map_drives_positions(publish)
+        errs = [e for _, e in res]
+        err = Q.reduce_write_quorum_errs(errs, write_quorum)
+        # Always sweep staging: drives that failed mid-stream (or failed
+        # publish) still hold their partial tmp shard files.
+        self._cleanup_tmp(tmp_id)
+        if err is not None:
+            raise err
+        return fi_for(0, data_dir, None)
+
+    def _put_inline(self, bucket, obj, data, fi_for, k, parity,
+                    distribution, write_quorum) -> FileInfo:
+        """Small objects: framed shards live inline in each drive's xl.meta
+        (cf. inline data, /root/reference/cmd/xl-storage.go:1183)."""
+        shards = self._encode_full(data, k, parity)  # n framed byte strings
+        per_drive = Q.unshuffle_to_drives(shards, distribution)
+
+        def write_one(pos):
+            d = self.drives[pos]
+            if d is None:
+                raise ErrDiskNotFound("offline")
+            d.write_metadata(bucket, obj, fi_for(pos, "", per_drive[pos]))
+
+        res = self._map_drives_positions(write_one)
+        err = Q.reduce_write_quorum_errs([e for _, e in res], write_quorum)
+        if err is not None:
+            raise err
+        return fi_for(0, "", None)
+
+    def _map_drives_positions(self, fn) -> list:
+        def call(pos):
+            try:
+                return fn(pos), None
+            except Exception as e:  # noqa: BLE001
+                return None, e
+        return list(self.pool.map(call, range(self.n)))
+
+    # -- encode drivers ------------------------------------------------------
+
+    def _encode_full(self, data: bytes, k: int, m: int) -> list[bytes]:
+        """Encode a small object in one shot; returns n framed shard files."""
+        out = [bytearray() for _ in range(k + m)]
+        for framed in self._encode_stream(data, k, m):
+            for i, b in enumerate(framed):
+                out[i] += b
+        return [bytes(b) for b in out]
+
+    def _encode_stream(self, data: bytes, k: int, m: int):
+        """Yield lists of n framed shard-chunks per batch of blocks.
+
+        Full 1 MiB blocks are encoded as one batched device dispatch
+        ((B, K, S) uint8); the partial tail block goes through the CPU
+        oracle codec (tiny, not worth a dispatch).
+        """
+        size = len(data)
+        shard_size = -(-BLOCK_SIZE // k)
+        n_full = size // BLOCK_SIZE
+        buf = np.frombuffer(data, dtype=np.uint8)
+
+        for start in range(0, n_full, BATCH_BLOCKS):
+            nb = min(BATCH_BLOCKS, n_full - start)
+            batch = buf[start * BLOCK_SIZE:(start + nb) * BLOCK_SIZE]
+            if BLOCK_SIZE % k == 0:
+                blocks = batch.reshape(nb, k, shard_size)
+            else:
+                # Non-power-of-two K: each block zero-pads to K*shard_size
+                # (split padding rule, cf. erasure-coding.go:81).
+                blocks = np.zeros((nb, k * shard_size), dtype=np.uint8)
+                blocks[:, :BLOCK_SIZE] = batch.reshape(nb, BLOCK_SIZE)
+                blocks = blocks.reshape(nb, k, shard_size)
+            parity = np.asarray(self._codec(k, m).encode_blocks(blocks))
+            full = np.concatenate([blocks, parity], axis=1)  # (nb, k+m, S)
+            # Frame: hash every (shard, block) stream in one vectorized pass.
+            flat = full.transpose(1, 0, 2).reshape((k + m) * nb, shard_size)
+            digests = highwayhash256_batch(flat).reshape(k + m, nb, 32)
+            framed = []
+            for i in range(k + m):
+                chunks = bytearray()
+                shard_rows = full[:, i, :]
+                for b in range(nb):
+                    chunks += digests[i, b].tobytes()
+                    chunks += shard_rows[b].tobytes()
+                framed.append(bytes(chunks))
+            yield framed
+
+        tail = buf[n_full * BLOCK_SIZE:]
+        if tail.size or size == 0:
+            if tail.size == 0:
+                return
+            cpu = self._cpu(k, m)
+            shards = cpu.encode_data(tail.tobytes())  # k+m arrays
+            tail_shard = shards[0].size
+            framed = [bitrot_io.frame_shard(s, tail_shard) for s in shards]
+            yield framed
+
+    # -- get -----------------------------------------------------------------
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, version_id: str = "") -> tuple[FileInfo, bytes]:
+        """Read [offset, offset+length) of an object, verifying bitrot and
+        reconstructing up to `parity` missing/corrupt shards.
+
+        cf. GetObjectNInfo → getObjectWithFileInfo,
+        /root/reference/cmd/erasure-object.go:221.
+        """
+        fi, metas, errs = self._read_metadata(bucket, obj, version_id)
+        if fi.deleted:
+            raise ErrObjectNotFound(f"{bucket}/{obj} (delete marker)")
+        size = fi.size
+        if length < 0:
+            length = size - offset
+        if offset < 0 or offset + length > size:
+            raise StorageError(f"range [{offset}, {offset + length}) "
+                               f"outside object of size {size}")
+        if length == 0 or size == 0:
+            return fi, b""
+
+        if fi.inline_data is not None or (fi.parts and not fi.data_dir):
+            data = self._read_inline(bucket, obj, fi, metas, version_id)
+            return fi, data[offset:offset + length]
+
+        data = self._read_part(bucket, obj, fi, part_number=1,
+                               offset=offset, length=length)
+        return fi, data
+
+    def _read_metadata(self, bucket, obj, version_id=""):
+        version_id = normalize_version_id(version_id)
+        res = self._map_drives(
+            lambda d: d.read_version(bucket, obj, version_id))
+        metas = [fi for fi, _ in res]
+        errs = [e for _, e in res]
+        n_found = sum(1 for f in metas if f is not None)
+        if n_found == 0:
+            err, count = Q.reduce_errs(errs, ignored=(ErrDiskNotFound,))
+            if isinstance(err, (ErrFileNotFound, ErrVolumeNotFound)):
+                if not self.bucket_exists(bucket):
+                    raise ErrBucketNotFound(bucket)
+                raise ErrObjectNotFound(f"{bucket}/{obj}")
+            if isinstance(err, ErrFileVersionNotFound):
+                raise ErrVersionNotFound(f"{bucket}/{obj}@{version_id}")
+            raise ErrErasureReadQuorum(f"{bucket}/{obj}: {err}")
+        read_quorum, _ = Q.object_quorum_from_meta(
+            metas, self.n, self.default_parity)
+        fi = Q.find_file_info_in_quorum(metas, read_quorum)
+        return fi, metas, errs
+
+    def _read_inline(self, bucket, obj, fi, metas, version_id) -> bytes:
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        dist = fi.erasure.distribution
+        # Gather each drive's inline shard (already framed).
+        shard_bytes: list[bytes | None] = [None] * (k + m)
+        want_key = Q._fi_key(fi)
+        for pos, meta in enumerate(metas):
+            # Only trust shards from drives whose metadata matches the
+            # elected version — a stale drive's inline shard is internally
+            # consistent and would silently corrupt the read.
+            if (meta is not None and meta.inline_data is not None
+                    and Q._fi_key(meta) == want_key):
+                shard_bytes[dist[pos] - 1] = meta.inline_data
+        return self._decode_shard_files(shard_bytes, fi, fi.size)
+
+    def _read_part(self, bucket, obj, fi, part_number, offset, length) -> bytes:
+        """Ranged read of one part: fetch only the frames covering the
+        block range, verify, reconstruct, assemble, slice."""
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        dist = fi.erasure.distribution
+        part_size = fi.parts[part_number - 1].size
+        shard_size = fi.erasure.shard_size
+        b0 = offset // BLOCK_SIZE
+        b1 = -(-(offset + length) // BLOCK_SIZE)
+        frame = 32 + shard_size
+        path = f"{obj}/{fi.data_dir}/part.{part_number}"
+        geo = self._range_geometry(fi, part_size, b0, b1)
+
+        def read_shard(pos: int) -> np.ndarray:
+            d = self.drives[pos]
+            if d is None:
+                raise ErrDiskNotFound("offline")
+            # Byte range of frames [b0, b1) in this shard file; the tail
+            # frame (partial block) is shorter, so clamp via file size.
+            start = b0 * frame
+            end = b1 * frame
+            raw = d.read_file(bucket, path, start, end - start)
+            return self._parse_shard_segment(raw, fi, geo)
+
+        # Choose K readers: data shards first, then parity as spares,
+        # verifying bitrot at fetch time so a corrupt shard triggers a
+        # spare read like an I/O failure does
+        # (cf. parallelReader + preferReaders, cmd/erasure-decode.go:101).
+        order = Q.shuffle_by_distribution(list(range(self.n)), dist)
+        # order[s] = drive position holding shard s.
+        rows: list[np.ndarray | None] = [None] * (k + m)
+        tried: set[int] = set()
+        good = 0
+        candidates = list(range(k + m))
+        active = candidates[:k]
+        while good < k:
+            futs = {}
+            for s in active:
+                if s in tried or rows[s] is not None:
+                    continue
+                tried.add(s)
+                futs[s] = self.pool.submit(read_shard, order[s])
+            if not futs and good < k:
+                raise ErrErasureReadQuorum(
+                    f"{bucket}/{obj}: only {good}/{k} shards readable")
+            fails = 0
+            for s, fut in futs.items():
+                try:
+                    rows[s] = fut.result()
+                    good += 1
+                except Exception:  # noqa: BLE001 — any failure => spare read
+                    fails += 1
+            if good >= k:
+                break
+            # Spare reads: extend to the next untried shards.
+            remaining = [s for s in candidates if s not in tried]
+            if not remaining:
+                raise ErrErasureReadQuorum(
+                    f"{bucket}/{obj}: only {good}/{k} shards readable")
+            active = remaining[:max(fails, k - good)]
+
+        return self._assemble(rows, fi, part_size, b0, offset, length)
+
+    @staticmethod
+    def _range_geometry(fi, part_size: int, b0: int, b1: int) -> dict:
+        k = fi.erasure.data_blocks
+        n_full_blocks = part_size // BLOCK_SIZE
+        tail_len = part_size % BLOCK_SIZE
+        tail_shard = -(-tail_len // k) if tail_len else 0
+        has_tail = b1 > n_full_blocks
+        nb_full = min(b1, n_full_blocks) - b0
+        return {"nb_full": nb_full, "has_tail": has_tail,
+                "tail_len": tail_len, "tail_shard": tail_shard,
+                "expect": nb_full * fi.erasure.shard_size
+                          + (tail_shard if has_tail else 0)}
+
+    def _parse_shard_segment(self, raw: bytes, fi, geo: dict) -> np.ndarray:
+        """Unframe + bitrot-verify one shard's frame range; enforce the
+        exact expected logical length (short/corrupt => ErrFileCorrupt)."""
+        row = bitrot_io.unframe_shard(raw, fi.erasure.shard_size,
+                                      verify=True)
+        if row.size != geo["expect"]:
+            raise ErrFileCorrupt(
+                f"shard segment {row.size} != expected {geo['expect']}")
+        return row
+
+    def _decode_shard_files(self, shard_bytes, fi, part_size) -> bytes:
+        """Whole-object decode from full framed shard files (inline path):
+        parse+verify what's present, then assemble."""
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        b1 = -(-part_size // BLOCK_SIZE)
+        geo = self._range_geometry(fi, part_size, 0, b1)
+        rows: list[np.ndarray | None] = [None] * (k + m)
+        for s, data in enumerate(shard_bytes):
+            if data is None:
+                continue
+            try:
+                rows[s] = self._parse_shard_segment(data, fi, geo)
+            except ErrFileCorrupt:
+                rows[s] = None
+        return self._assemble(rows, fi, part_size, 0, 0, part_size)
+
+    def _assemble(self, rows, fi, part_size, b0=0, offset=0,
+                  length=None) -> bytes:
+        """Reconstruct missing rows (device batched matmul) and assemble
+        the requested byte range from verified shard segments."""
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        shard_size = fi.erasure.shard_size
+        if length is None:
+            length = part_size - offset
+        b1 = -(-(offset + length) // BLOCK_SIZE)
+        geo = self._range_geometry(fi, part_size, b0, b1)
+        nb_full, has_tail = geo["nb_full"], geo["has_tail"]
+        tail_len, tail_shard = geo["tail_len"], geo["tail_shard"]
+
+        if sum(1 for r in rows if r is not None) < k:
+            raise ErrErasureReadQuorum("too many missing/corrupt shards")
+
+        # Split rows into the full-block matrix and the tail segment.
+        full_mat: list[np.ndarray | None] = [None] * (k + m)
+        tails: list[np.ndarray | None] = [None] * (k + m)
+        expect_full = nb_full * shard_size
+        for s, r in enumerate(rows):
+            if r is None:
+                continue
+            full_mat[s] = r[:expect_full].reshape(nb_full, shard_size) \
+                if nb_full else np.zeros((0, shard_size), np.uint8)
+            tails[s] = r[expect_full:] if has_tail else None
+
+        # Reconstruct missing data rows (device batched matmul).
+        missing = [s for s in range(k) if full_mat[s] is None]
+        if missing and nb_full:
+            avail = [s for s in range(k + m) if full_mat[s] is not None][:k]
+            x = np.stack([full_mat[s] for s in avail], axis=1)  # (B, K, S)
+            out = np.asarray(self._codec(k, m).transform_blocks(
+                x, tuple(avail), tuple(missing)))
+            for j, s in enumerate(missing):
+                full_mat[s] = out[:, j, :]
+        if has_tail:
+            t_missing = [s for s in range(k) if tails[s] is None]
+            if t_missing:
+                t_avail = [s for s in range(k + m) if tails[s] is not None]
+                cpu = self._cpu(k, m)
+                shards_in = [tails[s] if s in t_avail else None
+                             for s in range(k + m)]
+                rec = cpu.reconstruct(shards_in, data_only=True)
+                for s in t_missing:
+                    tails[s] = rec[s]
+
+        # Assemble: per block, concat K data segments, trim to block len.
+        pieces = []
+        for bi in range(nb_full):
+            block = np.concatenate([full_mat[s][bi] for s in range(k)])
+            pieces.append(block[:BLOCK_SIZE])
+        if has_tail:
+            tail_block = np.concatenate([tails[s] for s in range(k)])
+            pieces.append(tail_block[:tail_len])
+        data = np.concatenate(pieces) if pieces else np.zeros(0, np.uint8)
+        lo = offset - b0 * BLOCK_SIZE
+        return data[lo:lo + length].tobytes()
+
+    # -- head / delete -------------------------------------------------------
+
+    def head_object(self, bucket: str, obj: str,
+                    version_id: str = "") -> FileInfo:
+        fi, _, _ = self._read_metadata(bucket, obj, version_id)
+        if fi.deleted and not version_id:
+            raise ErrObjectNotFound(f"{bucket}/{obj} (delete marker)")
+        return fi
+
+    def delete_object(self, bucket: str, obj: str, version_id: str = "",
+                      versioned: bool = False) -> FileInfo | None:
+        """Delete a version, or write a delete marker when the bucket is
+        versioned and no explicit version was named
+        (cf. DeleteObject, /root/reference/cmd/erasure-object.go:1038)."""
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        write_quorum = self.n // 2 + 1
+        if versioned and version_id == "":
+            dm = FileInfo(volume=bucket, name=obj, version_id=new_uuid(),
+                          mod_time_ns=_now_ns(), deleted=True)
+
+            def mark(d):
+                try:
+                    d.delete_version(bucket, obj, mark_delete=True, fi=dm)
+                except ErrFileNotFound:
+                    # Delete marker on a nonexistent object is still legal.
+                    d.write_metadata(bucket, obj, dm)
+
+            res = self._map_drives(mark)
+            err = Q.reduce_write_quorum_errs([e for _, e in res],
+                                             write_quorum)
+            if err is not None:
+                raise err
+            return dm
+
+        vid = normalize_version_id(version_id)
+        res = self._map_drives(lambda d: d.delete_version(bucket, obj, vid))
+        errs = [e for _, e in res]
+        nf = (ErrFileNotFound, ErrFileVersionNotFound)
+        if errs and all(isinstance(e, nf) for e in errs):
+            if any(isinstance(e, ErrFileVersionNotFound) for e in errs):
+                raise ErrVersionNotFound(f"{bucket}/{obj}@{version_id}")
+            raise ErrObjectNotFound(f"{bucket}/{obj}")
+        # A drive that never had the version counts as success.
+        errs = [None if isinstance(e, nf) else e for e in errs]
+        err = Q.reduce_write_quorum_errs(errs, write_quorum)
+        if err is not None:
+            raise err
+        return None
+
+    # -- listing (walk-based; metacache comes later) -------------------------
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 10000) -> list[FileInfo]:
+        """Quorum-merged listing: walk all drives, merge names, elect the
+        latest version per object (simplified metacache,
+        cf. /root/reference/cmd/metacache-set.go)."""
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        from ..storage.xlmeta import XLMeta
+        merged: dict[str, FileInfo] = {}
+        res = self._map_drives(
+            lambda d: list(d.walk_dir(bucket, prefix)))
+        for entries, e in res:
+            if e is not None:
+                continue
+            for name, raw in entries:
+                try:
+                    fi = XLMeta.from_bytes(raw).latest(bucket, name)
+                except StorageError:
+                    continue
+                # Newest version wins across drives: a stale drive must
+                # not resurrect deleted/overwritten objects.
+                prev = merged.get(name)
+                if prev is None or fi.mod_time_ns > prev.mod_time_ns:
+                    merged[name] = fi
+        out = [fi for name, fi in sorted(merged.items())
+               if not fi.deleted]
+        return out[:max_keys]
+
+    def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
+        # Use the first drive that can serve the full version list.
+        from ..storage.xlmeta import XLMeta
+        for d in self.drives:
+            if d is None:
+                continue
+            try:
+                raw = d.read_all(bucket, f"{obj}/xl.meta")
+                return XLMeta.from_bytes(raw).list_versions(bucket, obj)
+            except StorageError:
+                continue
+        raise ErrObjectNotFound(f"{bucket}/{obj}")
+
+    # -- internals -----------------------------------------------------------
+
+    def _cleanup_tmp(self, tmp_id: str) -> None:
+        def rm(d):
+            d.delete(SYS_VOL, f"{TMP_DIR}/{tmp_id}", recursive=True)
+        self._map_drives(rm)

@@ -180,6 +180,15 @@ class LocalDrive:
             f.flush()
             os.fsync(f.fileno())
 
+    def append_file(self, vol: str, path: str, data: bytes) -> None:
+        """Append to a staged shard file (streaming writes land batch by
+        batch; rename_data fsyncs staged files before publishing)."""
+        self._check_vol(vol)
+        p = self._file_path(vol, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "ab") as f:
+            f.write(data)
+
     def read_file(self, vol: str, path: str, offset: int = 0,
                   length: int = -1) -> bytes:
         p = self._file_path(vol, path)
@@ -277,6 +286,22 @@ class LocalDrive:
                 src = self._file_path(src_vol, src_dir)
                 if not os.path.isdir(src):
                     raise ErrFileNotFound(f"{src_vol}/{src_dir}")
+                # Durability before visibility: staged part files were
+                # written with plain appends; flush them (and the dir
+                # entry) before the rename makes the version readable.
+                for name in os.listdir(src):
+                    fp = os.path.join(src, name)
+                    if os.path.isfile(fp):
+                        fd = os.open(fp, os.O_RDONLY)
+                        try:
+                            os.fsync(fd)
+                        finally:
+                            os.close(fd)
+                dfd = os.open(src, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
                 dst = self._file_path(dst_vol,
                                       os.path.join(dst_obj, fi.data_dir))
                 os.makedirs(os.path.dirname(dst), exist_ok=True)

@@ -61,3 +61,43 @@ class ErrMethodNotAllowed(StorageError):
 
 class ErrDoneForNow(StorageError):
     """Listing pagination sentinel."""
+
+
+class ErrErasureReadQuorum(StorageError):
+    """Not enough drives agree to serve a read."""
+
+
+class ErrErasureWriteQuorum(StorageError):
+    """Not enough drives acknowledged a write."""
+
+
+class ErrObjectNotFound(StorageError):
+    pass
+
+
+class ErrVersionNotFound(StorageError):
+    pass
+
+
+class ErrBucketNotFound(StorageError):
+    pass
+
+
+class ErrBucketExists(StorageError):
+    pass
+
+
+class ErrBucketNotEmpty(StorageError):
+    pass
+
+
+class ErrInvalidArgument(StorageError):
+    pass
+
+
+class ErrUploadNotFound(StorageError):
+    """Multipart upload id does not exist."""
+
+
+class ErrInvalidPart(StorageError):
+    """CompleteMultipartUpload referenced a missing/mismatched part."""
