@@ -28,7 +28,6 @@ import numpy as np
 
 from ..ops.erasure_cpu import ReedSolomonCPU
 from ..ops.erasure_jax import ReedSolomonTPU
-from ..ops.highwayhash import highwayhash256_batch
 from ..storage import bitrot_io
 from ..storage.drive import (SMALL_FILE_THRESHOLD, SYS_VOL, TMP_DIR,
                              LocalDrive)
@@ -39,7 +38,7 @@ from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
                               ErrObjectNotFound, ErrVersionNotFound,
                               ErrVolumeExists, ErrVolumeNotFound,
                               StorageError)
-from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo,
+from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo, XLMeta,
                               new_uuid, normalize_version_id)
 from . import quorum as Q
 
@@ -308,17 +307,7 @@ class ErasureSet:
             parity = np.asarray(self._codec(k, m).encode_blocks(blocks))
             full = np.concatenate([blocks, parity], axis=1)  # (nb, k+m, S)
             # Frame: hash every (shard, block) stream in one vectorized pass.
-            flat = full.transpose(1, 0, 2).reshape((k + m) * nb, shard_size)
-            digests = highwayhash256_batch(flat).reshape(k + m, nb, 32)
-            framed = []
-            for i in range(k + m):
-                chunks = bytearray()
-                shard_rows = full[:, i, :]
-                for b in range(nb):
-                    chunks += digests[i, b].tobytes()
-                    chunks += shard_rows[b].tobytes()
-                framed.append(bytes(chunks))
-            yield framed
+            yield bitrot_io.frame_shards_batch(full.transpose(1, 0, 2))
 
         tail = buf[n_full * BLOCK_SIZE:]
         if tail.size or size == 0:
@@ -344,9 +333,11 @@ class ErasureSet:
         if fi.deleted:
             raise ErrObjectNotFound(f"{bucket}/{obj} (delete marker)")
         size = fi.size
+        if offset < 0 or offset > size:
+            raise StorageError(f"offset {offset} outside object of size {size}")
         if length < 0:
             length = size - offset
-        if offset < 0 or offset + length > size:
+        if offset + length > size:
             raise StorageError(f"range [{offset}, {offset + length}) "
                                f"outside object of size {size}")
         if length == 0 or size == 0:
@@ -356,9 +347,26 @@ class ErasureSet:
             data = self._read_inline(bucket, obj, fi, metas, version_id)
             return fi, data[offset:offset + length]
 
-        data = self._read_part(bucket, obj, fi, part_number=1,
-                               offset=offset, length=length)
-        return fi, data
+        # Map the object byte range onto parts (each part an independent
+        # EC stream; cf. ObjectToPartOffset, cmd/erasure-metadata.go).
+        pieces = []
+        part_start = 0
+        remaining = length
+        pos = offset
+        for part in fi.parts:
+            part_end = part_start + part.size
+            if remaining <= 0:
+                break
+            if pos < part_end:
+                in_off = pos - part_start
+                in_len = min(remaining, part.size - in_off)
+                pieces.append(self._read_part(
+                    bucket, obj, fi, part_number=part.number,
+                    offset=in_off, length=in_len))
+                pos += in_len
+                remaining -= in_len
+            part_start = part_end
+        return fi, b"".join(pieces)
 
     def _read_metadata(self, bucket, obj, version_id=""):
         version_id = normalize_version_id(version_id)
@@ -616,8 +624,7 @@ class ErasureSet:
         cf. /root/reference/cmd/metacache-set.go)."""
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
-        from ..storage.xlmeta import XLMeta
-        merged: dict[str, FileInfo] = {}
+        per_name: dict[str, list[FileInfo]] = {}
         res = self._map_drives(
             lambda d: list(d.walk_dir(bucket, prefix)))
         for entries, e in res:
@@ -628,18 +635,24 @@ class ErasureSet:
                     fi = XLMeta.from_bytes(raw).latest(bucket, name)
                 except StorageError:
                     continue
-                # Newest version wins across drives: a stale drive must
-                # not resurrect deleted/overwritten objects.
-                prev = merged.get(name)
-                if prev is None or fi.mod_time_ns > prev.mod_time_ns:
-                    merged[name] = fi
-        out = [fi for name, fi in sorted(merged.items())
-               if not fi.deleted]
+                per_name.setdefault(name, []).append(fi)
+        # Quorum-elect each object's latest version, exactly like the read
+        # path — a single drive's torn write or stale delete marker must
+        # not change the listing (cf. metacache quorum-merge,
+        # /root/reference/cmd/metacache-entries.go).
+        quorum = self._live_quorum()
+        out = []
+        for name in sorted(per_name):
+            try:
+                fi = Q.find_file_info_in_quorum(per_name[name], quorum)
+            except ErrErasureReadQuorum:
+                continue
+            if not fi.deleted:
+                out.append(fi)
         return out[:max_keys]
 
     def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
         # Use the first drive that can serve the full version list.
-        from ..storage.xlmeta import XLMeta
         for d in self.drives:
             if d is None:
                 continue

@@ -1,0 +1,351 @@
+"""Multipart uploads: each part an independent erasure-coded stream.
+
+The erasure-multipart equivalent (/root/reference/cmd/erasure-multipart.go:
+NewMultipartUpload :39, PutObjectPart :400, CompleteMultipartUpload :771):
+uploads stage under the reserved system volume, each part is encoded with
+the SAME stripe geometry chosen at upload creation (so a 5 TiB object is
+10,000 independent device-batched EC streams), and completion atomically
+publishes all parts as one version via rename_data.
+
+S3 semantics preserved: out-of-order part uploads, part overwrite
+(last-write-wins), multipart ETag = md5(concat(part md5s))-N, minimum part
+size for all but the last part.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import uuid
+
+from ..storage import bitrot_io
+from ..storage.drive import MULTIPART_DIR, SYS_VOL, TMP_DIR
+from ..storage.errors import (ErrErasureWriteQuorum, ErrFileNotFound,
+                              ErrPathNotFound, StorageError)
+from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo,
+                              XLMeta, new_uuid)
+from ..utils import msgpackx
+from . import quorum as Q
+from .erasure_set import BLOCK_SIZE, ErasureSet
+
+MIN_PART_SIZE = 5 * 1024 * 1024        # S3 minimum for all but the last part
+MAX_PARTS = 10_000                     # docs/minio-limits.md:24-29
+
+# Upload metadata keys (internal).
+_MP_OBJECT_KEY = "x-mtpu-internal-mp-object"
+_MP_BUCKET_KEY = "x-mtpu-internal-mp-bucket"
+
+
+class ErrInvalidPart(StorageError):
+    pass
+
+
+class ErrInvalidPartOrder(StorageError):
+    pass
+
+
+class ErrPartTooSmall(StorageError):
+    pass
+
+
+class ErrUploadNotFound(StorageError):
+    pass
+
+
+def _upload_root(bucket: str, obj: str) -> str:
+    h = hashlib.sha256(f"{bucket}/{obj}".encode()).hexdigest()[:32]
+    return f"{MULTIPART_DIR}/{h}"
+
+
+def _upload_path(bucket: str, obj: str, upload_id: str) -> str:
+    return f"{_upload_root(bucket, obj)}/{upload_id}"
+
+
+def new_multipart_upload(es: ErasureSet, bucket: str, obj: str, *,
+                         metadata: dict | None = None,
+                         parity: int | None = None) -> str:
+    """Create an upload: fix the stripe geometry now so every part encodes
+    identically (cf. newMultipartUpload, erasure-multipart.go:39)."""
+    from ..storage.errors import ErrBucketNotFound
+    if not es.bucket_exists(bucket):
+        raise ErrBucketNotFound(bucket)
+    parity = es.default_parity if parity is None else parity
+    offline = sum(1 for d in es.drives if d is None)
+    if offline and parity < es.n // 2:
+        parity = min(parity + offline, es.n // 2)
+    k = es.n - parity
+    distribution = Q.hash_order(f"{bucket}/{obj}", es.n)
+    upload_id = f"{new_uuid()}x{time.time_ns()}"
+    meta = dict(metadata or {})
+    meta[_MP_OBJECT_KEY] = obj
+    meta[_MP_BUCKET_KEY] = bucket
+    path = _upload_path(bucket, obj, upload_id)
+
+    def write_one(pos):
+        d = es.drives[pos]
+        if d is None:
+            raise ErrFileNotFound("offline")
+        ec = ErasureInfo(data_blocks=k, parity_blocks=parity,
+                         block_size=BLOCK_SIZE,
+                         index=distribution[pos], distribution=distribution,
+                         checksums=[])
+        fi = FileInfo(volume=SYS_VOL, name=path, mod_time_ns=time.time_ns(),
+                      metadata=meta, erasure=ec)
+        d.write_metadata(SYS_VOL, path, fi)
+
+    res = es._map_drives_positions(write_one)
+    err = Q.reduce_write_quorum_errs([e for _, e in res], es.n // 2 + 1)
+    if err is not None:
+        raise err
+    return upload_id
+
+
+def _read_upload_fi(es: ErasureSet, bucket: str, obj: str,
+                    upload_id: str) -> FileInfo:
+    path = _upload_path(bucket, obj, upload_id)
+    res = es._map_drives(lambda d: d.read_version(SYS_VOL, path))
+    metas = [m for m, _ in res]
+    n_found = sum(1 for m in metas if m is not None)
+    if n_found < es._live_quorum():
+        raise ErrUploadNotFound(f"{bucket}/{obj}: {upload_id}")
+    return next(m for m in metas if m is not None)
+
+
+def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
+                    part_number: int, data: bytes) -> ObjectPartInfo:
+    """Encode one part as its own EC stream into the upload's staging dir
+    (cf. PutObjectPart, erasure-multipart.go:400)."""
+    if not 1 <= part_number <= MAX_PARTS:
+        raise ErrInvalidPart(f"part number {part_number}")
+    fi = _read_upload_fi(es, bucket, obj, upload_id)
+    ec = fi.erasure
+    k, m = ec.data_blocks, ec.parity_blocks
+    path = _upload_path(bucket, obj, upload_id)
+    etag = hashlib.md5(data).hexdigest()
+    write_quorum = k + (1 if k == m else 0)
+
+    # Stage under a unique name then rename into place, so a concurrent
+    # re-upload of the same part can't interleave appends.
+    stage = f"{path}/stage-{uuid.uuid4().hex}.{part_number}"
+    failed = [d is None for d in es.drives]
+    for batch_shards in es._encode_stream(data, k, m):
+        per_drive = Q.unshuffle_to_drives(batch_shards, ec.distribution)
+
+        def write_one(pos):
+            d = es.drives[pos]
+            if d is None or failed[pos]:
+                return
+            d.append_file(SYS_VOL, stage, per_drive[pos])
+
+        futures = [es.pool.submit(write_one, pos) for pos in range(es.n)]
+        for pos, fut in enumerate(futures):
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001
+                failed[pos] = True
+        if sum(1 for f in failed if not f) < write_quorum:
+            _cleanup_stage(es, stage)
+            raise ErrErasureWriteQuorum(
+                f"{es.n - sum(failed)} < {write_quorum}")
+
+    part_meta = msgpackx.packb({
+        "n": part_number, "etag": etag, "size": len(data),
+        "as": len(data), "mt": time.time_ns()})
+
+    def publish(pos):
+        d = es.drives[pos]
+        if d is None or failed[pos]:
+            raise ErrFileNotFound("offline/failed")
+        if len(data) == 0:
+            d.create_file(SYS_VOL, f"{path}/part.{part_number}", b"")
+        else:
+            d.rename_file(SYS_VOL, stage, SYS_VOL,
+                          f"{path}/part.{part_number}")
+        d.write_all(SYS_VOL, f"{path}/part.{part_number}.meta", part_meta)
+
+    res = es._map_drives_positions(publish)
+    err = Q.reduce_write_quorum_errs([e for _, e in res], write_quorum)
+    _cleanup_stage(es, stage)
+    if err is not None:
+        raise err
+    return ObjectPartInfo(number=part_number, size=len(data),
+                          actual_size=len(data), etag=etag)
+
+
+def _cleanup_stage(es: ErasureSet, stage: str) -> None:
+    def rm(d):
+        try:
+            d.delete(SYS_VOL, stage)
+        except StorageError:
+            pass
+    es._map_drives(rm)
+
+
+def list_parts(es: ErasureSet, bucket: str, obj: str,
+               upload_id: str) -> list[ObjectPartInfo]:
+    """Quorum-agreed part list (cf. ListObjectParts)."""
+    _read_upload_fi(es, bucket, obj, upload_id)  # validates upload
+    path = _upload_path(bucket, obj, upload_id)
+    votes: dict[tuple, int] = {}
+    for d in es.drives:
+        if d is None:
+            continue
+        try:
+            names = d.list_raw(SYS_VOL, path)
+        except StorageError:
+            continue
+        for name in names:
+            if not name.endswith(".meta") or not name.startswith("part."):
+                continue
+            try:
+                pm = msgpackx.unpackb(d.read_all(SYS_VOL, f"{path}/{name}"))
+            except StorageError:
+                continue
+            key = (pm["n"], pm["etag"], pm["size"], pm["as"])
+            votes[key] = votes.get(key, 0) + 1
+    quorum = es._live_quorum()
+    best: dict[int, tuple] = {}
+    for key, count in votes.items():
+        if count >= quorum:
+            n = key[0]
+            if n not in best or votes[best[n]] < count:
+                best[n] = key
+    return [ObjectPartInfo(number=n, size=key[2], actual_size=key[3],
+                           etag=key[1])
+            for n, key in sorted(best.items())]
+
+
+def abort_multipart_upload(es: ErasureSet, bucket: str, obj: str,
+                           upload_id: str) -> None:
+    _read_upload_fi(es, bucket, obj, upload_id)  # 404 if unknown
+    path = _upload_path(bucket, obj, upload_id)
+
+    def rm(d):
+        try:
+            d.delete(SYS_VOL, path, recursive=True)
+        except StorageError:
+            pass
+    es._map_drives(rm)
+
+
+def list_multipart_uploads(es: ErasureSet, bucket: str,
+                           prefix: str = "") -> list[dict]:
+    """Active uploads for a bucket (cf. ListMultipartUploads)."""
+    found: dict[str, dict] = {}
+    for d in es.drives:
+        if d is None:
+            continue
+        try:
+            entries = list(d.walk_dir(SYS_VOL, MULTIPART_DIR + "/"))
+        except StorageError:
+            continue
+        for rel, raw in entries:
+            try:
+                fi = XLMeta.from_bytes(raw).latest(SYS_VOL, rel)
+            except StorageError:
+                continue
+            if fi.metadata.get(_MP_BUCKET_KEY) != bucket:
+                continue
+            o = fi.metadata.get(_MP_OBJECT_KEY, "")
+            if prefix and not o.startswith(prefix):
+                continue
+            upload_id = rel.rsplit("/", 1)[-1]
+            found.setdefault(upload_id, {
+                "object": o, "upload_id": upload_id,
+                "initiated_ns": fi.mod_time_ns})
+    return sorted(found.values(), key=lambda u: (u["object"],
+                                                 u["upload_id"]))
+
+
+def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
+                              upload_id: str,
+                              parts: list[tuple[int, str]], *,
+                              versioned: bool = False) -> FileInfo:
+    """Validate client part list, stitch staged parts into a fresh data
+    dir, and publish one version atomically
+    (cf. CompleteMultipartUpload, erasure-multipart.go:771)."""
+    fi_up = _read_upload_fi(es, bucket, obj, upload_id)
+    ec = fi_up.erasure
+    stored = {p.number: p for p in list_parts(es, bucket, obj, upload_id)}
+    if [n for n, _ in parts] != sorted({n for n, _ in parts}):
+        raise ErrInvalidPartOrder("parts must be ascending and unique")
+
+    chosen: list[ObjectPartInfo] = []
+    for i, (n, etag) in enumerate(parts):
+        p = stored.get(n)
+        if p is None or p.etag != etag.strip('"'):
+            raise ErrInvalidPart(f"part {n}")
+        if p.size < MIN_PART_SIZE and i != len(parts) - 1:
+            raise ErrPartTooSmall(
+                f"part {n}: {p.size} < {MIN_PART_SIZE}")
+        chosen.append(p)
+    if not chosen:
+        raise ErrInvalidPart("no parts")
+
+    # S3 multipart ETag: md5 of the concatenated binary part md5s, -N.
+    md5s = b"".join(bytes.fromhex(p.etag) for p in chosen)
+    etag = f"{hashlib.md5(md5s).hexdigest()}-{len(chosen)}"
+    total = sum(p.size for p in chosen)
+    data_dir = new_uuid()
+    version_id = new_uuid() if versioned else ""
+    mod_time = time.time_ns()
+    meta = {k: v for k, v in fi_up.metadata.items()
+            if not k.startswith("x-mtpu-internal-mp-")}
+    meta["etag"] = etag
+    path = _upload_path(bucket, obj, upload_id)
+    tmp_id = f"complete-{uuid.uuid4().hex}"
+    k_, m_ = ec.data_blocks, ec.parity_blocks
+    write_quorum = k_ + (1 if k_ == m_ else 0)
+
+    def fi_for(pos: int) -> FileInfo:
+        ec_pos = ErasureInfo(
+            data_blocks=k_, parity_blocks=m_, block_size=BLOCK_SIZE,
+            index=ec.distribution[pos], distribution=ec.distribution,
+            checksums=[{"part": p.number, "algo": "highwayhash256S",
+                        "hash": b""} for p in chosen])
+        return FileInfo(
+            volume=bucket, name=obj, version_id=version_id,
+            data_dir=data_dir, mod_time_ns=mod_time, size=total,
+            metadata=meta,
+            parts=[ObjectPartInfo(i + 1, p.size, p.actual_size, p.etag)
+                   for i, p in enumerate(chosen)],
+            erasure=ec_pos)
+
+    def publish(pos):
+        d = es.drives[pos]
+        if d is None:
+            raise ErrFileNotFound("offline")
+        # Verify this drive actually has every chosen part at the right
+        # shard size before moving anything (a drive that missed a part
+        # upload must not publish a torn object).
+        for p in chosen:
+            logical = _shard_len(ec, p.size)
+            want = bitrot_io.bitrot_shard_file_size(logical, ec.shard_size)
+            if d.file_size(SYS_VOL, f"{path}/part.{p.number}") != want:
+                raise ErrFileNotFound(f"part {p.number} incomplete here")
+        # Renumber: client part numbers may be sparse; on disk the object
+        # uses contiguous part.1..part.N.
+        for i, p in enumerate(chosen):
+            d.rename_file(SYS_VOL, f"{path}/part.{p.number}",
+                          SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.{i + 1}")
+        d.rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}", fi_for(pos),
+                      bucket, obj)
+
+    res = es._map_drives_positions(publish)
+    errs = [e for _, e in res]
+    err = Q.reduce_write_quorum_errs(errs, write_quorum)
+    # Cleanup staging + upload dir regardless.
+    def rm(d):
+        for p_ in (f"{TMP_DIR}/{tmp_id}", path):
+            try:
+                d.delete(SYS_VOL, p_, recursive=True)
+            except StorageError:
+                pass
+    es._map_drives(rm)
+    if err is not None:
+        raise err
+    return fi_for(0)
+
+
+def _shard_len(ec: ErasureInfo, part_size: int) -> int:
+    return ec.shard_file_size(part_size)

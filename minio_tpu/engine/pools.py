@@ -1,0 +1,228 @@
+"""erasureServerPools equivalent: the top-level ObjectLayer.
+
+Pools are independent ErasureSets stacks added over time for capacity.
+Writes go to the pool already holding the object, else the pool with the
+most free space; reads/deletes probe pools in order (cf.
+erasureServerPools.getPoolIdx, /root/reference/cmd/erasure-server-pool.go:373,
+PutObject :812, GetObjectNInfo :661).
+"""
+
+from __future__ import annotations
+
+from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
+                              ErrObjectNotFound, ErrVersionNotFound,
+                              StorageError)
+from ..storage.xlmeta import FileInfo
+from .sets import ErasureSets
+
+
+class ServerPools:
+    """The ObjectLayer facade over one or more pools."""
+
+    def __init__(self, pools: list[ErasureSets]):
+        if not pools:
+            raise ValueError("need at least one pool")
+        self.pools = pools
+        self.deployment_id = pools[0].deployment_id
+
+    # -- pool placement ------------------------------------------------------
+
+    def _pool_with_object(self, bucket: str, obj: str,
+                          version_id: str = "") -> int | None:
+        for i, p in enumerate(self.pools):
+            try:
+                p.head_object(bucket, obj, version_id)
+                return i
+            except (ErrObjectNotFound, ErrVersionNotFound, StorageError):
+                continue
+        return None
+
+    def get_pool_idx(self, bucket: str, obj: str) -> int:
+        """Existing pool wins; else most free space
+        (cf. getPoolIdx, erasure-server-pool.go:373)."""
+        existing = self._pool_with_object(bucket, obj)
+        if existing is not None:
+            return existing
+        if len(self.pools) == 1:
+            return 0
+        frees = [p.disk_usage()["free"] for p in self.pools]
+        return max(range(len(frees)), key=lambda i: frees[i])
+
+    # -- bucket ops ----------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        errs = []
+        for p in self.pools:
+            try:
+                p.make_bucket(bucket)
+                errs.append(None)
+            except StorageError as e:
+                errs.append(e)
+        if errs and all(isinstance(e, ErrBucketExists) for e in errs):
+            raise ErrBucketExists(bucket)
+        real = [e for e in errs
+                if e is not None and not isinstance(e, ErrBucketExists)]
+        if real:
+            raise real[0]
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return any(p.bucket_exists(bucket) for p in self.pools)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        errs = []
+        for p in self.pools:
+            try:
+                p.delete_bucket(bucket, force=force)
+                errs.append(None)
+            except StorageError as e:
+                errs.append(e)
+        if errs and all(isinstance(e, ErrBucketNotFound) for e in errs):
+            raise ErrBucketNotFound(bucket)
+        real = [e for e in errs
+                if e is not None and not isinstance(e, ErrBucketNotFound)]
+        if real:
+            raise real[0]
+
+    def list_buckets(self) -> list[str]:
+        names: set[str] = set()
+        for p in self.pools:
+            names.update(p.list_buckets())
+        return sorted(names)
+
+    # -- object ops ----------------------------------------------------------
+
+    def put_object(self, bucket: str, obj: str, data: bytes,
+                   **kw) -> FileInfo:
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        return self.pools[self.get_pool_idx(bucket, obj)].put_object(
+            bucket, obj, data, **kw)
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, version_id: str = ""):
+        last: StorageError | None = None
+        for p in self.pools:
+            try:
+                return p.get_object(bucket, obj, offset, length, version_id)
+            except (ErrObjectNotFound, ErrVersionNotFound) as e:
+                last = e
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        raise last or ErrObjectNotFound(f"{bucket}/{obj}")
+
+    def head_object(self, bucket: str, obj: str,
+                    version_id: str = "") -> FileInfo:
+        last: StorageError | None = None
+        for p in self.pools:
+            try:
+                return p.head_object(bucket, obj, version_id)
+            except (ErrObjectNotFound, ErrVersionNotFound) as e:
+                last = e
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        raise last or ErrObjectNotFound(f"{bucket}/{obj}")
+
+    def delete_object(self, bucket: str, obj: str, version_id: str = "",
+                      versioned: bool = False):
+        idx = self._pool_with_object(bucket, obj, version_id)
+        if idx is None:
+            if not self.bucket_exists(bucket):
+                raise ErrBucketNotFound(bucket)
+            if versioned and version_id == "":
+                # Delete marker still lands on the placement pool.
+                return self.pools[self.get_pool_idx(
+                    bucket, obj)].delete_object(bucket, obj, version_id,
+                                                versioned)
+            raise ErrObjectNotFound(f"{bucket}/{obj}")
+        return self.pools[idx].delete_object(bucket, obj, version_id,
+                                             versioned)
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 10000) -> list[FileInfo]:
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        merged: dict[str, FileInfo] = {}
+        for p in self.pools:
+            try:
+                for fi in p.list_objects(bucket, prefix, max_keys):
+                    prev = merged.get(fi.name)
+                    if prev is None or fi.mod_time_ns > prev.mod_time_ns:
+                        merged[fi.name] = fi
+            except ErrBucketNotFound:
+                continue
+        return [merged[k] for k in sorted(merged)][:max_keys]
+
+    def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
+        for p in self.pools:
+            try:
+                return p.list_object_versions(bucket, obj)
+            except (ErrObjectNotFound, StorageError):
+                continue
+        raise ErrObjectNotFound(f"{bucket}/{obj}")
+
+    # -- multipart -----------------------------------------------------------
+
+    def new_multipart_upload(self, bucket: str, obj: str, **kw) -> str:
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        idx = self.get_pool_idx(bucket, obj)
+        uid = self.pools[idx].new_multipart_upload(bucket, obj, **kw)
+        # Uploads are pool-sticky: encode the pool into the id.
+        return f"{idx}.{uid}"
+
+    @staticmethod
+    def _split_upload_id(upload_id: str) -> tuple[int, str]:
+        idx, _, rest = upload_id.partition(".")
+        try:
+            return int(idx), rest
+        except ValueError:
+            from .multipart import ErrUploadNotFound
+            raise ErrUploadNotFound(upload_id) from None
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: bytes):
+        idx, uid = self._split_upload_id(upload_id)
+        return self.pools[idx].put_object_part(bucket, obj, uid,
+                                               part_number, data)
+
+    def complete_multipart_upload(self, bucket: str, obj: str,
+                                  upload_id: str, parts, **kw):
+        idx, uid = self._split_upload_id(upload_id)
+        return self.pools[idx].complete_multipart_upload(bucket, obj, uid,
+                                                         parts, **kw)
+
+    def abort_multipart_upload(self, bucket: str, obj: str,
+                               upload_id: str) -> None:
+        idx, uid = self._split_upload_id(upload_id)
+        self.pools[idx].abort_multipart_upload(bucket, obj, uid)
+
+    def list_parts(self, bucket: str, obj: str, upload_id: str):
+        idx, uid = self._split_upload_id(upload_id)
+        return self.pools[idx].list_parts(bucket, obj, uid)
+
+    def list_multipart_uploads(self, bucket: str,
+                               prefix: str = "") -> list[dict]:
+        out = []
+        for i, p in enumerate(self.pools):
+            for u in p.list_multipart_uploads(bucket, prefix):
+                u = dict(u)
+                u["upload_id"] = f"{i}.{u['upload_id']}"
+                out.append(u)
+        return sorted(out, key=lambda u: (u["object"], u["upload_id"]))
+
+    # -- heal ----------------------------------------------------------------
+
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    **kw):
+        idx = self._pool_with_object(bucket, obj)
+        if idx is None:
+            raise ErrObjectNotFound(f"{bucket}/{obj}")
+        return self.pools[idx].heal_object(bucket, obj, version_id, **kw)
+
+    def heal_bucket(self, bucket: str) -> dict:
+        out = {}
+        for i, p in enumerate(self.pools):
+            healed = p.heal_bucket(bucket)
+            if healed:
+                out[i] = healed
+        return out

@@ -1,0 +1,185 @@
+"""erasureSets equivalent: a static hash ring of N erasure sets.
+
+Each object routes to exactly one set via SipHash-2-4 keyed by the
+deployment id (cf. sipHashMod + getHashedSet,
+/root/reference/cmd/erasure-sets.go:734,771). Bucket operations fan out to
+every set; listings quorum-merge across sets. Format bootstrap binds each
+drive to its (set, position) slot (cf. newErasureSets,
+/root/reference/cmd/erasure-sets.go:342).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from ..storage.drive import LocalDrive
+from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
+                              StorageError)
+from ..storage.format import init_format_sets
+from ..storage.xlmeta import FileInfo
+from ..utils.siphash import sip_hash_mod
+from . import heal as heal_mod
+from . import multipart as mp
+from .erasure_set import ErasureSet
+
+
+class ErasureSets:
+    """N sets x set_drive_count drives, one pool's worth of capacity."""
+
+    def __init__(self, drives: list[LocalDrive | None],
+                 set_drive_count: int,
+                 default_parity: int | None = None,
+                 deployment_id: str | None = None):
+        if set_drive_count < 2:
+            raise ValueError("set_drive_count must be >= 2")
+        if len(drives) % set_drive_count != 0:
+            raise ValueError(
+                f"{len(drives)} drives not divisible by set size "
+                f"{set_drive_count}")
+        self.set_drive_count = set_drive_count
+        self.set_count = len(drives) // set_drive_count
+        rows = [drives[i * set_drive_count:(i + 1) * set_drive_count]
+                for i in range(self.set_count)]
+        fmt = init_format_sets(rows, deployment_id)
+        self.deployment_id = fmt["id"]
+        self._dep_key = uuid.UUID(self.deployment_id).bytes
+        self.sets = [ErasureSet(row, default_parity=default_parity,
+                                set_index=i)
+                     for i, row in enumerate(rows)]
+
+    # -- placement -----------------------------------------------------------
+
+    def set_for(self, obj: str) -> ErasureSet:
+        """The set this object lives on (cf. getHashedSet,
+        /root/reference/cmd/erasure-sets.go:771)."""
+        idx = sip_hash_mod(obj, self.set_count, self._dep_key)
+        return self.sets[idx]
+
+    # -- bucket ops (fan out to all sets) ------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        errs = []
+        for s in self.sets:
+            try:
+                s.make_bucket(bucket)
+                errs.append(None)
+            except StorageError as e:
+                errs.append(e)
+        if errs and all(isinstance(e, ErrBucketExists) for e in errs):
+            raise ErrBucketExists(bucket)
+        real = [e for e in errs
+                if e is not None and not isinstance(e, ErrBucketExists)]
+        if real:
+            raise real[0]
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return any(s.bucket_exists(bucket) for s in self.sets)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        errs = []
+        for s in self.sets:
+            try:
+                s.delete_bucket(bucket, force=force)
+                errs.append(None)
+            except StorageError as e:
+                errs.append(e)
+        if errs and all(isinstance(e, ErrBucketNotFound) for e in errs):
+            raise ErrBucketNotFound(bucket)
+        real = [e for e in errs
+                if e is not None and not isinstance(e, ErrBucketNotFound)]
+        if real:
+            raise real[0]
+
+    def list_buckets(self) -> list[str]:
+        names: set[str] = set()
+        for s in self.sets:
+            names.update(s.list_buckets())
+        return sorted(names)
+
+    # -- object ops (route to one set) ---------------------------------------
+
+    def put_object(self, bucket: str, obj: str, data: bytes,
+                   **kw) -> FileInfo:
+        return self.set_for(obj).put_object(bucket, obj, data, **kw)
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, version_id: str = ""):
+        return self.set_for(obj).get_object(bucket, obj, offset, length,
+                                            version_id)
+
+    def head_object(self, bucket: str, obj: str,
+                    version_id: str = "") -> FileInfo:
+        return self.set_for(obj).head_object(bucket, obj, version_id)
+
+    def delete_object(self, bucket: str, obj: str, version_id: str = "",
+                      versioned: bool = False):
+        return self.set_for(obj).delete_object(bucket, obj, version_id,
+                                               versioned)
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 10000) -> list[FileInfo]:
+        merged: list[FileInfo] = []
+        for s in self.sets:
+            merged.extend(s.list_objects(bucket, prefix, max_keys))
+        merged.sort(key=lambda fi: fi.name)
+        return merged[:max_keys]
+
+    def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
+        return self.set_for(obj).list_object_versions(bucket, obj)
+
+    # -- multipart (route to one set) ----------------------------------------
+
+    def new_multipart_upload(self, bucket: str, obj: str, **kw) -> str:
+        return mp.new_multipart_upload(self.set_for(obj), bucket, obj, **kw)
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: bytes):
+        return mp.put_object_part(self.set_for(obj), bucket, obj,
+                                  upload_id, part_number, data)
+
+    def complete_multipart_upload(self, bucket: str, obj: str,
+                                  upload_id: str, parts, **kw) -> FileInfo:
+        return mp.complete_multipart_upload(self.set_for(obj), bucket, obj,
+                                            upload_id, parts, **kw)
+
+    def abort_multipart_upload(self, bucket: str, obj: str,
+                               upload_id: str) -> None:
+        mp.abort_multipart_upload(self.set_for(obj), bucket, obj, upload_id)
+
+    def list_parts(self, bucket: str, obj: str, upload_id: str):
+        return mp.list_parts(self.set_for(obj), bucket, obj, upload_id)
+
+    def list_multipart_uploads(self, bucket: str,
+                               prefix: str = "") -> list[dict]:
+        out = []
+        for s in self.sets:
+            out.extend(mp.list_multipart_uploads(s, bucket, prefix))
+        return sorted(out, key=lambda u: (u["object"], u["upload_id"]))
+
+    # -- heal ----------------------------------------------------------------
+
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    **kw) -> list[heal_mod.HealResult]:
+        return heal_mod.heal_object(self.set_for(obj), bucket, obj,
+                                    version_id, **kw)
+
+    def heal_bucket(self, bucket: str) -> dict[int, list[int]]:
+        out = {}
+        for i, s in enumerate(self.sets):
+            healed = heal_mod.heal_bucket(s, bucket)
+            if healed:
+                out[i] = healed
+        return out
+
+    # -- capacity ------------------------------------------------------------
+
+    def disk_usage(self) -> dict:
+        total = free = 0
+        for s in self.sets:
+            for d in s.drives:
+                if d is None:
+                    continue
+                info = d.disk_info()
+                total += info["total"]
+                free += info["free"]
+        return {"total": total, "free": free}

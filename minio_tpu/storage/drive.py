@@ -202,6 +202,28 @@ class LocalDrive:
         except IsADirectoryError:
             raise ErrIsNotRegular(f"{vol}/{path}") from None
 
+    def rename_file(self, src_vol: str, src_path: str, dst_vol: str,
+                    dst_path: str) -> None:
+        """Atomic same-drive file move (parents auto-created)."""
+        src = self._file_path(src_vol, src_path)
+        dst = self._file_path(dst_vol, dst_path)
+        if not os.path.isfile(src):
+            raise ErrFileNotFound(f"{src_vol}/{src_path}")
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(src, dst)
+
+    def list_raw(self, vol: str, path: str = "") -> list[str]:
+        """All directory entries (files and dirs) under a path, unfiltered —
+        used for internal bookkeeping dirs (multipart staging)."""
+        self._check_vol(vol)
+        p = self._file_path(vol, path) if path else self._vol_path(vol)
+        try:
+            return sorted(os.listdir(p))
+        except FileNotFoundError:
+            raise ErrPathNotFound(f"{vol}/{path}") from None
+        except NotADirectoryError:
+            raise ErrPathNotFound(f"{vol}/{path}") from None
+
     def file_size(self, vol: str, path: str) -> int:
         p = self._file_path(vol, path)
         try:
@@ -282,6 +304,10 @@ class LocalDrive:
                     old_dd = meta.delete_version("")
                 except ErrFileVersionNotFound:
                     pass
+                # Heal republishes the SAME data_dir; freeing it would
+                # delete the files just moved into place.
+                if old_dd == fi.data_dir:
+                    old_dd = ""
             if fi.uses_data_dir():
                 src = self._file_path(src_vol, src_dir)
                 if not os.path.isdir(src):

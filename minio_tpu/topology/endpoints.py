@@ -1,0 +1,110 @@
+"""Endpoint ellipsis expansion and erasure-set sizing.
+
+The reference expands ``http://host{1...16}/disk{1...64}`` patterns into an
+ordered drive list and chooses a set size by GCD so every node contributes
+symmetrically to every set (cf. createServerEndpoints,
+/root/reference/cmd/endpoint-ellipses.go:341, and the layout doc
+docs/distributed/DESIGN.md). This module implements the same math for
+local paths and host-qualified URLs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import re
+
+# Valid erasure-set drive counts (docs/distributed/DESIGN.md:40-44).
+SET_SIZES = list(range(4, 17))
+
+_ELLIPSIS = re.compile(r"\{(\d+)\.\.\.(\d+)\}")
+
+
+class TopologyError(ValueError):
+    pass
+
+
+def has_ellipses(*args: str) -> bool:
+    return any(_ELLIPSIS.search(a) for a in args)
+
+
+def expand_one(arg: str) -> list[str]:
+    """Expand every {a...b} range in one argument (cartesian, in order).
+
+    Numeric widths are preserved: {01...04} -> 01, 02, 03, 04.
+    """
+    spans = list(_ELLIPSIS.finditer(arg))
+    if not spans:
+        return [arg]
+    ranges = []
+    for mt in spans:
+        a, b = mt.group(1), mt.group(2)
+        lo, hi = int(a), int(b)
+        if lo > hi:
+            raise TopologyError(f"invalid range {mt.group(0)} in {arg!r}")
+        width = len(a) if a.startswith("0") else 0
+        ranges.append([str(v).zfill(width) for v in range(lo, hi + 1)])
+    out = []
+    for combo in itertools.product(*ranges):
+        s, last = [], 0
+        for mt, val in zip(spans, combo):
+            s.append(arg[last:mt.start()])
+            s.append(val)
+            last = mt.end()
+        s.append(arg[last:])
+        out.append("".join(s))
+    return out
+
+
+def expand_endpoints(args: list[str]) -> list[list[str]]:
+    """Expand each CLI arg into its ordered drive list (one list per arg)."""
+    return [expand_one(a) for a in args]
+
+
+def _possible_set_counts(total: int, sizes: list[int]) -> list[int]:
+    return [s for s in sizes if total % s == 0]
+
+
+def choose_set_drive_count(arg_counts: list[int],
+                           custom: int | None = None,
+                           sizes: list[int] | None = None) -> int:
+    """Pick the erasure-set drive count for a deployment.
+
+    Mirrors getSetIndexes (/root/reference/cmd/endpoint-ellipses.go:178):
+    the set size must divide every argument's drive count (symmetry), and
+    the largest valid size <= GCD is preferred. A custom count (env
+    MINIO_ERASURE_SET_DRIVE_COUNT in the reference) must itself be valid.
+    """
+    sizes = sizes or SET_SIZES
+    if not arg_counts or any(c <= 0 for c in arg_counts):
+        raise TopologyError("no drives")
+    g = arg_counts[0]
+    for c in arg_counts[1:]:
+        g = math.gcd(g, c)
+    valid = [s for s in sizes if s <= g and g % s == 0]
+    if custom is not None:
+        if custom not in sizes or g % custom != 0:
+            raise TopologyError(
+                f"custom set drive count {custom} incompatible with "
+                f"drive counts {arg_counts}")
+        return custom
+    if not valid:
+        raise TopologyError(
+            f"no valid erasure-set size for drive counts {arg_counts} "
+            f"(gcd {g}); valid sizes: {sizes}")
+    return max(valid)
+
+
+def layout_pool(args: list[str], custom_set_count: int | None = None,
+                sizes: list[int] | None = None) -> list[list[str]]:
+    """Full pool layout: expand ellipses and slice into sets.
+
+    Drives are interleaved across args the way the reference distributes
+    them (for multi-host symmetry each set draws equally from each arg when
+    counts allow; we use the simple contiguous slicing the reference applies
+    to the flattened ordered list)."""
+    per_arg = expand_endpoints(args)
+    counts = [len(x) for x in per_arg]
+    size = choose_set_drive_count(counts, custom_set_count, sizes)
+    flat = [e for lst in per_arg for e in lst]
+    return [flat[i:i + size] for i in range(0, len(flat), size)]

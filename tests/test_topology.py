@@ -1,0 +1,218 @@
+"""Topology tests: ellipsis expansion, set sizing, object->set placement,
+pool placement — mirroring cmd/endpoint-ellipses_test.go and
+cmd/erasure-sets_test.go."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine import multipart as mp
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.storage.drive import LocalDrive
+from minio_tpu.storage.errors import (ErrBucketExists, ErrBucketNotFound,
+                                      ErrFileCorrupt, ErrObjectNotFound)
+from minio_tpu.topology import endpoints as ep
+from minio_tpu.utils.siphash import sip_hash_mod
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def make_drives(tmp_path, n, name="p0"):
+    return [LocalDrive(str(tmp_path / name / f"d{i}")) for i in range(n)]
+
+
+class TestEllipses:
+    def test_expand_simple(self):
+        assert ep.expand_one("/tmp/d{1...4}") == [
+            "/tmp/d1", "/tmp/d2", "/tmp/d3", "/tmp/d4"]
+
+    def test_expand_zero_padded(self):
+        out = ep.expand_one("/x/d{01...12}")
+        assert out[0] == "/x/d01" and out[-1] == "/x/d12"
+        assert len(out) == 12
+
+    def test_expand_cartesian(self):
+        out = ep.expand_one("http://h{1...2}/disk{1...3}")
+        assert len(out) == 6
+        assert out[0] == "http://h1/disk1"
+        assert out[-1] == "http://h2/disk3"
+
+    def test_no_ellipsis_passthrough(self):
+        assert ep.expand_one("/tmp/single") == ["/tmp/single"]
+        assert not ep.has_ellipses("/tmp/single")
+        assert ep.has_ellipses("/d{1...4}")
+
+    def test_invalid_range(self):
+        with pytest.raises(ep.TopologyError):
+            ep.expand_one("/d{5...1}")
+
+    def test_set_sizing_gcd(self):
+        assert ep.choose_set_drive_count([16]) == 16
+        assert ep.choose_set_drive_count([64]) == 16
+        assert ep.choose_set_drive_count([24]) == 12
+        assert ep.choose_set_drive_count([4]) == 4
+        # Multi-arg: gcd of 16,16 -> 16
+        assert ep.choose_set_drive_count([16, 16]) == 16
+        with pytest.raises(ep.TopologyError):
+            ep.choose_set_drive_count([3])
+
+    def test_set_sizing_custom(self):
+        assert ep.choose_set_drive_count([16], custom=8) == 8
+        with pytest.raises(ep.TopologyError):
+            ep.choose_set_drive_count([16], custom=5)
+
+    def test_layout_pool(self):
+        sets = ep.layout_pool(["/t/d{1...8}"])
+        assert len(sets) == 1 and len(sets[0]) == 8
+        sets = ep.layout_pool(["/t/d{1...32}"])
+        assert len(sets) == 2 and all(len(s) == 16 for s in sets)
+
+
+class TestErasureSets:
+    def test_placement_deterministic_and_spread(self, tmp_path):
+        es = ErasureSets(make_drives(tmp_path, 8), set_drive_count=4)
+        assert es.set_count == 2
+        # Same key -> same set; many keys spread across sets.
+        hits = {0: 0, 1: 0}
+        for i in range(64):
+            s = es.set_for(f"obj-{i}")
+            assert s is es.set_for(f"obj-{i}")
+            hits[s.set_index] += 1
+        assert hits[0] > 0 and hits[1] > 0
+
+    def test_placement_matches_siphash(self, tmp_path):
+        import uuid as _uuid
+        es = ErasureSets(make_drives(tmp_path, 8, "q"), set_drive_count=4)
+        key = _uuid.UUID(es.deployment_id).bytes
+        for name in ("a", "deep/prefix/obj", "z" * 100):
+            want = sip_hash_mod(name, 2, key)
+            assert es.set_for(name).set_index == want
+
+    def test_crud_across_sets(self, tmp_path):
+        es = ErasureSets(make_drives(tmp_path, 8), set_drive_count=4)
+        es.make_bucket("b")
+        blobs = {f"o{i}": payload(50_000 + i, seed=i) for i in range(8)}
+        for k, v in blobs.items():
+            es.put_object("b", k, v)
+        # Objects land on their placement set only.
+        for k in blobs:
+            home = es.set_for(k)
+            other = es.sets[1 - home.set_index]
+            with pytest.raises(ErrObjectNotFound):
+                other.get_object("b", k)
+        for k, v in blobs.items():
+            _, got = es.get_object("b", k)
+            assert got == v
+        listed = [fi.name for fi in es.list_objects("b")]
+        assert listed == sorted(blobs)
+        es.delete_object("b", "o0")
+        with pytest.raises(ErrObjectNotFound):
+            es.get_object("b", "o0")
+
+    def test_format_persists_layout(self, tmp_path):
+        drives = make_drives(tmp_path, 8, "fmt")
+        es1 = ErasureSets(drives, set_drive_count=4)
+        dep = es1.deployment_id
+        es1.make_bucket("b")
+        es1.put_object("b", "x", payload(1000))
+        # Reopen from the same paths: same deployment id, data readable.
+        drives2 = [LocalDrive(d.root) for d in drives]
+        es2 = ErasureSets(drives2, set_drive_count=4)
+        assert es2.deployment_id == dep
+        _, got = es2.get_object("b", "x")
+        assert got == payload(1000)
+
+    def test_format_rejects_shuffled_drives(self, tmp_path):
+        drives = make_drives(tmp_path, 4, "sh")
+        ErasureSets(drives, set_drive_count=4)
+        shuffled = [LocalDrive(drives[i].root) for i in (1, 0, 2, 3)]
+        with pytest.raises(ErrFileCorrupt):
+            ErasureSets(shuffled, set_drive_count=4)
+
+    def test_multipart_via_sets(self, tmp_path):
+        es = ErasureSets(make_drives(tmp_path, 8, "mps"),
+                         set_drive_count=4)
+        es.make_bucket("b")
+        data = payload(6 * 1024 * 1024, seed=9)
+        uid = es.new_multipart_upload("b", "mo")
+        i1 = es.put_object_part("b", "mo", uid, 1, data)
+        fi = es.complete_multipart_upload("b", "mo", uid, [(1, i1.etag)])
+        _, got = es.get_object("b", "mo")
+        assert got == data
+
+
+class TestServerPools:
+    def make_pools(self, tmp_path, n_pools=2):
+        pools = []
+        dep = None
+        for i in range(n_pools):
+            es = ErasureSets(make_drives(tmp_path, 4, f"pool{i}"),
+                             set_drive_count=4, deployment_id=dep)
+            dep = es.deployment_id
+            pools.append(es)
+        return ServerPools(pools)
+
+    def test_put_get_roundtrip(self, tmp_path):
+        sp = self.make_pools(tmp_path)
+        sp.make_bucket("b")
+        data = payload(300_000, seed=1)
+        sp.put_object("b", "o", data)
+        _, got = sp.get_object("b", "o")
+        assert got == data
+        assert sp.head_object("b", "o").size == len(data)
+
+    def test_overwrite_stays_on_same_pool(self, tmp_path):
+        sp = self.make_pools(tmp_path)
+        sp.make_bucket("b")
+        sp.put_object("b", "o", payload(10_000, seed=1))
+        idx1 = sp._pool_with_object("b", "o")
+        sp.put_object("b", "o", payload(20_000, seed=2))
+        idx2 = sp._pool_with_object("b", "o")
+        assert idx1 == idx2
+        # Not duplicated on the other pool.
+        other = sp.pools[1 - idx1]
+        with pytest.raises(ErrObjectNotFound):
+            other.get_object("b", "o")
+
+    def test_list_merges_pools(self, tmp_path):
+        sp = self.make_pools(tmp_path)
+        sp.make_bucket("b")
+        # Force objects onto both pools by writing directly.
+        sp.pools[0].put_object("b", "a", payload(1000, 1))
+        sp.pools[1].put_object("b", "z", payload(1000, 2))
+        names = [fi.name for fi in sp.list_objects("b")]
+        assert names == ["a", "z"]
+
+    def test_delete_finds_pool(self, tmp_path):
+        sp = self.make_pools(tmp_path)
+        sp.make_bucket("b")
+        sp.pools[1].put_object("b", "o", payload(1000))
+        sp.delete_object("b", "o")
+        with pytest.raises(ErrObjectNotFound):
+            sp.get_object("b", "o")
+
+    def test_bucket_lifecycle(self, tmp_path):
+        sp = self.make_pools(tmp_path)
+        sp.make_bucket("b")
+        with pytest.raises(ErrBucketExists):
+            sp.make_bucket("b")
+        assert sp.list_buckets() == ["b"]
+        sp.delete_bucket("b")
+        with pytest.raises(ErrBucketNotFound):
+            sp.delete_bucket("b")
+
+    def test_multipart_pool_sticky(self, tmp_path):
+        sp = self.make_pools(tmp_path)
+        sp.make_bucket("b")
+        data = payload(6 * 1024 * 1024, seed=3)
+        uid = sp.new_multipart_upload("b", "mo")
+        assert "." in uid
+        i1 = sp.put_object_part("b", "mo", uid, 1, data)
+        ups = sp.list_multipart_uploads("b")
+        assert [u["upload_id"] for u in ups] == [uid]
+        fi = sp.complete_multipart_upload("b", "mo", uid, [(1, i1.etag)])
+        _, got = sp.get_object("b", "mo")
+        assert got == data
