@@ -26,6 +26,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..ops import fused
 from ..ops.erasure_cpu import ReedSolomonCPU
 from ..ops.erasure_jax import ReedSolomonTPU
 from ..storage import bitrot_io
@@ -304,10 +305,14 @@ class ErasureSet:
                 blocks = np.zeros((nb, k * shard_size), dtype=np.uint8)
                 blocks[:, :BLOCK_SIZE] = batch.reshape(nb, BLOCK_SIZE)
                 blocks = blocks.reshape(nb, k, shard_size)
-            parity = np.asarray(self._codec(k, m).encode_blocks(blocks))
+            # Parity AND bitrot digests in ONE device dispatch (north-star
+            # config #5 PUT side, ops/fused.py); framing is then pure byte
+            # interleaving on the host.
+            parity, digests = fused.encode_and_hash(blocks, k, m)
+            parity = np.asarray(parity)
             full = np.concatenate([blocks, parity], axis=1)  # (nb, k+m, S)
-            # Frame: hash every (shard, block) stream in one vectorized pass.
-            yield bitrot_io.frame_shards_batch(full.transpose(1, 0, 2))
+            yield bitrot_io.frame_shards_batch(full.transpose(1, 0, 2),
+                                               digests=np.asarray(digests))
 
         tail = buf[n_full * BLOCK_SIZE:]
         if tail.size or size == 0:
@@ -406,7 +411,14 @@ class ErasureSet:
 
     def _read_part(self, bucket, obj, fi, part_number, offset, length) -> bytes:
         """Ranged read of one part: fetch only the frames covering the
-        block range, verify, reconstruct, assemble, slice."""
+        block range, then run bitrot verify + reconstruction of missing
+        rows as ONE fused device dispatch (north-star config #5; the
+        parallelReader analogue of cmd/erasure-decode.go:101 with the
+        verifying ReadAt of cmd/bitrot-streaming.go:142 moved on-device).
+
+        A digest mismatch is handled exactly like an I/O failure: the
+        corrupt row is dropped and a spare shard is fetched.
+        """
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         dist = fi.erasure.distribution
         part_size = fi.parts[part_number - 1].size
@@ -416,56 +428,105 @@ class ErasureSet:
         frame = 32 + shard_size
         path = f"{obj}/{fi.data_dir}/part.{part_number}"
         geo = self._range_geometry(fi, part_size, b0, b1)
+        nb = geo["nb_full"]
+        has_tail, tail_shard = geo["has_tail"], geo["tail_shard"]
 
-        def read_shard(pos: int) -> np.ndarray:
+        def read_shard(pos: int):
+            """Fetch + structurally parse one shard's frame range.
+
+            Returns (hashes (nb, 32), blocks (nb, S), tail or None); full
+            blocks are NOT hash-verified here — that happens batched on
+            device. The (tiny) tail fragment verifies on host immediately.
+            """
             d = self.drives[pos]
             if d is None:
                 raise ErrDiskNotFound("offline")
-            # Byte range of frames [b0, b1) in this shard file; the tail
-            # frame (partial block) is shorter, so clamp via file size.
-            start = b0 * frame
-            end = b1 * frame
-            raw = d.read_file(bucket, path, start, end - start)
-            return self._parse_shard_segment(raw, fi, geo)
+            raw = d.read_file(bucket, path, b0 * frame, (b1 - b0) * frame)
+            buf = np.frombuffer(raw, dtype=np.uint8)
+            expect = nb * frame + ((32 + tail_shard) if has_tail else 0)
+            if buf.size != expect:
+                raise ErrFileCorrupt(
+                    f"shard segment {buf.size} != expected {expect}")
+            frames = buf[:nb * frame].reshape(nb, frame)
+            tail = None
+            if has_tail:
+                tail = bitrot_io.unframe_shard(
+                    buf[nb * frame:].tobytes(), tail_shard, verify=True)
+            return frames[:, :32], np.ascontiguousarray(frames[:, 32:]), tail
 
-        # Choose K readers: data shards first, then parity as spares,
-        # verifying bitrot at fetch time so a corrupt shard triggers a
-        # spare read like an I/O failure does
-        # (cf. parallelReader + preferReaders, cmd/erasure-decode.go:101).
         order = Q.shuffle_by_distribution(list(range(self.n)), dist)
-        # order[s] = drive position holding shard s.
-        rows: list[np.ndarray | None] = [None] * (k + m)
+        # order[s] = drive position holding shard s. Data shards first,
+        # parity as spares (cf. preferReaders, cmd/erasure-decode.go:101).
+        rows: dict[int, tuple] = {}
         tried: set[int] = set()
-        good = 0
         candidates = list(range(k + m))
-        active = candidates[:k]
-        while good < k:
+        sel: list[int] = []
+        missing: list[int] = []
+        out = None
+        while True:
+            active = [s for s in candidates
+                      if s not in tried and s not in rows][:max(k - len(rows), 0)]
+            if len(rows) < k and not active:
+                raise ErrErasureReadQuorum(
+                    f"{bucket}/{obj}: only {len(rows)}/{k} shards readable")
             futs = {}
             for s in active:
-                if s in tried or rows[s] is not None:
-                    continue
                 tried.add(s)
                 futs[s] = self.pool.submit(read_shard, order[s])
-            if not futs and good < k:
-                raise ErrErasureReadQuorum(
-                    f"{bucket}/{obj}: only {good}/{k} shards readable")
-            fails = 0
             for s, fut in futs.items():
                 try:
                     rows[s] = fut.result()
-                    good += 1
                 except Exception:  # noqa: BLE001 — any failure => spare read
-                    fails += 1
-            if good >= k:
+                    pass
+            if len(rows) < k:
+                continue
+            sel = sorted(rows)[:k]
+            missing = [s for s in range(k) if s not in sel]
+            if not nb:
                 break
-            # Spare reads: extend to the next untried shards.
-            remaining = [s for s in candidates if s not in tried]
-            if not remaining:
-                raise ErrErasureReadQuorum(
-                    f"{bucket}/{obj}: only {good}/{k} shards readable")
-            active = remaining[:max(fails, k - good)]
+            # ONE dispatch: digests of the K chosen rows + reconstruction
+            # of the missing data rows from those same HBM-resident bytes.
+            x = np.stack([rows[s][1] for s in sel], axis=1)  # (nb, K, S)
+            digests, dev_out = fused.verify_and_transform(
+                x, k, m, tuple(sel), tuple(missing))
+            digests = np.asarray(digests)
+            bad = [sel[i] for i in range(k)
+                   if not np.array_equal(digests[:, i], rows[sel[i]][0])]
+            if not bad:
+                out = np.asarray(dev_out) if missing else None
+                break
+            for s in bad:
+                del rows[s]
 
-        return self._assemble(rows, fi, part_size, b0, offset, length)
+        # Gather data-row block matrices (read or reconstructed).
+        data_blocks: dict[int, np.ndarray] = {
+            s: rows[s][1] for s in sel if s < k}
+        if out is not None:
+            for j, s in enumerate(missing):
+                data_blocks[s] = out[:, j, :]
+
+        # Tail fragment: reconstruct missing rows via the CPU oracle codec
+        # (a partial block is tiny — not worth a device dispatch).
+        tails: dict[int, np.ndarray] = {}
+        if has_tail:
+            tails = {s: rows[s][2] for s in sel}
+            t_missing = [s for s in range(k) if s not in tails]
+            if t_missing:
+                shards_in = [tails.get(s) for s in range(k + m)]
+                rec = self._cpu(k, m).reconstruct(shards_in, data_only=True)
+                for s in t_missing:
+                    tails[s] = rec[s]
+
+        pieces = []
+        for bi in range(nb):
+            block = np.concatenate([data_blocks[s][bi] for s in range(k)])
+            pieces.append(block[:BLOCK_SIZE])
+        if has_tail:
+            tail_block = np.concatenate([tails[s] for s in range(k)])
+            pieces.append(tail_block[:geo["tail_len"]])
+        data = np.concatenate(pieces) if pieces else np.zeros(0, np.uint8)
+        lo = offset - b0 * BLOCK_SIZE
+        return data[lo:lo + length].tobytes()
 
     @staticmethod
     def _range_geometry(fi, part_size: int, b0: int, b1: int) -> dict:
