@@ -315,14 +315,25 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
         d = es.drives[pos]
         if d is None:
             raise ErrFileNotFound("offline")
-        # Verify this drive actually has every chosen part at the right
-        # shard size before moving anything (a drive that missed a part
-        # upload must not publish a torn object).
+        # Verify this drive actually has every chosen part — right shard
+        # size AND the quorum-elected etag from the drive's own part meta.
+        # Size alone is not enough: a drive that missed a same-size part
+        # re-upload still holds the OLD content and would publish a torn
+        # stripe whose bitrot frames are self-consistent (silent
+        # corruption on reads that select this row).
         for p in chosen:
             logical = _shard_len(ec, p.size)
             want = bitrot_io.bitrot_shard_file_size(logical, ec.shard_size)
             if d.file_size(SYS_VOL, f"{path}/part.{p.number}") != want:
                 raise ErrFileNotFound(f"part {p.number} incomplete here")
+            try:
+                pm = msgpackx.unpackb(
+                    d.read_all(SYS_VOL, f"{path}/part.{p.number}.meta"))
+            except StorageError:
+                raise ErrFileNotFound(f"part {p.number} meta missing here") \
+                    from None
+            if pm.get("etag") != p.etag or pm.get("size") != p.size:
+                raise ErrFileNotFound(f"part {p.number} stale here")
         # Renumber: client part numbers may be sparse; on disk the object
         # uses contiguous part.1..part.N.
         for i, p in enumerate(chosen):
@@ -334,7 +345,34 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
     res = es._map_drives_positions(publish)
     errs = [e for _, e in res]
     err = Q.reduce_write_quorum_errs(errs, write_quorum)
-    # Cleanup staging + upload dir regardless.
+    if err is not None:
+        # Roll back so the upload stays retryable (S3 allows retrying a
+        # failed CompleteMultipartUpload): un-stage any parts parked in
+        # tmp, drop the sub-quorum published version where publish
+        # succeeded, and KEEP the upload dir.
+        def rollback(pos):
+            d = es.drives[pos]
+            if d is None:
+                return
+            for i, p in enumerate(chosen):
+                try:
+                    d.rename_file(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.{i + 1}",
+                                  SYS_VOL, f"{path}/part.{p.number}")
+                except StorageError:
+                    pass
+            if errs[pos] is None:
+                try:
+                    d.delete_version(bucket, obj, version_id)
+                except StorageError:
+                    pass
+            try:
+                d.delete(SYS_VOL, f"{TMP_DIR}/{tmp_id}", recursive=True)
+            except StorageError:
+                pass
+        es._map_drives_positions(rollback)
+        raise err
+
+    # Success: sweep staging + the whole upload dir.
     def rm(d):
         for p_ in (f"{TMP_DIR}/{tmp_id}", path):
             try:
@@ -342,8 +380,6 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
             except StorageError:
                 pass
     es._map_drives(rm)
-    if err is not None:
-        raise err
     return fi_for(0)
 
 

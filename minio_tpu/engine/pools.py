@@ -33,8 +33,12 @@ class ServerPools:
             try:
                 p.head_object(bucket, obj, version_id)
                 return i
-            except (ErrObjectNotFound, ErrVersionNotFound, StorageError):
+            except (ErrObjectNotFound, ErrVersionNotFound,
+                    ErrBucketNotFound):
                 continue
+            # Anything else (e.g. read-quorum loss) must propagate: treating
+            # a degraded pool as "object not here" would place an overwrite
+            # PUT on another pool and leave a permanently stale duplicate.
         return None
 
     def get_pool_idx(self, bucket: str, obj: str) -> int:

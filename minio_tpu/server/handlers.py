@@ -1,0 +1,564 @@
+"""S3 API handlers: bucket/object/multipart surface over ServerPools.
+
+The handler-layer equivalent of cmd/object-handlers.go /
+cmd/bucket-handlers.go / cmd/bucket-listobjects-handlers.go, dispatched by
+(method, path-shape, query) like cmd/api-router.go:175 registers routes.
+Responses are S3 XML (cmd/api-response.go analogue in xml_responses.py).
+
+Handlers speak to the ObjectLayer (engine.pools.ServerPools) only —
+the same layering contract as the reference's layer 5 -> 6 boundary.
+"""
+
+from __future__ import annotations
+
+import datetime
+import email.utils
+import hashlib
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from ..engine.pools import ServerPools
+from ..storage.errors import StorageError
+from ..storage.xlmeta import FileInfo
+from .api_errors import S3Error, from_storage_error
+
+META_BUCKET = ".mtpu.sys"          # internal config bucket (minioMetaBucket)
+MAX_OBJECT_SIZE = 5 * 1024 ** 4    # 5 TiB (docs/minio-limits.md)
+MAX_KEY_LEN = 1024
+
+# User metadata prefix passed through to storage.
+AMZ_META_PREFIX = "x-amz-meta-"
+
+
+def _iso(ns: int) -> str:
+    dt = datetime.datetime.fromtimestamp(ns / 1e9, datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+def _http_date(ns: int) -> str:
+    return email.utils.formatdate(ns / 1e9, usegmt=True)
+
+
+def _xml(root: ET.Element) -> bytes:
+    return (b'<?xml version="1.0" encoding="UTF-8"?>'
+            + ET.tostring(root, encoding="unicode").encode())
+
+
+def _el(parent, tag, text=None):
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = str(text)
+    return e
+
+
+S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+class Response:
+    def __init__(self, status: int = 200, body: bytes = b"",
+                 headers: dict[str, str] | None = None):
+        self.status = status
+        self.body = body
+        self.headers = headers or {}
+
+
+def error_response(err: S3Error, resource: str, request_id: str) -> Response:
+    root = ET.Element("Error")
+    _el(root, "Code", err.api.code)
+    _el(root, "Message", err.message)
+    _el(root, "Resource", resource)
+    _el(root, "RequestId", request_id)
+    return Response(err.api.http_status, _xml(root),
+                    {"Content-Type": "application/xml"})
+
+
+def _valid_bucket_name(name: str) -> bool:
+    if not (3 <= len(name) <= 63) or name.startswith(".mtpu"):
+        return False
+    ok = set("abcdefghijklmnopqrstuvwxyz0123456789.-")
+    return (all(c in ok for c in name) and not name.startswith((".", "-"))
+            and not name.endswith((".", "-")))
+
+
+class S3Handlers:
+    """All bucket/object handlers; one instance per server."""
+
+    def __init__(self, pools: ServerPools):
+        self.pools = pools
+        try:
+            pools.make_bucket(META_BUCKET)
+        except StorageError:
+            pass
+
+    # ---- bucket config helpers (persisted in the meta bucket) -------------
+
+    def _config_get(self, path: str) -> bytes | None:
+        try:
+            _, data = self.pools.get_object(META_BUCKET, path)
+            return data
+        except StorageError:
+            return None
+
+    def _config_put(self, path: str, data: bytes) -> None:
+        self.pools.put_object(META_BUCKET, path, data)
+
+    def _config_del(self, path: str) -> None:
+        try:
+            self.pools.delete_object(META_BUCKET, path)
+        except StorageError:
+            pass
+
+    def bucket_versioning_enabled(self, bucket: str) -> bool:
+        data = self._config_get(f"buckets/{bucket}/versioning.xml")
+        return data is not None and b"<Status>Enabled</Status>" in data
+
+    # ---- service level ----------------------------------------------------
+
+    def list_buckets(self) -> Response:
+        root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
+        owner = _el(root, "Owner")
+        _el(owner, "ID", "mtpu")
+        _el(owner, "DisplayName", "mtpu")
+        bl = _el(root, "Buckets")
+        for b in self.pools.list_buckets():
+            if b == META_BUCKET:
+                continue
+            be = _el(bl, "Bucket")
+            _el(be, "Name", b)
+            _el(be, "CreationDate", _iso(0))
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    # ---- bucket level -----------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> Response:
+        if not _valid_bucket_name(bucket):
+            raise S3Error("InvalidBucketName")
+        self.pools.make_bucket(bucket)
+        return Response(200, headers={"Location": f"/{bucket}"})
+
+    def head_bucket(self, bucket: str) -> Response:
+        if not self.pools.bucket_exists(bucket) or bucket == META_BUCKET:
+            raise S3Error("NoSuchBucket")
+        return Response(200)
+
+    def delete_bucket(self, bucket: str) -> Response:
+        if self.pools.list_objects(bucket, max_keys=1):
+            raise S3Error("BucketNotEmpty")
+        self.pools.delete_bucket(bucket)
+        for cfg in ("versioning.xml",):
+            self._config_del(f"buckets/{bucket}/{cfg}")
+        return Response(204)
+
+    def get_bucket_location(self, bucket: str) -> Response:
+        self.head_bucket(bucket)
+        root = ET.Element("LocationConstraint", xmlns=S3_NS)
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def put_bucket_versioning(self, bucket: str, body: bytes) -> Response:
+        self.head_bucket(bucket)
+        self._config_put(f"buckets/{bucket}/versioning.xml", body)
+        return Response(200)
+
+    def get_bucket_versioning(self, bucket: str) -> Response:
+        self.head_bucket(bucket)
+        data = self._config_get(f"buckets/{bucket}/versioning.xml")
+        root = ET.Element("VersioningConfiguration", xmlns=S3_NS)
+        if data is not None and b"Enabled" in data:
+            _el(root, "Status", "Enabled")
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    # ---- listing ----------------------------------------------------------
+
+    @staticmethod
+    def _group_by_delimiter(infos: list[FileInfo], prefix: str,
+                            delimiter: str):
+        contents, prefixes, seen = [], [], set()
+        for fi in infos:
+            rest = fi.name[len(prefix):]
+            if delimiter and delimiter in rest:
+                cp = prefix + rest.split(delimiter)[0] + delimiter
+                if cp not in seen:
+                    seen.add(cp)
+                    prefixes.append(cp)
+            else:
+                contents.append(fi)
+        return contents, prefixes
+
+    def list_objects(self, bucket: str, query: dict) -> Response:
+        v2 = query.get("list-type", [""])[0] == "2"
+        prefix = query.get("prefix", [""])[0]
+        delimiter = query.get("delimiter", [""])[0]
+        max_keys = min(int(query.get("max-keys", ["1000"])[0] or 1000), 1000)
+        if v2:
+            marker = query.get("continuation-token", [""])[0] or \
+                query.get("start-after", [""])[0]
+        else:
+            marker = query.get("marker", [""])[0]
+        self.head_bucket(bucket)
+
+        infos = self.pools.list_objects(bucket, prefix, max_keys=100000)
+        if marker:
+            infos = [fi for fi in infos if fi.name > marker]
+        contents, prefixes = self._group_by_delimiter(infos, prefix, delimiter)
+
+        # Merge and truncate in lexical order over both kinds of entries.
+        entries = sorted(
+            [("o", fi.name, fi) for fi in contents]
+            + [("p", p, None) for p in prefixes], key=lambda t: t[1])
+        truncated = len(entries) > max_keys
+        entries = entries[:max_keys]
+        next_marker = entries[-1][1] if (truncated and entries) else ""
+
+        root = ET.Element("ListBucketResult", xmlns=S3_NS)
+        _el(root, "Name", bucket)
+        _el(root, "Prefix", prefix)
+        if delimiter:
+            _el(root, "Delimiter", delimiter)
+        _el(root, "MaxKeys", max_keys)
+        _el(root, "IsTruncated", "true" if truncated else "false")
+        if v2:
+            _el(root, "KeyCount", len(entries))
+            if truncated:
+                _el(root, "NextContinuationToken", next_marker)
+        else:
+            _el(root, "Marker", marker)
+            if truncated:
+                _el(root, "NextMarker", next_marker)
+        for kind, name, fi in entries:
+            if kind == "p":
+                cp = _el(root, "CommonPrefixes")
+                _el(cp, "Prefix", name)
+            else:
+                c = _el(root, "Contents")
+                _el(c, "Key", name)
+                _el(c, "LastModified", _iso(fi.mod_time_ns))
+                _el(c, "ETag", f'"{fi.metadata.get("etag", "")}"')
+                _el(c, "Size", fi.size)
+                _el(c, "StorageClass", "STANDARD")
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    # ---- object level -----------------------------------------------------
+
+    @staticmethod
+    def _object_headers(fi: FileInfo) -> dict[str, str]:
+        h = {
+            "ETag": f'"{fi.metadata.get("etag", "")}"',
+            "Last-Modified": _http_date(fi.mod_time_ns),
+            "Content-Type": fi.metadata.get(
+                "content-type", "application/octet-stream"),
+            "Accept-Ranges": "bytes",
+        }
+        if fi.version_id:
+            h["x-amz-version-id"] = fi.version_id
+        for k, v in fi.metadata.items():
+            if k.startswith(AMZ_META_PREFIX):
+                h[k] = v
+        return h
+
+    @staticmethod
+    def _check_conditions(headers: dict[str, str], fi: FileInfo) -> None:
+        """If-Match / If-None-Match / If-(Un)modified-Since
+        (cf. checkPreconditions, cmd/object-handlers-common.go)."""
+        etag = fi.metadata.get("etag", "")
+        h = {k.lower(): v for k, v in headers.items()}
+        im = h.get("if-match")
+        if im is not None and im.strip('"') not in (etag, "*"):
+            raise S3Error("PreconditionFailed")
+        inm = h.get("if-none-match")
+        if inm is not None and (inm == "*" or inm.strip('"') == etag):
+            raise S3Error("NotModified")
+
+        def parse_http_date(s):
+            try:
+                return email.utils.parsedate_to_datetime(s)
+            except (TypeError, ValueError):
+                return None
+        mod = datetime.datetime.fromtimestamp(
+            fi.mod_time_ns / 1e9, datetime.timezone.utc).replace(microsecond=0)
+        ims = parse_http_date(h.get("if-modified-since", ""))
+        if ims is not None and mod <= ims:
+            raise S3Error("NotModified")
+        ius = parse_http_date(h.get("if-unmodified-since", ""))
+        if ius is not None and mod > ius:
+            raise S3Error("PreconditionFailed")
+
+    @staticmethod
+    def _parse_range(spec: str, size: int) -> tuple[int, int] | None:
+        """HTTP Range -> (offset, length). cf. cmd/httprange.go."""
+        if not spec.startswith("bytes="):
+            return None
+        r = spec[len("bytes="):]
+        if "," in r:
+            raise S3Error("InvalidRange", "multiple ranges not supported")
+        start_s, _, end_s = r.partition("-")
+        try:
+            if start_s == "":                   # suffix: last N bytes
+                n = int(end_s)
+                if n == 0:
+                    raise S3Error("InvalidRange")
+                start = max(size - n, 0)
+                return start, size - start
+            start = int(start_s)
+            end = int(end_s) if end_s else size - 1
+        except ValueError:
+            # RFC 7233: a syntactically malformed Range is IGNORED
+            # (whole object), not a 416.
+            return None
+        if start >= size:
+            raise S3Error("InvalidRange")
+        end = min(end, size - 1)
+        if end < start:
+            raise S3Error("InvalidRange")
+        return start, end - start + 1
+
+    def get_object(self, bucket: str, key: str, query: dict,
+                   headers: dict[str, str], head: bool = False) -> Response:
+        version_id = query.get("versionId", [""])[0]
+        try:
+            if head:
+                fi = self.pools.head_object(bucket, key, version_id)
+                data = b""
+            else:
+                fi = self.pools.head_object(bucket, key, version_id)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        self._check_conditions(headers, fi)
+
+        rng = headers.get("Range") or headers.get("range")
+        offset, length = 0, fi.size
+        partial = False
+        if rng:
+            parsed = self._parse_range(rng, fi.size)
+            if parsed:
+                offset, length = parsed
+                partial = True
+        if not head:
+            try:
+                fi, data = self.pools.get_object(bucket, key, offset, length,
+                                                 version_id)
+            except StorageError as e:
+                raise from_storage_error(e) from None
+
+        h = self._object_headers(fi)
+        if partial:
+            h["Content-Range"] = \
+                f"bytes {offset}-{offset + length - 1}/{fi.size}"
+            h["Content-Length"] = str(length)
+            status = 206
+        else:
+            h["Content-Length"] = str(fi.size)
+            status = 200
+        return Response(status, b"" if head else data, h)
+
+    def put_object(self, bucket: str, key: str, body: bytes,
+                   headers: dict[str, str]) -> Response:
+        if len(key) > MAX_KEY_LEN:
+            raise S3Error("KeyTooLongError")
+        if len(body) > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        h = {k.lower(): v for k, v in headers.items()}
+        if "x-amz-copy-source" in h:
+            return self._copy_object(bucket, key, h)
+        md5_hdr = h.get("content-md5")
+        if md5_hdr:
+            import base64
+            try:
+                want = base64.b64decode(md5_hdr)
+            except Exception:  # noqa: BLE001
+                raise S3Error("InvalidDigest") from None
+            if hashlib.md5(body).digest() != want:
+                raise S3Error("BadDigest")
+        metadata = {k: v for k, v in h.items()
+                    if k.startswith(AMZ_META_PREFIX)}
+        if "content-type" in h:
+            metadata["content-type"] = h["content-type"]
+        versioned = self.bucket_versioning_enabled(bucket)
+        try:
+            fi = self.pools.put_object(bucket, key, body, metadata=metadata,
+                                       versioned=versioned)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        resp_headers = {"ETag": f'"{fi.metadata.get("etag", "")}"'}
+        if fi.version_id:
+            resp_headers["x-amz-version-id"] = fi.version_id
+        return Response(200, headers=resp_headers)
+
+    def _copy_object(self, bucket: str, key: str,
+                     h: dict[str, str]) -> Response:
+        src = urllib.parse.unquote(h["x-amz-copy-source"]).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        src_vid = ""
+        if "?versionId=" in src_key:
+            src_key, _, src_vid = src_key.partition("?versionId=")
+        try:
+            fi, data = self.pools.get_object(src_bucket, src_key,
+                                             version_id=src_vid)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        metadata = dict(fi.metadata)
+        metadata.pop("etag", None)
+        if h.get("x-amz-metadata-directive", "COPY") == "REPLACE":
+            metadata = {k: v for k, v in h.items()
+                        if k.startswith(AMZ_META_PREFIX)}
+        versioned = self.bucket_versioning_enabled(bucket)
+        try:
+            out = self.pools.put_object(bucket, key, data, metadata=metadata,
+                                        versioned=versioned)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        root = ET.Element("CopyObjectResult", xmlns=S3_NS)
+        _el(root, "ETag", f'"{out.metadata.get("etag", "")}"')
+        _el(root, "LastModified", _iso(out.mod_time_ns))
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def delete_object(self, bucket: str, key: str, query: dict) -> Response:
+        version_id = query.get("versionId", [""])[0]
+        versioned = self.bucket_versioning_enabled(bucket)
+        try:
+            dm = self.pools.delete_object(bucket, key, version_id, versioned)
+        except StorageError as e:
+            err = from_storage_error(e)
+            # S3 DELETE of a nonexistent key is a 204 no-op.
+            if err.api.code == "NoSuchKey":
+                return Response(204)
+            raise err from None
+        h = {}
+        if dm is not None and dm.version_id:
+            h = {"x-amz-version-id": dm.version_id,
+                 "x-amz-delete-marker": "true"}
+        return Response(204, headers=h)
+
+    def delete_objects(self, bucket: str, body: bytes) -> Response:
+        """POST /bucket?delete — multi-object delete
+        (cf. DeleteMultipleObjectsHandler, cmd/bucket-handlers.go)."""
+        self.head_bucket(bucket)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML") from None
+        quiet = root.findtext("Quiet", "false").lower() == "true" or \
+            root.findtext(f"{{{S3_NS}}}Quiet", "false").lower() == "true"
+        out = ET.Element("DeleteResult", xmlns=S3_NS)
+        versioned = self.bucket_versioning_enabled(bucket)
+        for obj in list(root.iter("Object")) + list(
+                root.iter(f"{{{S3_NS}}}Object")):
+            key = obj.findtext("Key") or obj.findtext(f"{{{S3_NS}}}Key") or ""
+            vid = obj.findtext("VersionId") or \
+                obj.findtext(f"{{{S3_NS}}}VersionId") or ""
+            try:
+                self.pools.delete_object(bucket, key, vid, versioned)
+                if not quiet:
+                    d = _el(out, "Deleted")
+                    _el(d, "Key", key)
+            except StorageError as e:
+                err = from_storage_error(e)
+                if err.api.code == "NoSuchKey":
+                    if not quiet:
+                        d = _el(out, "Deleted")
+                        _el(d, "Key", key)
+                    continue
+                ee = _el(out, "Error")
+                _el(ee, "Key", key)
+                _el(ee, "Code", err.api.code)
+                _el(ee, "Message", err.message)
+        return Response(200, _xml(out), {"Content-Type": "application/xml"})
+
+    # ---- multipart --------------------------------------------------------
+
+    def create_multipart(self, bucket: str, key: str,
+                         headers: dict[str, str]) -> Response:
+        h = {k.lower(): v for k, v in headers.items()}
+        metadata = {k: v for k, v in h.items()
+                    if k.startswith(AMZ_META_PREFIX)}
+        if "content-type" in h:
+            metadata["content-type"] = h["content-type"]
+        try:
+            upload_id = self.pools.new_multipart_upload(bucket, key,
+                                                        metadata=metadata)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "UploadId", upload_id)
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def put_part(self, bucket: str, key: str, query: dict,
+                 body: bytes) -> Response:
+        upload_id = query.get("uploadId", [""])[0]
+        part_number = int(query.get("partNumber", ["0"])[0])
+        if not (1 <= part_number <= 10000):
+            raise S3Error("InvalidArgument", "part number out of range")
+        try:
+            info = self.pools.put_object_part(bucket, key, upload_id,
+                                              part_number, body)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        return Response(200, headers={"ETag": f'"{info.etag}"'})
+
+    def complete_multipart(self, bucket: str, key: str, query: dict,
+                           body: bytes) -> Response:
+        upload_id = query.get("uploadId", [""])[0]
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML") from None
+        parts = []
+        for p in list(root.iter("Part")) + list(root.iter(f"{{{S3_NS}}}Part")):
+            num = p.findtext("PartNumber") or \
+                p.findtext(f"{{{S3_NS}}}PartNumber")
+            etag = (p.findtext("ETag") or p.findtext(f"{{{S3_NS}}}ETag")
+                    or "").strip('"')
+            parts.append((int(num), etag))
+        versioned = self.bucket_versioning_enabled(bucket)
+        try:
+            fi = self.pools.complete_multipart_upload(bucket, key, upload_id,
+                                                      parts,
+                                                      versioned=versioned)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        root = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "ETag", f'"{fi.metadata.get("etag", "")}"')
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def abort_multipart(self, bucket: str, key: str, query: dict) -> Response:
+        upload_id = query.get("uploadId", [""])[0]
+        try:
+            self.pools.abort_multipart_upload(bucket, key, upload_id)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        return Response(204)
+
+    def list_parts(self, bucket: str, key: str, query: dict) -> Response:
+        upload_id = query.get("uploadId", [""])[0]
+        try:
+            parts = self.pools.list_parts(bucket, key, upload_id)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        root = ET.Element("ListPartsResult", xmlns=S3_NS)
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "UploadId", upload_id)
+        _el(root, "IsTruncated", "false")
+        for p in parts:
+            pe = _el(root, "Part")
+            _el(pe, "PartNumber", p.number)
+            _el(pe, "ETag", f'"{p.etag}"')
+            _el(pe, "Size", p.size)
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def list_multipart_uploads(self, bucket: str, query: dict) -> Response:
+        prefix = query.get("prefix", [""])[0]
+        self.head_bucket(bucket)
+        uploads = self.pools.list_multipart_uploads(bucket, prefix)
+        root = ET.Element("ListMultipartUploadsResult", xmlns=S3_NS)
+        _el(root, "Bucket", bucket)
+        _el(root, "Prefix", prefix)
+        _el(root, "IsTruncated", "false")
+        for u in uploads:
+            ue = _el(root, "Upload")
+            _el(ue, "Key", u["object"])
+            _el(ue, "UploadId", u["upload_id"])
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})

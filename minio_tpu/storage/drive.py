@@ -264,12 +264,17 @@ class LocalDrive:
         return fi
 
     def write_metadata(self, vol: str, obj: str, fi: FileInfo) -> None:
-        """Add/replace one version in xl.meta (WriteMetadata)."""
+        """Add/replace one version in xl.meta (WriteMetadata).
+
+        A corrupt existing xl.meta is unreadable everywhere (its versions
+        are already lost on this drive) — start fresh so heal can REPLACE
+        it with the quorum-elected metadata instead of failing forever.
+        """
         self._check_vol(vol)
         with self._meta_lock:
             try:
                 meta = self._read_xlmeta(vol, obj)
-            except ErrFileNotFound:
+            except (ErrFileNotFound, ErrFileCorrupt):
                 meta = XLMeta()
             meta.add_version(fi)
             self._write_xlmeta(vol, obj, meta)

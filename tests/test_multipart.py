@@ -182,3 +182,51 @@ class TestMultipartSemantics:
         want = hashlib.md5(bytes.fromhex(i1.etag)
                            + bytes.fromhex(i2.etag)).hexdigest() + "-2"
         assert fi.etag == want
+
+
+class TestCompleteIntegrity:
+    def test_stale_same_size_part_excluded(self, tmp_path):
+        """A drive that missed a same-size part re-upload must not publish
+        its stale shard (etag check in complete's per-drive verify)."""
+        es = make_set(tmp_path, n=4, name="stale")
+        es.make_bucket("b")
+        uid = mp.new_multipart_upload(es, "b", "o")
+        old = payload(PART, seed=1)
+        new = payload(PART, seed=2)
+        mp.put_object_part(es, "b", "o", uid, 1, old)
+        # Re-upload part 1 with different same-size content while drive 3
+        # is offline (it keeps the stale staged part + meta).
+        d3 = es.drives[3]
+        es.drives[3] = None
+        info = mp.put_object_part(es, "b", "o", uid, 1, new)
+        es.drives[3] = d3
+        fi = mp.complete_multipart_upload(es, "b", "o", uid,
+                                          [(1, info.etag)])
+        # Every read combination must return the NEW content.
+        _, got = es.get_object("b", "o")
+        assert got == new
+        assert fi.size == PART
+
+    def test_failed_complete_keeps_upload_retryable(self, tmp_path):
+        """CompleteMultipartUpload that fails write quorum must leave the
+        staged parts in place so the client can retry (S3 semantics)."""
+        es = make_set(tmp_path, n=4, name="retry")
+        es.make_bucket("b")
+        uid = mp.new_multipart_upload(es, "b", "o")
+        data = payload(PART, seed=3)
+        info = mp.put_object_part(es, "b", "o", uid, 1, data)
+        # Take 3 of 4 drives offline: publish cannot reach write quorum.
+        saved = list(es.drives)
+        es.drives[1] = es.drives[2] = es.drives[3] = None
+        from minio_tpu.storage.errors import (ErrErasureWriteQuorum,
+                                              StorageError)
+        with pytest.raises(StorageError):
+            mp.complete_multipart_upload(es, "b", "o", uid,
+                                         [(1, info.etag)])
+        es.drives = saved
+        # Parts must still be listed; retry must now succeed.
+        parts = mp.list_parts(es, "b", "o", uid)
+        assert [p.number for p in parts] == [1]
+        mp.complete_multipart_upload(es, "b", "o", uid, [(1, info.etag)])
+        _, got = es.get_object("b", "o")
+        assert got == data
