@@ -1,0 +1,31 @@
+"""Native C++ RS comparator: differential vs the gf256 oracle.
+
+The comparator exists to give bench.py a MEASURED CPU baseline; this test
+pins its correctness (same Cauchy/Vandermonde code as the TPU path, byte
+for byte) so the baseline measures the right computation.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+g = shutil.which("g++")
+
+
+@pytest.mark.skipif(g is None, reason="no C++ toolchain")
+class TestNativeComparator:
+    def test_encode_matches_oracle(self):
+        from native import rs_comparator as rc
+        from minio_tpu.ops.erasure_cpu import ReedSolomonCPU
+        rng = np.random.default_rng(0)
+        for k, m, L in [(2, 2, 64), (8, 4, 4096 + 17), (5, 3, 333)]:
+            data = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
+            got = rc.encode(data, k, m)
+            cpu = ReedSolomonCPU(k, m)
+            want = np.stack(cpu.encode_data(data.reshape(-1).tobytes())[k:])
+            assert np.array_equal(got, want), (k, m, L)
+
+    def test_isa_reported(self):
+        from native import rs_comparator as rc
+        assert rc.isa() in ("avx512bw", "avx2", "scalar")
