@@ -1,0 +1,70 @@
+"""CLI entry: `python -m minio_tpu.server --drives /tmp/d{1...4} --port 9001`.
+
+The serverMain equivalent (/root/reference/cmd/server-main.go:441): expand
+drive endpoints, run startup self-tests, build the object layer
+(pools -> sets -> drives), start the S3 front door, serve until signalled.
+Credentials come from MTPU_ROOT_USER / MTPU_ROOT_PASSWORD (the reference's
+MINIO_ROOT_USER convention), defaulting to minioadmin/minioadmin.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def expand_ellipses(pattern: str) -> list[str]:
+    """Expand `/tmp/d{1...4}` patterns
+    (cf. cmd/endpoint-ellipses.go:341)."""
+    from ..topology.endpoints import expand_one, has_ellipses
+    if has_ellipses(pattern):
+        return expand_one(pattern)
+    return pattern.split()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="minio_tpu.server")
+    ap.add_argument("--drives", required=True,
+                    help="drive paths, ellipses ok: /tmp/d{1...4}")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--set-drive-count", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    # Startup self-test guards (hard-fail like cmd/erasure-coding.go:158,
+    # cmd/bitrot.go:214).
+    from ..ops.selftest import run_startup_self_tests
+    run_startup_self_tests()
+
+    from ..engine.pools import ServerPools
+    from ..engine.sets import ErasureSets
+    from ..storage.drive import LocalDrive
+    from .server import S3Server
+    from .sigv4 import Credentials
+
+    paths = expand_ellipses(args.drives)
+    drives = [LocalDrive(p) for p in paths]
+    sets = ErasureSets(drives,
+                       set_drive_count=args.set_drive_count or len(drives))
+    pools = ServerPools([sets])
+    creds = Credentials(os.environ.get("MTPU_ROOT_USER", "minioadmin"),
+                        os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin"))
+    srv = S3Server(pools, creds, host=args.host, port=args.port).start()
+    print(f"minio_tpu server on {srv.endpoint} "
+          f"({len(paths)} drives, set={sets.set_drive_count})", flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+    srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

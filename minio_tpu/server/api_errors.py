@@ -1,0 +1,113 @@
+"""S3 API error registry: code -> (HTTP status, default message) + the
+storage-error -> API-error mapping.
+
+The reference keeps ~300 codes in cmd/api-errors.go with a toAPIErrorCode
+translation; this is the subset our surface emits, structured the same
+way (XML error body with Code/Message/Resource/RequestId).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..storage import errors as se
+
+
+@dataclass(frozen=True)
+class APIError:
+    code: str
+    http_status: int
+    message: str
+
+
+_E = APIError
+
+ERRORS: dict[str, APIError] = {e.code: e for e in [
+    _E("AccessDenied", 403, "Access Denied."),
+    _E("BadDigest", 400, "The Content-Md5 you specified did not match what we received."),
+    _E("BucketAlreadyOwnedByYou", 409, "Your previous request to create the named bucket succeeded and you already own it."),
+    _E("BucketAlreadyExists", 409, "The requested bucket name is not available."),
+    _E("BucketNotEmpty", 409, "The bucket you tried to delete is not empty."),
+    _E("EntityTooLarge", 400, "Your proposed upload exceeds the maximum allowed object size."),
+    _E("EntityTooSmall", 400, "Your proposed upload is smaller than the minimum allowed object size."),
+    _E("IncompleteBody", 400, "You did not provide the number of bytes specified by the Content-Length HTTP header."),
+    _E("InternalError", 500, "We encountered an internal error, please try again."),
+    _E("InvalidAccessKeyId", 403, "The Access Key Id you provided does not exist in our records."),
+    _E("InvalidArgument", 400, "Invalid Argument."),
+    _E("InvalidBucketName", 400, "The specified bucket is not valid."),
+    _E("InvalidDigest", 400, "The Content-Md5 you specified is not valid."),
+    _E("InvalidPart", 400, "One or more of the specified parts could not be found."),
+    _E("InvalidPartOrder", 400, "The list of parts was not in ascending order."),
+    _E("InvalidRange", 416, "The requested range is not satisfiable."),
+    _E("InvalidRequest", 400, "Invalid Request."),
+    _E("KeyTooLongError", 400, "Your key is too long."),
+    _E("MalformedXML", 400, "The XML you provided was not well-formed or did not validate against our published schema."),
+    _E("MethodNotAllowed", 405, "The specified method is not allowed against this resource."),
+    _E("MissingContentLength", 411, "You must provide the Content-Length HTTP header."),
+    _E("NoSuchBucket", 404, "The specified bucket does not exist."),
+    _E("NoSuchBucketPolicy", 404, "The bucket policy does not exist."),
+    _E("NoSuchKey", 404, "The specified key does not exist."),
+    _E("NoSuchUpload", 404, "The specified multipart upload does not exist."),
+    _E("NoSuchVersion", 404, "The specified version does not exist."),
+    _E("NotImplemented", 501, "A header you provided implies functionality that is not implemented."),
+    _E("PreconditionFailed", 412, "At least one of the pre-conditions you specified did not hold."),
+    _E("NotModified", 304, "Not Modified."),
+    _E("RequestTimeTooSkewed", 403, "The difference between the request time and the server's time is too large."),
+    _E("SignatureDoesNotMatch", 403, "The request signature we calculated does not match the signature you provided."),
+    _E("SlowDown", 503, "Please reduce your request rate."),
+    _E("XAmzContentSHA256Mismatch", 400, "The provided 'x-amz-content-sha256' header does not match what was computed."),
+    _E("AuthorizationHeaderMalformed", 400, "The authorization header is malformed."),
+    _E("ExpiredToken", 400, "The provided token has expired."),
+    _E("AuthorizationQueryParametersError", 400, "Query-string authentication parameters are malformed."),
+    _E("ServiceUnavailable", 503, "The server is currently unavailable. Please retry."),
+    _E("QuotaExceeded", 403, "Bucket quota exceeded."),
+    _E("NoSuchLifecycleConfiguration", 404, "The lifecycle configuration does not exist."),
+    _E("NoSuchTagSet", 404, "The TagSet does not exist."),
+    _E("ReplicationConfigurationNotFoundError", 404, "The replication configuration was not found."),
+    _E("ServerSideEncryptionConfigurationNotFoundError", 404, "The server side encryption configuration was not found."),
+    _E("NoSuchObjectLockConfiguration", 404, "The specified object does not have an ObjectLock configuration."),
+    _E("ObjectLocked", 400, "Object is WORM protected and cannot be overwritten or deleted."),
+    _E("InvalidRetentionDate", 400, "Date must be provided in ISO 8601 format."),
+    _E("NoSuchNotificationConfiguration", 404, "The specified bucket does not have a notification configuration."),
+    _E("SelectParseError", 400, "The SQL expression could not be parsed."),
+]}
+
+
+class S3Error(Exception):
+    """Raise anywhere in a handler to short-circuit into an XML error."""
+
+    def __init__(self, code: str, message: str | None = None):
+        self.api = ERRORS[code]
+        self.message = message or self.api.message
+        super().__init__(f"{code}: {self.message}")
+
+
+def from_storage_error(e: Exception) -> S3Error:
+    """Map engine/storage exceptions to API errors
+    (cf. toAPIErrorCode, cmd/api-errors.go)."""
+    from ..engine import multipart as mp
+    if isinstance(e, S3Error):
+        return e
+    if isinstance(e, se.ErrBucketNotFound):
+        return S3Error("NoSuchBucket")
+    if isinstance(e, se.ErrBucketExists):
+        return S3Error("BucketAlreadyOwnedByYou")
+    if isinstance(e, (mp.ErrUploadNotFound, se.ErrUploadNotFound)):
+        return S3Error("NoSuchUpload")
+    if isinstance(e, mp.ErrPartTooSmall):
+        return S3Error("EntityTooSmall")
+    if isinstance(e, mp.ErrInvalidPartOrder):
+        return S3Error("InvalidPartOrder")
+    if isinstance(e, (mp.ErrInvalidPart, se.ErrInvalidPart)):
+        return S3Error("InvalidPart")
+    if isinstance(e, (se.ErrVersionNotFound, se.ErrFileVersionNotFound)):
+        return S3Error("NoSuchVersion")
+    if isinstance(e, (se.ErrObjectNotFound, se.ErrFileNotFound)):
+        return S3Error("NoSuchKey")
+    if isinstance(e, (se.ErrErasureReadQuorum, se.ErrErasureWriteQuorum)):
+        return S3Error("SlowDown", str(e))
+    if isinstance(e, (se.ErrVolumeNotEmpty, se.ErrBucketNotEmpty)):
+        return S3Error("BucketNotEmpty")
+    if isinstance(e, se.ErrInvalidArgument):
+        return S3Error("InvalidArgument", str(e))
+    return S3Error("InternalError", f"{type(e).__name__}: {e}")

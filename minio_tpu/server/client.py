@@ -1,0 +1,191 @@
+"""Minimal signed S3 client — the test harness's `mc` analogue.
+
+Signs every request with the same sigv4 module the server verifies with
+is NOT circular: the signer follows the public SigV4 spec from the client
+side (canonicalizing real HTTP bytes on the wire), so a mismatch in either
+direction fails the round-trip tests. Used by tests and (later) internal
+tooling.
+"""
+
+from __future__ import annotations
+
+import http.client
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from .sigv4 import Credentials, sign_request
+
+
+class S3ClientError(Exception):
+    def __init__(self, status: int, code: str, message: str):
+        self.status = status
+        self.code = code
+        self.message = message
+        super().__init__(f"{status} {code}: {message}")
+
+
+class S3Client:
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1"):
+        u = urllib.parse.urlsplit(endpoint)
+        self.host = u.hostname
+        self.port = u.port or 80
+        self.creds = Credentials(access_key, secret_key, region)
+
+    # -- core ----------------------------------------------------------------
+
+    def request(self, method: str, path: str,
+                query: dict[str, str] | None = None,
+                body: bytes = b"", headers: dict[str, str] | None = None,
+                raw_query: str | None = None):
+        q = {k: [v] for k, v in (query or {}).items()}
+        headers = dict(headers or {})
+        headers["Host"] = f"{self.host}:{self.port}"
+        if raw_query is None:
+            auth = sign_request(self.creds, method, path, q, headers, body)
+            headers.update(auth)
+            qs = urllib.parse.urlencode({k: v[0] for k, v in q.items()})
+            url = path + ("?" + qs if qs else "")
+        else:
+            url = path + "?" + raw_query
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
+        try:
+            conn.request(method, url, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    def _check(self, status, headers, data, ok=(200, 204, 206)):
+        if status in ok:
+            return status, headers, data
+        code, msg = "Unknown", ""
+        try:
+            root = ET.fromstring(data)
+            code = root.findtext("Code", "Unknown")
+            msg = root.findtext("Message", "")
+        except ET.ParseError:
+            pass
+        raise S3ClientError(status, code, msg)
+
+    # -- buckets -------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        self._check(*self.request("PUT", f"/{bucket}"))
+
+    def delete_bucket(self, bucket: str) -> None:
+        self._check(*self.request("DELETE", f"/{bucket}"))
+
+    def bucket_exists(self, bucket: str) -> bool:
+        status, _, _ = self.request("HEAD", f"/{bucket}")
+        return status == 200
+
+    def list_buckets(self) -> list[str]:
+        _, _, data = self._check(*self.request("GET", "/"))
+        root = ET.fromstring(data)
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        return [b.findtext(f"{ns}Name") or b.findtext("Name")
+                for b in root.iter(f"{ns}Bucket")] or \
+               [b.findtext("Name") for b in root.iter("Bucket")]
+
+    def set_versioning(self, bucket: str, enabled: bool) -> None:
+        status = "Enabled" if enabled else "Suspended"
+        body = (f'<VersioningConfiguration><Status>{status}</Status>'
+                f'</VersioningConfiguration>').encode()
+        self._check(*self.request("PUT", f"/{bucket}",
+                                  query={"versioning": ""}, body=body))
+
+    # -- objects -------------------------------------------------------------
+
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   headers: dict | None = None) -> dict:
+        _, h, _ = self._check(
+            *self.request("PUT", f"/{bucket}/{key}", body=data,
+                          headers=headers))
+        return h
+
+    def get_object(self, bucket: str, key: str,
+                   range_: tuple[int, int] | None = None,
+                   version_id: str = "") -> bytes:
+        headers = {}
+        if range_:
+            headers["Range"] = f"bytes={range_[0]}-{range_[1]}"
+        q = {"versionId": version_id} if version_id else None
+        _, _, data = self._check(
+            *self.request("GET", f"/{bucket}/{key}", query=q,
+                          headers=headers))
+        return data
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        status, h, data = self.request("HEAD", f"/{bucket}/{key}")
+        if status != 200:
+            raise S3ClientError(status, "HeadFailed", "")
+        return h
+
+    def delete_object(self, bucket: str, key: str,
+                      version_id: str = "") -> dict:
+        q = {"versionId": version_id} if version_id else None
+        _, h, _ = self._check(
+            *self.request("DELETE", f"/{bucket}/{key}", query=q))
+        return h
+
+    def copy_object(self, src_bucket: str, src_key: str, dst_bucket: str,
+                    dst_key: str) -> None:
+        self._check(*self.request(
+            "PUT", f"/{dst_bucket}/{dst_key}",
+            headers={"x-amz-copy-source": f"/{src_bucket}/{src_key}"}))
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     delimiter: str = "", v2: bool = True):
+        q = {"prefix": prefix}
+        if v2:
+            q["list-type"] = "2"
+        if delimiter:
+            q["delimiter"] = delimiter
+        _, _, data = self._check(*self.request("GET", f"/{bucket}", query=q))
+        root = ET.fromstring(data)
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        keys = [c.findtext(f"{ns}Key") for c in root.iter(f"{ns}Contents")]
+        prefixes = [c.findtext(f"{ns}Prefix")
+                    for c in root.iter(f"{ns}CommonPrefixes")]
+        return keys, prefixes
+
+    def delete_objects(self, bucket: str, keys: list[str]):
+        objs = "".join(f"<Object><Key>{k}</Key></Object>" for k in keys)
+        body = f"<Delete>{objs}</Delete>".encode()
+        _, _, data = self._check(*self.request(
+            "POST", f"/{bucket}", query={"delete": ""}, body=body))
+        return data
+
+    # -- multipart -----------------------------------------------------------
+
+    def create_multipart(self, bucket: str, key: str) -> str:
+        _, _, data = self._check(*self.request(
+            "POST", f"/{bucket}/{key}", query={"uploads": ""}))
+        root = ET.fromstring(data)
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        return root.findtext(f"{ns}UploadId") or root.findtext("UploadId")
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, data: bytes) -> str:
+        _, h, _ = self._check(*self.request(
+            "PUT", f"/{bucket}/{key}",
+            query={"partNumber": str(part_number), "uploadId": upload_id},
+            body=data))
+        return h.get("ETag", "").strip('"')
+
+    def complete_multipart(self, bucket: str, key: str, upload_id: str,
+                           parts: list[tuple[int, str]]) -> None:
+        inner = "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>\"{e}\"</ETag></Part>"
+            for n, e in parts)
+        body = f"<CompleteMultipartUpload>{inner}</CompleteMultipartUpload>" \
+            .encode()
+        self._check(*self.request(
+            "POST", f"/{bucket}/{key}", query={"uploadId": upload_id},
+            body=body))
+
+    def abort_multipart(self, bucket: str, key: str, upload_id: str) -> None:
+        self._check(*self.request(
+            "DELETE", f"/{bucket}/{key}", query={"uploadId": upload_id}))

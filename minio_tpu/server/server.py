@@ -1,0 +1,213 @@
+"""The S3 HTTP server: routing, middleware, auth dispatch.
+
+Equivalent of the reference's internal/http server + cmd/routers.go:82
+(configureServerHandler) + cmd/auth-handler.go:281 (checkRequestAuthType):
+a threading HTTP server whose single dispatch point classifies the request
+(anonymous / presigned / header-signed / streaming-signed), verifies
+SigV4, then routes on (method, path shape, query) the way
+cmd/api-router.go:175 registers gorilla-mux routes.
+
+Middleware checks (time validity, size limits, reserved-metadata filter)
+happen inline before dispatch, mirroring cmd/generic-handlers.go.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..engine.pools import ServerPools
+from .api_errors import S3Error
+from .handlers import Response, S3Handlers, error_response
+from .sigv4 import (STREAMING_PAYLOAD, Credentials, decode_streaming_body,
+                    verify_header_signature, verify_presigned)
+
+MAX_HEADER_BODY = 5 * 1024 ** 3      # max single PUT (5 GiB part limit)
+
+
+class S3Server:
+    """Owns the object layer, creds and the HTTP plumbing."""
+
+    def __init__(self, pools: ServerPools, creds: Credentials,
+                 host: str = "127.0.0.1", port: int = 0,
+                 trace_sink=None):
+        self.pools = pools
+        self.creds = creds
+        self.handlers = S3Handlers(pools)
+        self.trace_sink = trace_sink
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            server_version = "MinioTPU"
+
+            def log_message(self, fmt, *args):  # quiet; tracing has its own
+                pass
+
+            def _respond(self, resp: Response):
+                self.send_response(resp.status)
+                body = resp.body or b""
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                if "Content-Length" not in resp.headers:
+                    self.send_header("Content-Length", str(len(body)))
+                self.send_header("x-amz-request-id", self.request_id)
+                self.end_headers()
+                if self.command != "HEAD" and body:
+                    self.wfile.write(body)
+
+            def _handle(self):
+                self.request_id = secrets.token_hex(8)
+                parsed = urllib.parse.urlsplit(self.path)
+                path = urllib.parse.unquote(parsed.path)
+                query = urllib.parse.parse_qs(parsed.query,
+                                              keep_blank_values=True)
+                try:
+                    resp = outer._dispatch(self, path, query)
+                except S3Error as e:
+                    resp = error_response(e, path, self.request_id)
+                except Exception as e:  # noqa: BLE001
+                    resp = error_response(
+                        S3Error("InternalError",
+                                f"{type(e).__name__}: {e}"),
+                        path, self.request_id)
+                self._respond(resp)
+
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._httpd.server_port
+        self.host = host
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "S3Server":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- auth + dispatch -----------------------------------------------------
+
+    def _read_body(self, req) -> bytes:
+        length = int(req.headers.get("Content-Length", 0) or 0)
+        if length > MAX_HEADER_BODY:
+            raise S3Error("EntityTooLarge")
+        if length:
+            return req.rfile.read(length)
+        if req.headers.get("Transfer-Encoding", "").lower() == "chunked":
+            # HTTP chunked framing (not aws-chunked).
+            out = bytearray()
+            while True:
+                line = req.rfile.readline().strip()
+                size = int(line.split(b";")[0], 16)
+                if size == 0:
+                    req.rfile.readline()
+                    break
+                out += req.rfile.read(size)
+                req.rfile.readline()
+            return bytes(out)
+        return b""
+
+    def _authenticate(self, req, path: str, query: dict) -> bytes:
+        """Classify + verify auth; returns the (decoded) request body.
+        cf. checkRequestAuthType, cmd/auth-handler.go:281."""
+        headers = {k: v for k, v in req.headers.items()}
+        headers.setdefault("Host", f"{self.host}:{self.port}")
+        body = self._read_body(req)
+        if "X-Amz-Signature" in query:
+            verify_presigned(self.creds, req.command, path, query, headers)
+            return body
+        auth = req.headers.get("Authorization", "")
+        if not auth:
+            raise S3Error("AccessDenied", "anonymous access is disabled")
+        payload_decl = verify_header_signature(
+            self.creds, req.command, path, query, headers, body)
+        if payload_decl == STREAMING_PAYLOAD:
+            body = decode_streaming_body(self.creds, headers, body)
+        return body
+
+    def _dispatch(self, req, path: str, query: dict) -> Response:
+        body = self._authenticate(req, path, query)
+        h = self.handlers
+        method = req.command
+        headers = {k: v for k, v in req.headers.items()}
+
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0] if parts[0] else ""
+        key = parts[1] if len(parts) > 1 else ""
+
+        if self.trace_sink is not None:
+            self.trace_sink({"method": method, "path": path,
+                             "query": {k: v[0] for k, v in query.items()}})
+
+        if not bucket:
+            if method == "GET":
+                return h.list_buckets()
+            raise S3Error("MethodNotAllowed")
+
+        if not key:
+            return self._dispatch_bucket(method, bucket, query, headers, body)
+        return self._dispatch_object(method, bucket, key, query, headers,
+                                     body)
+
+    def _dispatch_bucket(self, method, bucket, query, headers,
+                         body) -> Response:
+        h = self.handlers
+        if method == "PUT":
+            if "versioning" in query:
+                return h.put_bucket_versioning(bucket, body)
+            return h.make_bucket(bucket)
+        if method == "HEAD":
+            return h.head_bucket(bucket)
+        if method == "DELETE":
+            return h.delete_bucket(bucket)
+        if method == "POST":
+            if "delete" in query:
+                return h.delete_objects(bucket, body)
+            raise S3Error("MethodNotAllowed")
+        if method == "GET":
+            if "location" in query:
+                return h.get_bucket_location(bucket)
+            if "versioning" in query:
+                return h.get_bucket_versioning(bucket)
+            if "uploads" in query:
+                return h.list_multipart_uploads(bucket, query)
+            return h.list_objects(bucket, query)
+        raise S3Error("MethodNotAllowed")
+
+    def _dispatch_object(self, method, bucket, key, query, headers,
+                         body) -> Response:
+        h = self.handlers
+        if method == "PUT":
+            if "partNumber" in query and "uploadId" in query:
+                return h.put_part(bucket, key, query, body)
+            return h.put_object(bucket, key, body, headers)
+        if method == "GET":
+            if "uploadId" in query:
+                return h.list_parts(bucket, key, query)
+            return h.get_object(bucket, key, query, headers)
+        if method == "HEAD":
+            return h.get_object(bucket, key, query, headers, head=True)
+        if method == "DELETE":
+            if "uploadId" in query:
+                return h.abort_multipart(bucket, key, query)
+            return h.delete_object(bucket, key, query)
+        if method == "POST":
+            if "uploads" in query:
+                return h.create_multipart(bucket, key, headers)
+            if "uploadId" in query:
+                return h.complete_multipart(bucket, key, query, body)
+            raise S3Error("MethodNotAllowed")
+        raise S3Error("MethodNotAllowed")

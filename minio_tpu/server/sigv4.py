@@ -1,0 +1,323 @@
+"""AWS Signature V4 verification (+ presigned URLs + streaming chunks).
+
+Server-side verification equivalent of the reference's
+cmd/signature-v4.go:208 (presigned) / :334 (header auth) and the
+aws-chunked reader of cmd/streaming-signature-v4.go. Implemented from the
+public SigV4 spec; validated by signing requests with our own signer in
+tests (the reference does the same — its test harness signs with its own
+client code).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.parse
+
+from .api_errors import S3Error
+
+ALGORITHM = "AWS4-HMAC-SHA256"
+STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+MAX_SKEW = datetime.timedelta(minutes=15)
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def uri_encode(s: str, encode_slash: bool = True) -> str:
+    safe = "-._~" if encode_slash else "-._~/"
+    return urllib.parse.quote(s, safe=safe)
+
+
+def signing_key(secret: str, date: str, region: str, service: str = "s3") -> bytes:
+    k = _hmac(f"AWS4{secret}".encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def canonical_query(query: dict[str, list[str]],
+                    drop: tuple[str, ...] = ()) -> str:
+    items = []
+    for k in sorted(query):
+        if k in drop:
+            continue
+        for v in sorted(query[k]):
+            items.append(f"{uri_encode(k)}={uri_encode(v)}")
+    return "&".join(items)
+
+
+def canonical_request(method: str, path: str, query: dict[str, list[str]],
+                      headers: dict[str, str], signed_headers: list[str],
+                      payload_hash: str, drop_query: tuple[str, ...] = ()) -> str:
+    canon_headers = "".join(
+        f"{h}:{' '.join(headers.get(h, '').split())}\n" for h in signed_headers)
+    return "\n".join([
+        method,
+        uri_encode(path, encode_slash=False) or "/",
+        canonical_query(query, drop_query),
+        canon_headers,
+        ";".join(signed_headers),
+        payload_hash,
+    ])
+
+
+def string_to_sign(amz_date: str, scope: str, canon_req: str) -> str:
+    return "\n".join([ALGORITHM, amz_date, scope,
+                      _sha256(canon_req.encode())])
+
+
+class Credentials:
+    def __init__(self, access_key: str, secret_key: str,
+                 region: str = "us-east-1"):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+
+def _parse_amz_date(s: str) -> datetime.datetime:
+    try:
+        return datetime.datetime.strptime(s, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=datetime.timezone.utc)
+    except ValueError:
+        raise S3Error("AuthorizationHeaderMalformed",
+                      f"bad x-amz-date {s!r}") from None
+
+
+def sign_request(creds: Credentials, method: str, path: str,
+                 query: dict[str, list[str]], headers: dict[str, str],
+                 payload: bytes | str = b"",
+                 now: datetime.datetime | None = None) -> dict[str, str]:
+    """Client-side signer (tests + internal RPC). Mutates nothing; returns
+    the headers to add (Authorization, x-amz-date, x-amz-content-sha256)."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    if isinstance(payload, str):       # pre-computed hash (e.g. streaming)
+        payload_hash = payload
+    else:
+        payload_hash = _sha256(payload)
+    h = {k.lower(): v for k, v in headers.items()}
+    h["x-amz-date"] = amz_date
+    h["x-amz-content-sha256"] = payload_hash
+    signed = sorted(set(list(h.keys()) + ["host"]))
+    scope = f"{date}/{creds.region}/s3/aws4_request"
+    canon = canonical_request(method, path, query, h, signed, payload_hash)
+    sts = string_to_sign(amz_date, scope, canon)
+    sig = hmac.new(signing_key(creds.secret_key, date, creds.region),
+                   sts.encode(), hashlib.sha256).hexdigest()
+    auth = (f"{ALGORITHM} Credential={creds.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    return {"Authorization": auth, "x-amz-date": amz_date,
+            "x-amz-content-sha256": payload_hash}
+
+
+def _parse_auth_header(auth: str) -> tuple[str, str, list[str], str]:
+    """-> (access_key, scope, signed_headers, signature)."""
+    if not auth.startswith(ALGORITHM):
+        raise S3Error("SignatureDoesNotMatch", "unsupported algorithm")
+    fields = {}
+    for part in auth[len(ALGORITHM):].split(","):
+        k, _, v = part.strip().partition("=")
+        fields[k] = v
+    try:
+        cred = fields["Credential"]
+        signed = fields["SignedHeaders"].split(";")
+        sig = fields["Signature"]
+    except KeyError as e:
+        raise S3Error("AuthorizationHeaderMalformed", str(e)) from None
+    access_key, _, scope = cred.partition("/")
+    return access_key, scope, signed, sig
+
+
+def verify_header_signature(creds: Credentials, method: str, path: str,
+                            query: dict[str, list[str]],
+                            headers: dict[str, str], body: bytes,
+                            now: datetime.datetime | None = None) -> str:
+    """Verify an Authorization-header SigV4 request.
+
+    Returns the payload-hash declaration (hex sha256, UNSIGNED-PAYLOAD or
+    STREAMING-...) so the caller can pick the body-decoding path.
+    cf. doesSignatureMatch, /root/reference/cmd/signature-v4.go:334.
+    """
+    h = {k.lower(): v for k, v in headers.items()}
+    auth = h.get("authorization", "")
+    access_key, scope, signed_headers, got_sig = _parse_auth_header(auth)
+    if access_key != creds.access_key:
+        raise S3Error("InvalidAccessKeyId")
+    if "host" not in signed_headers:
+        raise S3Error("AuthorizationHeaderMalformed", "host not signed")
+
+    amz_date = h.get("x-amz-date") or h.get("date", "")
+    ts = _parse_amz_date(amz_date)
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    if abs(now - ts) > MAX_SKEW:
+        raise S3Error("RequestTimeTooSkewed")
+
+    date = amz_date[:8]
+    want_scope = f"{date}/{creds.region}/s3/aws4_request"
+    if scope != want_scope:
+        raise S3Error("AuthorizationHeaderMalformed",
+                      f"scope {scope!r} != {want_scope!r}")
+
+    payload_hash = h.get("x-amz-content-sha256", UNSIGNED_PAYLOAD)
+    if payload_hash not in (UNSIGNED_PAYLOAD, STREAMING_PAYLOAD):
+        if body is not None and _sha256(body) != payload_hash:
+            raise S3Error("XAmzContentSHA256Mismatch")
+
+    canon = canonical_request(method, path, query, h, signed_headers,
+                              payload_hash)
+    sts = string_to_sign(amz_date, want_scope, canon)
+    want = hmac.new(signing_key(creds.secret_key, date, creds.region),
+                    sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, got_sig):
+        raise S3Error("SignatureDoesNotMatch")
+    return payload_hash
+
+
+def presign_url(creds: Credentials, method: str, path: str,
+                query: dict[str, list[str]], host: str, expires: int = 3600,
+                now: datetime.datetime | None = None) -> str:
+    """Generate a presigned URL (client side, for tests/tools)."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    scope = f"{date}/{creds.region}/s3/aws4_request"
+    q = {k: list(v) for k, v in query.items()}
+    q["X-Amz-Algorithm"] = [ALGORITHM]
+    q["X-Amz-Credential"] = [f"{creds.access_key}/{scope}"]
+    q["X-Amz-Date"] = [amz_date]
+    q["X-Amz-Expires"] = [str(expires)]
+    q["X-Amz-SignedHeaders"] = ["host"]
+    canon = canonical_request(method, path, q, {"host": host}, ["host"],
+                              UNSIGNED_PAYLOAD)
+    sts = string_to_sign(amz_date, scope, canon)
+    sig = hmac.new(signing_key(creds.secret_key, date, creds.region),
+                   sts.encode(), hashlib.sha256).hexdigest()
+    q["X-Amz-Signature"] = [sig]
+    qs = "&".join(f"{uri_encode(k)}={uri_encode(v[0])}" for k, v in q.items())
+    return f"{path}?{qs}"
+
+
+def verify_presigned(creds: Credentials, method: str, path: str,
+                     query: dict[str, list[str]], headers: dict[str, str],
+                     now: datetime.datetime | None = None) -> None:
+    """Verify a presigned (query-auth) request.
+    cf. doesPresignedSignatureMatch, cmd/signature-v4.go:208."""
+    q = {k: list(v) for k, v in query.items()}
+    try:
+        if q["X-Amz-Algorithm"][0] != ALGORITHM:
+            raise S3Error("AuthorizationQueryParametersError")
+        cred = q["X-Amz-Credential"][0]
+        amz_date = q["X-Amz-Date"][0]
+        expires = int(q["X-Amz-Expires"][0])
+        signed_headers = q["X-Amz-SignedHeaders"][0].split(";")
+        got_sig = q["X-Amz-Signature"][0]
+    except (KeyError, IndexError, ValueError):
+        raise S3Error("AuthorizationQueryParametersError") from None
+
+    access_key, _, scope = cred.partition("/")
+    if access_key != creds.access_key:
+        raise S3Error("InvalidAccessKeyId")
+    ts = _parse_amz_date(amz_date)
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    if now < ts - MAX_SKEW:
+        raise S3Error("RequestTimeTooSkewed")
+    if now > ts + datetime.timedelta(seconds=expires):
+        raise S3Error("ExpiredToken", "Request has expired")
+
+    date = amz_date[:8]
+    want_scope = f"{date}/{creds.region}/s3/aws4_request"
+    if scope != want_scope:
+        raise S3Error("AuthorizationQueryParametersError")
+    h = {k.lower(): v for k, v in headers.items()}
+    canon = canonical_request(method, path, q, h, signed_headers,
+                              UNSIGNED_PAYLOAD, drop_query=("X-Amz-Signature",))
+    sts = string_to_sign(amz_date, want_scope, canon)
+    want = hmac.new(signing_key(creds.secret_key, date, creds.region),
+                    sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, got_sig):
+        raise S3Error("SignatureDoesNotMatch")
+
+
+# -- aws-chunked streaming payload -------------------------------------------
+
+def decode_streaming_body(creds: Credentials, headers: dict[str, str],
+                          raw: bytes) -> bytes:
+    """Decode + verify a STREAMING-AWS4-HMAC-SHA256-PAYLOAD body.
+
+    Chunk framing: hex-size;chunk-signature=<sig>\r\n<data>\r\n ... with a
+    rolling signature chain seeded from the request signature
+    (cf. cmd/streaming-signature-v4.go).
+    """
+    h = {k.lower(): v for k, v in headers.items()}
+    auth = h.get("authorization", "")
+    _, scope, _, seed_sig = _parse_auth_header(auth)
+    amz_date = h.get("x-amz-date", "")
+    date = amz_date[:8]
+    region = scope.split("/")[1] if scope.count("/") >= 3 else creds.region
+    key = signing_key(creds.secret_key, date, region)
+
+    out = bytearray()
+    prev_sig = seed_sig
+    pos = 0
+    empty_hash = _sha256(b"")
+    while True:
+        nl = raw.find(b"\r\n", pos)
+        if nl < 0:
+            raise S3Error("IncompleteBody")
+        header = raw[pos:nl].decode("ascii", "replace")
+        size_hex, _, ext = header.partition(";")
+        try:
+            size = int(size_hex, 16)
+        except ValueError:
+            raise S3Error("IncompleteBody", "bad chunk size") from None
+        chunk_sig = ""
+        if ext.startswith("chunk-signature="):
+            chunk_sig = ext[len("chunk-signature="):]
+        data = raw[nl + 2:nl + 2 + size]
+        if len(data) != size:
+            raise S3Error("IncompleteBody")
+        sts = "\n".join([
+            "AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev_sig,
+            empty_hash, _sha256(data)])
+        want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, chunk_sig):
+            raise S3Error("SignatureDoesNotMatch", "chunk signature mismatch")
+        prev_sig = want
+        pos = nl + 2 + size
+        if raw[pos:pos + 2] == b"\r\n":
+            pos += 2
+        if size == 0:
+            break
+        out += data
+    return bytes(out)
+
+
+def encode_streaming_body(creds: Credentials, scope: str, amz_date: str,
+                          seed_sig: str, payload: bytes,
+                          chunk_size: int = 64 * 1024) -> bytes:
+    """Client-side aws-chunked encoder (tests)."""
+    date = amz_date[:8]
+    region = scope.split("/")[1]
+    key = signing_key(creds.secret_key, date, region)
+    empty_hash = _sha256(b"")
+    out = bytearray()
+    prev = seed_sig
+    chunks = [payload[i:i + chunk_size]
+              for i in range(0, len(payload), chunk_size)] + [b""]
+    for data in chunks:
+        sts = "\n".join(["AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev,
+                         empty_hash, _sha256(data)])
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        out += f"{len(data):x};chunk-signature={sig}\r\n".encode()
+        out += data + b"\r\n"
+        prev = sig
+    return bytes(out)
