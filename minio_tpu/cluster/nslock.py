@@ -1,0 +1,130 @@
+"""Namespace locks: per-(bucket, object) mutual exclusion, local or dist.
+
+The cmd/namespace-lock.go:224 equivalent: the engine asks for
+NSLockMap.new_lock(bucket, object) and gets either an in-process RW lock
+(standalone) or a dsync.DRWMutex over the set's lockers (distributed) —
+the same facade the reference swaps behind NewNSLock.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from .dsync import DRWMutex, LockLost
+
+
+class _LocalRWLock:
+    """Writer-preferring in-process RW lock (internal/lsync analogue)."""
+
+    def __init__(self):
+        self._mu = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_write(self, timeout: float) -> bool:
+        with self._mu:
+            self._writers_waiting += 1
+            try:
+                ok = self._mu.wait_for(
+                    lambda: not self._writer and self._readers == 0,
+                    timeout=timeout)
+                if not ok:
+                    return False
+                self._writer = True
+                return True
+            finally:
+                self._writers_waiting -= 1
+                # Readers wait on writers_waiting == 0; a writer that
+                # timed out must wake them or they stall needlessly.
+                self._mu.notify_all()
+
+    def release_write(self) -> None:
+        with self._mu:
+            self._writer = False
+            self._mu.notify_all()
+
+    def acquire_read(self, timeout: float) -> bool:
+        with self._mu:
+            ok = self._mu.wait_for(
+                lambda: not self._writer and self._writers_waiting == 0,
+                timeout=timeout)
+            if not ok:
+                return False
+            self._readers += 1
+            return True
+
+    def release_read(self) -> None:
+        with self._mu:
+            self._readers -= 1
+            self._mu.notify_all()
+
+
+class NSLockMap:
+    def __init__(self, lockers: list | None = None):
+        """lockers=None -> standalone (in-process locks); otherwise a
+        distributed map over the given (local+remote) lockers."""
+        self.lockers = lockers
+        # resource -> [lock, refcount]; entries are deleted at refcount 0
+        # (the reference refcounts nsLockMap entries the same way,
+        # cmd/namespace-lock.go) so the map doesn't grow with every key
+        # ever touched.
+        self._local: dict[str, list] = {}
+        self._mu = threading.Lock()
+
+    def _local_acquire(self, resource: str) -> _LocalRWLock:
+        with self._mu:
+            entry = self._local.setdefault(resource, [_LocalRWLock(), 0])
+            entry[1] += 1
+            return entry[0]
+
+    def _local_release(self, resource: str) -> None:
+        with self._mu:
+            entry = self._local.get(resource)
+            if entry is not None:
+                entry[1] -= 1
+                if entry[1] <= 0:
+                    del self._local[resource]
+
+    @contextmanager
+    def _locked(self, resource: str, write: bool, timeout: float):
+        if self.lockers is None:
+            lk = self._local_acquire(resource)
+            try:
+                ok = (lk.acquire_write(timeout) if write
+                      else lk.acquire_read(timeout))
+                if not ok:
+                    raise LockLost(resource)
+                try:
+                    yield
+                finally:
+                    if write:
+                        lk.release_write()
+                    else:
+                        lk.release_read()
+            finally:
+                self._local_release(resource)
+            return
+        lost = threading.Event()
+        dm = DRWMutex(resource, self.lockers,
+                      loss_callback=lambda r: lost.set())
+        ok = dm.get_lock(timeout) if write else dm.get_rlock(timeout)
+        if not ok:
+            raise LockLost(resource)
+        try:
+            yield
+        finally:
+            dm.unlock()
+        # The refresh loop lost quorum while the operation ran: another
+        # node may have acquired the lock mid-mutation, so the caller
+        # must treat the result as suspect (the reference cancels the op
+        # context via lockLossCallback, drwmutex.go:221).
+        if lost.is_set():
+            raise LockLost(f"{resource}: lock lost during operation")
+
+    def write_locked(self, bucket: str, obj: str, timeout: float = 10.0):
+        return self._locked(f"{bucket}/{obj}", True, timeout)
+
+    def read_locked(self, bucket: str, obj: str, timeout: float = 10.0):
+        return self._locked(f"{bucket}/{obj}", False, timeout)

@@ -61,7 +61,7 @@ class ErasureSet:
 
     def __init__(self, drives: list[LocalDrive | None],
                  default_parity: int | None = None,
-                 set_index: int = 0):
+                 set_index: int = 0, nslock=None):
         self.drives = list(drives)
         self.n = len(drives)
         if self.n < 2:
@@ -72,6 +72,14 @@ class ErasureSet:
         self.pool = ThreadPoolExecutor(max_workers=max(self.n, 4))
         self._codec_cache: dict[tuple[int, int], ReedSolomonTPU] = {}
         self._cpu_cache: dict[tuple[int, int], ReedSolomonCPU] = {}
+        # Namespace locks guard object mutations (cf. NSLock use at
+        # cmd/erasure-object.go:930). Standalone default: in-process RW
+        # locks; a distributed deployment injects an NSLockMap over the
+        # set's (local+remote) lockers (cluster/nslock.py).
+        if nslock is None:
+            from ..cluster.nslock import NSLockMap
+            nslock = NSLockMap()
+        self.nslock = nslock
 
     # -- codec helpers -------------------------------------------------------
 
@@ -159,6 +167,14 @@ class ErasureSet:
         """
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
+        with self.nslock.write_locked(bucket, obj):
+            return self._put_object_locked(bucket, obj, data,
+                                           metadata=metadata,
+                                           versioned=versioned,
+                                           parity=parity)
+
+    def _put_object_locked(self, bucket, obj, data, *, metadata,
+                           versioned, parity) -> FileInfo:
         parity = self.default_parity if parity is None else parity
         # Parity upgrade: offline drives become parity so the write keeps
         # full reconstruction capability (cf. erasure-object.go:766-800).
@@ -642,6 +658,12 @@ class ErasureSet:
         (cf. DeleteObject, /root/reference/cmd/erasure-object.go:1038)."""
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
+        with self.nslock.write_locked(bucket, obj):
+            return self._delete_object_locked(bucket, obj, version_id,
+                                              versioned)
+
+    def _delete_object_locked(self, bucket, obj, version_id="",
+                              versioned=False) -> FileInfo | None:
         write_quorum = self.n // 2 + 1
         if versioned and version_id == "":
             dm = FileInfo(volume=bucket, name=obj, version_id=new_uuid(),

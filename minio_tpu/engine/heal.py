@@ -165,8 +165,11 @@ def heal_object(es: ErasureSet, bucket: str, obj: str, version_id: str = "",
             # No drive has any metadata: nothing to heal (or the object is
             # gone); mirror the reference's not-found no-op.
             return []
-    return [_heal_version(es, bucket, obj, vid, deep, dry_run,
-                          remove_dangling) for vid in vids]
+    # Heal mutates shard files + metadata: same write lock as PUT/DELETE
+    # (cf. NSLock in healObject, cmd/erasure-healing.go:276).
+    with es.nslock.write_locked(bucket, obj, timeout=30.0):
+        return [_heal_version(es, bucket, obj, vid, deep, dry_run,
+                              remove_dangling) for vid in vids]
 
 
 def _heal_version(es: ErasureSet, bucket: str, obj: str, version_id: str,

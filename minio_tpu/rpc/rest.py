@@ -1,0 +1,209 @@
+"""RPC core: msgpack-over-HTTP POST with bearer auth + health checking.
+
+The internal/rest equivalent (/root/reference/internal/rest/client.go:76,126):
+every RPC is POST /rpc/v{N}/{method} with an msgpack body and a bearer
+token; the client runs a background health-check loop that flips the
+endpoint online/offline (consulted before use, so a dead peer costs one
+failed call, not one per request), with a NetworkError taxonomy distinct
+from application errors.
+
+Wire format: request body msgpack map; response 200 + msgpack payload, or
+5xx/4xx + msgpack {"err": <storage error class>, "msg": ...} re-raised
+as the matching exception class on the client (the analogue of the
+reference's errors-over-the-wire string table,
+cmd/storage-rest-server.go).
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..storage import errors as se
+from ..utils import msgpackx
+
+RPC_VERSION = "v1"
+HEALTH_METHOD = "health"
+_ERR_CLASSES = {
+    name: cls for name, cls in vars(se).items()
+    if isinstance(cls, type) and issubclass(cls, se.StorageError)}
+
+
+class NetworkError(Exception):
+    """Transport-level failure (connect/timeout/HTTP) — NOT an application
+    error; quorum logic treats these as drive-offline."""
+
+
+def pack_error(e: Exception) -> bytes:
+    return msgpackx.packb({"err": type(e).__name__, "msg": str(e)})
+
+
+def unpack_error(data: bytes) -> Exception:
+    try:
+        obj = msgpackx.unpackb(data)
+        cls = _ERR_CLASSES.get(obj.get("err", ""), se.StorageError)
+        return cls(obj.get("msg", ""))
+    except Exception:  # noqa: BLE001
+        return se.StorageError(data[:200])
+
+
+class RPCServer:
+    """Serves a method table over HTTP. Methods get (payload dict) and
+    return a msgpack-able object; raising a StorageError maps to a typed
+    error response."""
+
+    def __init__(self, token: str, host: str = "127.0.0.1", port: int = 0):
+        self.token = token
+        self._methods: dict[str, callable] = {HEALTH_METHOD: lambda p: {"ok": True}}
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                import hmac as _hmac
+                got = self.headers.get("Authorization", "")
+                want = f"Bearer {outer.token}"
+                if not _hmac.compare_digest(got, want):
+                    self._reply(403, pack_error(
+                        se.ErrFileAccessDenied("bad rpc token")))
+                    return
+                prefix = f"/rpc/{RPC_VERSION}/"
+                if not self.path.startswith(prefix):
+                    self._reply(404, pack_error(
+                        se.StorageError(f"no such path {self.path}")))
+                    return
+                method = self.path[len(prefix):]
+                fn = outer._methods.get(method)
+                if fn is None:
+                    self._reply(404, pack_error(
+                        se.StorageError(f"no such method {method}")))
+                    return
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                try:
+                    payload = msgpackx.unpackb(body) if body else {}
+                    result = fn(payload)
+                    self._reply(200, msgpackx.packb(result))
+                except se.StorageError as e:
+                    self._reply(500, pack_error(e))
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, pack_error(se.StorageError(
+                        f"{type(e).__name__}: {e}")))
+
+            def _reply(self, status: int, body: bytes):
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Type", "application/msgpack")
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = host, self._httpd.server_port
+        self._thread: threading.Thread | None = None
+
+    def register(self, name: str, fn) -> None:
+        self._methods[name] = fn
+
+    def start(self) -> "RPCServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class RPCClient:
+    """POST caller with online/offline health state.
+
+    A failed call marks the endpoint offline immediately; the background
+    checker (started lazily) probes `health` every `check_interval`
+    seconds and flips it back online when the peer answers
+    (cf. internal/rest/client.go:76-124).
+    """
+
+    def __init__(self, endpoint: str, token: str, timeout: float = 10.0,
+                 check_interval: float = 1.0):
+        host, _, port = endpoint.partition(":")
+        self.host, self.port = host, int(port)
+        self.token = token
+        self.timeout = timeout
+        self.check_interval = check_interval
+        self._online = True
+        self._checker_running = False
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- health --------------------------------------------------------------
+
+    def is_online(self) -> bool:
+        return self._online
+
+    def _mark_offline(self) -> None:
+        with self._lock:
+            if self._online:
+                self._online = False
+            if not self._checker_running and not self._closed:
+                self._checker_running = True
+                threading.Thread(target=self._health_loop,
+                                 daemon=True).start()
+
+    def _health_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self.check_interval)
+            try:
+                self._raw_call(HEALTH_METHOD, {}, timeout=2.0)
+                with self._lock:
+                    self._online = True
+                    self._checker_running = False
+                return
+            except (NetworkError, se.StorageError):
+                continue
+
+    def close(self) -> None:
+        self._closed = True
+
+    # -- calls ---------------------------------------------------------------
+
+    def _raw_call(self, method: str, payload: dict,
+                  timeout: float | None = None) -> object:
+        body = msgpackx.packb(payload)
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout or self.timeout)
+        try:
+            conn.request("POST", f"/rpc/{RPC_VERSION}/{method}", body=body,
+                         headers={"Authorization": f"Bearer {self.token}",
+                                  "Content-Type": "application/msgpack"})
+            resp = conn.getresponse()
+            data = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise NetworkError(f"{self.host}:{self.port} {method}: {e}") \
+                from None
+        finally:
+            conn.close()
+        if resp.status != 200:
+            raise unpack_error(data)
+        return msgpackx.unpackb(data) if data else None
+
+    def call(self, method: str, payload: dict | None = None) -> object:
+        """RPC with offline short-circuit (a StorageError from the peer
+        does NOT mark it offline — only transport failures do)."""
+        if not self._online:
+            raise NetworkError(f"{self.host}:{self.port} is offline")
+        try:
+            return self._raw_call(method, payload or {})
+        except NetworkError:
+            self._mark_offline()
+            raise

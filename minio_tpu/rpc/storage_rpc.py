@@ -1,0 +1,105 @@
+"""Storage RPC: serve local drives to peers; RemoteDrive client.
+
+The storage-REST plane equivalent (/root/reference/cmd/storage-rest-server.go:1138,
+cmd/storage-rest-client.go): every node serves its local drives, full-mesh;
+RemoteDrive implements the same method surface as storage.drive.LocalDrive,
+so the erasure engine fans out to local and remote drives identically
+(drive position in the stripe, not locality, is what matters).
+
+Methods carry (drive_idx, args...) msgpack payloads; FileInfo rides as
+its to_obj() map. Streaming shard I/O (append_file/read_file) moves raw
+bytes in the msgpack body — one hop, no extra framing.
+"""
+
+from __future__ import annotations
+
+from ..storage.drive import LocalDrive
+from ..storage.errors import ErrDiskNotFound
+from ..storage.xlmeta import FileInfo
+from .rest import NetworkError, RPCClient, RPCServer
+
+_DRIVE_METHODS = [
+    "make_volume", "list_volumes", "stat_volume", "delete_volume",
+    "write_all", "read_all", "delete", "create_file", "append_file",
+    "read_file", "rename_file", "file_size", "read_version",
+    "write_metadata", "update_metadata", "rename_data", "delete_version",
+    "list_dir", "walk_dir", "verify_file", "disk_info", "get_disk_id",
+    "list_raw", "clear_tmp",
+]
+
+
+def register_storage_rpc(server: RPCServer, drives: list[LocalDrive]) -> None:
+    """Expose `drives` (this node's local drives) on an RPCServer."""
+
+    def make_handler(method: str):
+        def handler(payload: dict):
+            idx = payload.get("drive", 0)
+            if not 0 <= idx < len(drives):
+                raise ErrDiskNotFound(f"drive {idx}")
+            args = payload.get("args", [])
+            kwargs = payload.get("kwargs", {})
+            # FileInfo args arrive as {"__fi__": obj, "vol":, "name":}
+            # markers (to_obj drops the volume/name path context).
+            args = [FileInfo.from_obj(a["__fi__"], a.get("vol", ""),
+                                      a.get("name", ""))
+                    if isinstance(a, dict) and "__fi__" in a else a
+                    for a in args]
+            result = getattr(drives[idx], method)(*args, **kwargs)
+            if isinstance(result, FileInfo):
+                return {"__fi__": result.to_obj(), "vol": result.volume,
+                        "name": result.name}
+            if method == "walk_dir":
+                return [[name, raw] for name, raw in result]
+            return result
+        return handler
+
+    for m in _DRIVE_METHODS:
+        server.register(f"storage.{m}", make_handler(m))
+
+
+class RemoteDrive:
+    """A peer's drive, with the LocalDrive method surface.
+
+    Transport failures surface as ErrDiskNotFound so quorum logic treats
+    a dead peer exactly like a pulled drive; `is_online()` delegates to
+    the client's health state for the topology monitor.
+    """
+
+    def __init__(self, client: RPCClient, drive_idx: int, path: str = ""):
+        self._client = client
+        self._idx = drive_idx
+        # Engine identity string (endpoint/path) for logs & format checks.
+        self.path = path or f"{client.host}:{client.port}/drive{drive_idx}"
+
+    def is_online(self) -> bool:
+        return self._client.is_online()
+
+    def _call(self, method: str, *args, **kwargs):
+        wire_args = [
+            {"__fi__": a.to_obj(), "vol": a.volume, "name": a.name}
+            if isinstance(a, FileInfo) else a for a in args]
+        try:
+            result = self._client.call(
+                f"storage.{method}",
+                {"drive": self._idx, "args": wire_args, "kwargs": kwargs})
+        except NetworkError as e:
+            raise ErrDiskNotFound(str(e)) from None
+        if isinstance(result, dict) and "__fi__" in result:
+            return FileInfo.from_obj(result["__fi__"], result.get("vol", ""),
+                                     result.get("name", ""))
+        return result
+
+
+def _add_method(name: str):
+    def method(self, *args, **kwargs):
+        result = self._call(name, *args, **kwargs)
+        if name == "walk_dir":
+            return [(n, raw) for n, raw in result]
+        return result
+    method.__name__ = name
+    setattr(RemoteDrive, name, method)
+
+
+for _m in _DRIVE_METHODS:
+    _add_method(_m)
+del _m

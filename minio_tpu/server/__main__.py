@@ -55,11 +55,14 @@ def main(argv: list[str] | None = None) -> int:
     print(f"minio_tpu server on {srv.endpoint} "
           f"({len(paths)} drives, set={sets.set_drive_count})", flush=True)
 
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
     try:
-        while not stop:
-            signal.pause()
+        # Event.wait is race-free against a signal arriving between the
+        # check and the sleep (unlike signal.pause()).
+        while not stop.wait(timeout=1.0):
+            pass
     except KeyboardInterrupt:
         pass
     srv.shutdown()

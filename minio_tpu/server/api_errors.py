@@ -85,9 +85,14 @@ class S3Error(Exception):
 def from_storage_error(e: Exception) -> S3Error:
     """Map engine/storage exceptions to API errors
     (cf. toAPIErrorCode, cmd/api-errors.go)."""
+    from ..cluster.dsync import LockLost
     from ..engine import multipart as mp
     if isinstance(e, S3Error):
         return e
+    if isinstance(e, LockLost):
+        # Lock contention/loss is retryable, not a server fault
+        # (the reference maps lock timeouts to 503).
+        return S3Error("SlowDown", str(e))
     if isinstance(e, se.ErrBucketNotFound):
         return S3Error("NoSuchBucket")
     if isinstance(e, se.ErrBucketExists):

@@ -41,13 +41,17 @@ class S3Client:
         q = {k: [v] for k, v in (query or {}).items()}
         headers = dict(headers or {})
         headers["Host"] = f"{self.host}:{self.port}"
+        # Sign over the DECODED path; send the percent-encoded form on the
+        # wire (keys with spaces/non-ASCII would otherwise break the
+        # request line and the signature).
+        wire_path = urllib.parse.quote(path, safe="/~-._")
         if raw_query is None:
             auth = sign_request(self.creds, method, path, q, headers, body)
             headers.update(auth)
             qs = urllib.parse.urlencode({k: v[0] for k, v in q.items()})
-            url = path + ("?" + qs if qs else "")
+            url = wire_path + ("?" + qs if qs else "")
         else:
-            url = path + "?" + raw_query
+            url = wire_path + "?" + raw_query
         conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
         try:
             conn.request(method, url, body=body, headers=headers)

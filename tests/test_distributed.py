@@ -1,0 +1,315 @@
+"""Distributed plane tests: RPC loopback, dsync quorum, mixed-drive sets.
+
+Mirrors the reference's strategy of testing distribution without a real
+cluster (SURVEY.md §4): N in-process lock servers over real HTTP for
+dsync (dsync-server_test.go analogue), and a storage-RPC loopback where
+an erasure set stripes across 2 local + 2 REMOTE drives served from the
+same process (storage-rest_test.go analogue).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.cluster.dsync import DRWMutex
+from minio_tpu.cluster.local_locker import LocalLocker
+from minio_tpu.cluster.nslock import NSLockMap
+from minio_tpu.engine.erasure_set import ErasureSet
+from minio_tpu.rpc.lock_rpc import RemoteLocker, register_lock_rpc
+from minio_tpu.rpc.rest import NetworkError, RPCClient, RPCServer
+from minio_tpu.rpc.storage_rpc import RemoteDrive, register_storage_rpc
+from minio_tpu.storage.drive import LocalDrive
+from minio_tpu.storage.errors import ErrDiskNotFound, ErrFileNotFound
+
+TOKEN = "test-cluster-token"
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# RPC core
+# ---------------------------------------------------------------------------
+
+class TestRPCCore:
+    def test_call_roundtrip_and_typed_errors(self):
+        srv = RPCServer(TOKEN).start()
+        srv.register("echo", lambda p: {"got": p.get("x")})
+
+        def boom(p):
+            raise ErrFileNotFound("nope")
+        srv.register("boom", boom)
+        try:
+            cli = RPCClient(srv.endpoint, TOKEN)
+            assert cli.call("echo", {"x": [1, "two", b"three"]}) == \
+                {"got": [1, "two", b"three"]}
+            with pytest.raises(ErrFileNotFound):
+                cli.call("boom")
+            # app errors do NOT mark the peer offline
+            assert cli.is_online()
+        finally:
+            srv.shutdown()
+
+    def test_bad_token_rejected(self):
+        srv = RPCServer(TOKEN).start()
+        try:
+            cli = RPCClient(srv.endpoint, "wrong")
+            from minio_tpu.storage.errors import StorageError
+            with pytest.raises(StorageError):
+                cli.call("health")
+        finally:
+            srv.shutdown()
+
+    def test_offline_detection_and_recovery(self):
+        srv = RPCServer(TOKEN).start()
+        port = srv.port
+        cli = RPCClient(srv.endpoint, TOKEN, check_interval=0.1)
+        assert cli.call("health")["ok"]
+        srv.shutdown()
+        with pytest.raises(NetworkError):
+            cli.call("health")
+        assert not cli.is_online()
+        # second call short-circuits without touching the network
+        with pytest.raises(NetworkError):
+            cli.call("health")
+        # bring a server back on the SAME port; checker flips us online
+        srv2 = RPCServer(TOKEN, port=port).start()
+        try:
+            deadline = time.monotonic() + 5
+            while not cli.is_online() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert cli.is_online()
+            assert cli.call("health")["ok"]
+        finally:
+            cli.close()
+            srv2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# dsync over real HTTP lock servers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def lock_cluster():
+    servers, lockers, clients = [], [], []
+    for _ in range(5):
+        locker = LocalLocker(stale_after=2.0)
+        srv = RPCServer(TOKEN).start()
+        register_lock_rpc(srv, locker)
+        cli = RPCClient(srv.endpoint, TOKEN, check_interval=0.1)
+        servers.append(srv)
+        lockers.append(locker)
+        clients.append(RemoteLocker(cli))
+    yield servers, lockers, clients
+    for s in servers:
+        s.shutdown()
+
+
+class TestDsync:
+    def test_exclusive_write_lock(self, lock_cluster):
+        _, _, remote = lock_cluster
+        a = DRWMutex("bkt/obj", remote)
+        b = DRWMutex("bkt/obj", remote)
+        assert a.get_lock(timeout=2)
+        assert not b.get_lock(timeout=0.5)
+        a.unlock()
+        assert b.get_lock(timeout=2)
+        b.unlock()
+
+    def test_shared_read_locks_block_writer(self, lock_cluster):
+        _, _, remote = lock_cluster
+        r1 = DRWMutex("bkt/o2", remote)
+        r2 = DRWMutex("bkt/o2", remote)
+        w = DRWMutex("bkt/o2", remote)
+        assert r1.get_rlock(timeout=2)
+        assert r2.get_rlock(timeout=2)
+        assert not w.get_lock(timeout=0.5)
+        r1.unlock()
+        r2.unlock()
+        assert w.get_lock(timeout=2)
+        w.unlock()
+
+    def test_quorum_survives_minority_servers_down(self, lock_cluster):
+        servers, _, remote = lock_cluster
+        servers[0].shutdown()
+        servers[1].shutdown()
+        m = DRWMutex("bkt/o3", remote)
+        assert m.get_lock(timeout=3)      # 3 of 5 still a write quorum
+        m.unlock()
+
+    def test_no_quorum_majority_down(self, lock_cluster):
+        servers, _, remote = lock_cluster
+        for s in servers[:3]:
+            s.shutdown()
+        m = DRWMutex("bkt/o4", remote)
+        assert not m.get_lock(timeout=1.0)
+
+    def test_stale_lock_swept_after_owner_dies(self, lock_cluster):
+        _, lockers, remote = lock_cluster
+        m = DRWMutex("bkt/o5", remote, refresh_interval=100)
+        assert m.get_lock(timeout=2)
+        m._stop_refresh.set()             # owner "crashes": no more refresh
+        time.sleep(2.2)                    # > stale_after on the lockers
+        m2 = DRWMutex("bkt/o5", remote)
+        assert m2.get_lock(timeout=2)
+        m2.unlock()
+
+    def test_refresh_loss_callback(self, lock_cluster):
+        servers, _, remote = lock_cluster
+        lost = threading.Event()
+        m = DRWMutex("bkt/o6", remote, refresh_interval=0.2,
+                     loss_callback=lambda r: lost.set())
+        assert m.get_lock(timeout=2)
+        for s in servers:                  # total cluster outage
+            s.shutdown()
+        assert lost.wait(timeout=5), "loss callback not fired"
+
+
+class TestNSLock:
+    def test_local_write_mutual_exclusion(self):
+        ns = NSLockMap()
+        order = []
+        with ns.write_locked("b", "o"):
+            t = threading.Thread(
+                target=lambda: (ns.write_locked("b", "o").__enter__(),
+                                order.append("second")))
+            done = threading.Event()
+
+            def second():
+                with ns.write_locked("b", "o"):
+                    order.append("second")
+                done.set()
+            t = threading.Thread(target=second)
+            t.start()
+            time.sleep(0.1)
+            order.append("first")
+        assert done.wait(2)
+        assert order == ["first", "second"]
+
+    def test_local_readers_shared(self):
+        ns = NSLockMap()
+        with ns.read_locked("b", "o"):
+            with ns.read_locked("b", "o"):
+                pass
+
+    def test_distributed_mode(self, lock_cluster):
+        _, _, remote = lock_cluster
+        ns = NSLockMap(lockers=remote)
+        with ns.write_locked("b", "o7"):
+            other = DRWMutex("b/o7", remote)
+            assert not other.get_lock(timeout=0.3)
+
+
+# ---------------------------------------------------------------------------
+# storage RPC: erasure set striping across local + remote drives
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def mixed_set(tmp_path):
+    """2 local drives + 2 drives served over real HTTP from the same
+    process — the single-process cluster trick (SURVEY.md §4)."""
+    local = [LocalDrive(str(tmp_path / f"local{i}")) for i in range(2)]
+    served = [LocalDrive(str(tmp_path / f"served{i}")) for i in range(2)]
+    srv = RPCServer(TOKEN).start()
+    register_storage_rpc(srv, served)
+    cli = RPCClient(srv.endpoint, TOKEN, check_interval=0.1)
+    remote = [RemoteDrive(cli, i) for i in range(2)]
+    es = ErasureSet(local + remote, default_parity=2)
+    yield es, srv, served
+    srv.shutdown()
+
+
+class TestStorageRPC:
+    def test_put_get_across_wire(self, mixed_set):
+        es, _, served = mixed_set
+        es.make_bucket("dist")
+        data = payload(300000, seed=4)
+        es.put_object("dist", "obj", data)
+        _, got = es.get_object("dist", "obj")
+        assert got == data
+        # the remote drives really hold shards (went over HTTP)
+        assert served[0].file_size("dist", "obj/" + es.head_object(
+            "dist", "obj").data_dir + "/part.1") > 0
+
+    def test_remote_failure_degrades_not_fails(self, mixed_set):
+        es, srv, _ = mixed_set
+        es.make_bucket("dist")
+        data = payload(200000, seed=5)
+        es.put_object("dist", "obj2", data)
+        srv.shutdown()                     # both remote drives vanish
+        _, got = es.get_object("dist", "obj2")   # k=2 local shards remain
+        assert got == data
+
+    def test_remote_inline_and_metadata(self, mixed_set):
+        es, _, served = mixed_set
+        es.make_bucket("dist")
+        es.put_object("dist", "small", b"tiny inline object")
+        _, got = es.get_object("dist", "small")
+        assert got == b"tiny inline object"
+        fi = served[1].read_version("dist", "small")
+        assert fi.inline_data is not None
+
+
+# ---------------------------------------------------------------------------
+# peer RPC / NotificationSys / bootstrap verify
+# ---------------------------------------------------------------------------
+
+class TestPeerPlane:
+    def test_notification_fan_out_reload(self):
+        from minio_tpu.rpc.peer_rpc import (NotificationSys, PeerRegistry,
+                                            register_peer_rpc)
+        servers, clients, hits = [], [], []
+        for i in range(3):
+            reg = PeerRegistry()
+            reg.on_reload("iam", lambda i=i: hits.append(i))
+            srv = RPCServer(TOKEN).start()
+            register_peer_rpc(srv, reg)
+            servers.append(srv)
+            clients.append(RPCClient(srv.endpoint, TOKEN))
+        try:
+            ns = NotificationSys(clients)
+            assert ns.reload_subsystem("iam") == 3
+            assert sorted(hits) == [0, 1, 2]
+            assert ns.reload_subsystem("unknown") == 0
+            infos = ns.server_info()
+            assert all(i and "uptime_s" in i for i in infos)
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_fan_out_tolerates_dead_peer(self):
+        from minio_tpu.rpc.peer_rpc import (NotificationSys, PeerRegistry,
+                                            register_peer_rpc)
+        reg = PeerRegistry()
+        reg.on_reload("cfg", lambda: None)
+        s1 = RPCServer(TOKEN).start()
+        register_peer_rpc(s1, reg)
+        s2 = RPCServer(TOKEN).start()
+        register_peer_rpc(s2, PeerRegistry())
+        c1, c2 = RPCClient(s1.endpoint, TOKEN), RPCClient(s2.endpoint, TOKEN)
+        s2.shutdown()
+        try:
+            ns = NotificationSys([c1, c2])
+            assert ns.reload_subsystem("cfg") == 1
+        finally:
+            s1.shutdown()
+
+    def test_bootstrap_verify_detects_mismatch(self):
+        from minio_tpu.rpc.peer_rpc import (register_bootstrap_rpc,
+                                            verify_cluster_config)
+        srv = RPCServer(TOKEN).start()
+        register_bootstrap_rpc(srv, {"deployment_id": "abc", "n_sets": 2})
+        cli = RPCClient(srv.endpoint, TOKEN)
+        try:
+            ok = verify_cluster_config([cli],
+                                       {"deployment_id": "abc", "n_sets": 2})
+            assert ok == []
+            bad = verify_cluster_config([cli],
+                                        {"deployment_id": "zzz", "n_sets": 2})
+            assert len(bad) == 1
+        finally:
+            srv.shutdown()

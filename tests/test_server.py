@@ -278,3 +278,13 @@ class TestAuth:
         from minio_tpu.server.api_errors import S3Error
         with pytest.raises(S3Error):
             decode_streaming_body(creds, headers, bad)
+
+
+class TestKeyEncoding:
+    def test_unicode_and_space_keys(self, cli):
+        cli.make_bucket("enc")
+        for key in ("a b/c d.txt", "ünïcode/κλειδί", "pct%41key"):
+            cli.put_object("enc", key, key.encode())
+            assert cli.get_object("enc", key) == key.encode()
+        keys, _ = cli.list_objects("enc", prefix="a b/")
+        assert keys == ["a b/c d.txt"]
