@@ -1,0 +1,160 @@
+"""AWS-style policy documents + evaluation.
+
+The policy-engine role of github.com/minio/pkg/iam/policy in the
+reference (used by IAMSys.IsAllowed, cmd/iam.go:206): JSON documents of
+Statements with Effect/Action/Resource/Condition, wildcard matching, and
+explicit-deny-wins evaluation. Canned policies mirror the reference's
+readonly/readwrite/writeonly/diagnostics set.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+
+
+class PolicyError(ValueError):
+    pass
+
+
+def _as_list(v) -> list:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _match(pattern: str, value: str) -> bool:
+    """AWS wildcard match: * and ? (case-sensitive)."""
+    return fnmatch.fnmatchcase(value, pattern)
+
+
+class Statement:
+    def __init__(self, d: dict):
+        self.effect = d.get("Effect", "")
+        if self.effect not in ("Allow", "Deny"):
+            raise PolicyError(f"bad Effect {self.effect!r}")
+        self.actions = [a for a in _as_list(d.get("Action"))]
+        self.not_actions = [a for a in _as_list(d.get("NotAction"))]
+        self.resources = [r.removeprefix("arn:aws:s3:::")
+                          for r in _as_list(d.get("Resource"))]
+        self.conditions = d.get("Condition", {}) or {}
+        if not self.actions and not self.not_actions:
+            raise PolicyError("statement without Action")
+
+    def matches_action(self, action: str) -> bool:
+        if self.not_actions:
+            return not any(_match(p, action) for p in self.not_actions)
+        return any(_match(p, action) for p in self.actions)
+
+    def matches_resource(self, resource: str) -> bool:
+        if not self.resources:
+            return True       # bucket-less actions (ListAllMyBuckets)
+        return any(_match(p, resource) for p in self.resources)
+
+    def matches_conditions(self, ctx: dict) -> bool:
+        """Subset of AWS condition operators over request context keys
+        (e.g. {"StringEquals": {"s3:prefix": ["a/"]}})."""
+        for op, kv in self.conditions.items():
+            for key, want in kv.items():
+                got = ctx.get(key)
+                want = [str(w) for w in _as_list(want)]
+                if op == "StringEquals":
+                    if got is None or str(got) not in want:
+                        return False
+                elif op == "StringNotEquals":
+                    if got is not None and str(got) in want:
+                        return False
+                elif op == "StringLike":
+                    if got is None or not any(_match(w, str(got))
+                                              for w in want):
+                        return False
+                elif op in ("IpAddress", "NotIpAddress"):
+                    import ipaddress
+                    if got is None:
+                        return False
+                    try:
+                        ip = ipaddress.ip_address(str(got))
+                        hit = any(ip in ipaddress.ip_network(w, strict=False)
+                                  for w in want)
+                    except ValueError:
+                        return False
+                    if op == "IpAddress" and not hit:
+                        return False
+                    if op == "NotIpAddress" and hit:
+                        return False
+                else:
+                    return False          # unknown operator: fail closed
+        return True
+
+
+class Policy:
+    def __init__(self, doc: dict | str):
+        if isinstance(doc, str):
+            doc = json.loads(doc)
+        self.version = doc.get("Version", "2012-10-17")
+        self.statements = [Statement(s)
+                           for s in _as_list(doc.get("Statement"))]
+        self.doc = doc
+
+    def is_allowed(self, action: str, resource: str,
+                   ctx: dict | None = None) -> bool:
+        """Explicit Deny wins; else any Allow; default deny."""
+        ctx = ctx or {}
+        allowed = False
+        for st in self.statements:
+            if not (st.matches_action(action)
+                    and st.matches_resource(resource)
+                    and st.matches_conditions(ctx)):
+                continue
+            if st.effect == "Deny":
+                return False
+            allowed = True
+        return allowed
+
+    def to_json(self) -> str:
+        return json.dumps(self.doc)
+
+
+def merge_allowed(policies: list[Policy], action: str, resource: str,
+                  ctx: dict | None = None) -> bool:
+    """Multiple attached policies: any explicit deny in any policy wins."""
+    ctx = ctx or {}
+    allowed = False
+    for p in policies:
+        for st in p.statements:
+            if not (st.matches_action(action)
+                    and st.matches_resource(resource)
+                    and st.matches_conditions(ctx)):
+                continue
+            if st.effect == "Deny":
+                return False
+            allowed = True
+    return allowed
+
+
+# -- canned policies (cf. the reference's built-in policy set) ---------------
+
+READ_WRITE = Policy({
+    "Version": "2012-10-17",
+    "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                   "Resource": ["arn:aws:s3:::*"]}]})
+
+READ_ONLY = Policy({
+    "Version": "2012-10-17",
+    "Statement": [{"Effect": "Allow",
+                   "Action": ["s3:GetObject", "s3:GetObjectVersion",
+                              "s3:ListBucket", "s3:ListBucketVersions",
+                              "s3:GetBucketLocation",
+                              "s3:ListAllMyBuckets"],
+                   "Resource": ["arn:aws:s3:::*"]}]})
+
+WRITE_ONLY = Policy({
+    "Version": "2012-10-17",
+    "Statement": [{"Effect": "Allow",
+                   "Action": ["s3:PutObject", "s3:DeleteObject",
+                              "s3:AbortMultipartUpload",
+                              "s3:ListMultipartUploadParts"],
+                   "Resource": ["arn:aws:s3:::*"]}]})
+
+CANNED = {"readwrite": READ_WRITE, "readonly": READ_ONLY,
+          "writeonly": WRITE_ONLY}

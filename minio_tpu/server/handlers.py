@@ -428,9 +428,13 @@ class S3Handlers:
                  "x-amz-delete-marker": "true"}
         return Response(204, headers=h)
 
-    def delete_objects(self, bucket: str, body: bytes) -> Response:
+    def delete_objects(self, bucket: str, body: bytes,
+                       can_delete=None) -> Response:
         """POST /bucket?delete — multi-object delete
-        (cf. DeleteMultipleObjectsHandler, cmd/bucket-handlers.go)."""
+        (cf. DeleteMultipleObjectsHandler, cmd/bucket-handlers.go).
+        `can_delete(key, version_id) -> bool` authorizes each key
+        individually — a bucket-level check would bypass object-path
+        Deny statements."""
         self.head_bucket(bucket)
         try:
             root = ET.fromstring(body)
@@ -445,6 +449,12 @@ class S3Handlers:
             key = obj.findtext("Key") or obj.findtext(f"{{{S3_NS}}}Key") or ""
             vid = obj.findtext("VersionId") or \
                 obj.findtext(f"{{{S3_NS}}}VersionId") or ""
+            if can_delete is not None and not can_delete(key, vid):
+                ee = _el(out, "Error")
+                _el(ee, "Key", key)
+                _el(ee, "Code", "AccessDenied")
+                _el(ee, "Message", "Access Denied.")
+                continue
             try:
                 self.pools.delete_object(bucket, key, vid, versioned)
                 if not quiet:

@@ -32,9 +32,10 @@ class S3Server:
 
     def __init__(self, pools: ServerPools, creds: Credentials,
                  host: str = "127.0.0.1", port: int = 0,
-                 trace_sink=None):
+                 trace_sink=None, iam=None):
         self.pools = pools
-        self.creds = creds
+        self.creds = creds                 # root credentials (policy bypass)
+        self.iam = iam                     # IAMSys | None
         self.handlers = S3Handlers(pools)
         self.trace_sink = trace_sink
         outer = self
@@ -120,26 +121,110 @@ class S3Server:
             return bytes(out)
         return b""
 
-    def _authenticate(self, req, path: str, query: dict) -> bytes:
-        """Classify + verify auth; returns the (decoded) request body.
+    def _lookup_creds(self, access_key: str) -> Credentials | None:
+        """Root first, then IAM identities (users/service/STS)."""
+        if access_key == self.creds.access_key:
+            return self.creds
+        if self.iam is not None:
+            ident = self.iam.lookup(access_key)
+            if ident is not None:
+                return Credentials(ident.access_key, ident.secret_key,
+                                   self.creds.region)
+        return None
+
+    def _authenticate(self, req, path: str,
+                      query: dict) -> tuple[bytes, str]:
+        """Classify + verify auth; returns (decoded body, access_key).
         cf. checkRequestAuthType, cmd/auth-handler.go:281."""
         headers = {k: v for k, v in req.headers.items()}
         headers.setdefault("Host", f"{self.host}:{self.port}")
         body = self._read_body(req)
         if "X-Amz-Signature" in query:
-            verify_presigned(self.creds, req.command, path, query, headers)
-            return body
+            ak = verify_presigned(self._lookup_creds, req.command, path,
+                                  query, headers)
+            self._check_session_token(
+                ak, query.get("X-Amz-Security-Token", [""])[0])
+            return body, ak
         auth = req.headers.get("Authorization", "")
         if not auth:
             raise S3Error("AccessDenied", "anonymous access is disabled")
-        payload_decl = verify_header_signature(
-            self.creds, req.command, path, query, headers, body)
+        payload_decl, ak = verify_header_signature(
+            self._lookup_creds, req.command, path, query, headers, body)
+        self._check_session_token(
+            ak, req.headers.get("x-amz-security-token", ""))
         if payload_decl == STREAMING_PAYLOAD:
-            body = decode_streaming_body(self.creds, headers, body)
-        return body
+            body = decode_streaming_body(self._lookup_creds, headers, body)
+        return body, ak
+
+    def _check_session_token(self, access_key: str, token: str) -> None:
+        """STS credentials must present their session token."""
+        if self.iam is None:
+            return
+        ident = self.iam.lookup(access_key)
+        if ident is not None and ident.kind == "sts":
+            if token != ident.session_token:
+                raise S3Error("InvalidAccessKeyId",
+                              "missing or wrong session token")
+
+    # -- authorization (cf. checkRequestAuthType policy check) ---------------
+
+    @staticmethod
+    def _s3_action(method: str, bucket: str, key: str, query: dict) -> str:
+        if not bucket:
+            return "s3:ListAllMyBuckets"
+        if not key:
+            if method == "GET":
+                if "location" in query:
+                    return "s3:GetBucketLocation"
+                if "versioning" in query:
+                    return "s3:GetBucketVersioning"
+                if "uploads" in query:
+                    return "s3:ListBucketMultipartUploads"
+                return "s3:ListBucket"
+            if method == "HEAD":
+                return "s3:ListBucket"
+            if method == "PUT":
+                if "versioning" in query:
+                    return "s3:PutBucketVersioning"
+                return "s3:CreateBucket"
+            if method == "DELETE":
+                return "s3:DeleteBucket"
+            if method == "POST" and "delete" in query:
+                return "s3:DeleteObject"
+            return "s3:ListBucket"
+        if method in ("GET", "HEAD"):
+            if "uploadId" in query:
+                return "s3:ListMultipartUploadParts"
+            return ("s3:GetObjectVersion" if "versionId" in query
+                    else "s3:GetObject")
+        if method == "PUT":
+            return "s3:PutObject"
+        if method == "DELETE":
+            if "uploadId" in query:
+                return "s3:AbortMultipartUpload"
+            return ("s3:DeleteObjectVersion" if "versionId" in query
+                    else "s3:DeleteObject")
+        if method == "POST":
+            return "s3:PutObject"
+        return "s3:GetObject"
+
+    def _authorize(self, access_key: str, method: str, bucket: str,
+                   key: str, query: dict, source_ip: str = "") -> None:
+        if access_key == self.creds.access_key or self.iam is None:
+            return                               # root bypasses policy
+        ident = self.iam.lookup(access_key)
+        if ident is None:
+            raise S3Error("InvalidAccessKeyId")
+        action = self._s3_action(method, bucket, key, query)
+        resource = f"{bucket}/{key}" if key else bucket
+        ctx = {"s3:prefix": query.get("prefix", [""])[0],
+               "aws:SourceIp": source_ip}
+        if not self.iam.is_allowed(ident, action, resource, ctx):
+            raise S3Error("AccessDenied",
+                          f"{action} on {resource} denied")
 
     def _dispatch(self, req, path: str, query: dict) -> Response:
-        body = self._authenticate(req, path, query)
+        body, access_key = self._authenticate(req, path, query)
         h = self.handlers
         method = req.command
         headers = {k: v for k, v in req.headers.items()}
@@ -153,17 +238,90 @@ class S3Server:
                              "query": {k: v[0] for k, v in query.items()}})
 
         if not bucket:
+            if method == "POST":
+                return self._handle_sts(access_key, headers, body)
             if method == "GET":
+                self._authorize(access_key, method, "", "", query,
+                                req.client_address[0])
                 return h.list_buckets()
             raise S3Error("MethodNotAllowed")
 
+        self._authorize(access_key, method, bucket, key, query,
+                        req.client_address[0])
         if not key:
-            return self._dispatch_bucket(method, bucket, query, headers, body)
+            return self._dispatch_bucket(method, bucket, query, headers,
+                                         body, access_key)
         return self._dispatch_object(method, bucket, key, query, headers,
                                      body)
 
+    # -- STS (cf. cmd/sts-handlers.go:99 AssumeRole) -------------------------
+
+    def _handle_sts(self, access_key: str, headers: dict,
+                    body: bytes) -> Response:
+        import json
+        import urllib.parse as up
+        import xml.etree.ElementTree as ET
+        import datetime as dt
+
+        form = up.parse_qs(body.decode("utf-8", "replace"))
+        if form.get("Action", [""])[0] != "AssumeRole":
+            raise S3Error("NotImplemented", "unknown STS action")
+        if self.iam is None:
+            raise S3Error("NotImplemented", "IAM is not enabled")
+        if access_key == self.creds.access_key:
+            from ..iam.iam import Identity
+            parent = Identity(access_key=access_key,
+                              secret_key=self.creds.secret_key,
+                              kind="root")
+        else:
+            parent = self.iam.lookup(access_key)
+            if parent is None or parent.kind == "sts":
+                raise S3Error("AccessDenied", "cannot assume from here")
+        try:
+            duration = int(form.get("DurationSeconds", ["3600"])[0])
+        except ValueError:
+            raise S3Error("InvalidArgument",
+                          "DurationSeconds must be an integer") from None
+        policy_doc = None
+        if form.get("Policy", [""])[0]:
+            try:
+                policy_doc = json.loads(form["Policy"][0])
+            except ValueError:
+                raise S3Error("MalformedXML", "bad inline policy") from None
+        ident = self.iam.assume_role(parent, duration, policy_doc)
+        exp = dt.datetime.fromtimestamp(
+            ident.expiration, dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        ns = "https://sts.amazonaws.com/doc/2011-06-15/"
+        root = ET.Element("AssumeRoleResponse", xmlns=ns)
+        result = ET.SubElement(root, "AssumeRoleResult")
+        c = ET.SubElement(result, "Credentials")
+        for tag, val in (("AccessKeyId", ident.access_key),
+                         ("SecretAccessKey", ident.secret_key),
+                         ("SessionToken", ident.session_token),
+                         ("Expiration", exp)):
+            e = ET.SubElement(c, tag)
+            e.text = val
+        xml_body = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                    + ET.tostring(root, encoding="unicode").encode())
+        return Response(200, xml_body,
+                        {"Content-Type": "application/xml"})
+
+    def _delete_authorizer(self, access_key: str, bucket: str):
+        """Per-key authorization closure for multi-object delete."""
+        if access_key == self.creds.access_key or self.iam is None:
+            return None                          # root: no per-key checks
+        ident = self.iam.lookup(access_key)
+
+        def can_delete(key: str, version_id: str) -> bool:
+            if ident is None:
+                return False
+            action = ("s3:DeleteObjectVersion" if version_id
+                      else "s3:DeleteObject")
+            return self.iam.is_allowed(ident, action, f"{bucket}/{key}")
+        return can_delete
+
     def _dispatch_bucket(self, method, bucket, query, headers,
-                         body) -> Response:
+                         body, access_key="") -> Response:
         h = self.handlers
         if method == "PUT":
             if "versioning" in query:
@@ -175,7 +333,9 @@ class S3Server:
             return h.delete_bucket(bucket)
         if method == "POST":
             if "delete" in query:
-                return h.delete_objects(bucket, body)
+                return h.delete_objects(
+                    bucket, body,
+                    can_delete=self._delete_authorizer(access_key, bucket))
             raise S3Error("MethodNotAllowed")
         if method == "GET":
             if "location" in query:

@@ -82,6 +82,14 @@ class Credentials:
         self.region = region
 
 
+def _as_lookup(creds):
+    """Accept either a Credentials (single principal) or a callable
+    access_key -> Credentials | None (IAM multi-principal)."""
+    if callable(creds):
+        return creds
+    return lambda ak: creds if ak == creds.access_key else None
+
+
 def _parse_amz_date(s: str) -> datetime.datetime:
     try:
         return datetime.datetime.strptime(s, "%Y%m%dT%H%M%SZ").replace(
@@ -137,20 +145,24 @@ def _parse_auth_header(auth: str) -> tuple[str, str, list[str], str]:
     return access_key, scope, signed, sig
 
 
-def verify_header_signature(creds: Credentials, method: str, path: str,
+def verify_header_signature(creds, method: str, path: str,
                             query: dict[str, list[str]],
                             headers: dict[str, str], body: bytes,
-                            now: datetime.datetime | None = None) -> str:
+                            now: datetime.datetime | None = None
+                            ) -> tuple[str, str]:
     """Verify an Authorization-header SigV4 request.
 
-    Returns the payload-hash declaration (hex sha256, UNSIGNED-PAYLOAD or
-    STREAMING-...) so the caller can pick the body-decoding path.
+    `creds` is a Credentials or an access_key->Credentials lookup (IAM).
+    Returns (payload-hash declaration, access_key) so the caller can pick
+    the body-decoding path and authorize the principal.
     cf. doesSignatureMatch, /root/reference/cmd/signature-v4.go:334.
     """
+    lookup = _as_lookup(creds)
     h = {k.lower(): v for k, v in headers.items()}
     auth = h.get("authorization", "")
     access_key, scope, signed_headers, got_sig = _parse_auth_header(auth)
-    if access_key != creds.access_key:
+    creds = lookup(access_key)
+    if creds is None:
         raise S3Error("InvalidAccessKeyId")
     if "host" not in signed_headers:
         raise S3Error("AuthorizationHeaderMalformed", "host not signed")
@@ -179,7 +191,7 @@ def verify_header_signature(creds: Credentials, method: str, path: str,
                     sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, got_sig):
         raise S3Error("SignatureDoesNotMatch")
-    return payload_hash
+    return payload_hash, access_key
 
 
 def presign_url(creds: Credentials, method: str, path: str,
@@ -206,11 +218,12 @@ def presign_url(creds: Credentials, method: str, path: str,
     return f"{path}?{qs}"
 
 
-def verify_presigned(creds: Credentials, method: str, path: str,
+def verify_presigned(creds, method: str, path: str,
                      query: dict[str, list[str]], headers: dict[str, str],
-                     now: datetime.datetime | None = None) -> None:
-    """Verify a presigned (query-auth) request.
+                     now: datetime.datetime | None = None) -> str:
+    """Verify a presigned (query-auth) request; returns the access key.
     cf. doesPresignedSignatureMatch, cmd/signature-v4.go:208."""
+    lookup = _as_lookup(creds)
     q = {k: list(v) for k, v in query.items()}
     try:
         if q["X-Amz-Algorithm"][0] != ALGORITHM:
@@ -224,7 +237,8 @@ def verify_presigned(creds: Credentials, method: str, path: str,
         raise S3Error("AuthorizationQueryParametersError") from None
 
     access_key, _, scope = cred.partition("/")
-    if access_key != creds.access_key:
+    creds = lookup(access_key)
+    if creds is None:
         raise S3Error("InvalidAccessKeyId")
     ts = _parse_amz_date(amz_date)
     now = now or datetime.datetime.now(datetime.timezone.utc)
@@ -245,11 +259,12 @@ def verify_presigned(creds: Credentials, method: str, path: str,
                     sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, got_sig):
         raise S3Error("SignatureDoesNotMatch")
+    return access_key
 
 
 # -- aws-chunked streaming payload -------------------------------------------
 
-def decode_streaming_body(creds: Credentials, headers: dict[str, str],
+def decode_streaming_body(creds, headers: dict[str, str],
                           raw: bytes) -> bytes:
     """Decode + verify a STREAMING-AWS4-HMAC-SHA256-PAYLOAD body.
 
@@ -257,9 +272,13 @@ def decode_streaming_body(creds: Credentials, headers: dict[str, str],
     rolling signature chain seeded from the request signature
     (cf. cmd/streaming-signature-v4.go).
     """
+    lookup = _as_lookup(creds)
     h = {k.lower(): v for k, v in headers.items()}
     auth = h.get("authorization", "")
-    _, scope, _, seed_sig = _parse_auth_header(auth)
+    access_key, scope, _, seed_sig = _parse_auth_header(auth)
+    creds = lookup(access_key)
+    if creds is None:
+        raise S3Error("InvalidAccessKeyId")
     amz_date = h.get("x-amz-date", "")
     date = amz_date[:8]
     region = scope.split("/")[1] if scope.count("/") >= 3 else creds.region

@@ -1,0 +1,240 @@
+"""IAM / policy / STS tests: policy eval unit tests, IAMSys persistence,
+and signed end-to-end enforcement through the S3 server."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.iam import policy as pol
+from minio_tpu.iam.iam import IAMSys
+from minio_tpu.server.client import S3Client, S3ClientError
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ROOT, ROOT_SECRET = "rootadmin", "rootadmin-secret"
+
+
+class TestPolicyEval:
+    def test_wildcard_allow(self):
+        p = pol.Policy({"Statement": [{"Effect": "Allow",
+                                       "Action": "s3:Get*",
+                                       "Resource": "arn:aws:s3:::bkt/*"}]})
+        assert p.is_allowed("s3:GetObject", "bkt/a/b")
+        assert not p.is_allowed("s3:PutObject", "bkt/a")
+        assert not p.is_allowed("s3:GetObject", "other/a")
+
+    def test_explicit_deny_wins(self):
+        p = pol.Policy({"Statement": [
+            {"Effect": "Allow", "Action": "s3:*",
+             "Resource": "arn:aws:s3:::*"},
+            {"Effect": "Deny", "Action": "s3:DeleteObject",
+             "Resource": "arn:aws:s3:::protected/*"}]})
+        assert p.is_allowed("s3:DeleteObject", "open/x")
+        assert not p.is_allowed("s3:DeleteObject", "protected/x")
+
+    def test_condition_prefix(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:ListBucket",
+            "Resource": "arn:aws:s3:::bkt",
+            "Condition": {"StringLike": {"s3:prefix": ["public/*"]}}}]})
+        assert p.is_allowed("s3:ListBucket", "bkt",
+                            {"s3:prefix": "public/x"})
+        assert not p.is_allowed("s3:ListBucket", "bkt",
+                                {"s3:prefix": "private/x"})
+
+    def test_default_deny_and_merge(self):
+        assert not pol.READ_ONLY.is_allowed("s3:PutObject", "b/k")
+        assert pol.merge_allowed([pol.READ_ONLY, pol.WRITE_ONLY],
+                                 "s3:PutObject", "b/k")
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(pol.PolicyError):
+            pol.Policy({"Statement": [{"Effect": "Maybe", "Action": "x"}]})
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    iam = IAMSys(pools)
+    srv = S3Server(pools, Credentials(ROOT, ROOT_SECRET), iam=iam).start()
+    root_cli = S3Client(srv.endpoint, ROOT, ROOT_SECRET)
+    yield srv, iam, root_cli
+    srv.shutdown()
+
+
+class TestIAMSys:
+    def test_user_lifecycle_and_persistence(self, stack):
+        srv, iam, _ = stack
+        iam.add_user("alice", "alice-secret-123", ["readwrite"])
+        assert iam.lookup("alice") is not None
+        # a fresh IAMSys over the same pools sees the persisted user
+        iam2 = IAMSys(srv.pools)
+        ident = iam2.lookup("alice")
+        assert ident is not None and ident.policies == ["readwrite"]
+        iam.remove_user("alice")
+        assert iam.lookup("alice") is None
+
+    def test_group_policy_attachment(self, stack):
+        _, iam, _ = stack
+        iam.add_user("bob", "bob-secret-123")
+        iam.add_group("readers", ["bob"], ["readonly"])
+        ident = iam.lookup("bob")
+        assert iam.is_allowed(ident, "s3:GetObject", "any/key")
+        assert not iam.is_allowed(ident, "s3:PutObject", "any/key")
+
+    def test_service_account_inherits(self, stack):
+        _, iam, _ = stack
+        iam.add_user("carol", "carol-secret-1", ["readwrite"])
+        svc = iam.add_service_account("carol")
+        ident = iam.lookup(svc.access_key)
+        assert ident.kind == "service"
+        assert iam.is_allowed(ident, "s3:PutObject", "b/k")
+
+    def test_disabled_user_rejected(self, stack):
+        _, iam, _ = stack
+        iam.add_user("dave", "dave-secret-12", ["readwrite"])
+        iam.set_user_status("dave", "disabled")
+        assert iam.lookup("dave") is None
+
+
+class TestEndToEndEnforcement:
+    def test_readonly_user_cannot_write(self, stack):
+        srv, iam, root_cli = stack
+        root_cli.make_bucket("iam-bkt")
+        root_cli.put_object("iam-bkt", "k", b"data")
+        iam.add_user("reader", "reader-secret-1", ["readonly"])
+        cli = S3Client(srv.endpoint, "reader", "reader-secret-1")
+        assert cli.get_object("iam-bkt", "k") == b"data"
+        with pytest.raises(S3ClientError) as ei:
+            cli.put_object("iam-bkt", "k2", b"nope")
+        assert ei.value.code == "AccessDenied"
+
+    def test_wrong_secret_rejected(self, stack):
+        srv, iam, _ = stack
+        iam.add_user("eve", "eve-secret-123", ["readwrite"])
+        cli = S3Client(srv.endpoint, "eve", "wrong-secret")
+        with pytest.raises(S3ClientError) as ei:
+            cli.list_buckets()
+        assert ei.value.code == "SignatureDoesNotMatch"
+
+    def test_custom_policy_scopes_bucket(self, stack):
+        srv, iam, root_cli = stack
+        root_cli.make_bucket("allowed")
+        root_cli.make_bucket("forbidden")
+        iam.set_policy("only-allowed", {
+            "Statement": [{"Effect": "Allow", "Action": "s3:*",
+                           "Resource": ["arn:aws:s3:::allowed",
+                                        "arn:aws:s3:::allowed/*"]}]})
+        iam.add_user("frank", "frank-secret-1", ["only-allowed"])
+        cli = S3Client(srv.endpoint, "frank", "frank-secret-1")
+        cli.put_object("allowed", "x", b"ok")
+        with pytest.raises(S3ClientError) as ei:
+            cli.put_object("forbidden", "x", b"no")
+        assert ei.value.code == "AccessDenied"
+
+
+class TestSTS:
+    def _assume_role(self, srv, cli, duration=3600):
+        body = f"Action=AssumeRole&Version=2011-06-15&DurationSeconds={duration}"
+        status, _, data = cli.request("POST", "/", body=body.encode())
+        assert status == 200, data
+        import re
+        def field(tag):
+            m = re.search(f"<{tag}>([^<]+)</{tag}>", data.decode())
+            return m.group(1)
+        return field("AccessKeyId"), field("SecretAccessKey"), \
+            field("SessionToken")
+
+    def test_assume_role_roundtrip(self, stack):
+        srv, iam, root_cli = stack
+        root_cli.make_bucket("sts-bkt")
+        iam.add_user("grace", "grace-secret-1", ["readwrite"])
+        user_cli = S3Client(srv.endpoint, "grace", "grace-secret-1")
+        ak, sk, token = self._assume_role(srv, user_cli)
+        assert ak.startswith("sts-")
+        sts_cli = S3Client(srv.endpoint, ak, sk)
+        # without the session token: rejected
+        with pytest.raises(S3ClientError):
+            sts_cli.list_buckets()
+        # with the token header: allowed, inherits grace's readwrite
+        status, _, _ = sts_cli.request(
+            "PUT", "/sts-bkt/obj", body=b"x",
+            headers={"x-amz-security-token": token})
+        assert status == 200
+        status, _, data = sts_cli.request(
+            "GET", "/sts-bkt/obj",
+            headers={"x-amz-security-token": token})
+        assert status == 200 and data == b"x"
+
+    def test_sts_cannot_reassume(self, stack):
+        srv, iam, root_cli = stack
+        iam.add_user("henry", "henry-secret-1", ["readwrite"])
+        cli = S3Client(srv.endpoint, "henry", "henry-secret-1")
+        ak, sk, token = self._assume_role(srv, cli)
+        sts_cli = S3Client(srv.endpoint, ak, sk)
+        body = b"Action=AssumeRole&Version=2011-06-15"
+        status, _, data = sts_cli.request(
+            "POST", "/", body=body,
+            headers={"x-amz-security-token": token})
+        assert status == 403
+
+
+class TestSecurityRegressions:
+    def test_sts_inline_policy_cannot_escalate(self, stack):
+        """A session policy INTERSECTS the parent's permissions (AWS
+        semantics) — a readonly user must not mint readwrite STS creds."""
+        srv, iam, root_cli = stack
+        root_cli.make_bucket("esc")
+        iam.add_user("low", "low-secret-1234", ["readonly"])
+        parent = iam.lookup("low")
+        allow_all = {"Statement": [{"Effect": "Allow", "Action": "s3:*",
+                                    "Resource": "arn:aws:s3:::*"}]}
+        ident = iam.assume_role(parent, 3600, allow_all)
+        # reads: parent allows AND inline allows
+        assert iam.is_allowed(ident, "s3:GetObject", "esc/k")
+        # writes: inline allows but parent does NOT -> denied
+        assert not iam.is_allowed(ident, "s3:PutObject", "esc/k")
+
+    def test_sts_survives_iam_reload(self, stack):
+        _, iam, _ = stack
+        iam.add_user("rel", "rel-secret-1234", ["readwrite"])
+        restrict = {"Statement": [{"Effect": "Allow",
+                                   "Action": "s3:GetObject",
+                                   "Resource": "arn:aws:s3:::*"}]}
+        ident = iam.assume_role(iam.lookup("rel"), 3600, restrict)
+        iam.load()    # peer-triggered reload must not strand the session
+        assert iam.is_allowed(ident, "s3:GetObject", "b/k")
+        assert not iam.is_allowed(ident, "s3:PutObject", "b/k")
+
+    def test_multi_delete_respects_object_deny(self, stack):
+        srv, iam, root_cli = stack
+        root_cli.make_bucket("mdel")
+        root_cli.put_object("mdel", "open/x", b"1")
+        root_cli.put_object("mdel", "protected/x", b"2")
+        iam.set_policy("deny-protected", {"Statement": [
+            {"Effect": "Allow", "Action": "s3:*",
+             "Resource": ["arn:aws:s3:::mdel", "arn:aws:s3:::mdel/*"]},
+            {"Effect": "Deny", "Action": "s3:DeleteObject",
+             "Resource": "arn:aws:s3:::mdel/protected/*"}]})
+        iam.add_user("ivan", "ivan-secret-123", ["deny-protected"])
+        cli = S3Client(srv.endpoint, "ivan", "ivan-secret-123")
+        body = cli.delete_objects("mdel", ["open/x", "protected/x"])
+        assert b"<Deleted><Key>open/x</Key>" in body.replace(b"\n", b"")
+        assert b"AccessDenied" in body
+        # protected object still there
+        assert root_cli.get_object("mdel", "protected/x") == b"2"
+
+    def test_ip_condition_cidr(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::b/*",
+            "Condition": {"IpAddress":
+                          {"aws:SourceIp": ["10.1.12.0/24"]}}}]})
+        assert p.is_allowed("s3:GetObject", "b/k",
+                            {"aws:SourceIp": "10.1.12.55"})
+        assert not p.is_allowed("s3:GetObject", "b/k",
+                                {"aws:SourceIp": "10.1.120.55"})
+        assert not p.is_allowed("s3:GetObject", "b/k", {})
