@@ -1,0 +1,129 @@
+"""Admin-driven heal sequences with status reporting.
+
+The cmd/admin-heal-ops.go:396 equivalent: a heal sequence walks a scope
+(whole deployment, one bucket, or one prefix), heals format/buckets/
+objects in order, and exposes progress for the admin API to poll. One
+concurrent sequence per scope path; a background sequence (the bgHealing
+analogue) can run continuously at low priority.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from ..storage.errors import StorageError
+
+
+class HealSequence:
+    def __init__(self, pools, bucket: str = "", prefix: str = "",
+                 deep: bool = False, remove_dangling: bool = True):
+        self.id = uuid.uuid4().hex
+        self.pools = pools
+        self.bucket = bucket
+        self.prefix = prefix
+        self.deep = deep
+        self.remove_dangling = remove_dangling
+        self.state = "pending"      # pending|running|done|failed|stopped
+        self.started = 0.0
+        self.finished = 0.0
+        self.items_scanned = 0
+        self.items_healed = 0
+        self.failures: list[str] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- execution -----------------------------------------------------------
+
+    def _heal_one(self, es, bucket: str, name: str) -> None:
+        from ..engine import heal as H
+        self.items_scanned += 1
+        try:
+            results = H.heal_object(es, bucket, name, deep=self.deep,
+                                    remove_dangling=self.remove_dangling)
+            if any(r.healed_drives for r in results):
+                self.items_healed += 1
+        except StorageError as e:
+            self.failures.append(f"{bucket}/{name}: {e}")
+
+    def run(self) -> "HealSequence":
+        self.state = "running"
+        self.started = time.time()
+        try:
+            buckets = ([self.bucket] if self.bucket
+                       else self.pools.list_buckets())
+            for bucket in buckets:
+                from ..engine import heal as H
+                for pool in self.pools.pools:
+                    sets = getattr(pool, "sets", [pool])
+                    for es in sets:
+                        try:
+                            H.heal_bucket(es, bucket)
+                        except StorageError:
+                            pass
+                        try:
+                            infos = es.list_objects(bucket, self.prefix,
+                                                    max_keys=1000000)
+                        except StorageError:
+                            continue
+                        for fi in infos:
+                            if self._stop.is_set():
+                                self.state = "stopped"
+                                return self
+                            self._heal_one(es, bucket, fi.name)
+            self.state = "done"
+        except Exception as e:  # noqa: BLE001
+            self.state = "failed"
+            self.failures.append(str(e))
+        finally:
+            self.finished = time.time()
+        return self
+
+    def start(self) -> "HealSequence":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def status(self) -> dict:
+        return {"id": self.id, "state": self.state,
+                "bucket": self.bucket, "prefix": self.prefix,
+                "scanned": self.items_scanned,
+                "healed": self.items_healed,
+                "failures": list(self.failures[-20:]),
+                "started": self.started, "finished": self.finished}
+
+
+class HealState:
+    """Registry of running sequences (allHealState analogue,
+    cmd/admin-heal-ops.go:90): one sequence per scope path at a time."""
+
+    def __init__(self, pools):
+        self.pools = pools
+        self._mu = threading.Lock()
+        self._seqs: dict[str, HealSequence] = {}
+
+    def launch(self, bucket: str = "", prefix: str = "",
+               deep: bool = False) -> HealSequence:
+        scope = f"{bucket}/{prefix}"
+        with self._mu:
+            existing = self._seqs.get(scope)
+            if existing is not None and existing.state == "running":
+                return existing
+            seq = HealSequence(self.pools, bucket, prefix, deep)
+            self._seqs[scope] = seq
+        return seq.start()
+
+    def get(self, seq_id: str) -> HealSequence | None:
+        with self._mu:
+            for s in self._seqs.values():
+                if s.id == seq_id:
+                    return s
+        return None
+
+    def statuses(self) -> list[dict]:
+        with self._mu:
+            return [s.status() for s in self._seqs.values()]

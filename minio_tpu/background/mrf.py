@@ -1,0 +1,90 @@
+"""MRF — "most recently failed" heal queue.
+
+The cmd/mrf.go:52 equivalent: writes that succeeded with quorum but
+failed on SOME drives enqueue the object here; a background worker heals
+the stripe back to full width (immediately-retried with backoff rather
+than waiting for the scanner's next pass). The engine enqueues from its
+put path; drive reconnects implicitly resolve on the next retry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+
+class MRFQueue:
+    def __init__(self, heal_fn, *, max_items: int = 10000,
+                 retry_interval: float = 1.0, max_attempts: int = 8):
+        self.heal_fn = heal_fn          # (bucket, obj, version_id) -> None
+        self.max_items = max_items
+        self.retry_interval = retry_interval
+        self.max_attempts = max_attempts
+        self._mu = threading.Lock()
+        # key -> {"bucket","obj","vid","attempts","next_try"}
+        self._q: OrderedDict[str, dict] = OrderedDict()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.healed = 0
+        self.dropped = 0
+
+    def enqueue(self, bucket: str, obj: str, version_id: str = "") -> None:
+        key = f"{bucket}/{obj}@{version_id}"
+        with self._mu:
+            if key not in self._q and len(self._q) >= self.max_items:
+                self._q.popitem(last=False)      # shed oldest under pressure
+                self.dropped += 1
+            self._q[key] = {"bucket": bucket, "obj": obj,
+                            "vid": version_id, "attempts": 0,
+                            "next_try": time.monotonic()}
+        self._wake.set()
+
+    def pending(self) -> int:
+        with self._mu:
+            return len(self._q)
+
+    def drain_once(self) -> int:
+        """Try every due entry once; returns how many healed."""
+        now = time.monotonic()
+        with self._mu:
+            due = [(k, dict(v)) for k, v in self._q.items()
+                   if v["next_try"] <= now]
+        healed = 0
+        for key, item in due:
+            try:
+                self.heal_fn(item["bucket"], item["obj"], item["vid"])
+            except Exception:  # noqa: BLE001 — retry with backoff
+                with self._mu:
+                    if key in self._q:
+                        it = self._q[key]
+                        it["attempts"] += 1
+                        if it["attempts"] >= self.max_attempts:
+                            del self._q[key]
+                            self.dropped += 1
+                        else:
+                            it["next_try"] = now + self.retry_interval * \
+                                (2 ** it["attempts"])
+                continue
+            with self._mu:
+                self._q.pop(key, None)
+            self.healed += 1
+            healed += 1
+        return healed
+
+    def start(self) -> "MRFQueue":
+        def loop():
+            while not self._stop.is_set():
+                self._wake.wait(timeout=self.retry_interval)
+                self._wake.clear()
+                if self._stop.is_set():
+                    return
+                self.drain_once()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()

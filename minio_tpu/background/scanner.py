@@ -1,0 +1,137 @@
+"""Data scanner: perpetual namespace crawl with usage + heal triggering.
+
+The cmd/data-scanner.go:96 equivalent: each cycle walks the namespace
+(quorum-merged listing per set), accumulates the data-usage tree, and
+queues objects whose stripe looks unhealthy (missing metadata on some
+drives) for heal. Dirty buckets (DirtyTracker) are scanned every cycle;
+clean ones every `full_scan_every` cycles — the bloom-filter skip.
+Sleeps adaptively between objects (scannerSleeper analogue) so the crawl
+yields to foreground traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..storage.errors import StorageError
+from .usage import DataUsage, DirtyTracker
+
+
+class ScanStats:
+    def __init__(self):
+        self.cycles = 0
+        self.objects_scanned = 0
+        self.heals_triggered = 0
+        self.last_cycle_s = 0.0
+
+
+class DataScanner:
+    def __init__(self, pools, *, heal_fn=None,
+                 full_scan_every: int = 16,
+                 object_sleep: float = 0.0,
+                 dirty: DirtyTracker | None = None):
+        self.pools = pools
+        self.heal_fn = heal_fn         # (bucket, obj, version_id) -> None
+        self.full_scan_every = full_scan_every
+        self.object_sleep = object_sleep
+        self.dirty = dirty or DirtyTracker.shared()
+        self.stats = ScanStats()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_usage: DataUsage | None = None
+
+    # -- one cycle -----------------------------------------------------------
+
+    def _object_needs_heal(self, es, bucket: str, name: str) -> bool:
+        """Cheap health probe: does any LIVE drive lack the object's
+        xl.meta? Offline drives don't count — nothing can be healed onto
+        them, and counting them would heal-spam every object.
+        (The deep per-shard verify belongs to heal itself.)"""
+        from ..storage.errors import ErrDiskNotFound
+        res = es._map_drives(
+            lambda d: d.read_version(bucket, name))
+        missing = sum(1 for _, e in res
+                      if e is not None and not isinstance(e, ErrDiskNotFound))
+        live = sum(1 for d in es.drives if d is not None)
+        return 0 < missing < live
+
+    def scan_cycle(self, deep: bool = False) -> DataUsage:
+        t0 = time.time()
+        self.stats.cycles += 1
+        cycle = self.stats.cycles
+        dirty = self.dirty.snapshot_and_clear()
+        usage = DataUsage()
+        usage.cycle = cycle
+
+        for bucket in self.pools.list_buckets():
+            full = (bucket in dirty or deep
+                    or cycle % self.full_scan_every == 1)
+            if not full and self._last_usage is not None \
+                    and bucket in self._last_usage.buckets:
+                # Clean bucket: carry forward last cycle's numbers.
+                usage.buckets[bucket] = self._last_usage.buckets[bucket]
+                continue
+            for pool in self.pools.pools:
+                try:
+                    sets = pool.sets
+                except AttributeError:
+                    sets = [pool]
+                for es in sets:
+                    try:
+                        infos = es.list_objects(bucket, max_keys=1000000)
+                    except StorageError:
+                        continue
+                    for fi in infos:
+                        self.stats.objects_scanned += 1
+                        usage.account(bucket, fi.name, fi.size)
+                        if self.heal_fn is not None and \
+                                self._object_needs_heal(es, bucket, fi.name):
+                            self.stats.heals_triggered += 1
+                            try:
+                                self.heal_fn(bucket, fi.name, "")
+                            except StorageError:
+                                pass
+                        if self.object_sleep:
+                            time.sleep(self.object_sleep)
+
+        usage.scanned_at = time.time()
+        self.stats.last_cycle_s = usage.scanned_at - t0
+        self._last_usage = usage
+        # Persist on every set (survives restarts; admin reads it without
+        # a rescan, cf. data-usage-cache persistence).
+        for pool in self.pools.pools:
+            sets = getattr(pool, "sets", [pool])
+            for es in sets:
+                try:
+                    usage.persist(es)
+                except StorageError:
+                    continue
+        return usage
+
+    def latest_usage(self) -> DataUsage | None:
+        if self._last_usage is not None:
+            return self._last_usage
+        for pool in self.pools.pools:
+            sets = getattr(pool, "sets", [pool])
+            for es in sets:
+                u = DataUsage.load(es)
+                if u is not None:
+                    return u
+        return None
+
+    # -- background loop -----------------------------------------------------
+
+    def start(self, interval: float = 60.0) -> "DataScanner":
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.scan_cycle()
+                except Exception:  # noqa: BLE001 — scanner must survive
+                    continue
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,130 @@
+"""Data-usage accounting: the scanner's output tree.
+
+The cmd/data-usage-cache.go equivalent: per-bucket (and top-level-prefix)
+object/version/byte counts, merged across sets/pools, persisted as
+msgpack on the set's drives under the system volume and readable without
+a rescan. Also the dirty-bucket tracker — the role of the reference's
+persisted bloom filter of modified prefixes (cmd/data-update-tracker.go:59):
+writes mark their bucket dirty so scan cycles can skip untouched buckets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..storage.drive import SYS_VOL
+from ..storage.errors import StorageError
+from ..utils import msgpackx
+
+USAGE_PATH = "usage/usage.msgpack"
+
+
+class BucketUsage:
+    __slots__ = ("objects", "versions", "bytes", "prefixes")
+
+    def __init__(self):
+        self.objects = 0
+        self.versions = 0
+        self.bytes = 0
+        self.prefixes: dict[str, int] = {}     # top-level prefix -> bytes
+
+    def to_obj(self) -> dict:
+        return {"o": self.objects, "v": self.versions, "b": self.bytes,
+                "p": self.prefixes}
+
+    @classmethod
+    def from_obj(cls, d: dict) -> "BucketUsage":
+        u = cls()
+        u.objects = d.get("o", 0)
+        u.versions = d.get("v", 0)
+        u.bytes = d.get("b", 0)
+        u.prefixes = dict(d.get("p", {}))
+        return u
+
+
+class DataUsage:
+    def __init__(self):
+        self.buckets: dict[str, BucketUsage] = {}
+        self.scanned_at = 0.0
+        self.cycle = 0
+
+    def account(self, bucket: str, name: str, size: int,
+                versions: int = 1) -> None:
+        u = self.buckets.setdefault(bucket, BucketUsage())
+        u.objects += 1
+        u.versions += versions
+        u.bytes += size
+        top = name.split("/", 1)[0] + ("/" if "/" in name else "")
+        u.prefixes[top] = u.prefixes.get(top, 0) + size
+
+    def total_bytes(self) -> int:
+        return sum(u.bytes for u in self.buckets.values())
+
+    def to_bytes(self) -> bytes:
+        return msgpackx.packb({
+            "at": self.scanned_at, "cycle": self.cycle,
+            "buckets": {b: u.to_obj() for b, u in self.buckets.items()}})
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "DataUsage":
+        d = msgpackx.unpackb(raw)
+        u = cls()
+        u.scanned_at = d.get("at", 0.0)
+        u.cycle = d.get("cycle", 0)
+        u.buckets = {b: BucketUsage.from_obj(v)
+                     for b, v in d.get("buckets", {}).items()}
+        return u
+
+    # -- persistence on a set's drives --------------------------------------
+
+    def persist(self, es) -> None:
+        raw = self.to_bytes()
+
+        def put(d):
+            d.write_all(SYS_VOL, USAGE_PATH, raw)
+        es._map_drives(put)
+
+    @classmethod
+    def load(cls, es) -> "DataUsage | None":
+        for d in es.drives:
+            if d is None:
+                continue
+            try:
+                return cls.from_bytes(d.read_all(SYS_VOL, USAGE_PATH))
+            except StorageError:
+                continue
+        return None
+
+
+class DirtyTracker:
+    """Which buckets changed since the last scan cycle — lets the scanner
+    skip untouched trees the way the reference's bloom filter does."""
+
+    _global = None
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._dirty: set[str] = set()
+        self._stamp: dict[str, float] = {}
+
+    @classmethod
+    def shared(cls) -> "DirtyTracker":
+        if cls._global is None:
+            cls._global = cls()
+        return cls._global
+
+    def mark(self, bucket: str) -> None:
+        with self._mu:
+            self._dirty.add(bucket)
+            self._stamp[bucket] = time.time()
+
+    def snapshot_and_clear(self) -> set[str]:
+        with self._mu:
+            out = set(self._dirty)
+            self._dirty.clear()
+            return out
+
+    def is_dirty(self, bucket: str) -> bool:
+        with self._mu:
+            return bucket in self._dirty

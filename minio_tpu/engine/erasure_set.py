@@ -80,6 +80,15 @@ class ErasureSet:
             from ..cluster.nslock import NSLockMap
             nslock = NSLockMap()
         self.nslock = nslock
+        # Optional background-subsystem hooks: an MRF queue receives
+        # partial-write failures; the dirty tracker feeds the scanner's
+        # changed-bucket skip logic (background/usage.py).
+        self.mrf = None
+        self._dirty_tracker = None
+
+    def _mark_dirty(self, bucket: str) -> None:
+        if self._dirty_tracker is not None:
+            self._dirty_tracker.mark(bucket)
 
     # -- codec helpers -------------------------------------------------------
 
@@ -168,10 +177,12 @@ class ErasureSet:
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
         with self.nslock.write_locked(bucket, obj):
-            return self._put_object_locked(bucket, obj, data,
-                                           metadata=metadata,
-                                           versioned=versioned,
-                                           parity=parity)
+            fi = self._put_object_locked(bucket, obj, data,
+                                         metadata=metadata,
+                                         versioned=versioned,
+                                         parity=parity)
+        self._mark_dirty(bucket)
+        return fi
 
     def _put_object_locked(self, bucket, obj, data, *, metadata,
                            versioned, parity) -> FileInfo:
@@ -259,7 +270,13 @@ class ErasureSet:
         self._cleanup_tmp(tmp_id)
         if err is not None:
             raise err
-        return fi_for(0, data_dir, None)
+        fi = fi_for(0, data_dir, None)
+        # Partial success (quorum met, some drives failed): queue for MRF
+        # heal so the stripe returns to full width without waiting for
+        # the scanner (cf. enqueue at cmd/erasure-object.go:1403).
+        if self.mrf is not None and (any(failed) or any(errs)):
+            self.mrf.enqueue(bucket, obj, fi.version_id)
+        return fi
 
     def _put_inline(self, bucket, obj, data, fi_for, k, parity,
                     distribution, write_quorum) -> FileInfo:
@@ -275,10 +292,15 @@ class ErasureSet:
             d.write_metadata(bucket, obj, fi_for(pos, "", per_drive[pos]))
 
         res = self._map_drives_positions(write_one)
-        err = Q.reduce_write_quorum_errs([e for _, e in res], write_quorum)
+        errs = [e for _, e in res]
+        err = Q.reduce_write_quorum_errs(errs, write_quorum)
         if err is not None:
             raise err
-        return fi_for(0, "", None)
+        fi = fi_for(0, "", None)
+        if self.mrf is not None and any(errs):
+            # Same partial-success rule as the streaming path.
+            self.mrf.enqueue(bucket, obj, fi.version_id)
+        return fi
 
     def _map_drives_positions(self, fn) -> list:
         def call(pos):
@@ -681,6 +703,7 @@ class ErasureSet:
                                              write_quorum)
             if err is not None:
                 raise err
+            self._mark_dirty(bucket)
             return dm
 
         vid = normalize_version_id(version_id)
@@ -696,6 +719,7 @@ class ErasureSet:
         err = Q.reduce_write_quorum_errs(errs, write_quorum)
         if err is not None:
             raise err
+        self._mark_dirty(bucket)
         return None
 
     # -- listing (walk-based; metacache comes later) -------------------------
