@@ -1,0 +1,126 @@
+"""Bucket lifecycle (ILM): rule parsing + evaluation + expiry worker.
+
+The internal/bucket/lifecycle + cmd/bucket-lifecycle.go equivalent:
+XML rules with prefix/tag filters, current-version Expiration
+(days/date), NoncurrentVersionExpiration, and AbortIncompleteMultipart-
+Upload; the scanner (or the worker here) evaluates each object and
+applies the elected action. Transition-to-tier reuses the same rule
+machinery with a warm-backend target (bucket/tier.py).
+"""
+
+from __future__ import annotations
+
+import datetime
+import time
+import xml.etree.ElementTree as ET
+
+from ..storage.errors import StorageError
+
+
+def _text(el, tag, default=""):
+    if el is None:
+        return default
+    v = el.findtext(tag)
+    return default if v is None else v
+
+
+class Rule:
+    def __init__(self, el: ET.Element):
+        self.id = _text(el, "ID")
+        self.status = _text(el, "Status", "Enabled")
+        flt = el.find("Filter")
+        self.prefix = _text(flt, "Prefix", _text(el, "Prefix"))
+        self.tags: dict[str, str] = {}
+        if flt is not None:
+            for tag_el in flt.iter("Tag"):
+                self.tags[_text(tag_el, "Key")] = _text(tag_el, "Value")
+        exp = el.find("Expiration")
+        self.expire_days = int(_text(exp, "Days", "0") or 0)
+        self.expire_date = _text(exp, "Date")
+        self.expire_delete_marker = \
+            _text(exp, "ExpiredObjectDeleteMarker") == "true"
+        nce = el.find("NoncurrentVersionExpiration")
+        self.noncurrent_days = int(_text(nce, "NoncurrentDays", "0") or 0)
+        abort = el.find("AbortIncompleteMultipartUpload")
+        self.abort_mpu_days = int(_text(abort, "DaysAfterInitiation",
+                                        "0") or 0)
+        trans = el.find("Transition")
+        self.transition_days = int(_text(trans, "Days", "0") or 0)
+        self.transition_tier = _text(trans, "StorageClass")
+
+    def matches(self, name: str, tags: dict[str, str]) -> bool:
+        if self.status != "Enabled":
+            return False
+        if self.prefix and not name.startswith(self.prefix):
+            return False
+        for k, v in self.tags.items():
+            if tags.get(k) != v:
+                return False
+        return True
+
+
+class Lifecycle:
+    def __init__(self, rules: list[Rule]):
+        self.rules = rules
+
+    @classmethod
+    def parse(cls, xml_bytes: bytes) -> "Lifecycle":
+        root = ET.fromstring(xml_bytes)
+        # strip namespaces for uniform lookup
+        for el in root.iter():
+            if "}" in el.tag:
+                el.tag = el.tag.split("}", 1)[1]
+        return cls([Rule(r) for r in root.iter("Rule")])
+
+    def eval(self, name: str, mod_time_ns: int, *,
+             tags: dict[str, str] | None = None,
+             is_latest: bool = True, deleted: bool = False,
+             now: float | None = None) -> str:
+        """-> "" | "expire" | "expire-noncurrent" | "transition:<tier>"
+        (cf. lifecycle.Eval / ComputeAction)."""
+        now = time.time() if now is None else now
+        age_days = (now - mod_time_ns / 1e9) / 86400.0
+        for r in self.rules:
+            if not r.matches(name, tags or {}):
+                continue
+            if not is_latest and r.noncurrent_days and \
+                    age_days >= r.noncurrent_days:
+                return "expire-noncurrent"
+            if is_latest and not deleted:
+                if r.expire_days and age_days >= r.expire_days:
+                    return "expire"
+                if r.expire_date:
+                    try:
+                        d = datetime.datetime.fromisoformat(
+                            r.expire_date.replace("Z", "+00:00"))
+                        if now >= d.timestamp():
+                            return "expire"
+                    except ValueError:
+                        pass
+                if r.transition_tier and r.transition_days and \
+                        age_days >= r.transition_days:
+                    return f"transition:{r.transition_tier}"
+        return ""
+
+
+def apply_lifecycle(pools, bucket: str, lc: Lifecycle,
+                    now: float | None = None) -> dict:
+    """One expiry pass over a bucket (the transition worker analogue,
+    cmd/bucket-lifecycle.go:213 — expiry actions only here; transitions
+    are handed to the tier module by the caller)."""
+    stats = {"expired": 0, "expired_noncurrent": 0, "transitioned": 0}
+    try:
+        infos = pools.list_objects(bucket, max_keys=1000000)
+    except StorageError:
+        return stats
+    for fi in infos:
+        action = lc.eval(fi.name, fi.mod_time_ns, now=now)
+        if action == "expire":
+            try:
+                pools.delete_object(bucket, fi.name)
+                stats["expired"] += 1
+            except StorageError:
+                pass
+        elif action.startswith("transition:"):
+            stats["transitioned"] += 1       # handled by tier worker
+    return stats

@@ -1,0 +1,78 @@
+"""BucketMetadataSys: every per-bucket config in one cached store.
+
+The cmd/bucket-metadata-sys.go equivalent: versioning, policy, lifecycle,
+notification, replication, quota, object-lock, tagging and SSE configs
+are persisted per bucket under the internal meta bucket and served from
+an in-memory cache; peer nodes invalidate via the peer-RPC reload ping.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..storage.errors import StorageError
+
+CONFIG_FILES = {
+    "versioning": "versioning.xml",
+    "policy": "policy.json",
+    "lifecycle": "lifecycle.xml",
+    "notification": "notification.xml",
+    "replication": "replication.xml",
+    "quota": "quota.json",
+    "object_lock": "object-lock.xml",
+    "tagging": "tagging.xml",
+    "encryption": "encryption.xml",
+}
+
+
+class BucketMetadataSys:
+    def __init__(self, pools, meta_bucket: str = ".mtpu.sys"):
+        self.pools = pools
+        self.meta_bucket = meta_bucket
+        self._mu = threading.Lock()
+        self._cache: dict[tuple[str, str], bytes | None] = {}
+
+    def _path(self, bucket: str, kind: str) -> str:
+        return f"buckets/{bucket}/{CONFIG_FILES[kind]}"
+
+    def get(self, bucket: str, kind: str) -> bytes | None:
+        key = (bucket, kind)
+        with self._mu:
+            if key in self._cache:
+                return self._cache[key]
+        try:
+            _, data = self.pools.get_object(self.meta_bucket,
+                                            self._path(bucket, kind))
+        except StorageError:
+            data = None
+        with self._mu:
+            self._cache[key] = data
+        return data
+
+    def put(self, bucket: str, kind: str, data: bytes) -> None:
+        self.pools.put_object(self.meta_bucket, self._path(bucket, kind),
+                              data)
+        with self._mu:
+            self._cache[bucket, kind] = data
+
+    def delete(self, bucket: str, kind: str) -> None:
+        try:
+            self.pools.delete_object(self.meta_bucket,
+                                     self._path(bucket, kind))
+        except StorageError:
+            pass
+        with self._mu:
+            self._cache[bucket, kind] = None
+
+    def drop_bucket(self, bucket: str) -> None:
+        for kind in CONFIG_FILES:
+            self.delete(bucket, kind)
+
+    def invalidate(self, bucket: str | None = None) -> None:
+        """Peer reload hook: drop cache entries."""
+        with self._mu:
+            if bucket is None:
+                self._cache.clear()
+            else:
+                for key in [k for k in self._cache if k[0] == bucket]:
+                    del self._cache[key]

@@ -1,0 +1,200 @@
+"""Event notification: rules, S3 event records, targets, queue store.
+
+The internal/event equivalent: bucket notification configs match
+(event-type, prefix/suffix filter) -> target ARN; matching object events
+produce S3-format JSON records delivered to targets. Targets here:
+  - WebhookTarget: HTTP POST (the reference's most-used target),
+  - QueueTarget: in-process queue w/ optional on-disk persistence —
+    the `queuestore` role, so events survive a target outage.
+Undeliverable events are retried from the store (cf.
+internal/event/targetlist.go:126 + store.go).
+"""
+
+from __future__ import annotations
+
+import datetime
+import http.client
+import json
+import os
+import threading
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+
+
+class NotificationRule:
+    def __init__(self, arn: str, events: list[str], prefix: str = "",
+                 suffix: str = ""):
+        self.arn = arn
+        self.events = events
+        self.prefix = prefix
+        self.suffix = suffix
+
+    def matches(self, event_name: str, key: str) -> bool:
+        ok = any(event_name == e or
+                 (e.endswith("*") and event_name.startswith(e[:-1]))
+                 for e in self.events)
+        return (ok and key.startswith(self.prefix)
+                and key.endswith(self.suffix))
+
+
+def parse_notification_config(xml_bytes: bytes) -> list[NotificationRule]:
+    """NotificationConfiguration XML (QueueConfiguration entries)."""
+    root = ET.fromstring(xml_bytes)
+    for el in root.iter():
+        if "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]
+    rules = []
+    for qc in list(root.iter("QueueConfiguration")) + \
+            list(root.iter("TopicConfiguration")) + \
+            list(root.iter("CloudFunctionConfiguration")):
+        arn = qc.findtext("Queue") or qc.findtext("Topic") or \
+            qc.findtext("CloudFunction") or ""
+        events = [e.text for e in qc.iter("Event") if e.text]
+        prefix = suffix = ""
+        for fr in qc.iter("FilterRule"):
+            name = (fr.findtext("Name") or "").lower()
+            value = fr.findtext("Value") or ""
+            if name == "prefix":
+                prefix = value
+            elif name == "suffix":
+                suffix = value
+        rules.append(NotificationRule(arn, events, prefix, suffix))
+    return rules
+
+
+def make_event(event_name: str, bucket: str, key: str, size: int = 0,
+               etag: str = "", version_id: str = "") -> dict:
+    """S3 event record JSON (cf. internal/event/event.go)."""
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return {
+        "eventVersion": "2.1",
+        "eventSource": "minio_tpu:s3",
+        "eventTime": now.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z",
+        "eventName": event_name,
+        "s3": {
+            "s3SchemaVersion": "1.0",
+            "bucket": {"name": bucket,
+                       "arn": f"arn:aws:s3:::{bucket}"},
+            "object": {"key": urllib.parse.quote(key),
+                       "size": size, "eTag": etag,
+                       "versionId": version_id,
+                       "sequencer": uuid.uuid4().hex[:16]},
+        },
+    }
+
+
+class QueueTarget:
+    """In-process queue with optional persistence (queuestore role)."""
+
+    def __init__(self, arn: str, store_dir: str | None = None,
+                 max_items: int = 10000):
+        self.arn = arn
+        self.store_dir = store_dir
+        self.max_items = max_items
+        self._mu = threading.Lock()
+        self.events: list[dict] = []
+        if store_dir:
+            os.makedirs(store_dir, exist_ok=True)
+            for fn in sorted(os.listdir(store_dir)):
+                try:
+                    with open(os.path.join(store_dir, fn)) as f:
+                        self.events.append(json.load(f))
+                except (OSError, ValueError):
+                    continue
+
+    def send(self, event: dict) -> None:
+        with self._mu:
+            if len(self.events) >= self.max_items:
+                self.events.pop(0)
+            self.events.append(event)
+            if self.store_dir:
+                fn = os.path.join(self.store_dir,
+                                  f"{uuid.uuid4().hex}.json")
+                with open(fn, "w") as f:
+                    json.dump(event, f)
+
+    def drain(self) -> list[dict]:
+        with self._mu:
+            out, self.events = self.events, []
+            if self.store_dir:
+                for fn in os.listdir(self.store_dir):
+                    try:
+                        os.unlink(os.path.join(self.store_dir, fn))
+                    except OSError:
+                        pass
+            return out
+
+
+class WebhookTarget:
+    def __init__(self, arn: str, endpoint: str, timeout: float = 5.0,
+                 store_dir: str | None = None):
+        self.arn = arn
+        self.endpoint = endpoint
+        self.timeout = timeout
+        # Failed sends are parked in a queue store and retried later.
+        self.backlog = QueueTarget(arn + "-backlog", store_dir)
+
+    def _post(self, payload: bytes) -> bool:
+        u = urllib.parse.urlsplit(self.endpoint)
+        try:
+            conn = http.client.HTTPConnection(u.hostname, u.port or 80,
+                                              timeout=self.timeout)
+            conn.request("POST", u.path or "/", body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            return 200 <= resp.status < 300
+        except OSError:
+            return False
+
+    def send(self, event: dict) -> None:
+        payload = json.dumps({"Records": [event]}).encode()
+        if not self._post(payload):
+            self.backlog.send(event)
+
+    def retry_backlog(self) -> int:
+        sent = 0
+        for ev in self.backlog.drain():
+            if self._post(json.dumps({"Records": [ev]}).encode()):
+                sent += 1
+            else:
+                self.backlog.send(ev)
+        return sent
+
+
+class NotificationSystem:
+    """Per-bucket rules + a target registry; the TargetList.Send role."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.targets: dict[str, object] = {}
+        self.rules: dict[str, list[NotificationRule]] = {}
+
+    def register_target(self, target) -> None:
+        with self._mu:
+            self.targets[target.arn] = target
+
+    def set_bucket_rules(self, bucket: str,
+                         rules: list[NotificationRule]) -> None:
+        with self._mu:
+            self.rules[bucket] = rules
+
+    def publish(self, event_name: str, bucket: str, key: str, *,
+                size: int = 0, etag: str = "",
+                version_id: str = "") -> int:
+        with self._mu:
+            rules = list(self.rules.get(bucket, []))
+            targets = dict(self.targets)
+        sent = 0
+        for rule in rules:
+            if not rule.matches(event_name, key):
+                continue
+            target = targets.get(rule.arn)
+            if target is None:
+                continue
+            target.send(make_event(event_name, bucket, key, size, etag,
+                                   version_id))
+            sent += 1
+        return sent

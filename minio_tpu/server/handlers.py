@@ -83,34 +83,41 @@ def _valid_bucket_name(name: str) -> bool:
 class S3Handlers:
     """All bucket/object handlers; one instance per server."""
 
-    def __init__(self, pools: ServerPools):
+    def __init__(self, pools: ServerPools, *, notify=None,
+                 replication=None, scanner=None):
+        from ..bucket.metadata import BucketMetadataSys
         self.pools = pools
         try:
             pools.make_bucket(META_BUCKET)
         except StorageError:
             pass
+        self.meta = BucketMetadataSys(pools, META_BUCKET)
+        self.notify = notify              # bucket.notify.NotificationSystem
+        self.replication = replication    # bucket.replication.ReplicationPool
+        self.scanner = scanner            # background.scanner.DataScanner
 
-    # ---- bucket config helpers (persisted in the meta bucket) -------------
-
-    def _config_get(self, path: str) -> bytes | None:
-        try:
-            _, data = self.pools.get_object(META_BUCKET, path)
-            return data
-        except StorageError:
-            return None
-
-    def _config_put(self, path: str, data: bytes) -> None:
-        self.pools.put_object(META_BUCKET, path, data)
-
-    def _config_del(self, path: str) -> None:
-        try:
-            self.pools.delete_object(META_BUCKET, path)
-        except StorageError:
-            pass
+    # ---- bucket config helpers (persisted via BucketMetadataSys) ----------
 
     def bucket_versioning_enabled(self, bucket: str) -> bool:
-        data = self._config_get(f"buckets/{bucket}/versioning.xml")
+        data = self.meta.get(bucket, "versioning")
         return data is not None and b"<Status>Enabled</Status>" in data
+
+    def _publish_event(self, event: str, bucket: str, key: str,
+                       size: int = 0, etag: str = "",
+                       version_id: str = "") -> None:
+        if self.notify is not None:
+            self.notify.publish(event, bucket, key, size=size, etag=etag,
+                                version_id=version_id)
+
+    def _lock_config(self, bucket: str) -> dict | None:
+        from ..bucket import object_lock as ol
+        data = self.meta.get(bucket, "object_lock")
+        if data is None:
+            return None
+        try:
+            return ol.parse_lock_config(data)
+        except Exception:  # noqa: BLE001
+            return None
 
     # ---- service level ----------------------------------------------------
 
@@ -145,8 +152,7 @@ class S3Handlers:
         if self.pools.list_objects(bucket, max_keys=1):
             raise S3Error("BucketNotEmpty")
         self.pools.delete_bucket(bucket)
-        for cfg in ("versioning.xml",):
-            self._config_del(f"buckets/{bucket}/{cfg}")
+        self.meta.drop_bucket(bucket)
         return Response(204)
 
     def get_bucket_location(self, bucket: str) -> Response:
@@ -156,16 +162,84 @@ class S3Handlers:
 
     def put_bucket_versioning(self, bucket: str, body: bytes) -> Response:
         self.head_bucket(bucket)
-        self._config_put(f"buckets/{bucket}/versioning.xml", body)
+        self.meta.put(bucket, "versioning", body)
         return Response(200)
 
     def get_bucket_versioning(self, bucket: str) -> Response:
         self.head_bucket(bucket)
-        data = self._config_get(f"buckets/{bucket}/versioning.xml")
+        data = self.meta.get(bucket, "versioning")
         root = ET.Element("VersioningConfiguration", xmlns=S3_NS)
         if data is not None and b"Enabled" in data:
             _el(root, "Status", "Enabled")
         return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    # ---- generic bucket sub-resource configs ------------------------------
+
+    _CONFIG_KINDS = {
+        "lifecycle": ("lifecycle", "NoSuchLifecycleConfiguration"),
+        "policy": ("policy", "NoSuchBucketPolicy"),
+        "notification": ("notification",
+                         "NoSuchNotificationConfiguration"),
+        "replication": ("replication",
+                        "ReplicationConfigurationNotFoundError"),
+        "quota": ("quota", "NoSuchBucketPolicy"),
+        "object-lock": ("object_lock", "NoSuchObjectLockConfiguration"),
+        "tagging": ("tagging", "NoSuchTagSet"),
+        "encryption": ("encryption",
+                       "ServerSideEncryptionConfigurationNotFoundError"),
+    }
+
+    def put_bucket_config(self, bucket: str, sub: str,
+                          body: bytes) -> Response:
+        self.head_bucket(bucket)
+        kind, _ = self._CONFIG_KINDS[sub]
+        # Validate before storing (cf. per-config parse in
+        # cmd/bucket-handlers.go).
+        try:
+            if kind == "lifecycle":
+                from ..bucket.lifecycle import Lifecycle
+                Lifecycle.parse(body)
+            elif kind == "notification":
+                from ..bucket.notify import parse_notification_config
+                rules = parse_notification_config(body)
+                if self.notify is not None:
+                    self.notify.set_bucket_rules(bucket, rules)
+            elif kind == "replication":
+                from ..bucket.replication import parse_replication_config
+                parse_replication_config(body)
+            elif kind == "object_lock":
+                from ..bucket.object_lock import parse_lock_config
+                parse_lock_config(body)
+            elif kind == "quota":
+                from ..bucket.quota import parse_quota_config
+                parse_quota_config(body)
+            elif kind == "policy":
+                from ..iam.policy import Policy
+                Policy(body.decode())
+        except S3Error:
+            raise
+        except Exception:  # noqa: BLE001 — any parse failure
+            raise S3Error("MalformedXML") from None
+        self.meta.put(bucket, kind, body)
+        return Response(200)
+
+    def get_bucket_config(self, bucket: str, sub: str) -> Response:
+        self.head_bucket(bucket)
+        kind, missing_code = self._CONFIG_KINDS[sub]
+        data = self.meta.get(bucket, kind)
+        if data is None:
+            raise S3Error(missing_code)
+        ctype = ("application/json" if kind in ("policy", "quota")
+                 else "application/xml")
+        return Response(200, data, {"Content-Type": ctype})
+
+    def delete_bucket_config(self, bucket: str, sub: str) -> Response:
+        self.head_bucket(bucket)
+        kind, _ = self._CONFIG_KINDS[sub]
+        self.meta.delete(bucket, kind)
+        if kind == "notification" and self.notify is not None:
+            self.notify.set_bucket_rules(bucket, [])
+        return Response(204)
 
     # ---- listing ----------------------------------------------------------
 
@@ -372,13 +446,51 @@ class S3Handlers:
                     if k.startswith(AMZ_META_PREFIX)}
         if "content-type" in h:
             metadata["content-type"] = h["content-type"]
+
+        # Quota enforcement (cf. enforceBucketQuotaHard,
+        # cmd/bucket-quota.go).
+        quota_raw = self.meta.get(bucket, "quota")
+        if quota_raw is not None:
+            from ..bucket import quota as bq
+            reason = bq.check_quota(self.pools, bucket, len(body),
+                                    bq.parse_quota_config(quota_raw),
+                                    self.scanner)
+            if reason:
+                raise S3Error("QuotaExceeded", reason)
+
+        # Object-lock: existing protected version must not be silently
+        # replaced (unversioned overwrite destroys it); default retention
+        # from the bucket config applies to the new version.
+        lock_cfg = self._lock_config(bucket)
         versioned = self.bucket_versioning_enabled(bucket)
+        if lock_cfg is not None and lock_cfg.get("enabled"):
+            from ..bucket import object_lock as ol
+            if not versioned:
+                try:
+                    prev = self.pools.head_object(bucket, key)
+                    reason = ol.check_delete_allowed(prev.metadata)
+                    if reason:
+                        raise S3Error("ObjectLocked", reason)
+                except StorageError:
+                    pass
+            metadata.update(ol.default_retention_metadata(lock_cfg))
+            # explicit per-request retention headers win
+            for hk in (ol.RET_MODE_KEY, ol.RET_DATE_KEY, ol.LEGAL_HOLD_KEY):
+                if hk in h:
+                    metadata[hk] = h[hk]
+
         try:
             fi = self.pools.put_object(bucket, key, body, metadata=metadata,
                                        versioned=versioned)
         except StorageError as e:
             raise from_storage_error(e) from None
-        resp_headers = {"ETag": f'"{fi.metadata.get("etag", "")}"'}
+        etag = fi.metadata.get("etag", "")
+        self._publish_event("s3:ObjectCreated:Put", bucket, key,
+                            size=len(body), etag=etag,
+                            version_id=fi.version_id)
+        if self.replication is not None:
+            self.replication.on_put(bucket, key)
+        resp_headers = {"ETag": f'"{etag}"'}
         if fi.version_id:
             resp_headers["x-amz-version-id"] = fi.version_id
         return Response(200, headers=resp_headers)
@@ -411,9 +523,28 @@ class S3Handlers:
         _el(root, "LastModified", _iso(out.mod_time_ns))
         return Response(200, _xml(root), {"Content-Type": "application/xml"})
 
-    def delete_object(self, bucket: str, key: str, query: dict) -> Response:
+    def delete_object(self, bucket: str, key: str, query: dict,
+                      headers: dict[str, str] | None = None) -> Response:
         version_id = query.get("versionId", [""])[0]
         versioned = self.bucket_versioning_enabled(bucket)
+        hl = {k.lower(): v for k, v in (headers or {}).items()}
+
+        # WORM: deleting a SPECIFIC protected version is refused; an
+        # unversioned delete on a versioned bucket only writes a marker
+        # (data survives), which object lock permits.
+        if version_id or not versioned:
+            from ..bucket import object_lock as ol
+            try:
+                prev = self.pools.head_object(bucket, key, version_id)
+                bypass = hl.get(
+                    "x-amz-bypass-governance-retention", "") == "true"
+                reason = ol.check_delete_allowed(prev.metadata,
+                                                 bypass_governance=bypass)
+                if reason:
+                    raise S3Error("ObjectLocked", reason)
+            except StorageError:
+                pass
+
         try:
             dm = self.pools.delete_object(bucket, key, version_id, versioned)
         except StorageError as e:
@@ -422,11 +553,142 @@ class S3Handlers:
             if err.api.code == "NoSuchKey":
                 return Response(204)
             raise err from None
+        self._publish_event(
+            "s3:ObjectRemoved:DeleteMarkerCreated" if dm is not None
+            else "s3:ObjectRemoved:Delete", bucket, key,
+            version_id=version_id)
+        if self.replication is not None:
+            self.replication.on_delete(bucket, key)
         h = {}
         if dm is not None and dm.version_id:
             h = {"x-amz-version-id": dm.version_id,
                  "x-amz-delete-marker": "true"}
         return Response(204, headers=h)
+
+    # ---- object tagging / retention / legal hold ---------------------------
+
+    def put_object_tagging(self, bucket: str, key: str, query: dict,
+                           body: bytes) -> Response:
+        fi = self._head_for_update(bucket, key, query)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML") from None
+        for el in root.iter():
+            if "}" in el.tag:
+                el.tag = el.tag.split("}", 1)[1]
+        pairs = []
+        for tag_el in root.iter("Tag"):
+            k = tag_el.findtext("Key") or ""
+            v = tag_el.findtext("Value") or ""
+            pairs.append(f"{urllib.parse.quote(k)}={urllib.parse.quote(v)}")
+        self._update_metadata(bucket, key, fi,
+                              {"x-amz-tagging": "&".join(pairs)})
+        return Response(200)
+
+    def get_object_tagging(self, bucket: str, key: str,
+                           query: dict) -> Response:
+        fi = self._head_for_update(bucket, key, query)
+        root = ET.Element("Tagging", xmlns=S3_NS)
+        ts = _el(root, "TagSet")
+        raw = fi.metadata.get("x-amz-tagging", "")
+        if raw:
+            for pair in raw.split("&"):
+                k, _, v = pair.partition("=")
+                te = _el(ts, "Tag")
+                _el(te, "Key", urllib.parse.unquote(k))
+                _el(te, "Value", urllib.parse.unquote(v))
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def put_object_retention(self, bucket: str, key: str, query: dict,
+                             body: bytes,
+                             headers: dict | None = None) -> Response:
+        from ..bucket import object_lock as ol
+        fi = self._head_for_update(bucket, key, query)
+        try:
+            new_meta = ol.parse_retention_xml(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML") from None
+        if ol._parse_date(new_meta.get(ol.RET_DATE_KEY, "")) is None:
+            raise S3Error("InvalidRetentionDate")
+        hl = {k.lower(): v for k, v in (headers or {}).items()}
+        bypass = hl.get("x-amz-bypass-governance-retention", "") == "true"
+        # COMPLIANCE retention can only be extended; GOVERNANCE needs
+        # the bypass header to shorten (cf. enforceRetentionBypass).
+        if ol.is_retention_active(fi.metadata):
+            old_mode = fi.metadata.get(ol.RET_MODE_KEY, "").upper()
+            old_until = ol._parse_date(fi.metadata.get(ol.RET_DATE_KEY, ""))
+            new_until = ol._parse_date(new_meta[ol.RET_DATE_KEY])
+            shrinking = old_until and new_until and new_until < old_until
+            if old_mode == "COMPLIANCE" and shrinking:
+                raise S3Error("ObjectLocked",
+                              "compliance retention cannot be shortened")
+            if old_mode == "GOVERNANCE" and shrinking and not bypass:
+                raise S3Error("ObjectLocked",
+                              "governance retention needs bypass")
+        self._update_metadata(bucket, key, fi, new_meta)
+        return Response(200)
+
+    def get_object_retention(self, bucket: str, key: str,
+                             query: dict) -> Response:
+        from ..bucket import object_lock as ol
+        fi = self._head_for_update(bucket, key, query)
+        if not fi.metadata.get(ol.RET_MODE_KEY):
+            raise S3Error("NoSuchObjectLockConfiguration")
+        return Response(200, ol.retention_xml(fi.metadata),
+                        {"Content-Type": "application/xml"})
+
+    def put_object_legal_hold(self, bucket: str, key: str, query: dict,
+                              body: bytes) -> Response:
+        from ..bucket import object_lock as ol
+        fi = self._head_for_update(bucket, key, query)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML") from None
+        status = (root.findtext("Status")
+                  or root.findtext(f"{{{S3_NS}}}Status") or "OFF")
+        self._update_metadata(bucket, key, fi,
+                              {ol.LEGAL_HOLD_KEY: status.upper()})
+        return Response(200)
+
+    def get_object_legal_hold(self, bucket: str, key: str,
+                              query: dict) -> Response:
+        from ..bucket import object_lock as ol
+        fi = self._head_for_update(bucket, key, query)
+        root = ET.Element("LegalHold", xmlns=S3_NS)
+        _el(root, "Status",
+            "ON" if ol.is_legal_hold_on(fi.metadata) else "OFF")
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _head_for_update(self, bucket: str, key: str, query: dict):
+        version_id = query.get("versionId", [""])[0]
+        try:
+            return self.pools.head_object(bucket, key, version_id)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+
+    def _update_metadata(self, bucket: str, key: str, fi,
+                         updates: dict) -> None:
+        """Merge metadata keys into an existing version in place
+        (cf. updateObjectMetadata, cmd/erasure-object.go:1513)."""
+        meta = dict(fi.metadata)
+        meta.update({k: v for k, v in updates.items() if v})
+        for k, v in updates.items():
+            if not v:
+                meta.pop(k, None)
+        fi.metadata = meta
+        for pool in self.pools.pools:
+            sets = getattr(pool, "sets", [pool])
+            for es in sets:
+                try:
+                    res = es._map_drives(
+                        lambda d: d.update_metadata(bucket, key, fi))
+                    if any(e is None for _, e in res):
+                        return
+                except StorageError:
+                    continue
+        raise S3Error("InternalError", "metadata update failed")
 
     def delete_objects(self, bucket: str, body: bytes,
                        can_delete=None) -> Response:

@@ -32,11 +32,14 @@ class S3Server:
 
     def __init__(self, pools: ServerPools, creds: Credentials,
                  host: str = "127.0.0.1", port: int = 0,
-                 trace_sink=None, iam=None):
+                 trace_sink=None, iam=None, notify=None,
+                 replication=None, scanner=None):
         self.pools = pools
         self.creds = creds                 # root credentials (policy bypass)
         self.iam = iam                     # IAMSys | None
-        self.handlers = S3Handlers(pools)
+        self.handlers = S3Handlers(pools, notify=notify,
+                                   replication=replication,
+                                   scanner=scanner)
         self.trace_sink = trace_sink
         outer = self
 
@@ -147,7 +150,10 @@ class S3Server:
             return body, ak
         auth = req.headers.get("Authorization", "")
         if not auth:
-            raise S3Error("AccessDenied", "anonymous access is disabled")
+            # Anonymous: allowed only where the bucket policy grants it
+            # (the PolicySys role, cmd/bucket-policy.go) — _authorize
+            # makes that call with access_key "".
+            return body, ""
         payload_decl, ak = verify_header_signature(
             self._lookup_creds, req.command, path, query, headers, body)
         self._check_session_token(
@@ -168,8 +174,31 @@ class S3Server:
 
     # -- authorization (cf. checkRequestAuthType policy check) ---------------
 
+    _CONFIG_ACTIONS = {
+        "lifecycle": "LifecycleConfiguration",
+        "policy": "BucketPolicy",
+        "notification": "BucketNotification",
+        "replication": "ReplicationConfiguration",
+        "quota": "BucketPolicy",
+        "object-lock": "BucketObjectLockConfiguration",
+        "tagging": "BucketTagging",
+        "encryption": "EncryptionConfiguration",
+    }
+
     @staticmethod
     def _s3_action(method: str, bucket: str, key: str, query: dict) -> str:
+        verb = {"GET": "Get", "HEAD": "Get", "PUT": "Put",
+                "DELETE": "Delete"}.get(method, "Get")
+        if key:
+            for sub, base in (("tagging", "ObjectTagging"),
+                              ("retention", "ObjectRetention"),
+                              ("legal-hold", "ObjectLegalHold")):
+                if sub in query:
+                    return f"s3:{verb}{base}"
+        elif bucket:
+            for sub, base in S3Server._CONFIG_ACTIONS.items():
+                if sub in query:
+                    return f"s3:{verb}{base}"
         if not bucket:
             return "s3:ListAllMyBuckets"
         if not key:
@@ -210,15 +239,30 @@ class S3Server:
 
     def _authorize(self, access_key: str, method: str, bucket: str,
                    key: str, query: dict, source_ip: str = "") -> None:
+        action = self._s3_action(method, bucket, key, query)
+        resource = f"{bucket}/{key}" if key else bucket
+        ctx = {"s3:prefix": query.get("prefix", [""])[0],
+               "aws:SourceIp": source_ip}
+        if access_key == "":
+            # Anonymous request: only a bucket policy can grant it
+            # (cf. PolicySys.IsAllowed for anonymous,
+            # cmd/auth-handler.go + cmd/bucket-policy.go).
+            if bucket:
+                data = self.handlers.meta.get(bucket, "policy")
+                if data is not None:
+                    from ..iam.policy import Policy, PolicyError
+                    try:
+                        if Policy(data.decode()).is_allowed(
+                                action, resource, ctx):
+                            return
+                    except (PolicyError, ValueError):
+                        pass
+            raise S3Error("AccessDenied", "anonymous access denied")
         if access_key == self.creds.access_key or self.iam is None:
             return                               # root bypasses policy
         ident = self.iam.lookup(access_key)
         if ident is None:
             raise S3Error("InvalidAccessKeyId")
-        action = self._s3_action(method, bucket, key, query)
-        resource = f"{bucket}/{key}" if key else bucket
-        ctx = {"s3:prefix": query.get("prefix", [""])[0],
-               "aws:SourceIp": source_ip}
         if not self.iam.is_allowed(ident, action, resource, ctx):
             raise S3Error("AccessDenied",
                           f"{action} on {resource} denied")
@@ -323,13 +367,19 @@ class S3Server:
     def _dispatch_bucket(self, method, bucket, query, headers,
                          body, access_key="") -> Response:
         h = self.handlers
+        config_sub = next((s for s in h._CONFIG_KINDS
+                           if s in query and s != "versioning"), None)
         if method == "PUT":
             if "versioning" in query:
                 return h.put_bucket_versioning(bucket, body)
+            if config_sub:
+                return h.put_bucket_config(bucket, config_sub, body)
             return h.make_bucket(bucket)
         if method == "HEAD":
             return h.head_bucket(bucket)
         if method == "DELETE":
+            if config_sub:
+                return h.delete_bucket_config(bucket, config_sub)
             return h.delete_bucket(bucket)
         if method == "POST":
             if "delete" in query:
@@ -342,6 +392,8 @@ class S3Server:
                 return h.get_bucket_location(bucket)
             if "versioning" in query:
                 return h.get_bucket_versioning(bucket)
+            if config_sub:
+                return h.get_bucket_config(bucket, config_sub)
             if "uploads" in query:
                 return h.list_multipart_uploads(bucket, query)
             return h.list_objects(bucket, query)
@@ -353,17 +405,30 @@ class S3Server:
         if method == "PUT":
             if "partNumber" in query and "uploadId" in query:
                 return h.put_part(bucket, key, query, body)
+            if "tagging" in query:
+                return h.put_object_tagging(bucket, key, query, body)
+            if "retention" in query:
+                return h.put_object_retention(bucket, key, query, body,
+                                              headers)
+            if "legal-hold" in query:
+                return h.put_object_legal_hold(bucket, key, query, body)
             return h.put_object(bucket, key, body, headers)
         if method == "GET":
             if "uploadId" in query:
                 return h.list_parts(bucket, key, query)
+            if "tagging" in query:
+                return h.get_object_tagging(bucket, key, query)
+            if "retention" in query:
+                return h.get_object_retention(bucket, key, query)
+            if "legal-hold" in query:
+                return h.get_object_legal_hold(bucket, key, query)
             return h.get_object(bucket, key, query, headers)
         if method == "HEAD":
             return h.get_object(bucket, key, query, headers, head=True)
         if method == "DELETE":
             if "uploadId" in query:
                 return h.abort_multipart(bucket, key, query)
-            return h.delete_object(bucket, key, query)
+            return h.delete_object(bucket, key, query, headers)
         if method == "POST":
             if "uploads" in query:
                 return h.create_multipart(bucket, key, headers)

@@ -1,0 +1,340 @@
+"""Bucket feature tests: lifecycle, object lock, quota, tagging,
+notifications, replication — unit + signed end-to-end."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.bucket.lifecycle import Lifecycle, apply_lifecycle
+from minio_tpu.bucket.notify import (NotificationSystem, QueueTarget,
+                                     parse_notification_config)
+from minio_tpu.bucket.replication import (ReplicationPool,
+                                          parse_replication_config)
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.server.client import S3Client, S3ClientError
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ROOT, SECRET = "featadmin", "featadmin-secret"
+
+
+def make_pools(tmp_path, name="p"):
+    drives = [LocalDrive(str(tmp_path / name / f"d{i}")) for i in range(4)]
+    return ServerPools([ErasureSets(drives, set_drive_count=4)])
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    pools = make_pools(tmp_path)
+    notify = NotificationSystem()
+    srv = S3Server(pools, Credentials(ROOT, SECRET), notify=notify).start()
+    cli = S3Client(srv.endpoint, ROOT, SECRET)
+    yield srv, cli, notify
+    srv.shutdown()
+
+
+LC_XML = b"""<LifecycleConfiguration>
+ <Rule><ID>old</ID><Status>Enabled</Status>
+  <Filter><Prefix>logs/</Prefix></Filter>
+  <Expiration><Days>30</Days></Expiration>
+ </Rule>
+</LifecycleConfiguration>"""
+
+
+class TestLifecycle:
+    def test_parse_and_eval(self):
+        lc = Lifecycle.parse(LC_XML)
+        now = time.time()
+        old = int((now - 40 * 86400) * 1e9)
+        fresh = int((now - 5 * 86400) * 1e9)
+        assert lc.eval("logs/a", old) == "expire"
+        assert lc.eval("logs/a", fresh) == ""
+        assert lc.eval("data/a", old) == ""       # prefix filter
+
+    def test_noncurrent_expiry(self):
+        lc = Lifecycle.parse(b"""<LifecycleConfiguration><Rule>
+            <Status>Enabled</Status><Filter/>
+            <NoncurrentVersionExpiration><NoncurrentDays>7
+            </NoncurrentDays></NoncurrentVersionExpiration>
+            </Rule></LifecycleConfiguration>""")
+        old = int((time.time() - 10 * 86400) * 1e9)
+        assert lc.eval("k", old, is_latest=False) == "expire-noncurrent"
+        assert lc.eval("k", old, is_latest=True) == ""
+
+    def test_apply_expires_objects(self, tmp_path):
+        pools = make_pools(tmp_path, "lcp")
+        pools.make_bucket("lcb")
+        pools.put_object("lcb", "logs/old", b"x")
+        pools.put_object("lcb", "keep/me", b"y")
+        # Backdate via rewritten eval time instead of touching mtimes:
+        lc = Lifecycle.parse(LC_XML)
+        stats = apply_lifecycle(pools, "lcb", lc,
+                                now=time.time() + 40 * 86400)
+        assert stats["expired"] == 1
+        names = [fi.name for fi in pools.list_objects("lcb")]
+        assert names == ["keep/me"]
+
+    def test_config_endpoint_roundtrip(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("lcfg")
+        status, _, _ = cli._check(*cli.request(
+            "PUT", "/lcfg", query={"lifecycle": ""}, body=LC_XML))
+        assert status == 200
+        _, _, data = cli._check(*cli.request(
+            "GET", "/lcfg", query={"lifecycle": ""}))
+        assert b"<Days>30</Days>" in data
+        cli._check(*cli.request("DELETE", "/lcfg",
+                                query={"lifecycle": ""}))
+        status, _, data = cli.request("GET", "/lcfg",
+                                      query={"lifecycle": ""})
+        assert status == 404
+
+    def test_bad_config_rejected(self, stack):
+        _, cli, _ = stack
+        cli.make_bucket("lbad")
+        status, _, data = cli.request("PUT", "/lbad",
+                                      query={"lifecycle": ""},
+                                      body=b"<not-xml")
+        assert status == 400
+
+
+LOCK_XML = b"""<ObjectLockConfiguration>
+ <ObjectLockEnabled>Enabled</ObjectLockEnabled>
+ <Rule><DefaultRetention><Mode>GOVERNANCE</Mode><Days>1</Days>
+ </DefaultRetention></Rule>
+</ObjectLockConfiguration>"""
+
+
+class TestObjectLock:
+    def test_worm_protects_and_governance_bypass(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("worm")
+        cli._check(*cli.request("PUT", "/worm",
+                                query={"object-lock": ""}, body=LOCK_XML))
+        cli.put_object("worm", "doc", b"protected")
+        # default retention applied -> delete refused
+        with pytest.raises(S3ClientError) as ei:
+            cli.delete_object("worm", "doc")
+        assert ei.value.code == "ObjectLocked"
+        # governance bypass header allows it
+        status, _, _ = cli.request(
+            "DELETE", "/worm/doc",
+            headers={"x-amz-bypass-governance-retention": "true"})
+        assert status == 204
+
+    def test_legal_hold_blocks_even_bypass(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("hold")
+        cli.put_object("hold", "doc", b"x")
+        cli._check(*cli.request(
+            "PUT", "/hold/doc", query={"legal-hold": ""},
+            body=b"<LegalHold><Status>ON</Status></LegalHold>"))
+        _, _, data = cli._check(*cli.request(
+            "GET", "/hold/doc", query={"legal-hold": ""}))
+        assert b"<Status>ON</Status>" in data
+        status, _, _ = cli.request(
+            "DELETE", "/hold/doc",
+            headers={"x-amz-bypass-governance-retention": "true"})
+        assert status == 400
+        # release hold -> delete works
+        cli._check(*cli.request(
+            "PUT", "/hold/doc", query={"legal-hold": ""},
+            body=b"<LegalHold><Status>OFF</Status></LegalHold>"))
+        cli.delete_object("hold", "doc")
+
+    def test_retention_endpoint(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("ret")
+        cli.put_object("ret", "doc", b"x")
+        body = (b"<Retention><Mode>GOVERNANCE</Mode>"
+                b"<RetainUntilDate>2030-01-01T00:00:00Z"
+                b"</RetainUntilDate></Retention>")
+        cli._check(*cli.request("PUT", "/ret/doc",
+                                query={"retention": ""}, body=body))
+        _, _, data = cli._check(*cli.request(
+            "GET", "/ret/doc", query={"retention": ""}))
+        assert b"GOVERNANCE" in data and b"2030-01-01" in data
+        # compliance can't be shortened once set
+        body2 = (b"<Retention><Mode>COMPLIANCE</Mode>"
+                 b"<RetainUntilDate>2031-01-01T00:00:00Z"
+                 b"</RetainUntilDate></Retention>")
+        cli._check(*cli.request(
+            "PUT", "/ret/doc", query={"retention": ""}, body=body2,
+            headers={"x-amz-bypass-governance-retention": "true"}))
+        shorter = (b"<Retention><Mode>COMPLIANCE</Mode>"
+                   b"<RetainUntilDate>2030-06-01T00:00:00Z"
+                   b"</RetainUntilDate></Retention>")
+        status, _, _ = cli.request("PUT", "/ret/doc",
+                                   query={"retention": ""}, body=shorter)
+        assert status == 400
+
+
+class TestQuota:
+    def test_hard_quota_enforced(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("qbkt")
+        cfg = json.dumps({"quota": 10000, "quotatype": "hard"}).encode()
+        cli._check(*cli.request("PUT", "/qbkt", query={"quota": ""},
+                                body=cfg))
+        cli.put_object("qbkt", "a", b"x" * 6000)
+        with pytest.raises(S3ClientError) as ei:
+            cli.put_object("qbkt", "b", b"x" * 6000)
+        assert ei.value.code == "QuotaExceeded"
+        cli.put_object("qbkt", "small", b"x" * 1000)   # still fits
+
+
+class TestTagging:
+    def test_object_tagging_roundtrip(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("tag")
+        cli.put_object("tag", "obj", b"x")
+        body = (b"<Tagging><TagSet><Tag><Key>env</Key>"
+                b"<Value>prod</Value></Tag></TagSet></Tagging>")
+        cli._check(*cli.request("PUT", "/tag/obj",
+                                query={"tagging": ""}, body=body))
+        _, _, data = cli._check(*cli.request(
+            "GET", "/tag/obj", query={"tagging": ""}))
+        assert b"<Key>env</Key>" in data and b"<Value>prod</Value>" in data
+
+
+NOTIF_XML = b"""<NotificationConfiguration>
+ <QueueConfiguration>
+  <Queue>arn:minio:sqs::q1:webhook</Queue>
+  <Event>s3:ObjectCreated:*</Event>
+  <Filter><S3Key><FilterRule><Name>prefix</Name><Value>in/</Value>
+  </FilterRule></S3Key></Filter>
+ </QueueConfiguration>
+</NotificationConfiguration>"""
+
+
+class TestNotifications:
+    def test_rule_parse_and_match(self):
+        rules = parse_notification_config(NOTIF_XML)
+        assert len(rules) == 1
+        r = rules[0]
+        assert r.arn.endswith("webhook")
+        assert r.matches("s3:ObjectCreated:Put", "in/x")
+        assert not r.matches("s3:ObjectCreated:Put", "out/x")
+        assert not r.matches("s3:ObjectRemoved:Delete", "in/x")
+
+    def test_end_to_end_queue_events(self, stack):
+        srv, cli, notify = stack
+        q = QueueTarget("arn:minio:sqs::q1:webhook")
+        notify.register_target(q)
+        cli.make_bucket("evb")
+        cli._check(*cli.request("PUT", "/evb",
+                                query={"notification": ""},
+                                body=NOTIF_XML))
+        cli.put_object("evb", "in/hit", b"x")
+        cli.put_object("evb", "out/miss", b"x")
+        events = q.drain()
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["eventName"] == "s3:ObjectCreated:Put"
+        assert ev["s3"]["object"]["key"] == "in/hit"
+        assert ev["s3"]["bucket"]["name"] == "evb"
+
+    def test_queue_store_persists(self, tmp_path):
+        d = str(tmp_path / "qstore")
+        q = QueueTarget("arn:x", store_dir=d)
+        q.send({"eventName": "e1"})
+        q2 = QueueTarget("arn:x", store_dir=d)   # fresh process analogue
+        assert [e["eventName"] for e in q2.drain()] == ["e1"]
+
+
+REPL_XML = b"""<ReplicationConfiguration>
+ <Rule><Status>Enabled</Status><Prefix>rep/</Prefix>
+  <Destination><Bucket>arn:aws:s3:::dst-bucket</Bucket></Destination>
+ </Rule>
+</ReplicationConfiguration>"""
+
+
+class TestReplication:
+    def test_parse(self):
+        rules = parse_replication_config(REPL_XML)
+        assert len(rules) == 1
+        assert rules[0].prefix == "rep/"
+        assert rules[0].target_bucket == "dst-bucket"
+
+    def test_async_replication_between_pools(self, tmp_path):
+        src = make_pools(tmp_path, "src")
+        dst = make_pools(tmp_path, "dst")
+        src.make_bucket("srcb")
+        dst.make_bucket("dst-bucket")
+        pool = ReplicationPool(src)
+        pool.configure("srcb", parse_replication_config(REPL_XML), dst)
+        src.put_object("srcb", "rep/a", b"replicate me")
+        src.put_object("srcb", "skip/b", b"not me")
+        assert pool.on_put("srcb", "rep/a")
+        assert not pool.on_put("srcb", "skip/b")
+        assert pool.wait_idle()
+        fi, data = dst.get_object("dst-bucket", "rep/a")
+        assert data == b"replicate me"
+        assert fi.metadata["x-amz-replication-status"] == "REPLICA"
+        # delete-marker replication
+        src.delete_object("srcb", "rep/a")
+        pool.on_delete("srcb", "rep/a")
+        assert pool.wait_idle()
+        from minio_tpu.storage.errors import StorageError
+        with pytest.raises(StorageError):
+            dst.get_object("dst-bucket", "rep/a")
+        pool.stop()
+
+    def test_resync_replays_bucket(self, tmp_path):
+        src = make_pools(tmp_path, "rs")
+        dst = make_pools(tmp_path, "rd")
+        src.make_bucket("srcb")
+        dst.make_bucket("dst-bucket")
+        for i in range(3):
+            src.put_object("srcb", f"rep/{i}", f"v{i}".encode())
+        pool = ReplicationPool(src)
+        pool.configure("srcb", parse_replication_config(REPL_XML), dst)
+        assert pool.resync("srcb") == 3
+        assert pool.wait_idle()
+        for i in range(3):
+            _, data = dst.get_object("dst-bucket", f"rep/{i}")
+            assert data == f"v{i}".encode()
+        pool.stop()
+
+
+class TestBucketPolicyAnonymous:
+    def test_anonymous_download_via_bucket_policy(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("pub")
+        cli.put_object("pub", "file.txt", b"public data")
+        policy = json.dumps({"Version": "2012-10-17", "Statement": [
+            {"Effect": "Allow", "Principal": "*",
+             "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::pub/*"}]}).encode()
+        cli._check(*cli.request("PUT", "/pub", query={"policy": ""},
+                                body=policy))
+        import http.client
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+        conn.request("GET", "/pub/file.txt")
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        assert resp.status == 200 and data == b"public data"
+        # write still denied anonymously
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+        conn.request("PUT", "/pub/evil.txt", body=b"x")
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 403
+
+    def test_anonymous_denied_without_policy(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("priv")
+        cli.put_object("priv", "x", b"secret")
+        import http.client
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+        conn.request("GET", "/priv/x")
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 403
