@@ -1,0 +1,62 @@
+"""KMS: key-encryption-key service behind a narrow interface.
+
+The internal/kms equivalent: a KMS hands out (plaintext, sealed) data
+keys and unseals them later. StaticKMS seals with a locally-held master
+key (the reference's single-key KMS, internal/kms/single-key.go);
+the interface is what a KES-backed client would also implement.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+
+class KMSError(Exception):
+    pass
+
+
+class KMS:
+    """Interface: generate_data_key() -> (key_id, plaintext, sealed);
+    decrypt_data_key(key_id, sealed, context) -> plaintext."""
+
+    def generate_data_key(self, context: bytes = b""):
+        raise NotImplementedError
+
+    def decrypt_data_key(self, key_id: str, sealed: bytes,
+                         context: bytes = b"") -> bytes:
+        raise NotImplementedError
+
+
+class StaticKMS(KMS):
+    """Master key held in memory/env (MTPU_KMS_SECRET_KEY)."""
+
+    def __init__(self, master_key: bytes | None = None,
+                 key_id: str = "mtpu-default-key"):
+        if master_key is None:
+            env = os.environ.get("MTPU_KMS_SECRET_KEY", "")
+            master_key = (bytes.fromhex(env) if env
+                          else b"\x00" * 32)
+        if len(master_key) != 32:
+            raise KMSError("master key must be 32 bytes")
+        self._master = master_key
+        self.key_id = key_id
+
+    def generate_data_key(self, context: bytes = b""):
+        plaintext = secrets.token_bytes(32)
+        nonce = secrets.token_bytes(12)
+        sealed = nonce + AESGCM(self._master).encrypt(nonce, plaintext,
+                                                      context)
+        return self.key_id, plaintext, sealed
+
+    def decrypt_data_key(self, key_id: str, sealed: bytes,
+                         context: bytes = b"") -> bytes:
+        if key_id != self.key_id:
+            raise KMSError(f"unknown key id {key_id!r}")
+        try:
+            return AESGCM(self._master).decrypt(sealed[:12], sealed[12:],
+                                                context)
+        except Exception as e:  # noqa: BLE001
+            raise KMSError(f"unseal failed: {e}") from None

@@ -1,0 +1,410 @@
+"""S3 Select SQL: tokenizer + recursive-descent parser + evaluator.
+
+The internal/s3select/sql equivalent (the reference parses with
+participle and walks an AST the same way): the supported dialect is the
+S3 Select core —
+
+  SELECT */column-list/aggregates FROM S3Object[s] [alias]
+  [WHERE expr] [LIMIT n]
+
+with comparisons, AND/OR/NOT, arithmetic, LIKE, IN, IS [NOT] NULL,
+aggregates COUNT/SUM/AVG/MIN/MAX, and CAST-free dynamic typing (numeric
+strings compare numerically, like the reference's value coercion).
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class SQLError(ValueError):
+    pass
+
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<number>\d+\.\d+|\d+)
+    | (?P<string>'(?:[^']|'')*')
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_.]*|"[^"]+"|\[\d+\])
+    | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|\*|,|\+|-|/|%)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {"select", "from", "where", "limit", "and", "or", "not",
+             "like", "in", "is", "null", "as", "between", "escape"}
+
+
+def tokenize(sql: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(sql):
+        if sql[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise SQLError(f"bad token at {sql[pos:pos + 10]!r}")
+        pos = m.end()
+        if m.lastgroup == "ident":
+            text = m.group("ident")
+            if text.lower() in _KEYWORDS:
+                out.append(("kw", text.lower()))
+            else:
+                out.append(("ident", text))
+        else:
+            out.append((m.lastgroup, m.group(m.lastgroup)))
+    return out
+
+
+# -- AST nodes ---------------------------------------------------------------
+
+class Node:
+    pass
+
+
+class Literal(Node):
+    def __init__(self, value):
+        self.value = value
+
+
+class Column(Node):
+    def __init__(self, name: str):
+        self.name = name
+
+
+class BinOp(Node):
+    def __init__(self, op, left, right):
+        self.op, self.left, self.right = op, left, right
+
+
+class UnaryOp(Node):
+    def __init__(self, op, operand):
+        self.op, self.operand = op, operand
+
+
+class Agg(Node):
+    def __init__(self, fn: str, arg):
+        self.fn, self.arg = fn, arg
+
+
+class Query:
+    def __init__(self, projections, where, limit, star, aliases):
+        self.projections = projections    # list[(name, Node)]
+        self.where = where
+        self.limit = limit
+        self.star = star
+        self.aliases = aliases
+        self.has_aggregates = any(
+            isinstance(n, Agg) for _, n in projections)
+
+
+class Parser:
+    _AGG_FNS = {"count", "sum", "avg", "min", "max"}
+
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect(self, kind, value=None):
+        t = self.next()
+        if t[0] != kind or (value is not None and t[1].lower() != value):
+            raise SQLError(f"expected {value or kind}, got {t[1]!r}")
+        return t
+
+    # SELECT ... FROM S3Object [WHERE ...] [LIMIT n]
+    def parse(self) -> Query:
+        self.expect("kw", "select")
+        star = False
+        projections = []
+        if self.peek() == ("op", "*"):
+            self.next()
+            star = True
+        else:
+            while True:
+                node = self.parse_expr()
+                name = f"_{len(projections) + 1}"
+                if isinstance(node, Column):
+                    name = node.name.split(".")[-1]
+                if self.peek() == ("kw", "as"):
+                    self.next()
+                    name = self.next()[1]
+                projections.append((name, node))
+                if self.peek() == ("op", ","):
+                    self.next()
+                    continue
+                break
+        self.expect("kw", "from")
+        table = self.next()
+        if table[1].lower() not in ("s3object", "s3objects"):
+            raise SQLError(f"FROM must be S3Object, got {table[1]!r}")
+        alias = ""
+        if self.peek()[0] == "ident":
+            alias = self.next()[1]
+        where = None
+        limit = None
+        if self.peek() == ("kw", "where"):
+            self.next()
+            where = self.parse_expr()
+        if self.peek() == ("kw", "limit"):
+            self.next()
+            limit = int(self.expect("number")[1])
+        if self.peek()[0] != "eof":
+            raise SQLError(f"trailing tokens at {self.peek()[1]!r}")
+        return Query(projections, where, limit, star, {alias} if alias
+                     else set())
+
+    # precedence: OR < AND < NOT < comparison < additive < multiplicative
+    def parse_expr(self):
+        return self.parse_or()
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.peek() == ("kw", "or"):
+            self.next()
+            left = BinOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self):
+        left = self.parse_not()
+        while self.peek() == ("kw", "and"):
+            self.next()
+            left = BinOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self):
+        if self.peek() == ("kw", "not"):
+            self.next()
+            return UnaryOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self):
+        left = self.parse_additive()
+        t = self.peek()
+        if t[0] == "op" and t[1] in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            op = self.next()[1]
+            if op == "<>":
+                op = "!="
+            return BinOp(op, left, self.parse_additive())
+        if t == ("kw", "like"):
+            self.next()
+            return BinOp("like", left, self.parse_additive())
+        if t == ("kw", "between"):
+            self.next()
+            lo = self.parse_additive()
+            self.expect("kw", "and")
+            hi = self.parse_additive()
+            return BinOp("and", BinOp(">=", left, lo),
+                         BinOp("<=", left, hi))
+        if t == ("kw", "in"):
+            self.next()
+            self.expect("op", "(")
+            items = [self.parse_additive()]
+            while self.peek() == ("op", ","):
+                self.next()
+                items.append(self.parse_additive())
+            self.expect("op", ")")
+            node = BinOp("=", left, items[0])
+            for it in items[1:]:
+                node = BinOp("or", node, BinOp("=", left, it))
+            return node
+        if t == ("kw", "is"):
+            self.next()
+            negate = False
+            if self.peek() == ("kw", "not"):
+                self.next()
+                negate = True
+            self.expect("kw", "null")
+            node = UnaryOp("isnull", left)
+            return UnaryOp("not", node) if negate else node
+        return left
+
+    def parse_additive(self):
+        left = self.parse_multiplicative()
+        while self.peek()[0] == "op" and self.peek()[1] in ("+", "-"):
+            op = self.next()[1]
+            left = BinOp(op, left, self.parse_multiplicative())
+        return left
+
+    def parse_multiplicative(self):
+        left = self.parse_primary()
+        while self.peek()[0] == "op" and self.peek()[1] in ("*", "/", "%"):
+            op = self.next()[1]
+            left = BinOp(op, left, self.parse_primary())
+        return left
+
+    def parse_primary(self):
+        t = self.next()
+        if t[0] == "number":
+            return Literal(float(t[1]) if "." in t[1] else int(t[1]))
+        if t[0] == "string":
+            return Literal(t[1][1:-1].replace("''", "'"))
+        if t == ("op", "("):
+            node = self.parse_expr()
+            self.expect("op", ")")
+            return node
+        if t == ("op", "-"):
+            return BinOp("-", Literal(0), self.parse_primary())
+        if t[0] == "ident":
+            name = t[1].strip('"')
+            if name.lower() in self._AGG_FNS and self.peek() == ("op", "("):
+                self.next()
+                if self.peek() == ("op", "*"):
+                    self.next()
+                    arg = None
+                else:
+                    arg = self.parse_expr()
+                self.expect("op", ")")
+                return Agg(name.lower(), arg)
+            return Column(name)
+        if t == ("kw", "null"):
+            return Literal(None)
+        raise SQLError(f"unexpected token {t[1]!r}")
+
+
+def parse(sql: str) -> Query:
+    return Parser(tokenize(sql)).parse()
+
+
+# -- evaluation --------------------------------------------------------------
+
+def _coerce(v):
+    """Numeric strings act as numbers (the reference's dynamic typing)."""
+    if isinstance(v, str):
+        try:
+            return float(v) if "." in v or "e" in v.lower() else int(v)
+        except ValueError:
+            return v
+    return v
+
+
+def _like(value, pattern) -> bool:
+    if not isinstance(value, str) or not isinstance(pattern, str):
+        return False
+    rx = re.escape(pattern).replace("%", ".*").replace("_", ".")
+    return re.fullmatch(rx, value, re.DOTALL) is not None
+
+
+def eval_node(node: Node, record: dict, aliases: set):
+    if isinstance(node, Literal):
+        return node.value
+    if isinstance(node, Column):
+        name = node.name
+        head, _, rest = name.partition(".")
+        if rest and (head in aliases or head.lower() == "s3object"):
+            name = rest
+        if name in record:
+            return record[name]
+        return record.get(name.lower())
+    if isinstance(node, UnaryOp):
+        if node.op == "not":
+            return not eval_node(node.operand, record, aliases)
+        if node.op == "isnull":
+            return eval_node(node.operand, record, aliases) is None
+    if isinstance(node, BinOp):
+        if node.op == "and":
+            return bool(eval_node(node.left, record, aliases)) and \
+                bool(eval_node(node.right, record, aliases))
+        if node.op == "or":
+            return bool(eval_node(node.left, record, aliases)) or \
+                bool(eval_node(node.right, record, aliases))
+        lv = _coerce(eval_node(node.left, record, aliases))
+        rv = _coerce(eval_node(node.right, record, aliases))
+        try:
+            if node.op == "=":
+                return lv == rv
+            if node.op == "!=":
+                return lv != rv
+            if node.op == "<":
+                return lv < rv
+            if node.op == "<=":
+                return lv <= rv
+            if node.op == ">":
+                return lv > rv
+            if node.op == ">=":
+                return lv >= rv
+            if node.op == "+":
+                return lv + rv
+            if node.op == "-":
+                return lv - rv
+            if node.op == "*":
+                return lv * rv
+            if node.op == "/":
+                return lv / rv
+            if node.op == "%":
+                return lv % rv
+            if node.op == "like":
+                return _like(lv, rv)
+        except TypeError:
+            return None
+    raise SQLError(f"cannot evaluate {node!r}")
+
+
+class AggState:
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def update(self, v):
+        self.count += 1
+        if v is None:
+            return
+        v = _coerce(v)
+        if isinstance(v, (int, float)):
+            self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+
+def run_query(query: Query, records) -> list[dict]:
+    """records: iterable of dicts -> list of output row dicts."""
+    out = []
+    aggs: dict[int, AggState] = {}
+    n = 0
+    for record in records:
+        if query.where is not None and \
+                not eval_node(query.where, record, query.aliases):
+            continue
+        if query.has_aggregates:
+            for i, (_, node) in enumerate(query.projections):
+                if isinstance(node, Agg):
+                    st = aggs.setdefault(i, AggState())
+                    st.update(None if node.arg is None
+                              else eval_node(node.arg, record,
+                                             query.aliases))
+            continue
+        if query.star:
+            out.append(dict(record))
+        else:
+            row = {}
+            for name, node in query.projections:
+                row[name] = eval_node(node, record, query.aliases)
+            out.append(row)
+        n += 1
+        if query.limit is not None and n >= query.limit:
+            break
+    if query.has_aggregates:
+        row = {}
+        for i, (name, node) in enumerate(query.projections):
+            st = aggs.get(i, AggState())
+            if node.fn == "count":
+                row[name] = st.count
+            elif node.fn == "sum":
+                row[name] = st.sum
+            elif node.fn == "avg":
+                row[name] = st.sum / st.count if st.count else None
+            elif node.fn == "min":
+                row[name] = st.min
+            elif node.fn == "max":
+                row[name] = st.max
+        return [row]
+    return out

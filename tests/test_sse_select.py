@@ -1,0 +1,286 @@
+"""SSE (AES-GCM envelope), transparent compression, and S3 Select tests."""
+
+import base64
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from minio_tpu.crypto import sse
+from minio_tpu.crypto.kms import KMSError, StaticKMS
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.s3select import engine as sel
+from minio_tpu.s3select.sql import SQLError, parse, run_query
+from minio_tpu.server.client import S3Client, S3ClientError
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+from minio_tpu.utils import compress as cz
+
+ROOT, SECRET = "sseadmin", "sseadmin-secret"
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    srv = S3Server(pools, Credentials(ROOT, SECRET),
+                   kms=StaticKMS(b"\x42" * 32),
+                   compress_enabled=True).start()
+    cli = S3Client(srv.endpoint, ROOT, SECRET)
+    yield srv, cli
+    srv.shutdown()
+
+
+def ssec_headers(key: bytes) -> dict:
+    return {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key":
+            base64.b64encode(key).decode(),
+        "x-amz-server-side-encryption-customer-key-md5":
+            base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+
+
+class TestSealUnseal:
+    def test_roundtrip_and_tamper(self):
+        key = b"\x01" * 32
+        for size in (0, 1, 100, 64 * 1024, 200 * 1024 + 17):
+            data = np.random.default_rng(size).integers(
+                0, 256, size, dtype=np.uint8).tobytes()
+            blob = sse.seal(data, key)
+            assert sse.unseal(blob, key) == data
+        blob = bytearray(sse.seal(b"secret data", key))
+        blob[20] ^= 1
+        with pytest.raises(sse.SSEError):
+            sse.unseal(bytes(blob), key)
+
+    def test_packet_reorder_detected(self):
+        key = b"\x02" * 32
+        data = bytes(range(256)) * 1024      # 4 packets
+        blob = sse.seal(data, key)
+        import struct
+        base, rest = blob[:8], blob[8:]
+        # split packets
+        packets = []
+        pos = 0
+        while pos < len(rest):
+            (ln,) = struct.unpack(">I", rest[pos:pos + 4])
+            packets.append(rest[pos:pos + 4 + ln])
+            pos += 4 + ln
+        assert len(packets) >= 2
+        swapped = base + packets[1] + packets[0] + b"".join(packets[2:])
+        with pytest.raises(sse.SSEError):
+            sse.unseal(swapped, key)
+
+    def test_truncation_detected(self):
+        key = b"\x03" * 32
+        data = b"x" * (sse.PACKET_SIZE * 2)
+        blob = sse.seal(data, key)
+        import struct
+        (ln,) = struct.unpack(">I", blob[8:12])
+        truncated = blob[:8 + 4 + ln]        # drop the final packet
+        with pytest.raises(sse.SSEError):
+            sse.unseal(truncated, key)
+
+
+class TestKMS:
+    def test_data_key_roundtrip(self):
+        kms = StaticKMS(b"\x05" * 32)
+        kid, plain, sealed = kms.generate_data_key(b"ctx")
+        assert kms.decrypt_data_key(kid, sealed, b"ctx") == plain
+        with pytest.raises(KMSError):
+            kms.decrypt_data_key(kid, sealed, b"other-ctx")
+        with pytest.raises(KMSError):
+            kms.decrypt_data_key("wrong-id", sealed, b"ctx")
+
+
+class TestSSEEndToEnd:
+    def test_sse_s3(self, stack):
+        srv, cli = stack
+        cli.make_bucket("enc")
+        data = b"\x00" * 100000              # compressible AND encrypted
+        cli.put_object("enc", "obj.bin", data,
+                       headers={"x-amz-server-side-encryption": "AES256"})
+        assert cli.get_object("enc", "obj.bin") == data
+        h = cli.head_object("enc", "obj.bin")
+        assert h.get("x-amz-server-side-encryption") == "AES256"
+        assert int(h["Content-Length"]) == len(data)
+        # ciphertext (not plaintext) on disk
+        es = srv.pools.pools[0].sets[0]
+        fi = es.head_object("enc", "obj.bin")
+        raw = es.get_object("enc", "obj.bin")[1]
+        assert raw != data
+
+    def test_sse_c_requires_key(self, stack):
+        srv, cli = stack
+        cli.make_bucket("encc")
+        key = b"\x07" * 32
+        data = b"customer encrypted payload" * 100
+        cli.put_object("encc", "sec", data, headers=ssec_headers(key))
+        # without key: denied
+        with pytest.raises(S3ClientError) as ei:
+            cli.get_object("encc", "sec")
+        assert ei.value.code == "AccessDenied"
+        # wrong key: denied
+        status, _, _ = cli.request("GET", "/encc/sec",
+                                   headers=ssec_headers(b"\x08" * 32))
+        assert status == 403
+        # right key: plaintext
+        status, _, got = cli.request("GET", "/encc/sec",
+                                     headers=ssec_headers(key))
+        assert status == 200 and got == data
+
+    def test_sse_range_read(self, stack):
+        srv, cli = stack
+        cli.make_bucket("encr")
+        data = np.random.default_rng(9).integers(
+            0, 256, 200000, dtype=np.uint8).tobytes()
+        cli.put_object("encr", "r", data,
+                       headers={"x-amz-server-side-encryption": "AES256"})
+        status, _, got = cli.request(
+            "GET", "/encr/r",
+            headers={"Range": "bytes=1000-1999",
+                     "x-amz-server-side-encryption": "AES256"})
+        assert status == 206 and got == data[1000:2000]
+
+
+class TestCompression:
+    def test_compress_roundtrip_and_size(self, stack):
+        srv, cli = stack
+        cli.make_bucket("cmp")
+        data = b"A" * 500000                 # highly compressible
+        cli.put_object("cmp", "text.log", data)
+        assert cli.get_object("cmp", "text.log") == data
+        h = cli.head_object("cmp", "text.log")
+        assert int(h["Content-Length"]) == len(data)
+        # on-disk version is smaller
+        es = srv.pools.pools[0].sets[0]
+        fi = es.head_object("cmp", "text.log")
+        assert fi.size < len(data) // 10
+        keys, _ = cli.list_objects("cmp")
+        assert keys == ["text.log"]
+
+    def test_incompressible_passthrough(self):
+        rnd = np.random.default_rng(1).integers(
+            0, 256, 100000, dtype=np.uint8).tobytes()
+        out, meta = cz.compress(rnd)
+        assert out is rnd and meta == {}
+
+    def test_exclusions(self):
+        assert not cz.is_compressible("movie.mp4")
+        assert not cz.is_compressible("x.bin", "image/png")
+        assert cz.is_compressible("data.csv", "text/csv", 100000)
+
+
+CSV_DATA = (b"name,dept,salary\n"
+            b"alice,eng,120\n"
+            b"bob,eng,100\n"
+            b"carol,sales,90\n"
+            b"dave,sales,95\n")
+
+
+class TestSelectSQL:
+    def run(self, sql, data=CSV_DATA, header=True):
+        q = parse(sql)
+        return run_query(q, sel.read_csv(data, header=header))
+
+    def test_projection_where(self):
+        rows = self.run("SELECT name, salary FROM S3Object "
+                        "WHERE dept = 'eng'")
+        assert rows == [{"name": "alice", "salary": "120"},
+                        {"name": "bob", "salary": "100"}]
+
+    def test_numeric_comparison_and_star(self):
+        rows = self.run("SELECT * FROM S3Object WHERE salary > 95")
+        assert [r["name"] for r in rows] == ["alice", "bob"]
+
+    def test_aggregates(self):
+        rows = self.run("SELECT count(*) AS n, avg(salary) AS a, "
+                        "max(salary) AS mx FROM S3Object "
+                        "WHERE dept = 'sales'")
+        assert rows == [{"n": 2, "a": 92.5, "mx": 95}]
+
+    def test_like_and_limit(self):
+        rows = self.run("SELECT name FROM S3Object "
+                        "WHERE name LIKE '%a%' LIMIT 2")
+        assert [r["name"] for r in rows] == ["alice", "carol"]
+
+    def test_alias_and_arithmetic(self):
+        rows = self.run("SELECT s.name, s.salary * 2 AS double_pay "
+                        "FROM S3Object s WHERE s.salary < 95")
+        assert rows == [{"name": "carol", "double_pay": 180}]
+
+    def test_headerless_positional(self):
+        rows = self.run("SELECT _1 FROM S3Object WHERE _3 > 100",
+                        data=b"alice,eng,120\nbob,eng,100\n", header=False)
+        assert rows == [{"_1": "alice"}]
+
+    def test_json_input(self):
+        data = (b'{"a": 1, "b": "x"}\n{"a": 5, "b": "y"}\n')
+        q = parse("SELECT b FROM S3Object WHERE a >= 5")
+        rows = run_query(q, sel.read_json_lines(data))
+        assert rows == [{"b": "y"}]
+
+    def test_parse_error(self):
+        with pytest.raises(SQLError):
+            parse("SELECT FROM WHERE")
+        with pytest.raises(SQLError):
+            parse("SELECT * FROM othertable")
+
+
+SELECT_REQ = b"""<SelectObjectContentRequest>
+ <Expression>SELECT name FROM S3Object WHERE dept = 'eng'</Expression>
+ <ExpressionType>SQL</ExpressionType>
+ <InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>
+ </InputSerialization>
+ <OutputSerialization><CSV/></OutputSerialization>
+</SelectObjectContentRequest>"""
+
+
+class TestSelectEndToEnd:
+    def test_event_stream_response(self, stack):
+        srv, cli = stack
+        cli.make_bucket("sel")
+        cli.put_object("sel", "people.csv", CSV_DATA)
+        status, _, body = cli.request("POST", "/sel/people.csv",
+                                      query={"select": "",
+                                             "select-type": "2"},
+                                      body=SELECT_REQ)
+        assert status == 200, body
+        events = sel.decode_event_stream(body)
+        kinds = [k for k, _ in events]
+        assert kinds == ["Records", "Stats", "End"]
+        records = events[0][1]
+        assert records == b"alice\nbob\n"
+
+    def test_select_on_encrypted_compressed(self, stack):
+        srv, cli = stack
+        cli.make_bucket("selx")
+        cli.put_object("selx", "d.csv", CSV_DATA * 200,
+                       headers={"x-amz-server-side-encryption": "AES256"})
+        req = SELECT_REQ.replace(
+            b"SELECT name FROM S3Object WHERE dept = 'eng'",
+            b"SELECT count(*) FROM S3Object")
+        status, _, body = cli.request("POST", "/selx/d.csv",
+                                      query={"select": "",
+                                             "select-type": "2"},
+                                      body=req)
+        assert status == 200
+        events = sel.decode_event_stream(body)
+        assert events[0][1].strip() == str(4 * 200 + 199).encode()
+
+    def test_bad_sql_is_400(self, stack):
+        srv, cli = stack
+        cli.make_bucket("selb")
+        cli.put_object("selb", "d.csv", CSV_DATA)
+        req = SELECT_REQ.replace(
+            b"SELECT name FROM S3Object WHERE dept = 'eng'",
+            b"SELEKT nope")
+        status, _, body = cli.request("POST", "/selb/d.csv",
+                                      query={"select": "",
+                                             "select-type": "2"},
+                                      body=req)
+        assert status == 400 and b"SelectParseError" in body
