@@ -29,7 +29,13 @@ class Rule:
         self.id = _text(el, "ID")
         self.status = _text(el, "Status", "Enabled")
         flt = el.find("Filter")
-        self.prefix = _text(flt, "Prefix", _text(el, "Prefix"))
+        # S3 nests combined prefix+tag filters under <And>; a direct
+        # Prefix (or the legacy top-level one) also counts. Missing the
+        # And-prefix would silently widen the rule to the whole bucket.
+        and_el = flt.find("And") if flt is not None else None
+        self.prefix = (_text(flt, "Prefix")
+                       or _text(and_el, "Prefix")
+                       or _text(el, "Prefix"))
         self.tags: dict[str, str] = {}
         if flt is not None:
             for tag_el in flt.iter("Tag"):
@@ -103,24 +109,68 @@ class Lifecycle:
         return ""
 
 
+def _object_tags(fi) -> dict[str, str]:
+    import urllib.parse as up
+    raw = fi.metadata.get("x-amz-tagging", "")
+    out = {}
+    for pair in raw.split("&"):
+        if not pair:
+            continue
+        k, _, v = pair.partition("=")
+        out[up.unquote(k)] = up.unquote(v)
+    return out
+
+
 def apply_lifecycle(pools, bucket: str, lc: Lifecycle,
                     now: float | None = None) -> dict:
     """One expiry pass over a bucket (the transition worker analogue,
     cmd/bucket-lifecycle.go:213 — expiry actions only here; transitions
-    are handed to the tier module by the caller)."""
-    stats = {"expired": 0, "expired_noncurrent": 0, "transitioned": 0}
+    are handed to the tier module by the caller).
+
+    WORM-protected versions are skipped (the reference's lifecycle path
+    also runs retention enforcement before expiry) and noncurrent-expiry
+    rules walk the version list.
+    """
+    from . import object_lock as ol
+    stats = {"expired": 0, "expired_noncurrent": 0, "transitioned": 0,
+             "skipped_locked": 0}
     try:
         infos = pools.list_objects(bucket, max_keys=1000000)
     except StorageError:
         return stats
+    has_noncurrent = any(r.noncurrent_days for r in lc.rules)
     for fi in infos:
-        action = lc.eval(fi.name, fi.mod_time_ns, now=now)
+        tags = _object_tags(fi)
+        action = lc.eval(fi.name, fi.mod_time_ns, tags=tags, now=now)
         if action == "expire":
-            try:
-                pools.delete_object(bucket, fi.name)
-                stats["expired"] += 1
-            except StorageError:
-                pass
+            if ol.check_delete_allowed(fi.metadata):
+                stats["skipped_locked"] += 1
+            else:
+                try:
+                    pools.delete_object(bucket, fi.name)
+                    stats["expired"] += 1
+                except StorageError:
+                    pass
         elif action.startswith("transition:"):
             stats["transitioned"] += 1       # handled by tier worker
+        if not has_noncurrent:
+            continue
+        try:
+            versions = pools.list_object_versions(bucket, fi.name)
+        except StorageError:
+            continue
+        for v in versions:
+            if v.is_latest or not v.version_id:
+                continue
+            if lc.eval(fi.name, v.mod_time_ns, tags=tags,
+                       is_latest=False, now=now) != "expire-noncurrent":
+                continue
+            if ol.check_delete_allowed(v.metadata):
+                stats["skipped_locked"] += 1
+                continue
+            try:
+                pools.delete_object(bucket, fi.name, v.version_id)
+                stats["expired_noncurrent"] += 1
+            except StorageError:
+                pass
     return stats

@@ -40,11 +40,21 @@ class BucketMetadataSys:
         with self._mu:
             if key in self._cache:
                 return self._cache[key]
+        from ..storage.errors import (ErrBucketNotFound, ErrFileNotFound,
+                                      ErrObjectNotFound,
+                                      ErrVersionNotFound)
         try:
             _, data = self.pools.get_object(self.meta_bucket,
                                             self._path(bucket, kind))
+        except (ErrObjectNotFound, ErrVersionNotFound, ErrBucketNotFound,
+                ErrFileNotFound):
+            data = None                        # genuinely absent: cache it
         except StorageError:
-            data = None
+            # Transient failure (quorum/IO on the meta bucket): DO NOT
+            # cache 'absent' — that would silently disable quota/WORM/
+            # policy enforcement until restart. Propagate so the caller
+            # fails the request instead of failing open.
+            raise
         with self._mu:
             self._cache[key] = data
         return data

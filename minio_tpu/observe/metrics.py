@@ -1,0 +1,157 @@
+"""Prometheus metrics: counters/gauges/histograms + text exposition.
+
+The cmd/metrics-v2.go equivalent: API request/error counters by handler,
+in-flight gauge, latency histogram, plus cluster families (capacity,
+object/bucket counts from the scanner usage tree, heal stats). Rendered
+in the Prometheus text format at /minio/v2/metrics/{cluster,node}.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names=()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._mu = threading.Lock()
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, **labels) -> float:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._mu:
+            return self._values.get(key, 0.0)
+
+    def render(self, out: list) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} counter")
+        with self._mu:
+            if not self._values:
+                out.append(f"{self.name} 0")
+            for key, v in sorted(self._values.items()):
+                lbl = ",".join(f'{n}="{val}"' for n, val in
+                               zip(self.label_names, key))
+                out.append(f"{self.name}{{{lbl}}} {v:g}" if lbl
+                           else f"{self.name} {v:g}")
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._mu:
+            self._values[key] = value
+
+    def render(self, out: list) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} gauge")
+        with self._mu:
+            if not self._values:
+                out.append(f"{self.name} 0")
+            for key, v in sorted(self._values.items()):
+                lbl = ",".join(f'{n}="{val}"' for n, val in
+                               zip(self.label_names, key))
+                out.append(f"{self.name}{{{lbl}}} {v:g}" if lbl
+                           else f"{self.name} {v:g}")
+
+
+class Histogram:
+    BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, float("inf"))
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._mu = threading.Lock()
+        self._counts = [0] * len(self.BUCKETS)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, value: float) -> None:
+        with self._mu:
+            self._sum += value
+            self._n += 1
+            for i, b in enumerate(self.BUCKETS):
+                if value <= b:
+                    self._counts[i] += 1
+
+    def render(self, out: list) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} histogram")
+        with self._mu:
+            for b, c in zip(self.BUCKETS, self._counts):
+                le = "+Inf" if b == float("inf") else f"{b:g}"
+                out.append(f'{self.name}_bucket{{le="{le}"}} {c}')
+            out.append(f"{self.name}_sum {self._sum:g}")
+            out.append(f"{self.name}_count {self._n}")
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self.api_requests = Counter(
+            "mtpu_s3_requests_total", "S3 requests by API and status",
+            ("api", "status"))
+        self.api_errors = Counter(
+            "mtpu_s3_errors_total", "S3 error responses by code", ("code",))
+        self.inflight = Gauge(
+            "mtpu_s3_requests_inflight", "Requests currently being served")
+        self.latency = Histogram(
+            "mtpu_s3_ttfb_seconds", "Request latency seconds")
+        self.bytes_rx = Counter("mtpu_s3_rx_bytes_total",
+                                "Bytes received from clients")
+        self.bytes_tx = Counter("mtpu_s3_tx_bytes_total",
+                                "Bytes sent to clients")
+        self.bucket_usage = Gauge("mtpu_bucket_usage_total_bytes",
+                                  "Bucket usage from last scan", ("bucket",))
+        self.bucket_objects = Gauge("mtpu_bucket_objects",
+                                    "Object count from last scan",
+                                    ("bucket",))
+        self.heal_total = Counter("mtpu_heal_objects_healed_total",
+                                  "Objects healed")
+        self.drive_online = Gauge("mtpu_cluster_drives_online",
+                                  "Online drives")
+        self.drive_offline = Gauge("mtpu_cluster_drives_offline",
+                                   "Offline drives")
+
+    def observe_request(self, api: str, status: int, duration_s: float,
+                        rx: int, tx: int) -> None:
+        self.api_requests.inc(api=api, status=str(status))
+        if status >= 400:
+            self.api_errors.inc(code=str(status))
+        self.latency.observe(duration_s)
+        self.bytes_rx.inc(rx)
+        self.bytes_tx.inc(tx)
+
+    def update_cluster(self, pools, scanner=None) -> None:
+        online = offline = 0
+        for pool in pools.pools:
+            for es in getattr(pool, "sets", [pool]):
+                for d in es.drives:
+                    if d is None:
+                        offline += 1
+                    elif hasattr(d, "is_online") and not d.is_online():
+                        offline += 1
+                    else:
+                        online += 1
+        self.drive_online.set(online)
+        self.drive_offline.set(offline)
+        if scanner is not None:
+            usage = scanner.latest_usage()
+            if usage is not None:
+                for bucket, u in usage.buckets.items():
+                    self.bucket_usage.set(u.bytes, bucket=bucket)
+                    self.bucket_objects.set(u.objects, bucket=bucket)
+
+    def render(self) -> str:
+        out: list[str] = []
+        for m in (self.api_requests, self.api_errors, self.inflight,
+                  self.latency, self.bytes_rx, self.bytes_tx,
+                  self.bucket_usage, self.bucket_objects,
+                  self.heal_total, self.drive_online, self.drive_offline):
+            m.render(out)
+        return "\n".join(out) + "\n"

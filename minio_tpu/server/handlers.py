@@ -84,8 +84,10 @@ class S3Handlers:
     """All bucket/object handlers; one instance per server."""
 
     def __init__(self, pools: ServerPools, *, notify=None,
-                 replication=None, scanner=None):
+                 replication=None, scanner=None, kms=None,
+                 compress_enabled: bool = False):
         from ..bucket.metadata import BucketMetadataSys
+        from ..crypto.kms import StaticKMS
         self.pools = pools
         try:
             pools.make_bucket(META_BUCKET)
@@ -95,6 +97,35 @@ class S3Handlers:
         self.notify = notify              # bucket.notify.NotificationSystem
         self.replication = replication    # bucket.replication.ReplicationPool
         self.scanner = scanner            # background.scanner.DataScanner
+        self.kms = kms if kms is not None else StaticKMS()
+        self.compress_enabled = compress_enabled
+
+    # Client-visible size of a transformed (compressed/encrypted) object.
+    CLIENT_SIZE_KEY = "x-mtpu-internal-client-size"
+
+    def _logical_size(self, fi) -> int:
+        return int(fi.metadata.get(self.CLIENT_SIZE_KEY, fi.size))
+
+    def _read_plaintext(self, bucket: str, key: str, version_id: str,
+                        headers: dict) -> tuple:
+        """Fetch an object and reverse its storage transforms
+        (decrypt -> decompress); returns (fi, plaintext)."""
+        from ..crypto import sse
+        from ..utils import compress as cz
+        try:
+            fi, stored = self.pools.get_object(bucket, key,
+                                               version_id=version_id)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        data = stored
+        if sse.is_encrypted(fi.metadata):
+            try:
+                data = sse.decrypt_for_get(data, fi.metadata, headers,
+                                           self.kms)
+            except sse.SSEError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+        data = cz.decompress(data, fi.metadata)
+        return fi, data
 
     # ---- bucket config helpers (persisted via BucketMetadataSys) ----------
 
@@ -307,7 +338,7 @@ class S3Handlers:
                 _el(c, "Key", name)
                 _el(c, "LastModified", _iso(fi.mod_time_ns))
                 _el(c, "ETag", f'"{fi.metadata.get("etag", "")}"')
-                _el(c, "Size", fi.size)
+                _el(c, "Size", self._logical_size(fi))
                 _el(c, "StorageClass", "STANDARD")
         return Response(200, _xml(root), {"Content-Type": "application/xml"})
 
@@ -387,42 +418,93 @@ class S3Handlers:
 
     def get_object(self, bucket: str, key: str, query: dict,
                    headers: dict[str, str], head: bool = False) -> Response:
+        from ..crypto import sse
+        from ..utils import compress as cz
         version_id = query.get("versionId", [""])[0]
         try:
-            if head:
-                fi = self.pools.head_object(bucket, key, version_id)
-                data = b""
-            else:
-                fi = self.pools.head_object(bucket, key, version_id)
+            fi = self.pools.head_object(bucket, key, version_id)
         except StorageError as e:
             raise from_storage_error(e) from None
         self._check_conditions(headers, fi)
 
+        transformed = (sse.is_encrypted(fi.metadata)
+                       or cz.is_compressed(fi.metadata))
+        size = self._logical_size(fi)
         rng = headers.get("Range") or headers.get("range")
-        offset, length = 0, fi.size
+        offset, length = 0, size
         partial = False
         if rng:
-            parsed = self._parse_range(rng, fi.size)
+            parsed = self._parse_range(rng, size)
             if parsed:
                 offset, length = parsed
                 partial = True
+        data = b""
         if not head:
-            try:
-                fi, data = self.pools.get_object(bucket, key, offset, length,
-                                                 version_id)
-            except StorageError as e:
-                raise from_storage_error(e) from None
+            if transformed:
+                # Ranged reads on transformed objects decode the whole
+                # stream then slice by logical offsets (cf. the decrypt/
+                # decompress cleanup stack in GetObjectReader,
+                # cmd/object-api-utils.go:528).
+                fi, full = self._read_plaintext(bucket, key, version_id,
+                                                headers)
+                data = full[offset:offset + length]
+            else:
+                try:
+                    fi, data = self.pools.get_object(bucket, key, offset,
+                                                     length, version_id)
+                except StorageError as e:
+                    raise from_storage_error(e) from None
+        elif transformed and sse.is_encrypted(fi.metadata):
+            # HEAD on SSE-C must still verify the presented key.
+            algo = fi.metadata.get(sse.META_ALGO)
+            if algo == "SSE-C":
+                try:
+                    k = sse.parse_ssec_key(headers)
+                except sse.SSEError as e:
+                    raise S3Error("AccessDenied", str(e)) from None
+                import base64
+                import hashlib as _hl
+                if k is None or base64.b64encode(
+                        _hl.md5(k).digest()).decode() != \
+                        fi.metadata.get(sse.META_KEY_MD5, ""):
+                    raise S3Error("AccessDenied",
+                                  "SSE-C key required for HEAD")
 
         h = self._object_headers(fi)
+        h.update(sse.response_headers(fi.metadata))
         if partial:
             h["Content-Range"] = \
-                f"bytes {offset}-{offset + length - 1}/{fi.size}"
+                f"bytes {offset}-{offset + length - 1}/{size}"
             h["Content-Length"] = str(length)
             status = 206
         else:
-            h["Content-Length"] = str(fi.size)
+            h["Content-Length"] = str(size)
             status = 200
         return Response(status, b"" if head else data, h)
+
+    def select_object_content(self, bucket: str, key: str, query: dict,
+                              body: bytes,
+                              headers: dict[str, str]) -> Response:
+        """POST /bucket/key?select&select-type=2
+        (cf. SelectObjectContentHandler, cmd/object-handlers.go:101)."""
+        from ..s3select.engine import execute_select, parse_select_request
+        from ..s3select.sql import SQLError
+        import xml.etree.ElementTree as ETmod
+        try:
+            opts = parse_select_request(body)
+        except ETmod.ParseError:
+            raise S3Error("MalformedXML") from None
+        version_id = query.get("versionId", [""])[0]
+        _, data = self._read_plaintext(bucket, key, version_id, headers)
+        try:
+            out = execute_select(data, opts)
+        except SQLError as e:
+            raise S3Error("SelectParseError", str(e)) from None
+        except Exception as e:  # noqa: BLE001 — bad data/query combos
+            raise S3Error("SelectParseError",
+                          f"{type(e).__name__}: {e}") from None
+        return Response(200, out,
+                        {"Content-Type": "application/octet-stream"})
 
     def put_object(self, bucket: str, key: str, body: bytes,
                    headers: dict[str, str]) -> Response:
@@ -479,8 +561,29 @@ class S3Handlers:
                 if hk in h:
                     metadata[hk] = h[hk]
 
+        # Storage transforms: compress, then encrypt (the reference
+        # composes the same way — compressed plaintext is sealed,
+        # cf. cmd/object-api-utils.go:903 + cmd/encryption-v1.go:303).
+        from ..crypto import sse
+        from ..utils import compress as cz
+        stored = body
+        transform_meta: dict = {}
+        if self.compress_enabled and cz.is_compressible(
+                key, metadata.get("content-type", ""), len(body)):
+            stored, cu = cz.compress(stored)
+            transform_meta.update(cu)
         try:
-            fi = self.pools.put_object(bucket, key, body, metadata=metadata,
+            stored, su = sse.encrypt_for_put(stored, h, self.kms)
+        except sse.SSEError as e:
+            raise S3Error("InvalidArgument", str(e)) from None
+        transform_meta.update(su)
+        if transform_meta:
+            transform_meta[self.CLIENT_SIZE_KEY] = str(len(body))
+            metadata.update(transform_meta)
+
+        try:
+            fi = self.pools.put_object(bucket, key, stored,
+                                       metadata=metadata,
                                        versioned=versioned)
         except StorageError as e:
             raise from_storage_error(e) from None
@@ -557,7 +660,10 @@ class S3Handlers:
             "s3:ObjectRemoved:DeleteMarkerCreated" if dm is not None
             else "s3:ObjectRemoved:Delete", bucket, key,
             version_id=version_id)
-        if self.replication is not None:
+        # Only a delete of the CURRENT object propagates to replication
+        # targets; removing a specific noncurrent version must not take
+        # down the target's live copy.
+        if self.replication is not None and not version_id:
             self.replication.on_delete(bucket, key)
         h = {}
         if dm is not None and dm.version_id:
@@ -718,10 +824,19 @@ class S3Handlers:
                 _el(ee, "Message", "Access Denied.")
                 continue
             try:
-                self.pools.delete_object(bucket, key, vid, versioned)
+                # Route through the single-delete path so object-lock
+                # enforcement, events and replication all apply — the
+                # bulk path must not be a WORM bypass.
+                q = {"versionId": [vid]} if vid else {}
+                self.delete_object(bucket, key, q)
                 if not quiet:
                     d = _el(out, "Deleted")
                     _el(d, "Key", key)
+            except S3Error as err:
+                ee = _el(out, "Error")
+                _el(ee, "Key", key)
+                _el(ee, "Code", err.api.code)
+                _el(ee, "Message", err.message)
             except StorageError as e:
                 err = from_storage_error(e)
                 if err.api.code == "NoSuchKey":
@@ -744,6 +859,12 @@ class S3Handlers:
                     if k.startswith(AMZ_META_PREFIX)}
         if "content-type" in h:
             metadata["content-type"] = h["content-type"]
+        # Default retention stamps the upload now; the lock/quota gate
+        # runs again at complete time when the size is known.
+        lock_cfg = self._lock_config(bucket)
+        if lock_cfg is not None and lock_cfg.get("enabled"):
+            from ..bucket import object_lock as ol
+            metadata.update(ol.default_retention_metadata(lock_cfg))
         try:
             upload_id = self.pools.new_multipart_upload(bucket, key,
                                                         metadata=metadata)
@@ -783,16 +904,53 @@ class S3Handlers:
                     or "").strip('"')
             parts.append((int(num), etag))
         versioned = self.bucket_versioning_enabled(bucket)
+
+        # Same write-path gates as put_object — multipart must not be a
+        # quota/WORM bypass (the reference runs these in
+        # CompleteMultipartUploadHandler too).
+        try:
+            stored = {p.number: p
+                      for p in self.pools.list_parts(bucket, key,
+                                                     upload_id)}
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        total = sum(stored[n].size for n, _ in parts if n in stored)
+        quota_raw = self.meta.get(bucket, "quota")
+        if quota_raw is not None:
+            from ..bucket import quota as bq
+            reason = bq.check_quota(self.pools, bucket, total,
+                                    bq.parse_quota_config(quota_raw),
+                                    self.scanner)
+            if reason:
+                raise S3Error("QuotaExceeded", reason)
+        lock_cfg = self._lock_config(bucket)
+        if lock_cfg is not None and lock_cfg.get("enabled") \
+                and not versioned:
+            from ..bucket import object_lock as ol
+            try:
+                prev = self.pools.head_object(bucket, key)
+                reason = ol.check_delete_allowed(prev.metadata)
+                if reason:
+                    raise S3Error("ObjectLocked", reason)
+            except StorageError:
+                pass
+
         try:
             fi = self.pools.complete_multipart_upload(bucket, key, upload_id,
                                                       parts,
                                                       versioned=versioned)
         except StorageError as e:
             raise from_storage_error(e) from None
+        etag = fi.metadata.get("etag", "")
+        self._publish_event(
+            "s3:ObjectCreated:CompleteMultipartUpload", bucket, key,
+            size=fi.size, etag=etag, version_id=fi.version_id)
+        if self.replication is not None:
+            self.replication.on_put(bucket, key)
         root = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
         _el(root, "Bucket", bucket)
         _el(root, "Key", key)
-        _el(root, "ETag", f'"{fi.metadata.get("etag", "")}"')
+        _el(root, "ETag", f'"{etag}"')
         return Response(200, _xml(root), {"Content-Type": "application/xml"})
 
     def abort_multipart(self, bucket: str, key: str, query: dict) -> Response:

@@ -33,14 +33,26 @@ class S3Server:
     def __init__(self, pools: ServerPools, creds: Credentials,
                  host: str = "127.0.0.1", port: int = 0,
                  trace_sink=None, iam=None, notify=None,
-                 replication=None, scanner=None):
+                 replication=None, scanner=None, kms=None,
+                 compress_enabled: bool = False):
         self.pools = pools
         self.creds = creds                 # root credentials (policy bypass)
         self.iam = iam                     # IAMSys | None
         self.handlers = S3Handlers(pools, notify=notify,
                                    replication=replication,
-                                   scanner=scanner)
+                                   scanner=scanner, kms=kms,
+                                   compress_enabled=compress_enabled)
         self.trace_sink = trace_sink
+        from ..observe.logger import Logger, RingTarget
+        from ..observe.metrics import MetricsRegistry
+        from ..observe.trace import HTTPTracer
+        self.metrics = MetricsRegistry()
+        self.tracer = HTTPTracer()
+        self.log = Logger()
+        self.log_ring = RingTarget()
+        self.log.add_target(self.log_ring)
+        self.audit_targets: list = []
+        self.scanner = scanner
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -63,20 +75,58 @@ class S3Server:
                     self.wfile.write(body)
 
             def _handle(self):
+                import time as _time
                 self.request_id = secrets.token_hex(8)
                 parsed = urllib.parse.urlsplit(self.path)
                 path = urllib.parse.unquote(parsed.path)
                 query = urllib.parse.parse_qs(parsed.query,
                                               keep_blank_values=True)
+                t0 = _time.perf_counter()
+                outer.metrics.inflight.inc(1)
+                access_key = ""
                 try:
-                    resp = outer._dispatch(self, path, query)
+                    if path.startswith("/minio/admin/"):
+                        resp = outer._dispatch(self, path, query)
+                    elif path.startswith("/minio/"):
+                        resp = outer._dispatch_internal(self, path, query)
+                    else:
+                        resp = outer._dispatch(self, path, query)
                 except S3Error as e:
                     resp = error_response(e, path, self.request_id)
                 except Exception as e:  # noqa: BLE001
+                    outer.log.error(f"handler crash: {e}",
+                                    path=path, request_id=self.request_id)
                     resp = error_response(
                         S3Error("InternalError",
                                 f"{type(e).__name__}: {e}"),
                         path, self.request_id)
+                finally:
+                    outer.metrics.inflight.inc(-1)
+                dur = (_time.perf_counter() - t0)
+                api = f"{self.command} {path.split('/')[1] if '/' in path else ''}"
+                outer.metrics.observe_request(
+                    self.command, resp.status, dur,
+                    int(self.headers.get("Content-Length", 0) or 0),
+                    len(resp.body or b""))
+                outer.tracer.trace(
+                    method=self.command, path=path, status=resp.status,
+                    duration_ms=dur * 1e3,
+                    request_size=int(self.headers.get("Content-Length",
+                                                      0) or 0),
+                    response_size=len(resp.body or b""),
+                    source_ip=self.client_address[0])
+                if outer.audit_targets:
+                    from ..observe.logger import audit_entry
+                    entry = audit_entry(
+                        method=self.command, path=path,
+                        status=resp.status, duration_ms=dur * 1e3,
+                        source_ip=self.client_address[0],
+                        request_id=self.request_id)
+                    for t in outer.audit_targets:
+                        try:
+                            t.send(entry)
+                        except Exception:  # noqa: BLE001
+                            continue
                 self._respond(resp)
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
@@ -234,6 +284,8 @@ class S3Server:
             return ("s3:DeleteObjectVersion" if "versionId" in query
                     else "s3:DeleteObject")
         if method == "POST":
+            if "select" in query:
+                return "s3:GetObject"
             return "s3:PutObject"
         return "s3:GetObject"
 
@@ -267,11 +319,119 @@ class S3Server:
             raise S3Error("AccessDenied",
                           f"{action} on {resource} denied")
 
+    # -- admin API (cf. registerAdminRouter, cmd/admin-router.go:40) ---------
+
+    def _dispatch_admin(self, access_key: str, method: str, path: str,
+                        query: dict, body: bytes) -> Response:
+        import json as _json
+        import time as _time
+        if access_key != self.creds.access_key:
+            # Admin surface is root-only here (the reference also allows
+            # admin-policy users; root covers the parity need).
+            raise S3Error("AccessDenied", "admin API requires root")
+        sub = path[len("/minio/admin/v1/"):].strip("/")
+        j = lambda obj, status=200: Response(
+            status, _json.dumps(obj).encode(),
+            {"Content-Type": "application/json"})
+
+        if sub == "info" and method == "GET":
+            from ..observe.health import cluster_health
+            ok, detail = cluster_health(self.pools)
+            return j({"mode": "online" if ok else "degraded",
+                      "buckets": len([b for b in self.pools.list_buckets()
+                                      if b != ".mtpu.sys"]),
+                      "deploymentId": self.pools.deployment_id,
+                      "sets": detail["sets"]})
+        if sub == "datausage" and method == "GET":
+            if self.scanner is None:
+                return j({"error": "scanner not running"}, 503)
+            usage = self.scanner.latest_usage()
+            if usage is None:
+                usage = self.scanner.scan_cycle()
+            return j({"buckets": {b: u.to_obj()
+                                  for b, u in usage.buckets.items()},
+                      "scannedAt": usage.scanned_at})
+        if sub == "heal":
+            if not hasattr(self, "heal_state"):
+                from ..background.heal_ops import HealState
+                self.heal_state = HealState(self.pools)
+            if method == "POST":
+                seq = self.heal_state.launch(
+                    bucket=query.get("bucket", [""])[0],
+                    prefix=query.get("prefix", [""])[0],
+                    deep=query.get("deep", [""])[0] == "true")
+                return j(seq.status())
+            return j({"sequences": self.heal_state.statuses()})
+        if sub == "trace" and method == "GET":
+            if not hasattr(self, "_trace_ring"):
+                self._trace_ring = self.tracer.pubsub.subscribe(2000)
+            items = list(self._trace_ring)
+            self._trace_ring.clear()
+            return j({"trace": items})
+        if sub == "console" and method == "GET":
+            n = int(query.get("n", ["100"])[0] or 100)
+            return j({"log": self.log_ring.tail(n)})
+        if sub == "users":
+            if self.iam is None:
+                return j({"error": "IAM not enabled"}, 501)
+            if method == "GET":
+                return j({"users": self.iam.list_users()})
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                try:
+                    self.iam.add_user(req_obj["accessKey"],
+                                      req_obj["secretKey"],
+                                      req_obj.get("policies", []))
+                except (KeyError, ValueError) as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return j({"ok": True})
+            if method == "DELETE":
+                self.iam.remove_user(query.get("accessKey", [""])[0])
+                return j({"ok": True})
+        if sub == "policies" and method == "POST":
+            if self.iam is None:
+                return j({"error": "IAM not enabled"}, 501)
+            req_obj = _json.loads(body or b"{}")
+            try:
+                self.iam.set_policy(req_obj["name"], req_obj["policy"])
+            except (KeyError, ValueError) as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            return j({"ok": True})
+        if sub == "service" and method == "POST":
+            return j({"action": query.get("action", ["status"])[0],
+                      "acknowledged": True, "at": _time.time()})
+        raise S3Error("MethodNotAllowed",
+                      f"unknown admin endpoint {sub!r}")
+
+    def _dispatch_internal(self, req, path: str, query: dict) -> Response:
+        """Unauthenticated infra endpoints: health + metrics
+        (cf. cmd/metrics-router.go:46, cmd/healthcheck-handler.go)."""
+        import json as _json
+
+        from ..observe.health import cluster_health
+        if path in ("/minio/health/live", "/minio/health/ready"):
+            return Response(200)
+        if path == "/minio/health/cluster":
+            maint = int(query.get("maintenance", ["0"])[0] or 0)
+            ok, detail = cluster_health(self.pools, maint)
+            return Response(200 if ok else 503,
+                            _json.dumps(detail).encode(),
+                            {"Content-Type": "application/json"})
+        if path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node"):
+            self.metrics.update_cluster(self.pools, self.scanner)
+            return Response(200, self.metrics.render().encode(),
+                            {"Content-Type": "text/plain; version=0.0.4"})
+        raise S3Error("MethodNotAllowed")
+
     def _dispatch(self, req, path: str, query: dict) -> Response:
         body, access_key = self._authenticate(req, path, query)
         h = self.handlers
         method = req.command
         headers = {k: v for k, v in req.headers.items()}
+
+        if path.startswith("/minio/admin/"):
+            return self._dispatch_admin(access_key, method, path, query,
+                                        body)
 
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0] if parts[0] else ""
@@ -352,8 +512,29 @@ class S3Server:
 
     def _delete_authorizer(self, access_key: str, bucket: str):
         """Per-key authorization closure for multi-object delete."""
-        if access_key == self.creds.access_key or self.iam is None:
+        if access_key == self.creds.access_key:
             return None                          # root: no per-key checks
+        if access_key == "":
+            # Anonymous: each key needs a bucket-policy DeleteObject
+            # grant — a Put-only public bucket must not allow deletes.
+            from ..iam.policy import Policy, PolicyError
+            data = self.handlers.meta.get(bucket, "policy")
+            pol_obj = None
+            if data is not None:
+                try:
+                    pol_obj = Policy(data.decode())
+                except (PolicyError, ValueError):
+                    pol_obj = None
+
+            def can_anon(key: str, version_id: str) -> bool:
+                if pol_obj is None:
+                    return False
+                action = ("s3:DeleteObjectVersion" if version_id
+                          else "s3:DeleteObject")
+                return pol_obj.is_allowed(action, f"{bucket}/{key}")
+            return can_anon
+        if self.iam is None:
+            return lambda key, version_id: False
         ident = self.iam.lookup(access_key)
 
         def can_delete(key: str, version_id: str) -> bool:
@@ -430,6 +611,9 @@ class S3Server:
                 return h.abort_multipart(bucket, key, query)
             return h.delete_object(bucket, key, query, headers)
         if method == "POST":
+            if "select" in query:
+                return h.select_object_content(bucket, key, query, body,
+                                               headers)
             if "uploads" in query:
                 return h.create_multipart(bucket, key, headers)
             if "uploadId" in query:

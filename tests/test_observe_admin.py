@@ -1,0 +1,194 @@
+"""Observability + admin API tests: metrics, trace, health, logging,
+admin endpoints over signed HTTP."""
+
+import http.client
+import json
+
+import pytest
+
+from minio_tpu.background.scanner import DataScanner
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.iam.iam import IAMSys
+from minio_tpu.observe.logger import Logger, RingTarget, audit_entry
+from minio_tpu.observe.metrics import MetricsRegistry
+from minio_tpu.observe.trace import HTTPTracer
+from minio_tpu.server.client import S3Client, S3ClientError
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ROOT, SECRET = "obsadmin", "obsadmin-secret"
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    scanner = DataScanner(pools)
+    iam = IAMSys(pools)
+    srv = S3Server(pools, Credentials(ROOT, SECRET), iam=iam,
+                   scanner=scanner).start()
+    cli = S3Client(srv.endpoint, ROOT, SECRET)
+    yield srv, cli, scanner
+    srv.shutdown()
+
+
+def http_get(srv, path):
+    conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+class TestUnits:
+    def test_metrics_render(self):
+        m = MetricsRegistry()
+        m.observe_request("GET", 200, 0.004, 100, 5000)
+        m.observe_request("PUT", 500, 0.2, 1000, 0)
+        text = m.render()
+        assert 'mtpu_s3_requests_total{api="GET",status="200"} 1' in text
+        assert 'mtpu_s3_errors_total{code="500"} 1' in text
+        assert "mtpu_s3_ttfb_seconds_count 2" in text
+
+    def test_tracer_zero_cost_without_subscribers(self):
+        tr = HTTPTracer()
+        assert not tr.active()
+        tr.trace(method="GET", path="/x", status=200, duration_ms=1)
+        q = tr.pubsub.subscribe()
+        tr.trace(method="PUT", path="/y", status=200, duration_ms=2)
+        assert len(q) == 1 and q[0]["method"] == "PUT"
+        tr.pubsub.unsubscribe(q)
+        tr.trace(method="GET", path="/z", status=200, duration_ms=1)
+        assert len(q) == 1
+
+    def test_logger_ring_and_once(self):
+        log = Logger()
+        log.targets = []                       # silence console
+        ring = RingTarget(size=3)
+        log.add_target(ring)
+        for i in range(5):
+            log.info(f"msg{i}")
+        assert [e["message"] for e in ring.tail()] == \
+            ["msg2", "msg3", "msg4"]
+        log.log_once("error", "dup", key="k1")
+        log.log_once("error", "dup", key="k1")
+        assert sum(1 for e in ring.tail() if e["message"] == "dup") == 1
+
+    def test_audit_entry_shape(self):
+        e = audit_entry(method="PUT", path="/b/k", status=200,
+                        duration_ms=3.2, access_key="ak",
+                        source_ip="1.2.3.4")
+        assert e["api"]["statusCode"] == 200
+        assert e["remoteHost"] == "1.2.3.4"
+
+
+class TestEndpoints:
+    def test_health_live_and_cluster(self, stack):
+        srv, cli, _ = stack
+        status, _ = http_get(srv, "/minio/health/live")
+        assert status == 200
+        status, data = http_get(srv, "/minio/health/cluster")
+        assert status == 200
+        detail = json.loads(data)
+        assert detail["sets"][0]["online"] == 4
+        # kill 2 drives -> below write quorum (3 of 4) -> 503
+        es = srv.pools.pools[0].sets[0]
+        saved = list(es.drives)
+        es.drives[0] = es.drives[1] = None
+        status, _ = http_get(srv, "/minio/health/cluster")
+        assert status == 503
+        es.drives = saved
+
+    def test_prometheus_metrics_endpoint(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("mtr")
+        cli.put_object("mtr", "k", b"x" * 1000)
+        status, data = http_get(srv, "/minio/v2/metrics/cluster")
+        assert status == 200
+        text = data.decode()
+        assert "mtpu_s3_requests_total" in text
+        assert "mtpu_cluster_drives_online 4" in text
+
+    def test_trace_captures_requests(self, stack):
+        srv, cli, _ = stack
+        # subscribe via admin trace endpoint (first call registers)
+        cli.request("GET", "/minio/admin/v1/trace")
+        cli.make_bucket("trc")
+        cli.put_object("trc", "k", b"y")
+        status, _, data = cli.request("GET", "/minio/admin/v1/trace")
+        assert status == 200
+        trace = json.loads(data)["trace"]
+        assert any(t["method"] == "PUT" and "/trc/k" in t["path"]
+                   for t in trace)
+
+
+class TestAdminAPI:
+    def test_info_and_usage(self, stack):
+        srv, cli, scanner = stack
+        cli.make_bucket("adm")
+        cli.put_object("adm", "k", b"z" * 2000)
+        status, _, data = cli.request("GET", "/minio/admin/v1/info")
+        assert status == 200
+        info = json.loads(data)
+        assert info["mode"] == "online" and info["buckets"] == 1
+        status, _, data = cli.request("GET", "/minio/admin/v1/datausage")
+        assert status == 200
+        usage = json.loads(data)
+        assert usage["buckets"]["adm"]["b"] == 2000
+
+    def test_admin_requires_root(self, stack):
+        srv, cli, _ = stack
+        srv.iam.add_user("peon", "peon-secret-123", ["readwrite"])
+        peon = S3Client(srv.endpoint, "peon", "peon-secret-123")
+        status, _, data = peon.request("GET", "/minio/admin/v1/info")
+        assert status == 403
+
+    def test_heal_sequence_via_admin(self, stack):
+        import time
+        srv, cli, _ = stack
+        cli.make_bucket("healb")
+        cli.put_object("healb", "obj", b"h" * 200000)
+        import os, shutil
+        es = srv.pools.pools[0].sets[0]
+        shutil.rmtree(os.path.join(es.drives[2].root, "healb"))
+        status, _, data = cli.request("POST", "/minio/admin/v1/heal",
+                                      query={"bucket": "healb"})
+        assert status == 200
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            _, _, data = cli.request("GET", "/minio/admin/v1/heal")
+            seqs = json.loads(data)["sequences"]
+            if seqs and seqs[0]["state"] in ("done", "failed"):
+                break
+            time.sleep(0.2)
+        assert seqs[0]["state"] == "done"
+        assert seqs[0]["healed"] == 1
+
+    def test_user_management(self, stack):
+        srv, cli, _ = stack
+        body = json.dumps({"accessKey": "adminmade",
+                           "secretKey": "adminmade-secret",
+                           "policies": ["readonly"]}).encode()
+        status, _, _ = cli.request("POST", "/minio/admin/v1/users",
+                                   body=body)
+        assert status == 200
+        _, _, data = cli.request("GET", "/minio/admin/v1/users")
+        assert "adminmade" in json.loads(data)["users"]
+        made = S3Client(srv.endpoint, "adminmade", "adminmade-secret")
+        assert isinstance(made.list_buckets(), list)
+        status, _, _ = cli.request("DELETE", "/minio/admin/v1/users",
+                                   query={"accessKey": "adminmade"})
+        assert status == 200
+        with pytest.raises(S3ClientError):
+            made.list_buckets()
+
+    def test_console_log_endpoint(self, stack):
+        srv, cli, _ = stack
+        srv.log.info("hello from test", component="t")
+        status, _, data = cli.request("GET", "/minio/admin/v1/console")
+        assert status == 200
+        msgs = [e["message"] for e in json.loads(data)["log"]]
+        assert "hello from test" in msgs
