@@ -85,10 +85,13 @@ class ErasureSet:
         # changed-bucket skip logic (background/usage.py).
         self.mrf = None
         self._dirty_tracker = None
+        from .metacache import Metacache
+        self.metacache = Metacache(self)
 
     def _mark_dirty(self, bucket: str) -> None:
         if self._dirty_tracker is not None:
             self._dirty_tracker.mark(bucket)
+        self.metacache.bump(bucket)
 
     # -- codec helpers -------------------------------------------------------
 
@@ -725,38 +728,15 @@ class ErasureSet:
     # -- listing (walk-based; metacache comes later) -------------------------
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     max_keys: int = 10000) -> list[FileInfo]:
-        """Quorum-merged listing: walk all drives, merge names, elect the
-        latest version per object (simplified metacache,
-        cf. /root/reference/cmd/metacache-set.go)."""
+                     max_keys: int = 10000,
+                     marker: str = "") -> list[FileInfo]:
+        """Quorum-merged listing through the metacache: the parallel
+        drive walk + per-object quorum election runs once and is cached
+        (memory + persisted) until a write to the bucket invalidates it
+        (cf. /root/reference/cmd/metacache-server-pool.go:59)."""
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
-        per_name: dict[str, list[FileInfo]] = {}
-        res = self._map_drives(
-            lambda d: list(d.walk_dir(bucket, prefix)))
-        for entries, e in res:
-            if e is not None:
-                continue
-            for name, raw in entries:
-                try:
-                    fi = XLMeta.from_bytes(raw).latest(bucket, name)
-                except StorageError:
-                    continue
-                per_name.setdefault(name, []).append(fi)
-        # Quorum-elect each object's latest version, exactly like the read
-        # path — a single drive's torn write or stale delete marker must
-        # not change the listing (cf. metacache quorum-merge,
-        # /root/reference/cmd/metacache-entries.go).
-        quorum = self._live_quorum()
-        out = []
-        for name in sorted(per_name):
-            try:
-                fi = Q.find_file_info_in_quorum(per_name[name], quorum)
-            except ErrErasureReadQuorum:
-                continue
-            if not fi.deleted:
-                out.append(fi)
-        return out[:max_keys]
+        return self.metacache.list(bucket, prefix, marker, max_keys)
 
     def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
         # Use the first drive that can serve the full version list.

@@ -1,0 +1,166 @@
+"""Listing metacache: walk results computed once, cached, and reused.
+
+The cmd/metacache-*.go equivalent: a listing walks listing-quorum drives
+in parallel, quorum-merges the entries, and the result is kept — in
+memory AND persisted msgpack-on-drives — so the next page (or the next
+client asking for the same prefix) streams from cache instead of
+re-walking every drive. Bucket writes bump a generation counter that
+invalidates affected caches (the metacache-manager role).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+from ..storage.drive import SYS_VOL
+from ..storage.errors import StorageError
+from ..storage.xlmeta import XLMeta
+from ..utils import msgpackx
+from . import quorum as Q
+
+CACHE_TTL = 30.0            # seconds a cache stays valid without writes
+CACHE_DIR = "metacache"
+
+
+class _Entry:
+    __slots__ = ("name", "size", "mod_time_ns", "etag", "version_id",
+                 "metadata")
+
+    def __init__(self, name, size, mod_time_ns, etag, version_id,
+                 metadata):
+        self.name = name
+        self.size = size
+        self.mod_time_ns = mod_time_ns
+        self.etag = etag
+        self.version_id = version_id
+        self.metadata = metadata
+
+
+class Metacache:
+    def __init__(self, es):
+        self.es = es
+        self._mu = threading.Lock()
+        self._gen: dict[str, int] = {}          # bucket -> generation
+        self._mem: dict[tuple, tuple] = {}      # (bucket,prefix,gen) ->
+        #                                         (created, entries)
+        self._persisted_paths: dict[str, set] = {}
+        self.walks = 0                          # instrumentation
+
+    # -- invalidation --------------------------------------------------------
+
+    def bump(self, bucket: str) -> None:
+        with self._mu:
+            self._gen[bucket] = self._gen.get(bucket, 0) + 1
+            for key in [k for k in self._mem if k[0] == bucket]:
+                del self._mem[key]
+            paths = self._persisted_paths.pop(bucket, set())
+        # Drop persisted caches for this bucket too; other nodes fall
+        # back to the TTL bound (the reference's metacache life window).
+        for path in paths:
+            def rm(d, p=path):
+                d.delete(SYS_VOL, p)
+            try:
+                self.es._map_drives(rm)
+            except StorageError:
+                pass
+
+    def _generation(self, bucket: str) -> int:
+        with self._mu:
+            return self._gen.get(bucket, 0)
+
+    # -- walk + merge (cf. metacache-set.go listPath) ------------------------
+
+    def _walk_merge(self, bucket: str, prefix: str) -> list:
+        self.walks += 1
+        per_name: dict[str, list] = {}
+        res = self.es._map_drives(
+            lambda d: list(d.walk_dir(bucket, prefix)))
+        ok_drives = sum(1 for _, e in res if e is None)
+        if ok_drives == 0:
+            raise StorageError(f"listing failed on all drives: "
+                               f"{[str(e) for _, e in res if e]}")
+        for entries, e in res:
+            if e is not None:
+                continue
+            for name, raw in entries:
+                try:
+                    fi = XLMeta.from_bytes(raw).latest(bucket, name)
+                except StorageError:
+                    continue
+                per_name.setdefault(name, []).append(fi)
+        quorum = max(1, ok_drives // 2)
+        out = []
+        for name in sorted(per_name):
+            try:
+                fi = Q.find_file_info_in_quorum(per_name[name], quorum)
+            except StorageError:
+                continue
+            if not fi.deleted:
+                out.append(fi)
+        return out
+
+    # -- persisted cache (cf. metacache-stream persistence) ------------------
+
+    def _cache_path(self, bucket: str, prefix: str) -> str:
+        h = hashlib.sha256(f"{bucket}\x00{prefix}".encode()).hexdigest()[:24]
+        return f"{CACHE_DIR}/{h}.cache"
+
+    def _persist(self, bucket: str, prefix: str, entries: list) -> None:
+        payload = msgpackx.packb({
+            "at": time.time(), "bucket": bucket, "prefix": prefix,
+            "entries": [{"n": fi.name, "s": fi.size, "mt": fi.mod_time_ns,
+                         "e": fi.metadata.get("etag", ""),
+                         "v": fi.version_id,
+                         "m": dict(fi.metadata)} for fi in entries]})
+        path = self._cache_path(bucket, prefix)
+        with self._mu:
+            self._persisted_paths.setdefault(bucket, set()).add(path)
+
+        def put(d):
+            d.write_all(SYS_VOL, path, payload)
+        try:
+            self.es._map_drives(put)
+        except StorageError:
+            pass
+
+    def _load_persisted(self, bucket: str, prefix: str):
+        path = self._cache_path(bucket, prefix)
+        for d in self.es.drives:
+            if d is None:
+                continue
+            try:
+                obj = msgpackx.unpackb(d.read_all(SYS_VOL, path))
+            except StorageError:
+                continue
+            if time.time() - obj.get("at", 0) > CACHE_TTL:
+                return None
+            from ..storage.xlmeta import FileInfo
+            return [FileInfo(volume=bucket, name=e["n"], size=e["s"],
+                             mod_time_ns=e["mt"], version_id=e["v"],
+                             metadata=e["m"])
+                    for e in obj.get("entries", [])]
+        return None
+
+    # -- public API ----------------------------------------------------------
+
+    def list(self, bucket: str, prefix: str = "", marker: str = "",
+             max_keys: int = 10000) -> list:
+        """Cached quorum-merged listing with marker pagination."""
+        gen = self._generation(bucket)
+        key = (bucket, prefix, gen)
+        with self._mu:
+            hit = self._mem.get(key)
+        if hit is not None and time.time() - hit[0] <= CACHE_TTL:
+            entries = hit[1]
+        else:
+            entries = self._load_persisted(bucket, prefix)
+            if entries is None:
+                entries = self._walk_merge(bucket, prefix)
+                self._persist(bucket, prefix, entries)
+            with self._mu:
+                self._mem[key] = (time.time(), entries)
+        if marker:
+            entries = [fi for fi in entries if fi.name > marker]
+        return entries[:max_keys]

@@ -385,6 +385,7 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
             except StorageError:
                 pass
     es._map_drives(rm)
+    es._mark_dirty(bucket)
     return fi_for(0)
 
 

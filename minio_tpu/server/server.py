@@ -53,6 +53,7 @@ class S3Server:
         self.log.add_target(self.log_ring)
         self.audit_targets: list = []
         self.scanner = scanner
+        self.config = None                 # lazy ConfigSys (admin API)
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -397,6 +398,28 @@ class S3Server:
             except (KeyError, ValueError) as e:
                 raise S3Error("InvalidArgument", str(e)) from None
             return j({"ok": True})
+        if sub == "config":
+            if not hasattr(self, "config") or self.config is None:
+                from ..config.config import ConfigSys
+                self.config = ConfigSys(self.pools)
+            if method == "GET":
+                subsys = query.get("subsys", [""])[0]
+                if subsys:
+                    return j({subsys: self.config.get_subsys(subsys)})
+                return j(self.config.help())
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                try:
+                    self.config.set(req_obj["subsys"], req_obj["key"],
+                                    req_obj["value"])
+                except KeyError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return j({"ok": True})
+        if sub == "config-help" and method == "GET":
+            if not hasattr(self, "config") or self.config is None:
+                from ..config.config import ConfigSys
+                self.config = ConfigSys(self.pools)
+            return j(self.config.help(query.get("subsys", [""])[0]))
         if sub == "service" and method == "POST":
             return j({"action": query.get("action", ["status"])[0],
                       "acknowledged": True, "at": _time.time()})

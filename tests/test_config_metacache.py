@@ -1,0 +1,108 @@
+"""Config KVS system + listing metacache tests."""
+
+import json
+
+import pytest
+
+from minio_tpu.config.config import ConfigSys
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.storage.drive import LocalDrive
+
+
+def make_pools(tmp_path, name="p"):
+    drives = [LocalDrive(str(tmp_path / name / f"d{i}")) for i in range(4)]
+    return ServerPools([ErasureSets(drives, set_drive_count=4)])
+
+
+class TestConfigSys:
+    def test_layering_env_over_stored_over_default(self, tmp_path):
+        pools = make_pools(tmp_path)
+        env = {}
+        cfg = ConfigSys(pools, env=env)
+        assert cfg.get("compression", "enable") == "off"     # default
+        cfg.set("compression", "enable", "on")
+        assert cfg.get("compression", "enable") == "on"      # stored
+        env["MTPU_COMPRESSION_ENABLE"] = "off"
+        assert cfg.get("compression", "enable") == "off"     # env wins
+
+    def test_persistence_across_instances(self, tmp_path):
+        pools = make_pools(tmp_path)
+        cfg = ConfigSys(pools, env={})
+        cfg.set("storage_class", "standard", "EC:3")
+        cfg2 = ConfigSys(pools, env={})
+        assert cfg2.get("storage_class", "standard") == "EC:3"
+        assert cfg2.parity_for_class("standard") == 3
+
+    def test_unknown_keys_rejected(self, tmp_path):
+        cfg = ConfigSys(None, env={})
+        with pytest.raises(KeyError):
+            cfg.set("nope", "x", "1")
+        with pytest.raises(KeyError):
+            cfg.set("api", "nope", "1")
+
+    def test_dynamic_listener(self):
+        cfg = ConfigSys(None, env={})
+        seen = []
+        cfg.on_change("scanner", lambda s, k, v: seen.append((s, k, v)))
+        cfg.set("scanner", "speed", "fast")
+        assert seen == [("scanner", "speed", "fast")]
+
+    def test_help_registry(self):
+        cfg = ConfigSys(None, env={})
+        assert "api" in cfg.help()["subsystems"]
+        h = cfg.help("api")["api"]
+        assert any(kv["key"] == "requests_max" for kv in h)
+
+
+class TestMetacache:
+    def test_cache_avoids_rewalk(self, tmp_path):
+        pools = make_pools(tmp_path, "mc")
+        pools.make_bucket("mcb")
+        es = pools.pools[0].sets[0]
+        for i in range(5):
+            pools.put_object("mcb", f"k{i}", b"x")
+        es.metacache.walks = 0
+        a = es.list_objects("mcb")
+        assert len(a) == 5
+        walks_after_first = es.metacache.walks
+        b = es.list_objects("mcb")
+        assert [fi.name for fi in b] == [fi.name for fi in a]
+        assert es.metacache.walks == walks_after_first   # served cached
+
+    def test_write_invalidates(self, tmp_path):
+        pools = make_pools(tmp_path, "mi")
+        pools.make_bucket("mib")
+        pools.put_object("mib", "a", b"1")
+        assert len(pools.pools[0].sets[0].list_objects("mib")) == 1
+        pools.put_object("mib", "b", b"2")
+        names = [fi.name for fi in
+                 pools.pools[0].sets[0].list_objects("mib")]
+        assert names == ["a", "b"]
+        pools.delete_object("mib", "a")
+        names = [fi.name for fi in
+                 pools.pools[0].sets[0].list_objects("mib")]
+        assert names == ["b"]
+
+    def test_marker_pagination(self, tmp_path):
+        pools = make_pools(tmp_path, "mp")
+        pools.make_bucket("mpb")
+        for i in range(6):
+            pools.put_object("mpb", f"k{i}", b"x")
+        es = pools.pools[0].sets[0]
+        page1 = es.list_objects("mpb", max_keys=3)
+        assert [fi.name for fi in page1] == ["k0", "k1", "k2"]
+        page2 = es.list_objects("mpb", marker="k2", max_keys=3)
+        assert [fi.name for fi in page2] == ["k3", "k4", "k5"]
+
+    def test_persisted_cache_survives_new_metacache(self, tmp_path):
+        from minio_tpu.engine.metacache import Metacache
+        pools = make_pools(tmp_path, "mpers")
+        pools.make_bucket("pb")
+        pools.put_object("pb", "x", b"1")
+        es = pools.pools[0].sets[0]
+        es.list_objects("pb")                 # walk + persist
+        fresh = Metacache(es)                 # new process analogue
+        entries = fresh.list("pb")
+        assert [fi.name for fi in entries] == ["x"]
+        assert fresh.walks == 0               # came from the drive cache
