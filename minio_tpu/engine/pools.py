@@ -214,6 +214,20 @@ class ServerPools:
                 out.append(u)
         return sorted(out, key=lambda u: (u["object"], u["upload_id"]))
 
+    def update_object_metadata(self, bucket: str, obj: str, fi) -> None:
+        """Merge-updated FileInfo back onto the stripe (the
+        updateObjectMetadata seam, cmd/erasure-object.go:1513)."""
+        for p in self.pools:
+            for es in getattr(p, "sets", [p]):
+                try:
+                    res = es._map_drives(
+                        lambda d: d.update_metadata(bucket, obj, fi))
+                    if any(e is None for _, e in res):
+                        return
+                except StorageError:
+                    continue
+        raise ErrObjectNotFound(f"{bucket}/{obj}")
+
     # -- heal ----------------------------------------------------------------
 
     def heal_object(self, bucket: str, obj: str, version_id: str = "",

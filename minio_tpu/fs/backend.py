@@ -1,0 +1,281 @@
+"""FS backend: single-drive, non-erasure ObjectLayer.
+
+The cmd/fs-v1.go equivalent (~4k LoC of the reference's standalone
+mode): objects live as plain files with a JSON metadata sidecar
+(fs.json role), no erasure coding, no quorum — the deployment shape for
+a laptop or a gateway box. Implements the same ObjectLayer duck-type the
+S3 handlers use, so `S3Server(FSObjectLayer(...), ...)` serves the full
+API surface minus versioning (single-drive FS is unversioned in the
+reference too).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+import uuid
+
+from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
+                              ErrBucketNotEmpty, ErrObjectNotFound,
+                              ErrUploadNotFound, ErrInvalidPart,
+                              StorageError)
+from ..storage.xlmeta import FileInfo, ObjectPartInfo
+
+FS_META_DIR = ".mtpu.fs"           # per-bucket metadata + multipart staging
+
+
+class FSObjectLayer:
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.deployment_id = "fs-" + hashlib.sha256(
+            self.root.encode()).hexdigest()[:16]
+
+    # handlers iterate pools/sets for engine-specific paths; FS has none.
+    @property
+    def pools(self):
+        return []
+
+    # -- paths ---------------------------------------------------------------
+
+    def _bucket_dir(self, bucket: str) -> str:
+        return os.path.join(self.root, bucket)
+
+    def _obj_path(self, bucket: str, obj: str) -> str:
+        p = os.path.normpath(os.path.join(self._bucket_dir(bucket), obj))
+        if not p.startswith(self._bucket_dir(bucket) + os.sep):
+            raise StorageError(f"path escape: {obj!r}")
+        return p
+
+    def _meta_path(self, bucket: str, obj: str) -> str:
+        return os.path.join(self._bucket_dir(bucket), FS_META_DIR, "meta",
+                            obj + ".json")
+
+    # -- buckets -------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        d = self._bucket_dir(bucket)
+        if os.path.isdir(d):
+            raise ErrBucketExists(bucket)
+        os.makedirs(os.path.join(d, FS_META_DIR, "meta"))
+        os.makedirs(os.path.join(d, FS_META_DIR, "multipart"))
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return os.path.isdir(self._bucket_dir(bucket))
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        d = self._bucket_dir(bucket)
+        if not os.path.isdir(d):
+            raise ErrBucketNotFound(bucket)
+        if not force and self.list_objects(bucket, max_keys=1):
+            raise ErrBucketNotEmpty(bucket)
+        shutil.rmtree(d)
+
+    def list_buckets(self) -> list[str]:
+        return sorted(e for e in os.listdir(self.root)
+                      if os.path.isdir(self._bucket_dir(e)))
+
+    # -- objects -------------------------------------------------------------
+
+    def put_object(self, bucket: str, obj: str, data: bytes, *,
+                   metadata: dict | None = None, versioned: bool = False,
+                   parity=None) -> FileInfo:
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        meta = dict(metadata or {})
+        meta.setdefault("etag", hashlib.md5(data).hexdigest())
+        path = self._obj_path(bucket, obj)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp-{uuid.uuid4().hex}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)                     # atomic publish
+        fi = FileInfo(volume=bucket, name=obj, version_id="",
+                      mod_time_ns=time.time_ns(), size=len(data),
+                      metadata=meta)
+        self._write_meta(bucket, obj, fi)
+        return fi
+
+    def _write_meta(self, bucket: str, obj: str, fi: FileInfo) -> None:
+        mp = self._meta_path(bucket, obj)
+        os.makedirs(os.path.dirname(mp), exist_ok=True)
+        with open(mp, "w") as f:
+            json.dump({"meta": fi.metadata, "size": fi.size,
+                       "mt": fi.mod_time_ns}, f)
+
+    def _read_meta(self, bucket: str, obj: str) -> dict | None:
+        try:
+            with open(self._meta_path(bucket, obj)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def head_object(self, bucket: str, obj: str,
+                    version_id: str = "") -> FileInfo:
+        path = self._obj_path(bucket, obj)
+        if not os.path.isfile(path):
+            if not self.bucket_exists(bucket):
+                raise ErrBucketNotFound(bucket)
+            raise ErrObjectNotFound(f"{bucket}/{obj}")
+        st = os.stat(path)
+        side = self._read_meta(bucket, obj) or {}
+        return FileInfo(volume=bucket, name=obj, version_id="",
+                        mod_time_ns=side.get("mt", int(st.st_mtime * 1e9)),
+                        size=st.st_size, metadata=side.get("meta", {}))
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, version_id: str = ""):
+        fi = self.head_object(bucket, obj, version_id)
+        with open(self._obj_path(bucket, obj), "rb") as f:
+            f.seek(offset)
+            data = f.read() if length < 0 else f.read(length)
+        return fi, data
+
+    def delete_object(self, bucket: str, obj: str, version_id: str = "",
+                      versioned: bool = False):
+        path = self._obj_path(bucket, obj)
+        if not os.path.isfile(path):
+            if not self.bucket_exists(bucket):
+                raise ErrBucketNotFound(bucket)
+            raise ErrObjectNotFound(f"{bucket}/{obj}")
+        os.unlink(path)
+        try:
+            os.unlink(self._meta_path(bucket, obj))
+        except OSError:
+            pass
+        # prune empty parents up to the bucket root
+        d = os.path.dirname(path)
+        while d != self._bucket_dir(bucket):
+            try:
+                os.rmdir(d)
+            except OSError:
+                break
+            d = os.path.dirname(d)
+        return None
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 10000) -> list[FileInfo]:
+        base = self._bucket_dir(bucket)
+        if not os.path.isdir(base):
+            raise ErrBucketNotFound(bucket)
+        out = []
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != FS_META_DIR]
+            for fn in filenames:
+                rel = os.path.relpath(os.path.join(dirpath, fn), base)
+                rel = rel.replace(os.sep, "/")
+                if not rel.startswith(prefix):
+                    continue
+                try:
+                    out.append(self.head_object(bucket, rel))
+                except StorageError:
+                    continue
+        out.sort(key=lambda fi: fi.name)
+        return out[:max_keys]
+
+    def list_object_versions(self, bucket: str, obj: str):
+        return [self.head_object(bucket, obj)]
+
+    def update_object_metadata(self, bucket: str, obj: str, fi) -> None:
+        self._write_meta(bucket, obj, fi)
+
+    # -- multipart -----------------------------------------------------------
+
+    def _mp_dir(self, bucket: str, upload_id: str) -> str:
+        return os.path.join(self._bucket_dir(bucket), FS_META_DIR,
+                            "multipart", upload_id)
+
+    def new_multipart_upload(self, bucket: str, obj: str, *,
+                             metadata: dict | None = None,
+                             parity=None) -> str:
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        upload_id = uuid.uuid4().hex
+        d = self._mp_dir(bucket, upload_id)
+        os.makedirs(d)
+        with open(os.path.join(d, "upload.json"), "w") as f:
+            json.dump({"object": obj, "metadata": metadata or {}}, f)
+        return upload_id
+
+    def _mp_info(self, bucket: str, upload_id: str) -> dict:
+        try:
+            with open(os.path.join(self._mp_dir(bucket, upload_id),
+                                   "upload.json")) as f:
+                return json.load(f)
+        except OSError:
+            raise ErrUploadNotFound(upload_id) from None
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: bytes) -> ObjectPartInfo:
+        self._mp_info(bucket, upload_id)
+        etag = hashlib.md5(data).hexdigest()
+        with open(os.path.join(self._mp_dir(bucket, upload_id),
+                               f"part.{part_number}"), "wb") as f:
+            f.write(data)
+        with open(os.path.join(self._mp_dir(bucket, upload_id),
+                               f"part.{part_number}.etag"), "w") as f:
+            f.write(etag)
+        return ObjectPartInfo(number=part_number, size=len(data),
+                              actual_size=len(data), etag=etag)
+
+    def list_parts(self, bucket: str, obj: str,
+                   upload_id: str) -> list[ObjectPartInfo]:
+        self._mp_info(bucket, upload_id)
+        d = self._mp_dir(bucket, upload_id)
+        out = []
+        for fn in os.listdir(d):
+            if fn.startswith("part.") and not fn.endswith(".etag"):
+                n = int(fn.split(".")[1])
+                size = os.path.getsize(os.path.join(d, fn))
+                with open(os.path.join(d, fn + ".etag")) as f:
+                    etag = f.read()
+                out.append(ObjectPartInfo(number=n, size=size,
+                                          actual_size=size, etag=etag))
+        return sorted(out, key=lambda p: p.number)
+
+    def complete_multipart_upload(self, bucket: str, obj: str,
+                                  upload_id: str, parts, *,
+                                  versioned: bool = False) -> FileInfo:
+        info = self._mp_info(bucket, upload_id)
+        stored = {p.number: p for p in self.list_parts(bucket, obj,
+                                                       upload_id)}
+        d = self._mp_dir(bucket, upload_id)
+        buf = bytearray()
+        md5s = b""
+        for n, etag in parts:
+            p = stored.get(n)
+            if p is None or p.etag != etag.strip('"'):
+                raise ErrInvalidPart(f"part {n}")
+            with open(os.path.join(d, f"part.{n}"), "rb") as f:
+                buf += f.read()
+            md5s += bytes.fromhex(p.etag)
+        meta = dict(info.get("metadata", {}))
+        meta["etag"] = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        fi = self.put_object(bucket, info["object"], bytes(buf),
+                             metadata=meta)
+        shutil.rmtree(d, ignore_errors=True)
+        return fi
+
+    def abort_multipart_upload(self, bucket: str, obj: str,
+                               upload_id: str) -> None:
+        self._mp_info(bucket, upload_id)
+        shutil.rmtree(self._mp_dir(bucket, upload_id), ignore_errors=True)
+
+    def list_multipart_uploads(self, bucket: str,
+                               prefix: str = "") -> list[dict]:
+        base = os.path.join(self._bucket_dir(bucket), FS_META_DIR,
+                            "multipart")
+        out = []
+        if os.path.isdir(base):
+            for uid in os.listdir(base):
+                try:
+                    info = self._mp_info(bucket, uid)
+                except StorageError:
+                    continue
+                if info["object"].startswith(prefix):
+                    out.append({"object": info["object"],
+                                "upload_id": uid})
+        return sorted(out, key=lambda u: (u["object"], u["upload_id"]))

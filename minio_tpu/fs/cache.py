@@ -1,0 +1,135 @@
+"""Disk cache: read-through ObjectLayer wrapper with LRU eviction.
+
+The cmd/disk-cache*.go equivalent: GETs populate an on-disk cache
+(fast local SSD in the reference's deployment shape); hits serve from
+cache after validating the backend ETag; writes/deletes invalidate.
+Eviction trims least-recently-used entries once the configured size
+budget is exceeded. Everything else proxies to the wrapped layer, so
+the wrapper composes with any backend (erasure pools or FS).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+
+class DiskCache:
+    def __init__(self, backend, cache_dir: str,
+                 max_bytes: int = 1 << 30):
+        self.backend = backend
+        self.dir = os.path.abspath(cache_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.max_bytes = max_bytes
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __getattr__(self, name):
+        # Everything not overridden proxies to the backend.
+        return getattr(self.backend, name)
+
+    # -- cache mechanics -----------------------------------------------------
+
+    def _key(self, bucket: str, obj: str) -> str:
+        return hashlib.sha256(f"{bucket}\x00{obj}".encode()).hexdigest()
+
+    def _paths(self, bucket: str, obj: str) -> tuple[str, str]:
+        k = self._key(bucket, obj)
+        return (os.path.join(self.dir, k + ".data"),
+                os.path.join(self.dir, k + ".json"))
+
+    def _store(self, bucket: str, obj: str, fi, data: bytes) -> None:
+        dp, mp = self._paths(bucket, obj)
+        with open(dp + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(dp + ".tmp", dp)
+        with open(mp, "w") as f:
+            json.dump({"etag": fi.metadata.get("etag", ""),
+                       "size": fi.size, "mt": fi.mod_time_ns,
+                       "meta": fi.metadata}, f)
+        self._evict()
+
+    def _load(self, bucket: str, obj: str):
+        dp, mp = self._paths(bucket, obj)
+        try:
+            with open(mp) as f:
+                meta = json.load(f)
+            with open(dp, "rb") as f:
+                data = f.read()
+        except (OSError, ValueError):
+            return None
+        now = time.time()
+        os.utime(dp, (now, now))               # LRU touch
+        return meta, data
+
+    def invalidate(self, bucket: str, obj: str) -> None:
+        for p in self._paths(bucket, obj):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _evict(self) -> None:
+        with self._mu:
+            entries = []
+            total = 0
+            for fn in os.listdir(self.dir):
+                if not fn.endswith(".data"):
+                    continue
+                p = os.path.join(self.dir, fn)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_atime, st.st_size, p))
+                total += st.st_size
+            if total <= self.max_bytes:
+                return
+            entries.sort()                      # oldest atime first
+            for _, size, p in entries:
+                try:
+                    os.unlink(p)
+                    os.unlink(p[:-5] + ".json")
+                except OSError:
+                    pass
+                total -= size
+                if total <= self.max_bytes:
+                    break
+
+    # -- intercepted ObjectLayer methods -------------------------------------
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, version_id: str = ""):
+        if version_id:
+            return self.backend.get_object(bucket, obj, offset, length,
+                                           version_id)
+        # validate against backend metadata (cheap) before serving a hit
+        fi = self.backend.head_object(bucket, obj)
+        cached = self._load(bucket, obj)
+        if cached is not None and \
+                cached[0].get("etag") == fi.metadata.get("etag", ""):
+            self.hits += 1
+            data = cached[1]
+            if length < 0:
+                return fi, data[offset:]
+            return fi, data[offset:offset + length]
+        self.misses += 1
+        fi, full = self.backend.get_object(bucket, obj)
+        self._store(bucket, obj, fi, full)
+        if length < 0:
+            return fi, full[offset:]
+        return fi, full[offset:offset + length]
+
+    def put_object(self, bucket: str, obj: str, data: bytes, **kw):
+        self.invalidate(bucket, obj)
+        return self.backend.put_object(bucket, obj, data, **kw)
+
+    def delete_object(self, bucket: str, obj: str, version_id: str = "",
+                      versioned: bool = False):
+        self.invalidate(bucket, obj)
+        return self.backend.delete_object(bucket, obj, version_id,
+                                          versioned)

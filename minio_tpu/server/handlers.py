@@ -784,17 +784,10 @@ class S3Handlers:
             if not v:
                 meta.pop(k, None)
         fi.metadata = meta
-        for pool in self.pools.pools:
-            sets = getattr(pool, "sets", [pool])
-            for es in sets:
-                try:
-                    res = es._map_drives(
-                        lambda d: d.update_metadata(bucket, key, fi))
-                    if any(e is None for _, e in res):
-                        return
-                except StorageError:
-                    continue
-        raise S3Error("InternalError", "metadata update failed")
+        try:
+            self.pools.update_object_metadata(bucket, key, fi)
+        except StorageError as e:
+            raise from_storage_error(e) from None
 
     def delete_objects(self, bucket: str, body: bytes,
                        can_delete=None) -> Response:

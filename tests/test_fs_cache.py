@@ -1,0 +1,131 @@
+"""FS single-drive backend + disk cache wrapper tests, including the
+full S3 server running over the FS layer."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.fs.backend import FSObjectLayer
+from minio_tpu.fs.cache import DiskCache
+from minio_tpu.server.client import S3Client, S3ClientError
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.errors import (ErrBucketNotEmpty, ErrObjectNotFound,
+                                      StorageError)
+
+ROOT, SECRET = "fsadmin", "fsadmin-secret-1"
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+class TestFSBackend:
+    def test_crud_roundtrip(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        fs.make_bucket("bkt")
+        data = payload(5000)
+        fi = fs.put_object("bkt", "dir/obj", data)
+        assert fi.metadata["etag"]
+        got_fi, got = fs.get_object("bkt", "dir/obj")
+        assert got == data
+        _, part = fs.get_object("bkt", "dir/obj", offset=100, length=50)
+        assert part == data[100:150]
+        assert [f.name for f in fs.list_objects("bkt")] == ["dir/obj"]
+        fs.delete_object("bkt", "dir/obj")
+        with pytest.raises(ErrObjectNotFound):
+            fs.head_object("bkt", "dir/obj")
+        fs.delete_bucket("bkt")
+
+    def test_nonempty_bucket_delete_refused(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        fs.make_bucket("bkt")
+        fs.put_object("bkt", "x", b"1")
+        with pytest.raises(ErrBucketNotEmpty):
+            fs.delete_bucket("bkt")
+
+    def test_path_escape_rejected(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        fs.make_bucket("bkt")
+        with pytest.raises(StorageError):
+            fs.put_object("bkt", "../../evil", b"x")
+
+    def test_multipart(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        fs.make_bucket("bkt")
+        uid = fs.new_multipart_upload("bkt", "big")
+        p1, p2 = payload(1000, 1), payload(2000, 2)
+        i1 = fs.put_object_part("bkt", "big", uid, 1, p1)
+        i2 = fs.put_object_part("bkt", "big", uid, 2, p2)
+        fi = fs.complete_multipart_upload("bkt", "big", uid,
+                                          [(1, i1.etag), (2, i2.etag)])
+        assert fi.metadata["etag"].endswith("-2")
+        _, got = fs.get_object("bkt", "big")
+        assert got == p1 + p2
+
+    def test_server_over_fs(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        srv = S3Server(fs, Credentials(ROOT, SECRET)).start()
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("web")
+            data = payload(30000, 7)
+            cli.put_object("web", "a/b.txt", data)
+            assert cli.get_object("web", "a/b.txt") == data
+            assert cli.get_object("web", "a/b.txt",
+                                  range_=(10, 99)) == data[10:100]
+            keys, prefixes = cli.list_objects("web", delimiter="/")
+            assert prefixes == ["a/"]
+            cli.delete_object("web", "a/b.txt")
+        finally:
+            srv.shutdown()
+
+
+class TestDiskCache:
+    def test_read_through_and_hit(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        cache.make_bucket("bkt")
+        data = payload(10000, 3)
+        cache.put_object("bkt", "obj", data)
+        _, a = cache.get_object("bkt", "obj")
+        assert a == data and cache.misses == 1 and cache.hits == 0
+        _, b = cache.get_object("bkt", "obj")
+        assert b == data and cache.hits == 1
+        _, c = cache.get_object("bkt", "obj", offset=10, length=20)
+        assert c == data[10:30] and cache.hits == 2
+
+    def test_write_invalidates(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        cache.make_bucket("bkt")
+        cache.put_object("bkt", "obj", b"v1")
+        assert cache.get_object("bkt", "obj")[1] == b"v1"
+        cache.put_object("bkt", "obj", b"v2")
+        assert cache.get_object("bkt", "obj")[1] == b"v2"
+
+    def test_stale_cache_revalidated_by_etag(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        cache.make_bucket("bkt")
+        cache.put_object("bkt", "obj", b"old")
+        cache.get_object("bkt", "obj")
+        # backend changed BEHIND the cache
+        fs.put_object("bkt", "obj", b"new contents")
+        _, got = cache.get_object("bkt", "obj")
+        assert got == b"new contents"
+
+    def test_lru_eviction(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"), max_bytes=25000)
+        cache.make_bucket("bkt")
+        for i in range(4):                    # 4 x 10k > 25k budget
+            cache.put_object("bkt", f"o{i}", payload(10000, i))
+            cache.get_object("bkt", f"o{i}")
+        import os
+        files = [f for f in os.listdir(str(tmp_path / "cache"))
+                 if f.endswith(".data")]
+        assert len(files) <= 2                # evicted down to budget
+        # evicted objects still readable (read-through repopulates)
+        _, got = cache.get_object("bkt", "o0")
+        assert got == payload(10000, 0)
