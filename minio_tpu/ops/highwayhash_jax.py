@@ -253,18 +253,63 @@ def _finalize(state):
     return b.reshape(-1, 32).astype(jnp.uint8)
 
 
+_SCAN_UNROLL = 8
+
+
+def _scan_packets(state, hi: jax.Array, lo: jax.Array,
+                  unroll: int = 1):
+    """Advance the state over (P, 4, n) packet lanes with lax.scan."""
+    p = hi.shape[0]
+    main = (p // unroll) * unroll
+    if main:
+        xs = (hi[:main].reshape(-1, unroll, *hi.shape[1:]),
+              lo[:main].reshape(-1, unroll, *lo.shape[1:]))
+
+        def body(st, lane):
+            for i in range(unroll):
+                st = _update_packet(st, (lane[0][i], lane[1][i]))
+            return st, None
+
+        state, _ = jax.lax.scan(body, state, xs)
+    for i in range(main, p):                  # static tail (< unroll)
+        state = _update_packet(state, (hi[i], lo[i]))
+    return state
+
+
 def _hh256_impl(x: jax.Array, key: bytes) -> jax.Array:
     n, length = x.shape
     state = _init_state(n, key)
     n_packets = length // 32
     if n_packets:
-        lanes = _bytes_to_lanes(x[:, :n_packets * 32].reshape(n, n_packets, 32))
-        xs = lanes  # ((P, 4, n), (P, 4, n))
-
-        def body(st, lane):
-            return _update_packet(st, lane), None
-
-        state, _ = jax.lax.scan(body, state, xs)
+        hi, lo = _bytes_to_lanes(
+            x[:, :n_packets * 32].reshape(n, n_packets, 32))
+        # Long streams on TPU run the packet chain inside one Pallas
+        # program (state in VMEM scratch, no per-packet XLA dispatch
+        # overhead — highwayhash_pallas.py); everything else takes the
+        # portable scan (unrolled for long streams to amortize the loop).
+        kernel_done = False
+        try:
+            from . import highwayhash_pallas as hp
+            if hp.supported(n, n_packets):
+                main = (n_packets // hp.PB) * hp.PB
+                s_pad = (-n) % hp.SBLK
+                hi_m, lo_m = hi[:main], lo[:main]
+                if s_pad:
+                    pad = ((0, 0), (0, 0), (0, s_pad))
+                    hi_m = jnp.pad(hi_m, pad)
+                    lo_m = jnp.pad(lo_m, pad)
+                state = hp.bulk_state(hi_m, lo_m, key)
+                if s_pad:
+                    state = {k: (v[0][:, :n], v[1][:, :n])
+                             for k, v in state.items()}
+                if main < n_packets:
+                    state = _scan_packets(state, hi[main:], lo[main:])
+                kernel_done = True
+        except Exception:  # noqa: BLE001 — fall back to the XLA path
+            state = _init_state(n, key)
+        if not kernel_done:
+            u = _SCAN_UNROLL if n_packets >= 64 else 1
+            state = _scan_packets(state, hi, lo, u)
     r = length % 32
     if r:
         tail = x[:, n_packets * 32:]

@@ -95,3 +95,21 @@ def test_verify_detects_flipped_bit():
     assert np.array_equal(good[0], bad[0])
     assert not np.array_equal(good[1, 0], bad[1, 0])
     assert np.array_equal(good[1, 1], bad[1, 1])
+
+
+@pytest.mark.skipif(
+    __import__("jax").default_backend() != "tpu",
+    reason="pallas hash kernel needs a real TPU")
+def test_pallas_bulk_kernel_matches_oracle():
+    """Gated experiment (MTPU_HH_PALLAS): in-kernel packet chain must stay
+    bit-identical to the XLA/scalar paths when enabled."""
+    import os
+    from minio_tpu.ops import highwayhash_pallas as hp
+    x = rng.integers(0, 256, size=(hp.SBLK, 32 * hp.PB * 2), dtype=np.uint8)
+    os.environ["MTPU_HH_PALLAS"] = "1"
+    try:
+        got = np.asarray(hh256_batch_jax(x))
+    finally:
+        os.environ.pop("MTPU_HH_PALLAS", None)
+    want = highwayhash256_batch(x[:2])
+    assert np.array_equal(got[:2], want)
