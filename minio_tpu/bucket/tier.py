@@ -1,0 +1,232 @@
+"""Remote tiers: warm backends, transitions, restore, delete journal.
+
+The cmd/tier*.go + cmd/warm-backend-*.go equivalent: named tiers map to
+warm backends (a remote S3 endpoint, or a directory — the test double
+the reference also effectively has via its MinIO-to-MinIO tier); the
+lifecycle transition worker moves eligible object data to the tier and
+leaves a stub version whose metadata records (tier, tier-key); GETs
+stream through transparently; restore copies the data back; deleting a
+transitioned version enqueues the tier object into a persisted journal
+replayed until the remote delete succeeds (cf. cmd/tier-journal.go).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+
+from ..storage.drive import SYS_VOL
+from ..storage.errors import ErrObjectNotFound, StorageError
+
+TIER_NAME_KEY = "x-mtpu-internal-tier"
+TIER_OBJ_KEY = "x-mtpu-internal-tier-key"
+TIER_SIZE_KEY = "x-mtpu-internal-tier-size"
+JOURNAL_PATH = "tier/journal.json"
+
+
+class DirTierBackend:
+    """Warm backend over a local directory (NAS-style tier)."""
+
+    def __init__(self, root: str):
+        import os
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _p(self, key: str) -> str:
+        import os
+        return os.path.join(self.root, key.replace("/", "_"))
+
+    def put(self, key: str, data: bytes) -> None:
+        with open(self._p(key), "wb") as f:
+            f.write(data)
+
+    def get(self, key: str) -> bytes:
+        try:
+            with open(self._p(key), "rb") as f:
+                return f.read()
+        except OSError:
+            raise ErrObjectNotFound(f"tier object {key}") from None
+
+    def delete(self, key: str) -> None:
+        import os
+        try:
+            os.unlink(self._p(key))
+        except OSError:
+            pass
+
+
+class S3TierBackend:
+    """Warm backend over a remote S3 endpoint (warm-backend-s3 role)."""
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 bucket: str, prefix: str = "tier/"):
+        from ..server.client import S3Client
+        self.cli = S3Client(endpoint, access_key, secret_key)
+        self.bucket = bucket
+        self.prefix = prefix
+
+    def put(self, key: str, data: bytes) -> None:
+        self.cli.put_object(self.bucket, self.prefix + key, data)
+
+    def get(self, key: str) -> bytes:
+        from ..server.client import S3ClientError
+        try:
+            return self.cli.get_object(self.bucket, self.prefix + key)
+        except S3ClientError:
+            raise ErrObjectNotFound(f"tier object {key}") from None
+
+    def delete(self, key: str) -> None:
+        from ..server.client import S3ClientError
+        try:
+            self.cli.delete_object(self.bucket, self.prefix + key)
+        except S3ClientError:
+            pass
+
+
+class TierManager:
+    def __init__(self, pools):
+        self.pools = pools
+        self._mu = threading.Lock()
+        self._tiers: dict[str, object] = {}
+        self._journal: list[dict] = []
+        self._load_journal()
+
+    # -- registry ------------------------------------------------------------
+
+    def add_tier(self, name: str, backend) -> None:
+        with self._mu:
+            self._tiers[name.upper()] = backend
+
+    def get_tier(self, name: str):
+        with self._mu:
+            backend = self._tiers.get(name.upper())
+        if backend is None:
+            raise StorageError(f"unknown tier {name!r}")
+        return backend
+
+    def list_tiers(self) -> list[str]:
+        with self._mu:
+            return sorted(self._tiers)
+
+    # -- transition / read-through / restore ---------------------------------
+
+    def transition_object(self, bucket: str, key: str, tier: str) -> None:
+        """Move the current version's data to the tier, leave a stub
+        (cf. TransitionObject, cmd/erasure-object.go:1556)."""
+        backend = self.get_tier(tier)
+        fi, data = self.pools.get_object(bucket, key)
+        if fi.metadata.get(TIER_NAME_KEY):
+            return                              # already transitioned
+        tier_key = f"{bucket}/{uuid.uuid4().hex}"
+        backend.put(tier_key, data)
+        meta = dict(fi.metadata)
+        meta[TIER_NAME_KEY] = tier.upper()
+        meta[TIER_OBJ_KEY] = tier_key
+        meta[TIER_SIZE_KEY] = str(len(data))
+        # Stub version: empty data, same etag/user metadata.
+        self.pools.put_object(bucket, key, b"", metadata=meta)
+
+    def is_transitioned(self, fi) -> bool:
+        return bool(fi.metadata.get(TIER_NAME_KEY))
+
+    def read_through(self, fi) -> bytes:
+        backend = self.get_tier(fi.metadata[TIER_NAME_KEY])
+        return backend.get(fi.metadata[TIER_OBJ_KEY])
+
+    def restore_object(self, bucket: str, key: str,
+                       version_id: str = "") -> bool:
+        """Copy tiered data back into the hot store (PostRestoreObject).
+        Returns False when the targeted version is not transitioned —
+        callers map that to InvalidObjectState, like S3 does for a
+        restore of a non-archived object."""
+        fi = self.pools.head_object(bucket, key, version_id)
+        if not self.is_transitioned(fi):
+            return False
+        data = self.read_through(fi)
+        meta = {k: v for k, v in fi.metadata.items()
+                if k not in (TIER_NAME_KEY, TIER_OBJ_KEY, TIER_SIZE_KEY)}
+        self.pools.put_object(bucket, key, data, metadata=meta)
+        self.enqueue_delete(fi.metadata[TIER_NAME_KEY],
+                            fi.metadata[TIER_OBJ_KEY])
+        self.drain_journal()
+        return True
+
+    # -- delete journal (cf. cmd/tier-journal.go) ----------------------------
+
+    def _save_journal(self) -> None:
+        payload = json.dumps(self._journal).encode()
+        for pool in getattr(self.pools, "pools", []):
+            for es in getattr(pool, "sets", [pool]):
+                try:
+                    for d in es.drives:
+                        if d is not None:
+                            d.write_all(SYS_VOL, JOURNAL_PATH, payload)
+                    return
+                except StorageError:
+                    continue
+
+    def _load_journal(self) -> None:
+        for pool in getattr(self.pools, "pools", []):
+            for es in getattr(pool, "sets", [pool]):
+                for d in es.drives:
+                    if d is None:
+                        continue
+                    try:
+                        self._journal = json.loads(
+                            d.read_all(SYS_VOL, JOURNAL_PATH))
+                        return
+                    except (StorageError, ValueError):
+                        continue
+
+    def enqueue_delete(self, tier: str, tier_key: str) -> None:
+        with self._mu:
+            self._journal.append({"tier": tier, "key": tier_key})
+        self._save_journal()
+
+    def drain_journal(self) -> int:
+        """Replay pending tier deletes; survivors stay queued."""
+        with self._mu:
+            pending = list(self._journal)
+        done = 0
+        remaining = []
+        for entry in pending:
+            try:
+                self.get_tier(entry["tier"]).delete(entry["key"])
+                done += 1
+            except StorageError:
+                remaining.append(entry)
+        with self._mu:
+            self._journal = remaining
+        self._save_journal()
+        return done
+
+    def on_version_deleted(self, fi) -> None:
+        """Hook: a transitioned version was removed from the hot store."""
+        if self.is_transitioned(fi):
+            self.enqueue_delete(fi.metadata[TIER_NAME_KEY],
+                                fi.metadata[TIER_OBJ_KEY])
+            self.drain_journal()
+
+
+def run_transitions(pools, bucket: str, lc, tier_mgr: TierManager,
+                    now: float | None = None) -> int:
+    """Apply lifecycle transition actions (initBackgroundTransition role,
+    cmd/bucket-lifecycle.go:213)."""
+    from .lifecycle import _object_tags
+    moved = 0
+    try:
+        infos = pools.list_objects(bucket, max_keys=1000000)
+    except StorageError:
+        return 0
+    for fi in infos:
+        action = lc.eval(fi.name, fi.mod_time_ns,
+                         tags=_object_tags(fi), now=now)
+        if action.startswith("transition:"):
+            tier = action.split(":", 1)[1]
+            try:
+                tier_mgr.transition_object(bucket, fi.name, tier)
+                moved += 1
+            except StorageError:
+                continue
+    return moved

@@ -1,0 +1,135 @@
+"""Site replication: mirror IAM + bucket configuration across sites.
+
+The cmd/site-replication.go equivalent: a site group shares users,
+policies, buckets and bucket configs; changes made on one site are
+pushed to the others over their admin/S3 APIs (signed with each site's
+root credentials). Object data replication between sites composes with
+bucket.replication targets; this module covers the control-plane half
+the reference's site replication adds on top.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..server.client import S3Client, S3ClientError
+from ..storage.errors import StorageError
+
+_REPLICATED_CONFIGS = ("versioning", "policy", "lifecycle",
+                       "object-lock", "tagging", "quota", "notification")
+
+
+class SitePeer:
+    def __init__(self, name: str, endpoint: str, access_key: str,
+                 secret_key: str):
+        self.name = name
+        self.cli = S3Client(endpoint, access_key, secret_key)
+
+    # -- control-plane pushes ------------------------------------------------
+
+    def push_user(self, access_key: str, secret_key: str,
+                  policies: list[str]) -> bool:
+        body = json.dumps({"accessKey": access_key,
+                           "secretKey": secret_key,
+                           "policies": policies}).encode()
+        status, _, _ = self.cli.request("POST", "/minio/admin/v1/users",
+                                        body=body)
+        return status == 200
+
+    def push_policy(self, name: str, doc: dict) -> bool:
+        body = json.dumps({"name": name, "policy": doc}).encode()
+        status, _, _ = self.cli.request("POST",
+                                        "/minio/admin/v1/policies",
+                                        body=body)
+        return status == 200
+
+    def push_bucket(self, bucket: str, configs: dict[str, bytes]) -> bool:
+        try:
+            self.cli.make_bucket(bucket)
+        except S3ClientError as e:
+            if e.code not in ("BucketAlreadyOwnedByYou",
+                              "BucketAlreadyExists"):
+                return False
+        ok = True
+        for sub, data in configs.items():
+            status, _, _ = self.cli.request("PUT", f"/{bucket}",
+                                            query={sub: ""}, body=data)
+            ok = ok and status == 200
+        return ok
+
+
+class SiteReplicator:
+    """Attached to the 'source of truth' site; fans control-plane changes
+    out to the peer sites."""
+
+    def __init__(self, iam, meta, peers: list[SitePeer]):
+        self.iam = iam                   # IAMSys
+        self.meta = meta                 # BucketMetadataSys
+        self.peers = peers
+        self.pushed = 0
+        self.failed = 0
+
+    def _fan(self, fn) -> int:
+        ok = 0
+        for peer in self.peers:
+            try:
+                if fn(peer):
+                    ok += 1
+                    self.pushed += 1
+                else:
+                    self.failed += 1
+            except Exception:  # noqa: BLE001 — peer down: count + continue
+                self.failed += 1
+        return ok
+
+    # -- hooks (call after local mutations) ----------------------------------
+
+    def on_user_added(self, access_key: str, secret_key: str,
+                      policies: list[str]) -> int:
+        return self._fan(lambda p: p.push_user(access_key, secret_key,
+                                               policies))
+
+    def on_policy_set(self, name: str, doc: dict) -> int:
+        return self._fan(lambda p: p.push_policy(name, doc))
+
+    def on_bucket_config(self, bucket: str) -> int:
+        configs = self._bucket_configs(bucket)
+        return self._fan(lambda p: p.push_bucket(bucket, configs))
+
+    def _bucket_configs(self, bucket: str) -> dict[str, bytes]:
+        from ..bucket.metadata import CONFIG_FILES
+        out = {}
+        for sub in _REPLICATED_CONFIGS:
+            kind = sub.replace("-", "_")
+            if kind not in CONFIG_FILES:
+                continue
+            try:
+                data = self.meta.get(bucket, kind)
+            except StorageError:
+                continue
+            if data is not None:
+                out[sub] = data
+        return out
+
+    # -- full resync ---------------------------------------------------------
+
+    def sync_all(self, buckets: list[str]) -> dict:
+        stats = {"users": 0, "policies": 0, "buckets": 0}
+        if self.iam is not None:
+            with self.iam._mu:
+                users = [u for u in self.iam._users.values()
+                         if u.kind == "user"]
+                policies = {n: p for n, p in self.iam._policies.items()
+                            if n not in ("readwrite", "readonly",
+                                         "writeonly")}
+            for name, p in policies.items():
+                if self.on_policy_set(name, p.doc):
+                    stats["policies"] += 1
+            for u in users:
+                if self.on_user_added(u.access_key, u.secret_key,
+                                      u.policies):
+                    stats["users"] += 1
+        for bucket in buckets:
+            if self.on_bucket_config(bucket):
+                stats["buckets"] += 1
+        return stats

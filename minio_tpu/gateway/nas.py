@@ -1,0 +1,16 @@
+"""NAS gateway: the FS ObjectLayer over a shared mount.
+
+The cmd/gateway/nas equivalent is exactly this shape in the reference
+too — the single-drive FS backend pointed at network-attached storage,
+with the S3 front door (auth, policies, notifications) layered on top.
+"""
+
+from __future__ import annotations
+
+from ..fs.backend import FSObjectLayer
+
+
+class NASGateway(FSObjectLayer):
+    """FSObjectLayer over a shared mount; multiple gateway instances may
+    point at the same export (last-writer-wins file semantics, like the
+    reference's NAS gateway)."""

@@ -1,0 +1,226 @@
+"""S3 gateway: serve our full front door over a remote S3 backend.
+
+The cmd/gateway/s3 equivalent: an ObjectLayer whose storage is another
+S3-compatible endpoint. Our server's auth/policy/notification/etc. wrap
+the remote store; object data round-trips over signed HTTP. The NAS
+gateway (cmd/gateway/nas) is the FS backend pointed at a shared mount —
+see gateway.nas.
+"""
+
+from __future__ import annotations
+
+import email.utils
+import hashlib
+
+from ..server.client import S3Client, S3ClientError
+from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
+                              ErrInvalidPart, ErrObjectNotFound,
+                              ErrUploadNotFound, StorageError)
+from ..storage.xlmeta import FileInfo, ObjectPartInfo
+
+
+def _map_err(e: S3ClientError) -> StorageError:
+    return {
+        "NoSuchBucket": ErrBucketNotFound,
+        "NoSuchKey": ErrObjectNotFound,
+        "NoSuchUpload": ErrUploadNotFound,
+        "InvalidPart": ErrInvalidPart,
+        "BucketAlreadyOwnedByYou": ErrBucketExists,
+        "BucketAlreadyExists": ErrBucketExists,
+    }.get(e.code, StorageError)(f"{e.code}: {e.message}")
+
+
+class S3Gateway:
+    def __init__(self, endpoint: str, access_key: str, secret_key: str):
+        self.cli = S3Client(endpoint, access_key, secret_key)
+        self.deployment_id = "s3gw-" + hashlib.sha256(
+            endpoint.encode()).hexdigest()[:16]
+
+    @property
+    def pools(self):
+        return []
+
+    # -- buckets -------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        try:
+            self.cli.make_bucket(bucket)
+        except S3ClientError as e:
+            raise _map_err(e) from None
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return self.cli.bucket_exists(bucket)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        try:
+            self.cli.delete_bucket(bucket)
+        except S3ClientError as e:
+            raise _map_err(e) from None
+
+    def list_buckets(self) -> list[str]:
+        return self.cli.list_buckets()
+
+    # -- objects -------------------------------------------------------------
+
+    def put_object(self, bucket: str, obj: str, data: bytes, *,
+                   metadata: dict | None = None, versioned: bool = False,
+                   parity=None) -> FileInfo:
+        headers = {}
+        meta = dict(metadata or {})
+        for k, v in meta.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v
+        if "content-type" in meta:
+            headers["Content-Type"] = meta["content-type"]
+        try:
+            resp = self.cli.put_object(bucket, obj, data, headers=headers)
+        except S3ClientError as e:
+            raise _map_err(e) from None
+        meta.setdefault("etag",
+                        resp.get("ETag", "").strip('"')
+                        or hashlib.md5(data).hexdigest())
+        return FileInfo(volume=bucket, name=obj, size=len(data),
+                        metadata=meta)
+
+    def _fi_from_headers(self, bucket: str, obj: str,
+                         h: dict) -> FileInfo:
+        meta = {"etag": h.get("ETag", "").strip('"')}
+        for k, v in h.items():
+            lk = k.lower()
+            if lk.startswith("x-amz-meta-"):
+                meta[lk] = v
+        if "Content-Type" in h:
+            meta["content-type"] = h["Content-Type"]
+        mt = 0
+        if h.get("Last-Modified"):
+            try:
+                mt = int(email.utils.parsedate_to_datetime(
+                    h["Last-Modified"]).timestamp() * 1e9)
+            except (TypeError, ValueError):
+                pass
+        return FileInfo(volume=bucket, name=obj,
+                        size=int(h.get("Content-Length", 0) or 0),
+                        mod_time_ns=mt, metadata=meta)
+
+    def head_object(self, bucket: str, obj: str,
+                    version_id: str = "") -> FileInfo:
+        try:
+            h = self.cli.head_object(bucket, obj)
+        except S3ClientError:
+            if not self.bucket_exists(bucket):
+                raise ErrBucketNotFound(bucket) from None
+            raise ErrObjectNotFound(f"{bucket}/{obj}") from None
+        return self._fi_from_headers(bucket, obj, h)
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, version_id: str = ""):
+        fi = self.head_object(bucket, obj, version_id)
+        try:
+            if offset == 0 and length < 0:
+                data = self.cli.get_object(bucket, obj)
+            else:
+                end = (fi.size - 1 if length < 0
+                       else offset + length - 1)
+                data = self.cli.get_object(bucket, obj,
+                                           range_=(offset, end))
+        except S3ClientError as e:
+            raise _map_err(e) from None
+        return fi, data
+
+    def delete_object(self, bucket: str, obj: str, version_id: str = "",
+                      versioned: bool = False):
+        try:
+            self.cli.delete_object(bucket, obj)
+        except S3ClientError as e:
+            raise _map_err(e) from None
+        return None
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 10000) -> list[FileInfo]:
+        try:
+            keys, _ = self.cli.list_objects(bucket, prefix=prefix)
+        except S3ClientError as e:
+            raise _map_err(e) from None
+        out = []
+        for k in keys[:max_keys]:
+            try:
+                out.append(self.head_object(bucket, k))
+            except StorageError:
+                continue
+        return out
+
+    def list_object_versions(self, bucket: str, obj: str):
+        return [self.head_object(bucket, obj)]
+
+    def update_object_metadata(self, bucket: str, obj: str, fi) -> None:
+        # Remote S3 metadata updates require copy-in-place.
+        _, data = self.get_object(bucket, obj)
+        self.put_object(bucket, obj, data, metadata=fi.metadata)
+
+    # -- multipart (proxied) -------------------------------------------------
+
+    def new_multipart_upload(self, bucket: str, obj: str, *,
+                             metadata=None, parity=None) -> str:
+        try:
+            return f"{obj}\x00{self.cli.create_multipart(bucket, obj)}"
+        except S3ClientError as e:
+            raise _map_err(e) from None
+
+    @staticmethod
+    def _split(upload_id: str) -> tuple[str, str]:
+        obj, _, uid = upload_id.partition("\x00")
+        if not uid:
+            raise ErrUploadNotFound(upload_id)
+        return obj, uid
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: bytes) -> ObjectPartInfo:
+        _, uid = self._split(upload_id)
+        try:
+            etag = self.cli.upload_part(bucket, obj, uid, part_number,
+                                        data)
+        except S3ClientError as e:
+            raise _map_err(e) from None
+        return ObjectPartInfo(number=part_number, size=len(data),
+                              actual_size=len(data), etag=etag)
+
+    def list_parts(self, bucket: str, obj: str,
+                   upload_id: str) -> list[ObjectPartInfo]:
+        _, uid = self._split(upload_id)
+        status, _, data = self.cli.request(
+            "GET", f"/{bucket}/{obj}", query={"uploadId": uid})
+        if status != 200:
+            raise ErrUploadNotFound(upload_id)
+        import re
+        out = []
+        for m in re.finditer(
+                r"<Part><PartNumber>(\d+)</PartNumber>"
+                r"<ETag>\"?([0-9a-f-]+)\"?</ETag><Size>(\d+)</Size>",
+                data.decode()):
+            out.append(ObjectPartInfo(number=int(m.group(1)),
+                                      size=int(m.group(3)),
+                                      actual_size=int(m.group(3)),
+                                      etag=m.group(2)))
+        return out
+
+    def complete_multipart_upload(self, bucket: str, obj: str,
+                                  upload_id: str, parts, *,
+                                  versioned: bool = False) -> FileInfo:
+        _, uid = self._split(upload_id)
+        try:
+            self.cli.complete_multipart(bucket, obj, uid, list(parts))
+        except S3ClientError as e:
+            raise _map_err(e) from None
+        return self.head_object(bucket, obj)
+
+    def abort_multipart_upload(self, bucket: str, obj: str,
+                               upload_id: str) -> None:
+        _, uid = self._split(upload_id)
+        try:
+            self.cli.abort_multipart(bucket, obj, uid)
+        except S3ClientError as e:
+            raise _map_err(e) from None
+
+    def list_multipart_uploads(self, bucket: str,
+                               prefix: str = "") -> list[dict]:
+        return []

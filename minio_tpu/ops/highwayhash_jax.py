@@ -276,21 +276,22 @@ def _scan_packets(state, hi: jax.Array, lo: jax.Array,
     return state
 
 
-def _hh256_impl(x: jax.Array, key: bytes) -> jax.Array:
+def _hh256_impl(x: jax.Array, key: bytes,
+                allow_pallas: bool = False) -> jax.Array:
     n, length = x.shape
     state = _init_state(n, key)
     n_packets = length // 32
     if n_packets:
         hi, lo = _bytes_to_lanes(
             x[:, :n_packets * 32].reshape(n, n_packets, 32))
-        # Long streams on TPU run the packet chain inside one Pallas
-        # program (state in VMEM scratch, no per-packet XLA dispatch
-        # overhead — highwayhash_pallas.py); everything else takes the
-        # portable scan (unrolled for long streams to amortize the loop).
+        # Long streams on TPU can run the packet chain inside one Pallas
+        # program (highwayhash_pallas.py — gated experiment); everything
+        # else takes the portable scan (unrolled for long streams to
+        # amortize the loop).
         kernel_done = False
         try:
             from . import highwayhash_pallas as hp
-            if hp.supported(n, n_packets):
+            if allow_pallas and hp.supported(n, n_packets):
                 main = (n_packets // hp.PB) * hp.PB
                 s_pad = (-n) % hp.SBLK
                 hi_m, lo_m = hi[:main], lo[:main]
@@ -325,8 +326,12 @@ def _hh256_impl(x: jax.Array, key: bytes) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=8)
-def _jit_for_key(key: bytes):
-    return jax.jit(functools.partial(_hh256_impl, key=key))
+def _jit_for_key(key: bytes, allow_pallas: bool):
+    # allow_pallas is part of the cache key: the env flag is consulted at
+    # trace time, so a program compiled one way must never be served for
+    # the other setting.
+    return jax.jit(functools.partial(_hh256_impl, key=key,
+                                     allow_pallas=allow_pallas))
 
 
 def hh256_batch_jax(blocks, key: bytes = MAGIC_KEY) -> jax.Array:
@@ -335,5 +340,7 @@ def hh256_batch_jax(blocks, key: bytes = MAGIC_KEY) -> jax.Array:
     Bit-identical to the reference's magic-keyed HighwayHash256; any L
     (remainder rules included). One compiled program per (n, L) shape.
     """
+    import os
     blocks = jnp.asarray(blocks, dtype=jnp.uint8)
-    return _jit_for_key(key)(blocks)
+    allow_pallas = os.environ.get("MTPU_HH_PALLAS", "") == "1"
+    return _jit_for_key(key, allow_pallas)(blocks)

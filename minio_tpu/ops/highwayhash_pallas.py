@@ -159,10 +159,10 @@ def supported(n_streams: int, n_packets: int) -> bool:
     16-bit partial products with carries), so in-kernel execution saves
     dispatch overhead but loses more to serialized emulated multiplies.
     Kept as the documented negative result for SURVEY §7 hard-part #3;
-    the XLA scan remains the production device path.
+    the XLA scan remains the production device path. The env gate lives
+    in hh256_batch_jax (part of the jit cache key); this checks only
+    backend/shape feasibility.
     """
-    import os
-    return (os.environ.get("MTPU_HH_PALLAS", "") == "1"
-            and jax.default_backend() == "tpu"
+    return (jax.default_backend() == "tpu"
             and n_packets >= PB
             and n_streams >= SBLK // 4)

@@ -70,6 +70,7 @@ ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("InvalidRetentionDate", 400, "Date must be provided in ISO 8601 format."),
     _E("NoSuchNotificationConfiguration", 404, "The specified bucket does not have a notification configuration."),
     _E("SelectParseError", 400, "The SQL expression could not be parsed."),
+    _E("InvalidObjectState", 403, "The operation is not valid for the object's storage class."),
 ]}
 
 

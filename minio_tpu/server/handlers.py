@@ -85,7 +85,7 @@ class S3Handlers:
 
     def __init__(self, pools: ServerPools, *, notify=None,
                  replication=None, scanner=None, kms=None,
-                 compress_enabled: bool = False):
+                 compress_enabled: bool = False, tier_mgr=None):
         from ..bucket.metadata import BucketMetadataSys
         from ..crypto.kms import StaticKMS
         self.pools = pools
@@ -99,22 +99,40 @@ class S3Handlers:
         self.scanner = scanner            # background.scanner.DataScanner
         self.kms = kms if kms is not None else StaticKMS()
         self.compress_enabled = compress_enabled
+        self.tier_mgr = tier_mgr          # bucket.tier.TierManager
 
     # Client-visible size of a transformed (compressed/encrypted) object.
     CLIENT_SIZE_KEY = "x-mtpu-internal-client-size"
 
     def _logical_size(self, fi) -> int:
+        from ..bucket.tier import TIER_SIZE_KEY
+        if TIER_SIZE_KEY in fi.metadata:
+            # transitioned stub: size of the tiered stored bytes; the
+            # client-size key still wins if transforms applied
+            return int(fi.metadata.get(self.CLIENT_SIZE_KEY,
+                                       fi.metadata[TIER_SIZE_KEY]))
         return int(fi.metadata.get(self.CLIENT_SIZE_KEY, fi.size))
+
+    def _is_transitioned(self, fi) -> bool:
+        return (self.tier_mgr is not None
+                and self.tier_mgr.is_transitioned(fi))
 
     def _read_plaintext(self, bucket: str, key: str, version_id: str,
                         headers: dict) -> tuple:
         """Fetch an object and reverse its storage transforms
-        (decrypt -> decompress); returns (fi, plaintext)."""
+        (tier read-through -> decrypt -> decompress);
+        returns (fi, plaintext)."""
         from ..crypto import sse
         from ..utils import compress as cz
         try:
+            # One fetch; the stub body of a transitioned version is empty,
+            # and checking the RETURNED fi (not a prior head) means a
+            # concurrent transition can't hand us a stub we mistake for
+            # data.
             fi, stored = self.pools.get_object(bucket, key,
                                                version_id=version_id)
+            if self._is_transitioned(fi):
+                stored = self.tier_mgr.read_through(fi)
         except StorageError as e:
             raise from_storage_error(e) from None
         data = stored
@@ -428,7 +446,8 @@ class S3Handlers:
         self._check_conditions(headers, fi)
 
         transformed = (sse.is_encrypted(fi.metadata)
-                       or cz.is_compressed(fi.metadata))
+                       or cz.is_compressed(fi.metadata)
+                       or self._is_transitioned(fi))
         size = self._logical_size(fi)
         rng = headers.get("Range") or headers.get("range")
         offset, length = 0, size
@@ -542,24 +561,33 @@ class S3Handlers:
 
         # Object-lock: existing protected version must not be silently
         # replaced (unversioned overwrite destroys it); default retention
-        # from the bucket config applies to the new version.
+        # from the bucket config applies to the new version. The same
+        # pre-head also spots a transitioned stub an unversioned
+        # overwrite is about to destroy — its tier object must be freed
+        # or the cold copy leaks forever.
         lock_cfg = self._lock_config(bucket)
         versioned = self.bucket_versioning_enabled(bucket)
+        prev = None
+        if not versioned and (self.tier_mgr is not None
+                              or (lock_cfg is not None
+                                  and lock_cfg.get("enabled"))):
+            try:
+                prev = self.pools.head_object(bucket, key)
+            except StorageError:
+                prev = None
         if lock_cfg is not None and lock_cfg.get("enabled"):
             from ..bucket import object_lock as ol
-            if not versioned:
-                try:
-                    prev = self.pools.head_object(bucket, key)
-                    reason = ol.check_delete_allowed(prev.metadata)
-                    if reason:
-                        raise S3Error("ObjectLocked", reason)
-                except StorageError:
-                    pass
+            if prev is not None:
+                reason = ol.check_delete_allowed(prev.metadata)
+                if reason:
+                    raise S3Error("ObjectLocked", reason)
             metadata.update(ol.default_retention_metadata(lock_cfg))
             # explicit per-request retention headers win
             for hk in (ol.RET_MODE_KEY, ol.RET_DATE_KEY, ol.LEGAL_HOLD_KEY):
                 if hk in h:
                     metadata[hk] = h[hk]
+        replaced_tiered = (prev is not None and self.tier_mgr is not None
+                          and self.tier_mgr.is_transitioned(prev))
 
         # Storage transforms: compress, then encrypt (the reference
         # composes the same way — compressed plaintext is sealed,
@@ -587,6 +615,8 @@ class S3Handlers:
                                        versioned=versioned)
         except StorageError as e:
             raise from_storage_error(e) from None
+        if replaced_tiered:
+            self.tier_mgr.on_version_deleted(prev)
         etag = fi.metadata.get("etag", "")
         self._publish_event("s3:ObjectCreated:Put", bucket, key,
                             size=len(body), etag=etag,
@@ -632,21 +662,25 @@ class S3Handlers:
         versioned = self.bucket_versioning_enabled(bucket)
         hl = {k.lower(): v for k, v in (headers or {}).items()}
 
-        # WORM: deleting a SPECIFIC protected version is refused; an
-        # unversioned delete on a versioned bucket only writes a marker
-        # (data survives), which object lock permits.
+        # One metadata fetch serves both the WORM check and the tier-free
+        # check (only hard deletes — versionId set or unversioned bucket —
+        # destroy data; a delete marker keeps the version readable).
+        prev = None
         if version_id or not versioned:
-            from ..bucket import object_lock as ol
             try:
                 prev = self.pools.head_object(bucket, key, version_id)
-                bypass = hl.get(
-                    "x-amz-bypass-governance-retention", "") == "true"
-                reason = ol.check_delete_allowed(prev.metadata,
-                                                 bypass_governance=bypass)
-                if reason:
-                    raise S3Error("ObjectLocked", reason)
             except StorageError:
-                pass
+                prev = None
+        if prev is not None:
+            from ..bucket import object_lock as ol
+            bypass = hl.get(
+                "x-amz-bypass-governance-retention", "") == "true"
+            reason = ol.check_delete_allowed(prev.metadata,
+                                             bypass_governance=bypass)
+            if reason:
+                raise S3Error("ObjectLocked", reason)
+        tiered_fi = (prev if prev is not None and self.tier_mgr is not None
+                     and self.tier_mgr.is_transitioned(prev) else None)
 
         try:
             dm = self.pools.delete_object(bucket, key, version_id, versioned)
@@ -656,6 +690,10 @@ class S3Handlers:
             if err.api.code == "NoSuchKey":
                 return Response(204)
             raise err from None
+        # Only a hard delete frees the tier copy; a delete marker keeps
+        # the noncurrent version readable.
+        if tiered_fi is not None and dm is None:
+            self.tier_mgr.on_version_deleted(tiered_fi)
         self._publish_event(
             "s3:ObjectRemoved:DeleteMarkerCreated" if dm is not None
             else "s3:ObjectRemoved:Delete", bucket, key,

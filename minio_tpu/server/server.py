@@ -34,14 +34,15 @@ class S3Server:
                  host: str = "127.0.0.1", port: int = 0,
                  trace_sink=None, iam=None, notify=None,
                  replication=None, scanner=None, kms=None,
-                 compress_enabled: bool = False):
+                 compress_enabled: bool = False, tier_mgr=None):
         self.pools = pools
         self.creds = creds                 # root credentials (policy bypass)
         self.iam = iam                     # IAMSys | None
         self.handlers = S3Handlers(pools, notify=notify,
                                    replication=replication,
                                    scanner=scanner, kms=kms,
-                                   compress_enabled=compress_enabled)
+                                   compress_enabled=compress_enabled,
+                                   tier_mgr=tier_mgr)
         self.trace_sink = trace_sink
         from ..observe.logger import Logger, RingTarget
         from ..observe.metrics import MetricsRegistry
@@ -287,6 +288,8 @@ class S3Server:
         if method == "POST":
             if "select" in query:
                 return "s3:GetObject"
+            if "restore" in query:
+                return "s3:RestoreObject"
             return "s3:PutObject"
         return "s3:GetObject"
 
@@ -634,6 +637,19 @@ class S3Server:
                 return h.abort_multipart(bucket, key, query)
             return h.delete_object(bucket, key, query, headers)
         if method == "POST":
+            if "restore" in query:
+                if h.tier_mgr is None:
+                    raise S3Error("NotImplemented", "tiering not enabled")
+                from ..storage.errors import StorageError as _SE
+                try:
+                    restored = h.tier_mgr.restore_object(
+                        bucket, key, query.get("versionId", [""])[0])
+                except _SE as e:
+                    from .api_errors import from_storage_error as _fse
+                    raise _fse(e) from None
+                if not restored:
+                    raise S3Error("InvalidObjectState")
+                return Response(202)
             if "select" in query:
                 return h.select_object_content(bucket, key, query, body,
                                                headers)

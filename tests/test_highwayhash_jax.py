@@ -106,10 +106,14 @@ def test_pallas_bulk_kernel_matches_oracle():
     import os
     from minio_tpu.ops import highwayhash_pallas as hp
     x = rng.integers(0, 256, size=(hp.SBLK, 32 * hp.PB * 2), dtype=np.uint8)
+    saved = os.environ.get("MTPU_HH_PALLAS")
     os.environ["MTPU_HH_PALLAS"] = "1"
     try:
         got = np.asarray(hh256_batch_jax(x))
     finally:
-        os.environ.pop("MTPU_HH_PALLAS", None)
+        if saved is None:
+            os.environ.pop("MTPU_HH_PALLAS", None)
+        else:
+            os.environ["MTPU_HH_PALLAS"] = saved
     want = highwayhash256_batch(x[:2])
     assert np.array_equal(got[:2], want)

@@ -1,0 +1,239 @@
+"""Tiering, gateways, and site replication tests."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.bucket.lifecycle import Lifecycle
+from minio_tpu.bucket.tier import (DirTierBackend, S3TierBackend,
+                                   TierManager, run_transitions)
+from minio_tpu.cluster.site_replication import SitePeer, SiteReplicator
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.gateway.nas import NASGateway
+from minio_tpu.gateway.s3 import S3Gateway
+from minio_tpu.iam.iam import IAMSys
+from minio_tpu.server.client import S3Client, S3ClientError
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ROOT, SECRET = "tieradmin", "tieradmin-secret"
+
+
+def make_pools(tmp_path, name="p"):
+    drives = [LocalDrive(str(tmp_path / name / f"d{i}")) for i in range(4)]
+    return ServerPools([ErasureSets(drives, set_drive_count=4)])
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+class TestTiering:
+    def test_transition_readthrough_restore_delete(self, tmp_path):
+        pools = make_pools(tmp_path)
+        tm = TierManager(pools)
+        tm.add_tier("COLD", DirTierBackend(str(tmp_path / "cold")))
+        notify = None
+        srv = S3Server(pools, Credentials(ROOT, SECRET),
+                       tier_mgr=tm).start()
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("tbkt")
+            data = payload(200000, 1)
+            cli.put_object("tbkt", "archive/x", data)
+            tm.transition_object("tbkt", "archive/x", "COLD")
+            # hot copy is now a stub
+            fi = pools.head_object("tbkt", "archive/x")
+            assert fi.size == 0 and tm.is_transitioned(fi)
+            # GET streams through the tier transparently
+            assert cli.get_object("tbkt", "archive/x") == data
+            h = cli.head_object("tbkt", "archive/x")
+            assert int(h["Content-Length"]) == len(data)
+            # restore copies data back to hot
+            status, _, _ = cli.request("POST", "/tbkt/archive/x",
+                                       query={"restore": ""})
+            assert status == 202
+            fi = pools.head_object("tbkt", "archive/x")
+            assert not tm.is_transitioned(fi) and fi.size == len(data)
+            assert cli.get_object("tbkt", "archive/x") == data
+        finally:
+            srv.shutdown()
+
+    def test_delete_frees_tier_object_via_journal(self, tmp_path):
+        pools = make_pools(tmp_path)
+        tm = TierManager(pools)
+        backend = DirTierBackend(str(tmp_path / "cold"))
+        tm.add_tier("COLD", backend)
+        srv = S3Server(pools, Credentials(ROOT, SECRET),
+                       tier_mgr=tm).start()
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("tbkt")
+            cli.put_object("tbkt", "x", payload(150000, 2))
+            tm.transition_object("tbkt", "x", "COLD")
+            fi = pools.head_object("tbkt", "x")
+            tier_key = fi.metadata["x-mtpu-internal-tier-key"]
+            import os
+            assert os.path.exists(backend._p(tier_key))
+            cli.delete_object("tbkt", "x")
+            assert not os.path.exists(backend._p(tier_key))
+        finally:
+            srv.shutdown()
+
+    def test_lifecycle_transition_worker(self, tmp_path):
+        pools = make_pools(tmp_path)
+        tm = TierManager(pools)
+        tm.add_tier("GLACIER", DirTierBackend(str(tmp_path / "gl")))
+        pools.make_bucket("lwb")
+        pools.put_object("lwb", "old/a", payload(130000, 3))
+        lc = Lifecycle.parse(b"""<LifecycleConfiguration><Rule>
+            <Status>Enabled</Status><Filter><Prefix>old/</Prefix></Filter>
+            <Transition><Days>10</Days><StorageClass>GLACIER</StorageClass>
+            </Transition></Rule></LifecycleConfiguration>""")
+        moved = run_transitions(pools, "lwb", lc, tm,
+                                now=time.time() + 11 * 86400)
+        assert moved == 1
+        fi = pools.head_object("lwb", "old/a")
+        assert tm.is_transitioned(fi)
+
+    def test_s3_tier_backend(self, tmp_path):
+        # remote warm tier = another in-process server
+        remote = make_pools(tmp_path, "remote")
+        rsrv = S3Server(remote, Credentials(ROOT, SECRET)).start()
+        try:
+            rcli = S3Client(rsrv.endpoint, ROOT, SECRET)
+            rcli.make_bucket("warm")
+            backend = S3TierBackend(rsrv.endpoint, ROOT, SECRET, "warm")
+            backend.put("k1", b"tiered bytes")
+            assert backend.get("k1") == b"tiered bytes"
+            backend.delete("k1")
+            from minio_tpu.storage.errors import ErrObjectNotFound
+            with pytest.raises(ErrObjectNotFound):
+                backend.get("k1")
+        finally:
+            rsrv.shutdown()
+
+
+class TestGateways:
+    def test_s3_gateway_roundtrip(self, tmp_path):
+        backend_pools = make_pools(tmp_path, "bp")
+        backend_srv = S3Server(backend_pools,
+                               Credentials(ROOT, SECRET)).start()
+        gw_srv = None
+        try:
+            gw = S3Gateway(backend_srv.endpoint, ROOT, SECRET)
+            gw_srv = S3Server(gw, Credentials("gwroot",
+                                              "gwroot-secret")).start()
+            cli = S3Client(gw_srv.endpoint, "gwroot", "gwroot-secret")
+            cli.make_bucket("via-gw")
+            data = payload(120000, 5)
+            cli.put_object("via-gw", "k", data,
+                           headers={"x-amz-meta-src": "gw"})
+            assert cli.get_object("via-gw", "k") == data
+            assert cli.get_object("via-gw", "k",
+                                  range_=(100, 199)) == data[100:200]
+            h = cli.head_object("via-gw", "k")
+            assert h.get("x-amz-meta-src") == "gw"
+            # the data really lives on the backend cluster
+            direct = S3Client(backend_srv.endpoint, ROOT, SECRET)
+            assert direct.get_object("via-gw", "k") == data
+            keys, _ = cli.list_objects("via-gw")
+            assert keys == ["k"]
+            cli.delete_object("via-gw", "k")
+            with pytest.raises(S3ClientError):
+                cli.get_object("via-gw", "k")
+        finally:
+            if gw_srv:
+                gw_srv.shutdown()
+            backend_srv.shutdown()
+
+    def test_s3_gateway_multipart(self, tmp_path):
+        backend_pools = make_pools(tmp_path, "bm")
+        backend_srv = S3Server(backend_pools,
+                               Credentials(ROOT, SECRET)).start()
+        try:
+            gw = S3Gateway(backend_srv.endpoint, ROOT, SECRET)
+            gw.make_bucket("mpgw")
+            uid = gw.new_multipart_upload("mpgw", "big")
+            p1 = payload(5 << 20, 6)
+            p2 = payload(1 << 20, 7)
+            i1 = gw.put_object_part("mpgw", "big", uid, 1, p1)
+            i2 = gw.put_object_part("mpgw", "big", uid, 2, p2)
+            fi = gw.complete_multipart_upload(
+                "mpgw", "big", uid, [(1, i1.etag), (2, i2.etag)])
+            _, got = gw.get_object("mpgw", "big")
+            assert got == p1 + p2
+        finally:
+            backend_srv.shutdown()
+
+    def test_nas_gateway(self, tmp_path):
+        nas = NASGateway(str(tmp_path / "mount"))
+        nas.make_bucket("share")
+        nas.put_object("share", "f", b"nas bytes")
+        assert nas.get_object("share", "f")[1] == b"nas bytes"
+
+
+class TestSiteReplication:
+    def test_iam_and_bucket_config_mirrored(self, tmp_path):
+        # site A (source of truth) + site B (peer)
+        pa = make_pools(tmp_path, "sa")
+        pb = make_pools(tmp_path, "sb")
+        iam_a, iam_b = IAMSys(pa), IAMSys(pb)
+        sa = S3Server(pa, Credentials(ROOT, SECRET), iam=iam_a).start()
+        sb = S3Server(pb, Credentials(ROOT, SECRET), iam=iam_b).start()
+        try:
+            cli_a = S3Client(sa.endpoint, ROOT, SECRET)
+            repl = SiteReplicator(
+                iam_a, sa.handlers.meta,
+                [SitePeer("b", sb.endpoint, ROOT, SECRET)])
+            # local mutations on A
+            iam_a.set_policy("team", {"Statement": [
+                {"Effect": "Allow", "Action": "s3:GetObject",
+                 "Resource": "arn:aws:s3:::*"}]})
+            iam_a.add_user("mirrored", "mirrored-secret1", ["team"])
+            cli_a.make_bucket("shared")
+            cli_a.set_versioning("shared", True)
+            # fan out
+            assert repl.on_policy_set(
+                "team", iam_a._policies["team"].doc) == 1
+            assert repl.on_user_added("mirrored", "mirrored-secret1",
+                                      ["team"]) == 1
+            assert repl.on_bucket_config("shared") == 1
+            # site B now accepts the mirrored user + has the bucket
+            cli_b_user = S3Client(sb.endpoint, "mirrored",
+                                  "mirrored-secret1")
+            assert "shared" in S3Client(sb.endpoint, ROOT,
+                                        SECRET).list_buckets()
+            ident_b = iam_b.lookup("mirrored")
+            assert ident_b is not None
+            assert iam_b.is_allowed(ident_b, "s3:GetObject", "x/y")
+            assert not iam_b.is_allowed(ident_b, "s3:PutObject", "x/y")
+        finally:
+            sa.shutdown()
+            sb.shutdown()
+
+    def test_sync_all(self, tmp_path):
+        pa = make_pools(tmp_path, "s2a")
+        pb = make_pools(tmp_path, "s2b")
+        iam_a, iam_b = IAMSys(pa), IAMSys(pb)
+        sa = S3Server(pa, Credentials(ROOT, SECRET), iam=iam_a).start()
+        sb = S3Server(pb, Credentials(ROOT, SECRET), iam=iam_b).start()
+        try:
+            cli_a = S3Client(sa.endpoint, ROOT, SECRET)
+            iam_a.add_user("user1", "user1-secret-1234", ["readwrite"])
+            cli_a.make_bucket("pre-existing")
+            repl = SiteReplicator(
+                iam_a, sa.handlers.meta,
+                [SitePeer("b", sb.endpoint, ROOT, SECRET)])
+            stats = repl.sync_all(["pre-existing"])
+            assert stats["users"] == 1
+            assert stats["buckets"] == 1
+            assert iam_b.lookup("user1") is not None
+        finally:
+            sa.shutdown()
+            sb.shutdown()
