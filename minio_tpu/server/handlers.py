@@ -438,7 +438,19 @@ class S3Handlers:
                    headers: dict[str, str], head: bool = False) -> Response:
         from ..crypto import sse
         from ..utils import compress as cz
+        from . import extract as ex
         version_id = query.get("versionId", [""])[0]
+        if ex.is_zip_extract_get(headers):
+            split = ex.split_zip_path(key)
+            if split is not None:
+                zip_key, member = split
+                _, zip_bytes = self._read_plaintext(bucket, zip_key,
+                                                    version_id, headers)
+                data = ex.read_zip_member(zip_bytes, member)
+                h = {"Content-Length": str(len(data)),
+                     "Content-Type": "application/octet-stream",
+                     "Accept-Ranges": "none"}
+                return Response(200, b"" if head else data, h)
         try:
             fi = self.pools.head_object(bucket, key, version_id)
         except StorageError as e:
@@ -534,6 +546,16 @@ class S3Handlers:
         h = {k.lower(): v for k, v in headers.items()}
         if "x-amz-copy-source" in h:
             return self._copy_object(bucket, key, h)
+        from . import extract as ex
+        if ex.is_snowball_put(headers):
+            # Auto-extract a tar body into individual objects under the
+            # key prefix (cf. PutObjectExtract, cmd/untar.go:100).
+            n = 0
+            for sub_key, data, _meta in ex.extract_tar(body, key):
+                self.put_object(bucket, sub_key, data, {})
+                n += 1
+            return Response(200, headers={"x-mtpu-extracted-objects":
+                                          str(n)})
         md5_hdr = h.get("content-md5")
         if md5_hdr:
             import base64

@@ -34,7 +34,9 @@ class S3Server:
                  host: str = "127.0.0.1", port: int = 0,
                  trace_sink=None, iam=None, notify=None,
                  replication=None, scanner=None, kms=None,
-                 compress_enabled: bool = False, tier_mgr=None):
+                 compress_enabled: bool = False, tier_mgr=None,
+                 oidc=None):
+        self.oidc = oidc                   # iam.oidc.OpenIDConfig | None
         self.pools = pools
         self.creds = creds                 # root credentials (policy bypass)
         self.iam = iam                     # IAMSys | None
@@ -423,6 +425,29 @@ class S3Server:
                 from ..config.config import ConfigSys
                 self.config = ConfigSys(self.pools)
             return j(self.config.help(query.get("subsys", [""])[0]))
+        if sub == "profile":
+            # cf. StartProfilingHandler/DownloadProfilingHandler,
+            # cmd/admin-handlers.go:491,599 — cProfile in place of pprof.
+            import cProfile
+            import io as _io
+            import pstats
+            if method == "POST":
+                if getattr(self, "_profiler", None) is None:
+                    self._profiler = cProfile.Profile()
+                    self._profiler.enable()
+                    return j({"profiling": "started"})
+                return j({"profiling": "already running"}, 409)
+            if method == "GET":
+                prof = getattr(self, "_profiler", None)
+                if prof is None:
+                    return j({"error": "profiling not running"}, 404)
+                prof.disable()
+                self._profiler = None
+                buf = _io.StringIO()
+                pstats.Stats(prof, stream=buf).sort_stats(
+                    "cumulative").print_stats(50)
+                return Response(200, buf.getvalue().encode(),
+                                {"Content-Type": "text/plain"})
         if sub == "service" and method == "POST":
             return j({"action": query.get("action", ["status"])[0],
                       "acknowledged": True, "at": _time.time()})
@@ -476,8 +501,14 @@ class S3Server:
                 return h.list_buckets()
             raise S3Error("MethodNotAllowed")
 
-        self._authorize(access_key, method, bucket, key, query,
-                        req.client_address[0])
+        ctype = headers.get("Content-Type", headers.get("content-type", ""))
+        form_post = (method == "POST" and not key and "delete" not in query
+                     and ctype.startswith("multipart/form-data"))
+        if not form_post:
+            # Browser form posts carry their own signed POST policy;
+            # _handle_post_upload authenticates + authorizes from the form.
+            self._authorize(access_key, method, bucket, key, query,
+                            req.client_address[0])
         if not key:
             return self._dispatch_bucket(method, bucket, query, headers,
                                          body, access_key)
@@ -494,10 +525,15 @@ class S3Server:
         import datetime as dt
 
         form = up.parse_qs(body.decode("utf-8", "replace"))
-        if form.get("Action", [""])[0] != "AssumeRole":
+        action = form.get("Action", [""])[0]
+        if action == "AssumeRoleWithWebIdentity":
+            return self._handle_sts_web_identity(form)
+        if action != "AssumeRole":
             raise S3Error("NotImplemented", "unknown STS action")
         if self.iam is None:
             raise S3Error("NotImplemented", "IAM is not enabled")
+        if access_key == "":
+            raise S3Error("AccessDenied", "AssumeRole must be signed")
         if access_key == self.creds.access_key:
             from ..iam.iam import Identity
             parent = Identity(access_key=access_key,
@@ -535,6 +571,81 @@ class S3Server:
                     + ET.tostring(root, encoding="unicode").encode())
         return Response(200, xml_body,
                         {"Content-Type": "application/xml"})
+
+    @staticmethod
+    def _sts_credentials_xml(action: str, ident) -> Response:
+        import datetime as dt
+        import xml.etree.ElementTree as ET
+        exp = dt.datetime.fromtimestamp(
+            ident.expiration, dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        ns = "https://sts.amazonaws.com/doc/2011-06-15/"
+        root = ET.Element(f"{action}Response", xmlns=ns)
+        result = ET.SubElement(root, f"{action}Result")
+        c = ET.SubElement(result, "Credentials")
+        for tag, val in (("AccessKeyId", ident.access_key),
+                         ("SecretAccessKey", ident.secret_key),
+                         ("SessionToken", ident.session_token),
+                         ("Expiration", exp)):
+            e = ET.SubElement(c, tag)
+            e.text = val
+        xml_body = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                    + ET.tostring(root, encoding="unicode").encode())
+        return Response(200, xml_body,
+                        {"Content-Type": "application/xml"})
+
+    def _handle_sts_web_identity(self, form: dict) -> Response:
+        """AssumeRoleWithWebIdentity: token-authenticated (unsigned) STS
+        (cf. cmd/sts-handlers.go:99 OIDC flow)."""
+        from ..iam.iam import Identity
+        from ..iam.oidc import OIDCError
+        if self.iam is None or getattr(self, "oidc", None) is None:
+            raise S3Error("NotImplemented", "OIDC is not configured")
+        token = form.get("WebIdentityToken", [""])[0]
+        if not token:
+            raise S3Error("InvalidArgument", "missing WebIdentityToken")
+        try:
+            claims = self.oidc.validate(token)
+        except OIDCError as e:
+            raise S3Error("AccessDenied", f"token rejected: {e}") from None
+        policies = self.oidc.policies_from(claims)
+        if not policies:
+            raise S3Error("AccessDenied", "token grants no policies")
+        parent = Identity(access_key=f"oidc:{claims.get('sub', 'unknown')}",
+                          secret_key="", kind="user", policies=policies)
+        try:
+            duration = int(form.get("DurationSeconds", ["3600"])[0])
+        except ValueError:
+            raise S3Error("InvalidArgument",
+                          "DurationSeconds must be an integer") from None
+        ident = self.iam.assume_role(parent, duration)
+        return self._sts_credentials_xml("AssumeRoleWithWebIdentity",
+                                         ident)
+
+    def _handle_post_upload(self, bucket: str, content_type: str,
+                            body: bytes) -> Response:
+        """Browser form upload (cf. PostPolicyBucketHandler).
+
+        Auth rides in the form itself (signed POST policy), so this is
+        reached through the anonymous path and re-authenticated here.
+        """
+        from . import postpolicy as pp
+        fields = pp.parse_multipart_form(content_type, body)
+        file_data, _ = fields.get("file", (b"", ""))
+        key = fields.get("key", (b"", ""))[0].decode("utf-8", "replace")
+        if not key:
+            raise S3Error("InvalidArgument", "missing key field")
+        key = key.replace("${filename}", fields.get("file", (b"", ""))[1])
+        access_key = pp.verify_post_signature(self._lookup_creds, fields)
+        pp.check_post_policy(fields["policy"][0], fields, len(file_data),
+                             bucket=bucket)
+        self._authorize(access_key, "PUT", bucket, key, {})
+        headers = {}
+        ct = fields.get("content-type")
+        if ct:
+            headers["Content-Type"] = ct[0].decode("utf-8", "replace")
+        resp = self.handlers.put_object(bucket, key, file_data, headers)
+        resp.status = 204
+        return resp
 
     def _delete_authorizer(self, access_key: str, bucket: str):
         """Per-key authorization closure for multi-object delete."""
@@ -593,6 +704,10 @@ class S3Server:
                 return h.delete_objects(
                     bucket, body,
                     can_delete=self._delete_authorizer(access_key, bucket))
+            ctype = headers.get("Content-Type",
+                                headers.get("content-type", ""))
+            if ctype.startswith("multipart/form-data"):
+                return self._handle_post_upload(bucket, ctype, body)
             raise S3Error("MethodNotAllowed")
         if method == "GET":
             if "location" in query:

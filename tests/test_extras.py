@@ -1,0 +1,222 @@
+"""Extras: browser POST uploads, snowball tar extract, zip serving,
+OIDC web-identity STS, profiling endpoint."""
+
+import io
+import json
+import tarfile
+import time
+import zipfile
+
+import pytest
+
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.iam.iam import IAMSys
+from minio_tpu.iam.oidc import OpenIDConfig, make_hs256_token
+from minio_tpu.server.client import S3Client
+from minio_tpu.server.postpolicy import make_post_form
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ROOT, SECRET = "extadmin", "extadmin-secret"
+OIDC_SECRET = b"oidc-shared-secret"
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    iam = IAMSys(pools)
+    oidc = OpenIDConfig(hs256_secret=OIDC_SECRET, audience="mtpu")
+    srv = S3Server(pools, Credentials(ROOT, SECRET), iam=iam,
+                   oidc=oidc).start()
+    cli = S3Client(srv.endpoint, ROOT, SECRET)
+    yield srv, cli
+    srv.shutdown()
+
+
+def multipart_body(fields: dict[str, bytes], file_data: bytes,
+                   filename: str = "f.bin") -> tuple[str, bytes]:
+    boundary = "testboundary42"
+    out = bytearray()
+    for name, value in fields.items():
+        out += (f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="{name}"\r\n\r\n').encode()
+        out += value + b"\r\n"
+    out += (f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="file"; filename="{filename}"\r\n\r\n').encode()
+    out += file_data + b"\r\n"
+    out += f"--{boundary}--\r\n".encode()
+    return f"multipart/form-data; boundary={boundary}", bytes(out)
+
+
+class TestPostUpload:
+    def _post(self, srv, cli, bucket, key, data, tamper=None):
+        import http.client
+        form = make_post_form(cli.creds, bucket, key.split("/")[0])
+        fields = {k.encode() and k: v.encode()
+                  for k, v in form.items()}
+        fields["key"] = key.encode()
+        if tamper:
+            tamper(fields)
+        ctype, body = multipart_body(fields, data)
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=15)
+        conn.request("POST", f"/{bucket}", body=body,
+                     headers={"Content-Type": ctype})
+        resp = conn.getresponse()
+        out = resp.read()
+        conn.close()
+        return resp.status, out
+
+    def test_browser_form_upload(self, stack):
+        srv, cli = stack
+        cli.make_bucket("forms")
+        status, out = self._post(srv, cli, "forms", "up/loaded.bin",
+                                 b"posted bytes")
+        assert status == 204, out
+        assert cli.get_object("forms", "up/loaded.bin") == b"posted bytes"
+
+    def test_bad_signature_rejected(self, stack):
+        srv, cli = stack
+        cli.make_bucket("forms")
+
+        def tamper(fields):
+            fields["x-amz-signature"] = b"0" * 64
+        status, out = self._post(srv, cli, "forms", "up/x", b"x",
+                                 tamper=tamper)
+        assert status == 403
+
+    def test_policy_condition_enforced(self, stack):
+        srv, cli = stack
+        cli.make_bucket("forms")
+        # key outside the starts-with prefix in the signed policy
+        import http.client
+        form = make_post_form(cli.creds, "forms", "allowed")
+        fields = {k: v.encode() for k, v in form.items()}
+        fields["key"] = b"forbidden/esc"
+        ctype, body = multipart_body(fields, b"x")
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=15)
+        conn.request("POST", "/forms", body=body,
+                     headers={"Content-Type": ctype})
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 403
+
+
+class TestSnowball:
+    def test_tar_auto_extract(self, stack):
+        srv, cli = stack
+        cli.make_bucket("snow")
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            for name, data in (("a.txt", b"alpha"), ("d/b.txt", b"beta")):
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        cli.put_object("snow", "batch", buf.getvalue(),
+                       headers={"X-Amz-Meta-Snowball-Auto-Extract": "true"})
+        assert cli.get_object("snow", "batch/a.txt") == b"alpha"
+        assert cli.get_object("snow", "batch/d/b.txt") == b"beta"
+
+    def test_path_escape_skipped(self, stack):
+        srv, cli = stack
+        cli.make_bucket("snow")
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            info = tarfile.TarInfo("../../evil")
+            info.size = 4
+            tf.addfile(info, io.BytesIO(b"evil"))
+            info = tarfile.TarInfo("good")
+            info.size = 2
+            tf.addfile(info, io.BytesIO(b"ok"))
+        cli.put_object("snow", "esc", buf.getvalue(),
+                       headers={"X-Amz-Meta-Snowball-Auto-Extract": "true"})
+        keys, _ = cli.list_objects("snow", prefix="esc/")
+        assert keys == ["esc/good"]
+
+
+class TestZipServing:
+    def test_get_member_inside_zip(self, stack):
+        srv, cli = stack
+        cli.make_bucket("zips")
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            zf.writestr("docs/readme.md", "zipped content")
+            zf.writestr("img.bin", b"\x01\x02")
+        cli.put_object("zips", "archive.zip", buf.getvalue())
+        status, _, data = cli.request(
+            "GET", "/zips/archive.zip/docs/readme.md",
+            headers={"x-minio-extract": "true"})
+        assert status == 200 and data == b"zipped content"
+        status, _, data = cli.request(
+            "GET", "/zips/archive.zip/nope",
+            headers={"x-minio-extract": "true"})
+        assert status == 404
+
+
+class TestOIDC:
+    def test_web_identity_flow(self, stack):
+        import http.client
+        import re
+        srv, cli = stack
+        cli.make_bucket("oidcb")
+        cli.put_object("oidcb", "k", b"data")
+        token = make_hs256_token(OIDC_SECRET, {
+            "sub": "user@idp", "aud": "mtpu",
+            "exp": time.time() + 600, "policy": "readonly"})
+        body = ("Action=AssumeRoleWithWebIdentity&Version=2011-06-15"
+                f"&WebIdentityToken={token}")
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=15)
+        conn.request("POST", "/", body=body.encode())
+        resp = conn.getresponse()
+        data = resp.read().decode()
+        conn.close()
+        assert resp.status == 200, data
+
+        def field(tag):
+            return re.search(f"<{tag}>([^<]+)</{tag}>", data).group(1)
+        sts_cli = S3Client(srv.endpoint, field("AccessKeyId"),
+                           field("SecretAccessKey"))
+        token_hdr = {"x-amz-security-token": field("SessionToken")}
+        status, _, got = sts_cli.request("GET", "/oidcb/k",
+                                         headers=token_hdr)
+        assert status == 200 and got == b"data"
+        status, _, _ = sts_cli.request("PUT", "/oidcb/x", body=b"w",
+                                       headers=token_hdr)
+        assert status == 403                       # readonly claim
+
+    def test_bad_token_rejected(self, stack):
+        import http.client
+        srv, _ = stack
+        token = make_hs256_token(b"wrong-secret", {
+            "sub": "x", "aud": "mtpu", "exp": time.time() + 600,
+            "policy": "readonly"})
+        body = ("Action=AssumeRoleWithWebIdentity&Version=2011-06-15"
+                f"&WebIdentityToken={token}")
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=15)
+        conn.request("POST", "/", body=body.encode())
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 403
+
+    def test_expired_token_rejected(self, stack):
+        from minio_tpu.iam.oidc import OIDCError
+        cfg = OpenIDConfig(hs256_secret=OIDC_SECRET)
+        token = make_hs256_token(OIDC_SECRET, {"exp": time.time() - 10})
+        with pytest.raises(OIDCError):
+            cfg.validate(token)
+
+
+class TestProfiling:
+    def test_start_and_download(self, stack):
+        srv, cli = stack
+        status, _, _ = cli.request("POST", "/minio/admin/v1/profile")
+        assert status == 200
+        cli.make_bucket("prof")
+        cli.put_object("prof", "k", b"x" * 1000)
+        status, _, data = cli.request("GET", "/minio/admin/v1/profile")
+        assert status == 200
+        assert b"cumulative" in data or b"function calls" in data
