@@ -738,6 +738,19 @@ class ErasureSet:
             raise ErrBucketNotFound(bucket)
         return self.metacache.list(bucket, prefix, marker, max_keys)
 
+    def list_object_names(self, bucket: str,
+                          prefix: str = "") -> list[str]:
+        """All object names with ANY version present (delete-marked
+        included) — the versions-listing walk needs names the
+        latest-version listing filters out."""
+        names: set[str] = set()
+        res = self._map_drives(
+            lambda d: [n for n, _ in d.walk_dir(bucket, prefix)])
+        for entries, e in res:
+            if e is None:
+                names.update(entries)
+        return sorted(names)
+
     def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
         # Use the first drive that can serve the full version list.
         for d in self.drives:

@@ -156,6 +156,17 @@ class ServerPools:
                 continue
         return [merged[k] for k in sorted(merged)][:max_keys]
 
+    def list_object_names(self, bucket: str,
+                          prefix: str = "") -> list[str]:
+        names: set[str] = set()
+        for p in self.pools:
+            for es in getattr(p, "sets", [p]):
+                try:
+                    names.update(es.list_object_names(bucket, prefix))
+                except StorageError:
+                    continue
+        return sorted(names)
+
     def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
         for p in self.pools:
             try:

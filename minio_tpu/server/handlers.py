@@ -360,6 +360,46 @@ class S3Handlers:
                 _el(c, "StorageClass", "STANDARD")
         return Response(200, _xml(root), {"Content-Type": "application/xml"})
 
+    def list_object_versions(self, bucket: str, query: dict) -> Response:
+        """GET /bucket?versions (cf. ListObjectVersionsHandler,
+        cmd/bucket-listobjects-handlers.go)."""
+        prefix = query.get("prefix", [""])[0]
+        max_keys = min(int(query.get("max-keys", ["1000"])[0] or 1000),
+                       1000)
+        self.head_bucket(bucket)
+        root = ET.Element("ListVersionsResult", xmlns=S3_NS)
+        _el(root, "Name", bucket)
+        _el(root, "Prefix", prefix)
+        _el(root, "MaxKeys", max_keys)
+        _el(root, "IsTruncated", "false")
+        count = 0
+        lister = getattr(self.pools, "list_object_names", None)
+        if lister is not None:
+            names = lister(bucket, prefix)[:max_keys]
+        else:
+            names = [fi.name for fi in
+                     self.pools.list_objects(bucket, prefix,
+                                             max_keys=max_keys)]
+        for name in names:
+            try:
+                versions = self.pools.list_object_versions(bucket, name)
+            except StorageError:
+                continue
+            for v in versions:
+                if count >= max_keys:
+                    break
+                tag = "DeleteMarker" if v.deleted else "Version"
+                e = _el(root, tag)
+                _el(e, "Key", v.name or name)
+                _el(e, "VersionId", v.version_id or "null")
+                _el(e, "IsLatest", "true" if v.is_latest else "false")
+                _el(e, "LastModified", _iso(v.mod_time_ns))
+                if not v.deleted:
+                    _el(e, "ETag", f'"{v.metadata.get("etag", "")}"')
+                    _el(e, "Size", self._logical_size(v))
+                count += 1
+        return Response(200, _xml(root), {"Content-Type": "application/xml"})
+
     # ---- object level -----------------------------------------------------
 
     @staticmethod

@@ -718,6 +718,8 @@ class S3Server:
                 return h.get_bucket_config(bucket, config_sub)
             if "uploads" in query:
                 return h.list_multipart_uploads(bucket, query)
+            if "versions" in query:
+                return h.list_object_versions(bucket, query)
             return h.list_objects(bucket, query)
         raise S3Error("MethodNotAllowed")
 

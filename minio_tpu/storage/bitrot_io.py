@@ -19,48 +19,110 @@ from .errors import ErrFileCorrupt
 
 HASH_SIZE = 32
 
-# Above this many total bytes, batch hashing dispatches to the jitted
-# device kernel (ops/highwayhash_jax.py) — bit-identical, but vectorized
-# across streams instead of looping packets in Python.
+# -- bitrot algorithm registry (cf. cmd/bitrot.go:39) ------------------------
+# The reference supports four algorithms; HighwayHash256S is the default
+# (and the only one with a device path). Each entry: digest size and a
+# batch hasher (n, L) uint8 -> (n, size).
+
 _DEVICE_HASH_THRESHOLD = 1 << 16
 
 
-def _hash_batch(blocks: np.ndarray) -> np.ndarray:
-    """(n, L) uint8 -> (n, 32) digests, device-accelerated when large."""
+def _hh_batch(blocks: np.ndarray) -> np.ndarray:
+    # Above the threshold, dispatch to the jitted device kernel
+    # (ops/highwayhash_jax.py) — bit-identical, vectorized across streams.
     if blocks.size >= _DEVICE_HASH_THRESHOLD:
         from ..ops.highwayhash_jax import hh256_batch_jax
         return np.asarray(hh256_batch_jax(blocks))
     return highwayhash256_batch(blocks)
 
 
+def _hashlib_batch(name: str, digest_size: int):
+    import hashlib
+
+    def hasher(blocks: np.ndarray) -> np.ndarray:
+        out = np.empty((blocks.shape[0], digest_size), dtype=np.uint8)
+        for i in range(blocks.shape[0]):
+            h = hashlib.new(name, blocks[i].tobytes())
+            out[i] = np.frombuffer(h.digest(), dtype=np.uint8)
+        return out
+    return hasher
+
+
+ALGORITHMS: dict[str, tuple[int, object]] = {
+    "highwayhash256S": (32, _hh_batch),
+    "highwayhash256": (32, _hh_batch),      # whole-file legacy variant
+    "sha256": (32, _hashlib_batch("sha256", 32)),
+    "blake2b512": (64, _hashlib_batch("blake2b", 64)),
+}
+
+DEFAULT_ALGO = "highwayhash256S"
+
+
+def digest_size(algo: str = DEFAULT_ALGO) -> int:
+    try:
+        return ALGORITHMS[algo][0]
+    except KeyError:
+        raise ErrFileCorrupt(f"unknown bitrot algorithm {algo!r}") from None
+
+
+def _hash_batch(blocks: np.ndarray,
+                algo: str = DEFAULT_ALGO) -> np.ndarray:
+    """(n, L) uint8 -> (n, digest_size) digests for the given algorithm."""
+    try:
+        return ALGORITHMS[algo][1](blocks)
+    except KeyError:
+        raise ErrFileCorrupt(f"unknown bitrot algorithm {algo!r}") from None
+
+
+def whole_file_digest(data: bytes, algo: str = DEFAULT_ALGO) -> bytes:
+    """Legacy whole-file bitrot (cf. cmd/bitrot-whole.go): one digest over
+    the entire shard file instead of per-block frames."""
+    buf = np.frombuffer(data, dtype=np.uint8)[None, :]
+    if algo.startswith("highwayhash"):
+        h = HighwayHash256()
+        h.update(data)
+        return h.digest()
+    return _hash_batch(np.ascontiguousarray(buf), algo)[0].tobytes()
+
+
+def verify_whole_file(data: bytes, want: bytes,
+                      algo: str = DEFAULT_ALGO) -> None:
+    if whole_file_digest(data, algo) != want:
+        raise ErrFileCorrupt(f"whole-file bitrot mismatch ({algo})")
+
+
 def ceil_frac(num: int, den: int) -> int:
     return -(-num // den)
 
 
-def bitrot_shard_file_size(size: int, shard_size: int) -> int:
+def bitrot_shard_file_size(size: int, shard_size: int,
+                           algo: str = DEFAULT_ALGO) -> int:
     """On-disk size of a shard file of logical size `size`."""
     if size == 0:
         return 0
-    return ceil_frac(size, shard_size) * HASH_SIZE + size
+    return ceil_frac(size, shard_size) * digest_size(algo) + size
 
 
-def bitrot_logical_size(disk_size: int, shard_size: int) -> int:
+def bitrot_logical_size(disk_size: int, shard_size: int,
+                        algo: str = DEFAULT_ALGO) -> int:
     """Inverse of bitrot_shard_file_size."""
     if disk_size == 0:
         return 0
-    frame = HASH_SIZE + shard_size
+    hs = digest_size(algo)
+    frame = hs + shard_size
     full = disk_size // frame
     rest = disk_size % frame
     if rest:
-        if rest <= HASH_SIZE:
+        if rest <= hs:
             # A trailing fragment that can't hold a hash + >=1 data byte
             # only occurs on a corrupt/truncated file.
             raise ErrFileCorrupt("truncated bitrot frame")
-        rest -= HASH_SIZE
+        rest -= hs
     return full * shard_size + rest
 
 
-def frame_shard(shard: np.ndarray, shard_size: int) -> bytes:
+def frame_shard(shard: np.ndarray, shard_size: int,
+                algo: str = DEFAULT_ALGO) -> bytes:
     """Frame one shard file's bytes into [hash|block] frames."""
     shard = np.asarray(shard, dtype=np.uint8).ravel()
     out = bytearray()
@@ -68,15 +130,13 @@ def frame_shard(shard: np.ndarray, shard_size: int) -> bytes:
     # Vectorized hash over all the full-size blocks at once.
     if n_full:
         blocks = shard[:n_full * shard_size].reshape(n_full, shard_size)
-        digests = _hash_batch(blocks)
+        digests = _hash_batch(blocks, algo)
         for i in range(n_full):
             out += digests[i].tobytes()
             out += blocks[i].tobytes()
     tail = shard[n_full * shard_size:]
     if tail.size:
-        h = HighwayHash256()
-        h.update(tail.tobytes())
-        out += h.digest()
+        out += _hash_batch(tail[None, :].copy(), algo)[0].tobytes()
         out += tail.tobytes()
     return bytes(out)
 
@@ -103,7 +163,8 @@ def frame_shards_batch(shards: np.ndarray,
 
 
 def unframe_shard(data: bytes, shard_size: int, verify: bool = True,
-                  logical_size: int | None = None) -> np.ndarray:
+                  logical_size: int | None = None,
+                  algo: str = DEFAULT_ALGO) -> np.ndarray:
     """Parse and (optionally) verify a framed shard file back to raw bytes.
 
     Raises ErrFileCorrupt on hash mismatch or size inconsistency — the same
@@ -111,31 +172,31 @@ def unframe_shard(data: bytes, shard_size: int, verify: bool = True,
     (cmd/bitrot-streaming.go:142).
     """
     if logical_size is not None and len(data) != bitrot_shard_file_size(
-            logical_size, shard_size):
+            logical_size, shard_size, algo):
         raise ErrFileCorrupt("framed size mismatch")
+    hs = digest_size(algo)
     buf = np.frombuffer(data, dtype=np.uint8)
-    frame = HASH_SIZE + shard_size
+    frame = hs + shard_size
     n_full = buf.size // frame
     rest = buf.size % frame
     pieces = []
     if n_full:
         frames = buf[:n_full * frame].reshape(n_full, frame)
-        hashes = frames[:, :HASH_SIZE]
-        blocks = frames[:, HASH_SIZE:]
+        hashes = frames[:, :hs]
+        blocks = frames[:, hs:]
         if verify:
-            got = _hash_batch(np.ascontiguousarray(blocks))
+            got = _hash_batch(np.ascontiguousarray(blocks), algo)
             if not np.array_equal(got, hashes):
                 raise ErrFileCorrupt("bitrot hash mismatch")
         pieces.append(blocks.reshape(-1))
     if rest:
         tail = buf[n_full * frame:]
-        if tail.size <= HASH_SIZE:
+        if tail.size <= hs:
             raise ErrFileCorrupt("truncated bitrot frame")
-        h, block = tail[:HASH_SIZE], tail[HASH_SIZE:]
+        h, block = tail[:hs], tail[hs:]
         if verify:
-            hh = HighwayHash256()
-            hh.update(block.tobytes())
-            if hh.digest() != h.tobytes():
+            got = _hash_batch(np.ascontiguousarray(block)[None, :], algo)
+            if got[0].tobytes() != h.tobytes():
                 raise ErrFileCorrupt("bitrot hash mismatch (tail)")
         pieces.append(block)
     if not pieces:

@@ -1,0 +1,54 @@
+"""xl.meta inspector: `python -m minio_tpu.tools.xlmeta_inspect <file>`.
+
+The docs/debugging/xl-meta equivalent: decodes a drive's object metadata
+file and prints the version table (type, id, mod time, size, data dir,
+EC geometry, inline presence) as JSON for debugging damaged deployments.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import sys
+
+
+def inspect(path: str) -> dict:
+    from ..storage.xlmeta import XLMeta
+    with open(path, "rb") as f:
+        meta = XLMeta.from_bytes(f.read())
+    out = {"versions": []}
+    for fi in meta.list_versions():
+        ec = None
+        if fi.erasure is not None:
+            ec = {"data": fi.erasure.data_blocks,
+                  "parity": fi.erasure.parity_blocks,
+                  "block_size": fi.erasure.block_size,
+                  "distribution": fi.erasure.distribution}
+        out["versions"].append({
+            "type": "delete-marker" if fi.deleted else "object",
+            "version_id": fi.version_id or "null",
+            "mod_time": datetime.datetime.fromtimestamp(
+                fi.mod_time_ns / 1e9,
+                datetime.timezone.utc).isoformat(),
+            "size": fi.size,
+            "data_dir": fi.data_dir,
+            "inline": fi.inline_data is not None,
+            "etag": fi.metadata.get("etag", ""),
+            "erasure": ec,
+            "n_metadata_keys": len(fi.metadata),
+        })
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m minio_tpu.tools.xlmeta_inspect "
+              "<path/to/xl.meta>", file=sys.stderr)
+        return 2
+    print(json.dumps(inspect(argv[0]), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,3 +220,68 @@ class TestProfiling:
         status, _, data = cli.request("GET", "/minio/admin/v1/profile")
         assert status == 200
         assert b"cumulative" in data or b"function calls" in data
+
+
+class TestBitrotRegistry:
+    def test_alternate_algorithms_roundtrip(self):
+        import numpy as np
+        from minio_tpu.storage import bitrot_io as bio
+        from minio_tpu.storage.errors import ErrFileCorrupt
+        rng = np.random.default_rng(0)
+        shard = rng.integers(0, 256, 5000, dtype=np.uint8)
+        for algo in ("highwayhash256S", "sha256", "blake2b512"):
+            framed = bio.frame_shard(shard, 1024, algo=algo)
+            assert len(framed) == bio.bitrot_shard_file_size(
+                5000, 1024, algo)
+            back = bio.unframe_shard(framed, 1024, algo=algo)
+            assert np.array_equal(back, shard)
+            bad = bytearray(framed)
+            bad[bio.digest_size(algo) + 3] ^= 1
+            with pytest.raises(ErrFileCorrupt):
+                bio.unframe_shard(bytes(bad), 1024, algo=algo)
+
+    def test_whole_file_bitrot(self):
+        from minio_tpu.storage import bitrot_io as bio
+        from minio_tpu.storage.errors import ErrFileCorrupt
+        data = b"whole file contents" * 100
+        for algo in ("highwayhash256", "sha256", "blake2b512"):
+            d = bio.whole_file_digest(data, algo)
+            assert len(d) == bio.digest_size(algo)
+            bio.verify_whole_file(data, d, algo)
+            with pytest.raises(ErrFileCorrupt):
+                bio.verify_whole_file(data + b"x", d, algo)
+
+    def test_unknown_algo_rejected(self):
+        from minio_tpu.storage import bitrot_io as bio
+        from minio_tpu.storage.errors import ErrFileCorrupt
+        with pytest.raises(ErrFileCorrupt):
+            bio.digest_size("md5")
+
+
+class TestListVersionsAndTools:
+    def test_list_object_versions_xml(self, stack):
+        srv, cli = stack
+        cli.make_bucket("verb")
+        cli.set_versioning("verb", True)
+        cli.put_object("verb", "k", b"v1")
+        cli.put_object("verb", "k", b"v2")
+        cli.delete_object("verb", "k")
+        status, _, data = cli.request("GET", "/verb",
+                                      query={"versions": ""})
+        assert status == 200
+        assert data.count(b"<Version>") == 2
+        assert data.count(b"<DeleteMarker>") == 1
+
+    def test_xlmeta_inspect_tool(self, stack, tmp_path):
+        import glob
+        from minio_tpu.tools.xlmeta_inspect import inspect
+        srv, cli = stack
+        cli.make_bucket("insp")
+        cli.put_object("insp", "obj", b"x" * 200000)
+        metas = glob.glob(str(tmp_path / "d0" / "insp" / "obj" /
+                              "xl.meta"))
+        assert metas
+        out = inspect(metas[0])
+        assert out["versions"][0]["type"] == "object"
+        assert out["versions"][0]["size"] == 200000
+        assert out["versions"][0]["erasure"]["data"] == 2
