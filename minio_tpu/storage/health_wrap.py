@@ -1,0 +1,89 @@
+"""Drive health wrapper: per-API latency EWMAs + call/error counters.
+
+The xlStorageDiskIDCheck equivalent (/root/reference/cmd/xl-storage-disk-
+id-check.go:68): every StorageAPI call on the wrapped drive is timed into
+an exponentially-weighted moving average and counted, giving the
+scanner/metrics/admin layers a live per-drive, per-API health picture
+without touching the drive implementation. Wraps LocalDrive or
+RemoteDrive alike (anything with the drive method surface).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class APIStats:
+    __slots__ = ("calls", "errors", "ewma_ms", "last_ms")
+
+    def __init__(self):
+        self.calls = 0
+        self.errors = 0
+        self.ewma_ms = 0.0
+        self.last_ms = 0.0
+
+
+class HealthWrappedDrive:
+    """Transparent instrumentation proxy for a drive."""
+
+    EWMA_ALPHA = 0.2
+
+    def __init__(self, drive):
+        object.__setattr__(self, "_drive", drive)
+        object.__setattr__(self, "_stats", {})
+        object.__setattr__(self, "_mu", threading.Lock())
+
+    # identity/attribute passthrough ----------------------------------------
+
+    def __getattr__(self, name):
+        attr = getattr(self._drive, name)
+        if not callable(attr) or name.startswith("_"):
+            return attr
+
+        def timed(*args, **kwargs):
+            t0 = time.perf_counter()
+            ok = True
+            try:
+                return attr(*args, **kwargs)
+            except Exception:
+                ok = False
+                raise
+            finally:
+                ms = (time.perf_counter() - t0) * 1e3
+                with self._mu:
+                    st = self._stats.setdefault(name, APIStats())
+                    st.calls += 1
+                    if not ok:
+                        st.errors += 1
+                    st.last_ms = ms
+                    st.ewma_ms = (ms if st.calls == 1 else
+                                  self.EWMA_ALPHA * ms
+                                  + (1 - self.EWMA_ALPHA) * st.ewma_ms)
+        timed.__name__ = name
+        return timed
+
+    # stats surface ----------------------------------------------------------
+
+    def api_stats(self) -> dict[str, dict]:
+        with self._mu:
+            return {name: {"calls": st.calls, "errors": st.errors,
+                           "ewma_ms": round(st.ewma_ms, 3),
+                           "last_ms": round(st.last_ms, 3)}
+                    for name, st in self._stats.items()}
+
+    def total_errors(self) -> int:
+        with self._mu:
+            return sum(st.errors for st in self._stats.values())
+
+    def slowest_apis(self, n: int = 5) -> list[tuple[str, float]]:
+        with self._mu:
+            items = sorted(((name, st.ewma_ms)
+                            for name, st in self._stats.items()),
+                           key=lambda t: -t[1])
+        return items[:n]
+
+
+def wrap_drives(drives: list) -> list:
+    """Wrap every non-None drive in a set with health instrumentation."""
+    return [None if d is None else HealthWrappedDrive(d) for d in drives]

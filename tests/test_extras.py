@@ -285,3 +285,48 @@ class TestListVersionsAndTools:
         assert out["versions"][0]["type"] == "object"
         assert out["versions"][0]["size"] == 200000
         assert out["versions"][0]["erasure"]["data"] == 2
+
+
+class TestHealthWrapAndTimeouts:
+    def test_health_wrapped_drive_stats(self, tmp_path):
+        from minio_tpu.storage.drive import LocalDrive
+        from minio_tpu.storage.errors import ErrFileNotFound
+        from minio_tpu.storage.health_wrap import HealthWrappedDrive
+        d = HealthWrappedDrive(LocalDrive(str(tmp_path / "hw")))
+        d.make_volume("vol")
+        d.write_all("vol", "f", b"data")
+        assert d.read_all("vol", "f") == b"data"
+        with pytest.raises(ErrFileNotFound):
+            d.read_all("vol", "missing")
+        stats = d.api_stats()
+        assert stats["read_all"]["calls"] == 2
+        assert stats["read_all"]["errors"] == 1
+        assert stats["write_all"]["ewma_ms"] > 0
+        assert d.total_errors() == 1
+        assert d.slowest_apis()  # non-empty
+
+    def test_health_wrap_in_erasure_set(self, tmp_path):
+        from minio_tpu.engine.erasure_set import ErasureSet
+        from minio_tpu.storage.drive import LocalDrive
+        from minio_tpu.storage.health_wrap import wrap_drives
+        drives = wrap_drives(
+            [LocalDrive(str(tmp_path / f"w{i}")) for i in range(4)])
+        es = ErasureSet(drives, default_parity=2)
+        es.make_bucket("hb")
+        es.put_object("hb", "k", b"x" * 1000)
+        _, got = es.get_object("hb", "k")
+        assert got == b"x" * 1000
+        assert drives[0].api_stats()["write_metadata"]["calls"] >= 1
+
+    def test_dynamic_timeout_adapts(self):
+        from minio_tpu.cluster.dynamic_timeout import DynamicTimeout
+        dt = DynamicTimeout(default_s=10.0, minimum_s=1.0)
+        # a window full of timeouts grows the deadline
+        for _ in range(dt.WINDOW):
+            dt.log_timeout()
+        assert dt.timeout() > 10.0
+        # windows of fast successes shrink it toward observed latency
+        for _ in range(dt.WINDOW * 4):
+            dt.log_success(0.5)
+        assert dt.timeout() <= 2.0
+        assert dt.timeout() >= 1.0     # floor holds
