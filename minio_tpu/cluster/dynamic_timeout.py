@@ -16,7 +16,13 @@ import threading
 class DynamicTimeout:
     WINDOW = 64
     GROW = 1.25
-    SHRINK_TRIGGER = 0.05      # <5% timeouts in a window => consider shrink
+    # Separate grow/shrink thresholds with a neutral dead band between
+    # them (the reference uses >=33% grow / <10% shrink): without the
+    # band, a workload whose tail sits near the deadline oscillates —
+    # shrink snaps onto the fast majority, the next window times out the
+    # tail, grow crawls back, repeat.
+    GROW_TRIGGER = 0.33        # >=33% timeouts => grow
+    SHRINK_TRIGGER = 0.05      # <5% timeouts => consider gradual shrink
 
     def __init__(self, default_s: float, minimum_s: float,
                  maximum_s: float | None = None):
@@ -43,16 +49,19 @@ class DynamicTimeout:
                 return
             n_timeout = sum(1 for t, _ in self._entries if t)
             frac = n_timeout / len(self._entries)
-            if frac > self.SHRINK_TRIGGER:
+            if frac >= self.GROW_TRIGGER:
                 self._timeout = min(self._timeout * self.GROW,
                                     self.maximum)
-            else:
-                # Track the high quantile of observed successes with
-                # headroom; never below the floor.
+            elif frac < self.SHRINK_TRIGGER:
+                # Gradual shrink toward the p95 of successes (with 2x
+                # headroom), at most one GROW step per window so a
+                # mistake costs one window, not a cliff.
                 succ = sorted(took for t, took in self._entries if not t)
                 if succ:
-                    p_high = succ[int(len(succ) * 0.95) - 1]
-                    candidate = max(p_high * 2.0, self.minimum)
+                    p_high = succ[max(int(len(succ) * 0.95) - 1, 0)]
+                    candidate = max(p_high * 2.0, self.minimum,
+                                    self._timeout / self.GROW)
                     if candidate < self._timeout:
                         self._timeout = candidate
+            # frac in [SHRINK_TRIGGER, GROW_TRIGGER): neutral band, hold.
             self._entries.clear()

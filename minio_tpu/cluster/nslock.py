@@ -65,7 +65,14 @@ class NSLockMap:
     def __init__(self, lockers: list | None = None):
         """lockers=None -> standalone (in-process locks); otherwise a
         distributed map over the given (local+remote) lockers."""
+        from .dynamic_timeout import DynamicTimeout
         self.lockers = lockers
+        # Adaptive lock deadline (cf. dynamicTimeout use at NewNSLock
+        # call sites, cmd/dynamic-timeouts.go:36): callers that don't
+        # pass an explicit timeout get one tuned from observed outcomes.
+        self.acquire_timeout = DynamicTimeout(default_s=10.0,
+                                              minimum_s=1.0,
+                                              maximum_s=60.0)
         # resource -> [lock, refcount]; entries are deleted at refcount 0
         # (the reference refcounts nsLockMap entries the same way,
         # cmd/namespace-lock.go) so the map doesn't grow with every key
@@ -88,12 +95,23 @@ class NSLockMap:
                     del self._local[resource]
 
     @contextmanager
-    def _locked(self, resource: str, write: bool, timeout: float):
+    def _locked(self, resource: str, write: bool, timeout: float | None):
+        import time as _time
+        adaptive = timeout is None
+        if adaptive:
+            timeout = self.acquire_timeout.timeout()
+        t0 = _time.monotonic()
         if self.lockers is None:
             lk = self._local_acquire(resource)
             try:
                 ok = (lk.acquire_write(timeout) if write
                       else lk.acquire_read(timeout))
+                if adaptive:
+                    if ok:
+                        self.acquire_timeout.log_success(
+                            _time.monotonic() - t0)
+                    else:
+                        self.acquire_timeout.log_timeout()
                 if not ok:
                     raise LockLost(resource)
                 try:
@@ -110,6 +128,11 @@ class NSLockMap:
         dm = DRWMutex(resource, self.lockers,
                       loss_callback=lambda r: lost.set())
         ok = dm.get_lock(timeout) if write else dm.get_rlock(timeout)
+        if adaptive:
+            if ok:
+                self.acquire_timeout.log_success(_time.monotonic() - t0)
+            else:
+                self.acquire_timeout.log_timeout()
         if not ok:
             raise LockLost(resource)
         try:
@@ -123,8 +146,11 @@ class NSLockMap:
         if lost.is_set():
             raise LockLost(f"{resource}: lock lost during operation")
 
-    def write_locked(self, bucket: str, obj: str, timeout: float = 10.0):
+    def write_locked(self, bucket: str, obj: str,
+                     timeout: float | None = None):
+        """timeout=None uses the adaptive deadline."""
         return self._locked(f"{bucket}/{obj}", True, timeout)
 
-    def read_locked(self, bucket: str, obj: str, timeout: float = 10.0):
+    def read_locked(self, bucket: str, obj: str,
+                    timeout: float | None = None):
         return self._locked(f"{bucket}/{obj}", False, timeout)

@@ -28,39 +28,67 @@ class HealthWrappedDrive:
     """Transparent instrumentation proxy for a drive."""
 
     EWMA_ALPHA = 0.2
+    _INTERNAL = ("_drive", "_stats", "_mu", "_timed_cache")
 
     def __init__(self, drive):
         object.__setattr__(self, "_drive", drive)
         object.__setattr__(self, "_stats", {})
         object.__setattr__(self, "_mu", threading.Lock())
+        object.__setattr__(self, "_timed_cache", {})
 
     # identity/attribute passthrough ----------------------------------------
 
+    def __setattr__(self, name, value):
+        # Attribute writes (e.g. format bootstrap assigning disk_id) must
+        # reach the REAL drive, or reads-via-methods and reads-via-attr
+        # silently diverge.
+        if name in self._INTERNAL:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._drive, name, value)
+
+    @staticmethod
+    def _benign(e: Exception) -> bool:
+        """Expected control-flow errors must not count against drive
+        health (the reference excludes not-found classes the same way)."""
+        from .errors import (ErrFileNotFound, ErrFileVersionNotFound,
+                             ErrObjectNotFound, ErrPathNotFound,
+                             ErrVersionNotFound, ErrVolumeExists,
+                             ErrVolumeNotFound)
+        return isinstance(e, (ErrFileNotFound, ErrFileVersionNotFound,
+                              ErrObjectNotFound, ErrPathNotFound,
+                              ErrVersionNotFound, ErrVolumeExists,
+                              ErrVolumeNotFound))
+
     def __getattr__(self, name):
+        cached = self._timed_cache.get(name)
+        if cached is not None:
+            return cached
         attr = getattr(self._drive, name)
         if not callable(attr) or name.startswith("_"):
             return attr
 
         def timed(*args, **kwargs):
             t0 = time.perf_counter()
-            ok = True
+            err: Exception | None = None
             try:
                 return attr(*args, **kwargs)
-            except Exception:
-                ok = False
+            except Exception as e:
+                err = e
                 raise
             finally:
                 ms = (time.perf_counter() - t0) * 1e3
                 with self._mu:
                     st = self._stats.setdefault(name, APIStats())
                     st.calls += 1
-                    if not ok:
+                    if err is not None and not self._benign(err):
                         st.errors += 1
                     st.last_ms = ms
                     st.ewma_ms = (ms if st.calls == 1 else
                                   self.EWMA_ALPHA * ms
                                   + (1 - self.EWMA_ALPHA) * st.ewma_ms)
         timed.__name__ = name
+        self._timed_cache[name] = timed
         return timed
 
     # stats surface ----------------------------------------------------------
