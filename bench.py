@@ -6,9 +6,11 @@ Covers the BASELINE.json config list (cf. the reference harnesses
   - encode           (B, 8, S) -> 4 parity rows        [headline metric]
   - decode_2lost     reconstruct 2 data rows from 8 of 12
   - heal_2lost       rebuild 1 data + 1 parity row (decode->re-encode)
-  - fused_verify_decode  HighwayHash256 digests of the 8 read rows fused
+  - fused_verify_decode  mxh256 bitrot digests of the 8 read rows fused
                          with the 2-row reconstruct in ONE dispatch
-                         (north-star config #5)
+                         (north-star config #5; the production GET path)
+  - fused_verify_decode_hh  same with HighwayHash256 (interop reads of
+                         objects written before the mxh256 default)
 
 vs_baseline divides encode throughput by a MEASURED native comparator:
 native/rs_cpu.cc, the same vpshufb nibble-table algorithm the reference's
@@ -116,25 +118,44 @@ def main() -> None:
     results["heal_2lost"] = data_bytes / per_call / 1e9
 
     # -- fused verify+decode (north-star config #5) -------------------------
+    # Production path: mxh256 digests (the default write algorithm) fused
+    # with the 2-row reconstruct. The HighwayHash variant (interop reads of
+    # pre-mxh objects) is timed separately as an extra.
     xf = x[:FUSED_BLOCKS]
     fused_bytes = FUSED_BLOCKS * K * SHARD
     mat = jnp.asarray(_transform_matrix_bits(K, M, sources, targets),
                       dtype=jnp.bfloat16)
 
+    from minio_tpu.ops.erasure_pallas import gf_matmul_blocks
     from minio_tpu.ops.highwayhash_jax import _hh256_impl
+    from minio_tpu.ops.mxhash_jax import mxh256_rows
+
+    decode_kernel = gf_matmul_blocks if on_tpu else _gf_matmul_blocks
 
     def fused_body(xi):
         b, kk, s = xi.shape
-        digests = _hh256_impl(xi.reshape(b * kk, s), MAGIC_KEY)
-        out = _gf_matmul_blocks(mat, xi, len(targets))
+        digests = mxh256_rows(xi.reshape(b * kk, s))
+        out = decode_kernel(mat, xi, len(targets))
         return fold(digests, out)
 
-    fused_loop = make_loop(fused_body, FUSED_ITER)
+    def fused_body_hh(xi):
+        b, kk, s = xi.shape
+        digests = _hh256_impl(xi.reshape(b * kk, s), MAGIC_KEY)
+        out = decode_kernel(mat, xi, len(targets))
+        return fold(digests, out)
+
     perturb_f = make_loop(lambda xi: xi[0, 0, 0], FUSED_ITER)
-    t_fused = _timed(fused_loop, xf, repeats=3)
     t_fbase = _timed(perturb_f, xf, repeats=3)
+    fused_loop = make_loop(fused_body, FUSED_ITER)
+    t_fused = _timed(fused_loop, xf, repeats=3)
     per_call = max((t_fused - t_fbase) / FUSED_ITER, t_fused / FUSED_ITER / 10)
     results["fused_verify_decode"] = fused_bytes / per_call / 1e9
+
+    fused_hh_loop = make_loop(fused_body_hh, FUSED_ITER)
+    t_fused_hh = _timed(fused_hh_loop, xf, repeats=3)
+    per_call = max((t_fused_hh - t_fbase) / FUSED_ITER,
+                   t_fused_hh / FUSED_ITER / 10)
+    results["fused_verify_decode_hh"] = fused_bytes / per_call / 1e9
 
     # -- measured CPU baseline (native comparator) --------------------------
     try:
@@ -159,6 +180,8 @@ def main() -> None:
             "decode_2lost_gbps": round(results["decode_2lost"], 2),
             "heal_2lost_gbps": round(results["heal_2lost"], 2),
             "fused_verify_decode_gbps": round(results["fused_verify_decode"], 2),
+            "fused_verify_decode_hh_gbps": round(
+                results["fused_verify_decode_hh"], 2),
             "cpu_baseline_gbps": round(cpu_gbps, 2),
             "cpu_baseline_isa": cpu_isa,
             "cpu_baseline_source": cpu_src,

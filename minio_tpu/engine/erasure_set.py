@@ -207,10 +207,11 @@ class ErasureSet:
             meta["x-mtpu-internal-erasure-upgraded"] = f"{offline}-offline"
         version_id = new_uuid() if versioned else ""
         mod_time = _now_ns()
+        algo = bitrot_io.write_algo()
         ec_base = ErasureInfo(
             data_blocks=k, parity_blocks=parity, block_size=BLOCK_SIZE,
             index=0, distribution=distribution,
-            checksums=[{"part": 1, "algo": "highwayhash256S", "hash": b""}])
+            checksums=[{"part": 1, "algo": algo, "hash": b""}])
 
         def fi_for(drive_pos: int, data_dir: str,
                    inline: bytes | None) -> FileInfo:
@@ -227,7 +228,7 @@ class ErasureSet:
 
         if len(data) <= SMALL_FILE_THRESHOLD:
             return self._put_inline(bucket, obj, data, fi_for, k, parity,
-                                    distribution, write_quorum)
+                                    distribution, write_quorum, algo)
 
         # Streaming path: encode batches of blocks on device, append framed
         # shards to per-drive staging files, publish with rename_data.
@@ -235,7 +236,7 @@ class ErasureSet:
         tmp_id = f"put-{uuid.uuid4().hex}"
         failed = [d is None for d in self.drives]
 
-        for batch_shards in self._encode_stream(data, k, parity):
+        for batch_shards in self._encode_stream(data, k, parity, algo):
             # batch_shards: list of n framed byte strings in SHARD order.
             per_drive = Q.unshuffle_to_drives(batch_shards, distribution)
 
@@ -282,10 +283,10 @@ class ErasureSet:
         return fi
 
     def _put_inline(self, bucket, obj, data, fi_for, k, parity,
-                    distribution, write_quorum) -> FileInfo:
+                    distribution, write_quorum, algo: str) -> FileInfo:
         """Small objects: framed shards live inline in each drive's xl.meta
         (cf. inline data, /root/reference/cmd/xl-storage.go:1183)."""
-        shards = self._encode_full(data, k, parity)  # n framed byte strings
+        shards = self._encode_full(data, k, parity, algo)  # n framed strings
         per_drive = Q.unshuffle_to_drives(shards, distribution)
 
         def write_one(pos):
@@ -315,21 +316,25 @@ class ErasureSet:
 
     # -- encode drivers ------------------------------------------------------
 
-    def _encode_full(self, data: bytes, k: int, m: int) -> list[bytes]:
+    def _encode_full(self, data: bytes, k: int, m: int,
+                     algo: str) -> list[bytes]:
         """Encode a small object in one shot; returns n framed shard files."""
         out = [bytearray() for _ in range(k + m)]
-        for framed in self._encode_stream(data, k, m):
+        for framed in self._encode_stream(data, k, m, algo):
             for i, b in enumerate(framed):
                 out[i] += b
         return [bytes(b) for b in out]
 
-    def _encode_stream(self, data: bytes, k: int, m: int):
+    def _encode_stream(self, data: bytes, k: int, m: int,
+                       algo: str | None = None):
         """Yield lists of n framed shard-chunks per batch of blocks.
 
         Full 1 MiB blocks are encoded as one batched device dispatch
         ((B, K, S) uint8); the partial tail block goes through the CPU
         oracle codec (tiny, not worth a dispatch).
         """
+        if algo is None:
+            algo = bitrot_io.write_algo()
         size = len(data)
         shard_size = -(-BLOCK_SIZE // k)
         n_full = size // BLOCK_SIZE
@@ -349,11 +354,18 @@ class ErasureSet:
             # Parity AND bitrot digests in ONE device dispatch (north-star
             # config #5 PUT side, ops/fused.py); framing is then pure byte
             # interleaving on the host.
-            parity, digests = fused.encode_and_hash(blocks, k, m)
+            if algo in fused.DEVICE_ALGOS:
+                parity, digests = fused.encode_and_hash(blocks, k, m,
+                                                        algo=algo)
+                digests = np.asarray(digests)
+            else:
+                # Host-hashed algorithms (e.g. sha256): device encodes,
+                # frame_shards_batch hashes.
+                parity, digests = self._codec(k, m).encode_blocks(blocks), None
             parity = np.asarray(parity)
             full = np.concatenate([blocks, parity], axis=1)  # (nb, k+m, S)
             yield bitrot_io.frame_shards_batch(full.transpose(1, 0, 2),
-                                               digests=np.asarray(digests))
+                                               digests=digests, algo=algo)
 
         tail = buf[n_full * BLOCK_SIZE:]
         if tail.size or size == 0:
@@ -362,7 +374,8 @@ class ErasureSet:
             cpu = self._cpu(k, m)
             shards = cpu.encode_data(tail.tobytes())  # k+m arrays
             tail_shard = shards[0].size
-            framed = [bitrot_io.frame_shard(s, tail_shard) for s in shards]
+            framed = [bitrot_io.frame_shard(s, tail_shard, algo)
+                      for s in shards]
             yield framed
 
     # -- get -----------------------------------------------------------------
@@ -464,9 +477,11 @@ class ErasureSet:
         dist = fi.erasure.distribution
         part_size = fi.parts[part_number - 1].size
         shard_size = fi.erasure.shard_size
+        algo = fi.erasure.bitrot_algo(part_number)
+        hs = bitrot_io.digest_size(algo)
         b0 = offset // BLOCK_SIZE
         b1 = -(-(offset + length) // BLOCK_SIZE)
-        frame = 32 + shard_size
+        frame = hs + shard_size
         path = f"{obj}/{fi.data_dir}/part.{part_number}"
         geo = self._range_geometry(fi, part_size, b0, b1)
         nb = geo["nb_full"]
@@ -484,7 +499,7 @@ class ErasureSet:
                 raise ErrDiskNotFound("offline")
             raw = d.read_file(bucket, path, b0 * frame, (b1 - b0) * frame)
             buf = np.frombuffer(raw, dtype=np.uint8)
-            expect = nb * frame + ((32 + tail_shard) if has_tail else 0)
+            expect = nb * frame + ((hs + tail_shard) if has_tail else 0)
             if buf.size != expect:
                 raise ErrFileCorrupt(
                     f"shard segment {buf.size} != expected {expect}")
@@ -492,8 +507,9 @@ class ErasureSet:
             tail = None
             if has_tail:
                 tail = bitrot_io.unframe_shard(
-                    buf[nb * frame:].tobytes(), tail_shard, verify=True)
-            return frames[:, :32], np.ascontiguousarray(frames[:, 32:]), tail
+                    buf[nb * frame:].tobytes(), tail_shard, verify=True,
+                    algo=algo)
+            return frames[:, :hs], np.ascontiguousarray(frames[:, hs:]), tail
 
         order = Q.shuffle_by_distribution(list(range(self.n)), dist)
         # order[s] = drive position holding shard s. Data shards first,
@@ -528,9 +544,18 @@ class ErasureSet:
             # ONE dispatch: digests of the K chosen rows + reconstruction
             # of the missing data rows from those same HBM-resident bytes.
             x = np.stack([rows[s][1] for s in sel], axis=1)  # (nb, K, S)
-            digests, dev_out = fused.verify_and_transform(
-                x, k, m, tuple(sel), tuple(missing))
-            digests = np.asarray(digests)
+            if algo in fused.DEVICE_ALGOS:
+                digests, dev_out = fused.verify_and_transform(
+                    x, k, m, tuple(sel), tuple(missing), algo=algo)
+                digests = np.asarray(digests)
+            else:
+                # Host-hashed algorithms: digest on host, reconstruct on
+                # device only if rows are missing.
+                flat = x.reshape(nb * k, shard_size)
+                digests = bitrot_io._hash_batch(flat, algo).reshape(
+                    nb, k, hs)
+                dev_out = self._codec(k, m).transform_blocks(
+                    x, tuple(sel), tuple(missing)) if missing else None
             bad = [sel[i] for i in range(k)
                    if not np.array_equal(digests[:, i], rows[sel[i]][0])]
             if not bad:
@@ -586,7 +611,8 @@ class ErasureSet:
         """Unframe + bitrot-verify one shard's frame range; enforce the
         exact expected logical length (short/corrupt => ErrFileCorrupt)."""
         row = bitrot_io.unframe_shard(raw, fi.erasure.shard_size,
-                                      verify=True)
+                                      verify=True,
+                                      algo=fi.erasure.bitrot_algo())
         if row.size != geo["expect"]:
             raise ErrFileCorrupt(
                 f"shard segment {row.size} != expected {geo['expect']}")

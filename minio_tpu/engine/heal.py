@@ -126,7 +126,8 @@ def _verify_drive_data(d: LocalDrive, bucket: str, obj: str, fi: FileInfo,
         if deep and meta.inline_data is not None and fi.erasure is not None:
             try:
                 bitrot_io.unframe_shard(meta.inline_data,
-                                        fi.erasure.shard_size, verify=True)
+                                        fi.erasure.shard_size, verify=True,
+                                        algo=fi.erasure.bitrot_algo())
             except StorageError:
                 return DRIVE_CORRUPT
         if meta.inline_data is None:
@@ -136,10 +137,13 @@ def _verify_drive_data(d: LocalDrive, bucket: str, obj: str, fi: FileInfo,
     for part in fi.parts:
         path = f"{obj}/{fi.data_dir}/part.{part.number}"
         logical = ec.shard_file_size(part.size)
-        want = bitrot_io.bitrot_shard_file_size(logical, ec.shard_size)
+        algo = ec.bitrot_algo(part.number)
+        want = bitrot_io.bitrot_shard_file_size(logical, ec.shard_size,
+                                                algo)
         try:
             if deep:
-                d.verify_file(bucket, path, ec.shard_size, logical)
+                d.verify_file(bucket, path, ec.shard_size, logical,
+                              algo=algo)
             elif d.file_size(bucket, path) != want:
                 return DRIVE_CORRUPT
         except ErrFileNotFound:
@@ -285,7 +289,8 @@ def _heal_metadata_only(es, bucket, obj, fi: FileInfo, metas, states,
         if data is None:
             continue
         try:
-            row = bitrot_io.unframe_shard(data, ec.shard_size, verify=True)
+            row = bitrot_io.unframe_shard(data, ec.shard_size, verify=True,
+                                          algo=ec.bitrot_algo())
             if row.size == logical:
                 rows[s] = row
         except StorageError:
@@ -302,7 +307,8 @@ def _heal_metadata_only(es, bucket, obj, fi: FileInfo, metas, states,
             rows[s] = row
     for pos in targets:
         s = dist[pos] - 1
-        framed = bitrot_io.frame_shard(rows[s], ec.shard_size)
+        framed = bitrot_io.frame_shard(rows[s], ec.shard_size,
+                                       ec.bitrot_algo())
         fi_pos = _fi_for_drive(fi, pos, inline=framed)
         es.drives[pos].write_metadata(bucket, obj, fi_pos)
 
@@ -385,8 +391,9 @@ def _heal_data(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
                 s = dist[pos] - 1
                 try:
                     raw = es.drives[pos].read_file(bucket, path)
-                    row = bitrot_io.unframe_shard(raw, ec.shard_size,
-                                                  verify=True)
+                    row = bitrot_io.unframe_shard(
+                        raw, ec.shard_size, verify=True,
+                        algo=ec.bitrot_algo(part.number))
                     if row.size != logical:
                         raise ErrFileCorrupt("short shard")
                     rows[s] = row
@@ -405,7 +412,8 @@ def _heal_data(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
                 rows[s] = row
             for pos in targets:
                 s = dist[pos] - 1
-                framed = bitrot_io.frame_shard(rows[s], ec.shard_size)
+                framed = bitrot_io.frame_shard(
+                    rows[s], ec.shard_size, ec.bitrot_algo(part.number))
                 es.drives[pos].create_file(
                     SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.{part.number}",
                     framed)

@@ -127,8 +127,9 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
     # Stage under a unique name then rename into place, so a concurrent
     # re-upload of the same part can't interleave appends.
     stage = f"{path}/stage-{uuid.uuid4().hex}.{part_number}"
+    algo = bitrot_io.write_algo()
     failed = [d is None for d in es.drives]
-    for batch_shards in es._encode_stream(data, k, m):
+    for batch_shards in es._encode_stream(data, k, m, algo):
         per_drive = Q.unshuffle_to_drives(batch_shards, ec.distribution)
 
         def write_one(pos):
@@ -150,7 +151,7 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
 
     part_meta = msgpackx.packb({
         "n": part_number, "etag": etag, "size": len(data),
-        "as": len(data), "mt": time.time_ns()})
+        "as": len(data), "mt": time.time_ns(), "algo": algo})
 
     def publish(pos):
         d = es.drives[pos]
@@ -184,6 +185,13 @@ def _cleanup_stage(es: ErasureSet, stage: str) -> None:
 def list_parts(es: ErasureSet, bucket: str, obj: str,
                upload_id: str) -> list[ObjectPartInfo]:
     """Quorum-agreed part list (cf. ListObjectParts)."""
+    parts, _ = _list_parts_with_algos(es, bucket, obj, upload_id)
+    return parts
+
+
+def _list_parts_with_algos(es: ErasureSet, bucket: str, obj: str,
+                           upload_id: str):
+    """Part list + per-part bitrot algo map from the part metas."""
     _read_upload_fi(es, bucket, obj, upload_id)  # validates upload
     path = _upload_path(bucket, obj, upload_id)
     votes: dict[tuple, int] = {}
@@ -201,7 +209,8 @@ def list_parts(es: ErasureSet, bucket: str, obj: str,
                 pm = msgpackx.unpackb(d.read_all(SYS_VOL, f"{path}/{name}"))
             except StorageError:
                 continue
-            key = (pm["n"], pm["etag"], pm["size"], pm["as"])
+            key = (pm["n"], pm["etag"], pm["size"], pm["as"],
+                   pm.get("algo", "highwayhash256S"))
             votes[key] = votes.get(key, 0) + 1
     quorum = es._live_quorum()
     best: dict[int, tuple] = {}
@@ -210,9 +219,11 @@ def list_parts(es: ErasureSet, bucket: str, obj: str,
             n = key[0]
             if n not in best or votes[best[n]] < count:
                 best[n] = key
-    return [ObjectPartInfo(number=n, size=key[2], actual_size=key[3],
-                           etag=key[1])
-            for n, key in sorted(best.items())]
+    parts = [ObjectPartInfo(number=n, size=key[2], actual_size=key[3],
+                            etag=key[1])
+             for n, key in sorted(best.items())]
+    algos = {n: key[4] for n, key in best.items()}
+    return parts, algos
 
 
 def abort_multipart_upload(es: ErasureSet, bucket: str, obj: str,
@@ -266,7 +277,8 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
     (cf. CompleteMultipartUpload, erasure-multipart.go:771)."""
     fi_up = _read_upload_fi(es, bucket, obj, upload_id)
     ec = fi_up.erasure
-    stored = {p.number: p for p in list_parts(es, bucket, obj, upload_id)}
+    listed, part_algos = _list_parts_with_algos(es, bucket, obj, upload_id)
+    stored = {p.number: p for p in listed}
     if [n for n, _ in parts] != sorted({n for n, _ in parts}):
         raise ErrInvalidPartOrder("parts must be ascending and unique")
 
@@ -301,8 +313,11 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
         ec_pos = ErasureInfo(
             data_blocks=k_, parity_blocks=m_, block_size=BLOCK_SIZE,
             index=ec.distribution[pos], distribution=ec.distribution,
-            checksums=[{"part": p.number, "algo": "highwayhash256S",
-                        "hash": b""} for p in chosen])
+            checksums=[{"part": i + 1,
+                        "algo": part_algos.get(p.number,
+                                               "highwayhash256S"),
+                        "hash": b""}
+                       for i, p in enumerate(chosen)])
         return FileInfo(
             volume=bucket, name=obj, version_id=version_id,
             data_dir=data_dir, mod_time_ns=mod_time, size=total,

@@ -1,17 +1,19 @@
 """Fused bitrot-verify + erasure-transform: one dispatch, one HBM pass.
 
 North-star config #5 (BASELINE.json): the reference verifies each shard
-block's HighwayHash at read time (cmd/bitrot-streaming.go:142) and then
+block's bitrot hash at read time (cmd/bitrot-streaming.go:142) and then
 reconstructs missing shards with a separate SIMD pass
 (cmd/erasure-decode.go:206). Here both run as ONE jitted device program
 over the same (B, K, S) shard batch:
 
-  - digests: HighwayHash256 of every input shard-block (B*K streams in
-    lockstep on the VPU),
+  - digests: the per-shard-block bitrot digest of every input row —
+    mxh256 (MXU int8 matmuls, ops/mxhash_jax.py) or HighwayHash256
+    (VPU scan, ops/highwayhash_jax.py) depending on the object's
+    recorded algorithm,
   - targets: the GF(2^8) bit-plane matmul on the MXU reconstructing the
     requested rows.
 
-XLA schedules the hash scan and the matmul from the same HBM-resident
+XLA schedules the hash and the erasure matmul from the same HBM-resident
 input, so the shard bytes cross HBM once instead of twice. The host
 compares the 32-byte digests against the frame hashes (tiny) and decides
 quorum / spare-read policy exactly like the unfused path.
@@ -28,23 +30,37 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from . import erasure_jax
+from . import erasure_jax, erasure_pallas
 from .highwayhash import MAGIC_KEY
 from .highwayhash_jax import _hh256_impl
+from .mxhash_jax import mxh256_rows
+
+# Algorithms with a device digest kernel (usable in the fused paths).
+DEVICE_ALGOS = ("mxh256", "highwayhash256S", "highwayhash256")
 
 
-@functools.lru_cache(maxsize=8)
-def _hash_rows_jit(key: bytes):
+def _digest_rows(x2d: jax.Array, algo: str, key: bytes) -> jax.Array:
+    """(n, S) uint8 -> (n, 32) digests with the algo's device kernel."""
+    if algo == "mxh256":
+        return mxh256_rows(x2d)
+    if algo in ("highwayhash256S", "highwayhash256"):
+        return _hh256_impl(x2d, key)
+    raise ValueError(f"no device kernel for bitrot algo {algo!r}")
+
+
+@functools.lru_cache(maxsize=16)
+def _hash_rows_jit(algo: str, key: bytes):
     @jax.jit
     def fn(x):  # (B, K, S) uint8
         b, kk, s = x.shape
-        return _hh256_impl(x.reshape(b * kk, s), key).reshape(b, kk, 32)
+        return _digest_rows(x.reshape(b * kk, s), algo, key).reshape(
+            b, kk, 32)
     return fn
 
 
 @functools.lru_cache(maxsize=512)
 def _verify_transform_jit(k: int, m: int, sources: tuple[int, ...],
-                          targets: tuple[int, ...], key: bytes):
+                          targets: tuple[int, ...], algo: str, key: bytes):
     mat = jnp.asarray(
         erasure_jax._transform_matrix_bits(k, m, sources, targets),
         dtype=jnp.bfloat16)
@@ -53,15 +69,18 @@ def _verify_transform_jit(k: int, m: int, sources: tuple[int, ...],
     @jax.jit
     def fn(x):  # x: (B, K, S) uint8 — rows in `sources` order
         b, kk, s = x.shape
-        digests = _hh256_impl(x.reshape(b * kk, s), key).reshape(b, kk, 32)
-        out = erasure_jax._gf_matmul_blocks(mat, x, rows)
+        digests = _digest_rows(x.reshape(b * kk, s), algo, key).reshape(
+            b, kk, 32)
+        out = erasure_pallas.gf_matmul_blocks(mat, x, rows)
         return digests, out
 
     return fn
 
 
 def verify_and_transform(x, k: int, m: int, sources: tuple[int, ...],
-                         targets: tuple[int, ...], key: bytes = MAGIC_KEY):
+                         targets: tuple[int, ...],
+                         algo: str = "highwayhash256S",
+                         key: bytes = MAGIC_KEY):
     """((B, K, S) shard rows) -> ((B, K, 32) digests, (B, T, S) rebuilt rows).
 
     Digests are of the INPUT rows (callers compare them against the bitrot
@@ -70,30 +89,32 @@ def verify_and_transform(x, k: int, m: int, sources: tuple[int, ...],
     """
     x = jnp.asarray(x, dtype=jnp.uint8)
     if not targets:
-        return _hash_rows_jit(key)(x), None
-    fn = _verify_transform_jit(k, m, tuple(sources), tuple(targets), key)
+        return _hash_rows_jit(algo, key)(x), None
+    fn = _verify_transform_jit(k, m, tuple(sources), tuple(targets),
+                               algo, key)
     return fn(x)
 
 
 @functools.lru_cache(maxsize=64)
-def _encode_hash_jit(k: int, m: int, key: bytes):
+def _encode_hash_jit(k: int, m: int, algo: str, key: bytes):
     mat = jnp.asarray(erasure_jax._encode_matrix_bits(k, m),
                       dtype=jnp.bfloat16)
 
     @jax.jit
     def fn(x):  # x: (B, K, S) uint8 data shards
         b, kk, s = x.shape
-        parity = erasure_jax._gf_matmul_blocks(mat, x, m)
+        parity = erasure_pallas.gf_matmul_blocks(mat, x, m)
         full = jnp.concatenate([x, parity], axis=1)       # (B, K+M, S)
-        digests = _hh256_impl(
+        digests = _digest_rows(
             full.transpose(1, 0, 2).reshape((kk + m) * b, s),
-            key).reshape(kk + m, b, 32)
+            algo, key).reshape(kk + m, b, 32)
         return parity, digests
 
     return fn
 
 
-def encode_and_hash(x, k: int, m: int, key: bytes = MAGIC_KEY):
+def encode_and_hash(x, k: int, m: int, algo: str = "highwayhash256S",
+                    key: bytes = MAGIC_KEY):
     """((B, K, S) data) -> ((B, M, S) parity, (K+M, B, 32) digests).
 
     The PUT hot path: parity AND per-shard-block bitrot digests in one
@@ -102,4 +123,4 @@ def encode_and_hash(x, k: int, m: int, key: bytes = MAGIC_KEY):
     (n_shards, n_blocks) order.
     """
     x = jnp.asarray(x, dtype=jnp.uint8)
-    return _encode_hash_jit(k, m, key)(x)
+    return _encode_hash_jit(k, m, algo, key)(x)

@@ -59,6 +59,28 @@ def bitrot_self_test() -> None:
         raise SelfTestError("bitrot (HighwayHash256) self-test mismatch")
 
 
+# Golden chain for mxh256 (the default write algorithm, ops/mxhash.py):
+# digest of b"" then iterated digest-of-digest, pinned at build time from
+# the exact-integer numpy spec implementation.
+_MXH_CHAIN_SHA256 = \
+    "d6373d19d83d8c7d0a34aa26414e76ea7ba722c0b0895b23e971fa4912566bc7"
+
+
+def mxhash_self_test() -> None:
+    from .mxhash import mxh256
+
+    h = b""
+    for _ in range(8):
+        h = mxh256(h)
+    if hashlib.sha256(h).hexdigest() != _MXH_CHAIN_SHA256:
+        raise SelfTestError("bitrot (mxh256) self-test mismatch")
+
+
 def run_startup_self_tests() -> None:
     erasure_self_test()
     bitrot_self_test()
+    mxhash_self_test()
+    # Fail boot on a misconfigured bitrot write algorithm (clear config
+    # error now, not a confusing per-request failure later).
+    from ..storage.bitrot_io import write_algo
+    write_algo()

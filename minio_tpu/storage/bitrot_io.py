@@ -14,15 +14,19 @@ from __future__ import annotations
 
 import numpy as np
 
+import os
+
 from ..ops.highwayhash import HighwayHash256, highwayhash256_batch
 from .errors import ErrFileCorrupt
 
 HASH_SIZE = 32
 
 # -- bitrot algorithm registry (cf. cmd/bitrot.go:39) ------------------------
-# The reference supports four algorithms; HighwayHash256S is the default
-# (and the only one with a device path). Each entry: digest size and a
-# batch hasher (n, L) uint8 -> (n, size).
+# The reference supports four algorithms with HighwayHash256S the default;
+# here the default WRITE algorithm is mxh256 (ops/mxhash.py) — designed so
+# verify runs as MXU matmuls at codec speed — while HighwayHash256S is kept
+# for interop reads of objects written before the switch. Each entry:
+# digest size and a batch hasher (n, L) uint8 -> (n, size).
 
 _DEVICE_HASH_THRESHOLD = 1 << 16
 
@@ -34,6 +38,14 @@ def _hh_batch(blocks: np.ndarray) -> np.ndarray:
         from ..ops.highwayhash_jax import hh256_batch_jax
         return np.asarray(hh256_batch_jax(blocks))
     return highwayhash256_batch(blocks)
+
+
+def _mxh_batch(blocks: np.ndarray) -> np.ndarray:
+    if blocks.size >= _DEVICE_HASH_THRESHOLD:
+        from ..ops.mxhash_jax import mxh256_batch_jax
+        return np.asarray(mxh256_batch_jax(blocks))
+    from ..ops.mxhash import mxh256_batch
+    return mxh256_batch(blocks)
 
 
 def _hashlib_batch(name: str, digest_size: int):
@@ -49,13 +61,32 @@ def _hashlib_batch(name: str, digest_size: int):
 
 
 ALGORITHMS: dict[str, tuple[int, object]] = {
+    "mxh256": (32, _mxh_batch),             # TPU-native (ops/mxhash.py)
     "highwayhash256S": (32, _hh_batch),
     "highwayhash256": (32, _hh_batch),      # whole-file legacy variant
     "sha256": (32, _hashlib_batch("sha256", 32)),
     "blake2b512": (64, _hashlib_batch("blake2b", 64)),
 }
 
+# Default for READING frames whose metadata predates per-object algo
+# recording (rounds 1-2 wrote HighwayHash256S unconditionally).
 DEFAULT_ALGO = "highwayhash256S"
+
+# Algorithms selectable for new writes (32-byte digests only, so the
+# frame geometry — and therefore shard file sizes — is algo-independent).
+WRITE_ALGORITHMS = ("mxh256", "highwayhash256S", "sha256")
+
+
+def write_algo() -> str:
+    """Bitrot algorithm for NEW objects: env MTPU_BITROT_ALGO; defaults
+    to the TPU-native mxh256. Misconfiguration is a ValueError (validated
+    again at server boot, server/__main__.py self-tests) — not a storage
+    corruption error."""
+    algo = os.environ.get("MTPU_BITROT_ALGO", "mxh256")
+    if algo not in WRITE_ALGORITHMS:
+        raise ValueError(
+            f"MTPU_BITROT_ALGO={algo!r} not one of {WRITE_ALGORITHMS}")
+    return algo
 
 
 def digest_size(algo: str = DEFAULT_ALGO) -> int:
@@ -142,7 +173,8 @@ def frame_shard(shard: np.ndarray, shard_size: int,
 
 
 def frame_shards_batch(shards: np.ndarray,
-                       digests: np.ndarray | None = None) -> list[bytes]:
+                       digests: np.ndarray | None = None,
+                       algo: str = DEFAULT_ALGO) -> list[bytes]:
     """Frame a batch at once: (n_shards, n_blocks, shard_size) -> one framed
     byte string per shard file, hashing all n_shards*n_blocks streams in a
     single vectorized pass (the hot PUT path). Pass `digests`
@@ -151,7 +183,8 @@ def frame_shards_batch(shards: np.ndarray,
     n_shards, n_blocks, shard_size = shards.shape
     if digests is None:
         flat = shards.reshape(n_shards * n_blocks, shard_size)
-        digests = _hash_batch(flat).reshape(n_shards, n_blocks, HASH_SIZE)
+        digests = _hash_batch(flat, algo).reshape(n_shards, n_blocks,
+                                                  digest_size(algo))
     out = []
     for i in range(n_shards):
         buf = bytearray()
@@ -205,9 +238,10 @@ def unframe_shard(data: bytes, shard_size: int, verify: bool = True,
 
 
 def read_frames_range(data: bytes, shard_size: int, block_start: int,
-                      block_end: int, verify: bool = True) -> np.ndarray:
+                      block_end: int, verify: bool = True,
+                      algo: str = DEFAULT_ALGO) -> np.ndarray:
     """Read shard blocks [block_start, block_end) from a framed file —
     the ranged-read fast path (no need to touch earlier frames)."""
-    frame = HASH_SIZE + shard_size
+    frame = digest_size(algo) + shard_size
     sub = data[block_start * frame:block_end * frame]
-    return unframe_shard(sub, shard_size, verify=verify)
+    return unframe_shard(sub, shard_size, verify=verify, algo=algo)

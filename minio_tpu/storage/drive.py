@@ -433,17 +433,18 @@ class LocalDrive:
     # -- bitrot verify -------------------------------------------------------
 
     def verify_file(self, vol: str, path: str, shard_size: int,
-                    expected_logical: int | None = None) -> None:
+                    expected_logical: int | None = None,
+                    algo: str = bitrot_io.DEFAULT_ALGO) -> None:
         """Full-file bitrot verification (cf. VerifyFile,
         /root/reference/cmd/xl-storage.go:2194). Raises ErrFileCorrupt."""
         data = self.read_file(vol, path)
         if expected_logical is not None:
             want = bitrot_io.bitrot_shard_file_size(expected_logical,
-                                                    shard_size)
+                                                    shard_size, algo)
             if len(data) != want:
                 raise ErrFileCorrupt(
                     f"size mismatch: {len(data)} != {want}")
-        bitrot_io.unframe_shard(data, shard_size, verify=True)
+        bitrot_io.unframe_shard(data, shard_size, verify=True, algo=algo)
 
     # -- disk info / format --------------------------------------------------
 

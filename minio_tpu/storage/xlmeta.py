@@ -83,6 +83,17 @@ class ErasureInfo:
     def shard_size(self) -> int:
         return -(-self.block_size // self.data_blocks)
 
+    def bitrot_algo(self, part_number: int = 1) -> str:
+        """Bitrot algorithm recorded for a part (cf. ChecksumInfo lookup,
+        /root/reference/cmd/erasure-metadata.go GetChecksumInfo). Metadata
+        from before per-object recording defaults to HighwayHash256S."""
+        for c in self.checksums:
+            if c.get("part") == part_number:
+                return c.get("algo", "highwayhash256S")
+        if self.checksums:
+            return self.checksums[0].get("algo", "highwayhash256S")
+        return "highwayhash256S"
+
     def shard_file_size(self, total_length: int) -> int:
         if total_length <= 0:
             return 0
