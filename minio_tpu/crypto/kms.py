@@ -34,15 +34,32 @@ class StaticKMS(KMS):
     """Master key held in memory/env (MTPU_KMS_SECRET_KEY)."""
 
     def __init__(self, master_key: bytes | None = None,
-                 key_id: str = "mtpu-default-key"):
+                 key_id: str = "mtpu-default-key",
+                 allow_insecure_zero_key: bool = False):
+        """allow_insecure_zero_key: migration-only escape hatch so data
+        written under the old implicit all-zero default stays readable
+        (e.g. a one-off re-encrypt pass); never set on a serving path."""
         if master_key is None:
             env = os.environ.get("MTPU_KMS_SECRET_KEY", "")
-            master_key = (bytes.fromhex(env) if env
-                          else b"\x00" * 32)
+            if not env:
+                # Never fall back to a well-known key: the reference
+                # refuses to serve SSE without a configured KMS key
+                # (internal/kms/single-key.go ParseSecretKey).
+                raise KMSError(
+                    "no KMS master key configured "
+                    "(set MTPU_KMS_SECRET_KEY to 32 hex-encoded bytes)")
+            try:
+                master_key = bytes.fromhex(env)
+            except ValueError:
+                raise KMSError("MTPU_KMS_SECRET_KEY is not valid hex "
+                               "(need 32 hex-encoded bytes)") from None
         if len(master_key) != 32:
             raise KMSError("master key must be 32 bytes")
+        if master_key == b"\x00" * 32 and not allow_insecure_zero_key:
+            raise KMSError("refusing all-zero KMS master key")
         self._master = master_key
         self.key_id = key_id
+
 
     def generate_data_key(self, context: bytes = b""):
         plaintext = secrets.token_bytes(32)
@@ -60,3 +77,12 @@ class StaticKMS(KMS):
                                                 context)
         except Exception as e:  # noqa: BLE001
             raise KMSError(f"unseal failed: {e}") from None
+
+
+def kms_from_env() -> StaticKMS | None:
+    """A keyed KMS if the environment provides one, else None — callers
+    must then reject SSE-S3/SSE-KMS requests instead of silently sealing
+    under a known key."""
+    if not os.environ.get("MTPU_KMS_SECRET_KEY", ""):
+        return None
+    return StaticKMS()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import base64
 import hashlib
+import hmac
 import secrets
 import struct
 
@@ -30,6 +31,7 @@ META_ALGO = "x-mtpu-internal-sse-algo"          # "SSE-S3" | "SSE-C"
 META_SEALED_KEY = "x-mtpu-internal-sse-sealed-key"
 META_KMS_KEY_ID = "x-mtpu-internal-sse-kms-id"
 META_KEY_MD5 = "x-mtpu-internal-sse-c-key-md5"
+META_SSEC_IV = "x-mtpu-internal-sse-c-iv"
 META_ACTUAL_SIZE = "x-mtpu-internal-actual-size"
 
 # request headers
@@ -111,16 +113,33 @@ def parse_ssec_key(headers: dict) -> bytes | None:
     return key
 
 
-def encrypt_for_put(data: bytes, headers: dict, kms: KMS | None):
+def derive_object_key(customer_key: bytes, iv: bytes, bucket: str,
+                      object_key: str) -> bytes:
+    """Per-object sealing key from the customer key: never seal with the
+    raw client key directly — one key reused across many objects with a
+    64-bit random nonce base risks GCM nonce reuse.  The reference
+    derives a unique ObjectKey per object the same way
+    (internal/crypto/key.go GenerateKey: HMAC over a random IV and the
+    bucket/object path)."""
+    return hmac.new(customer_key,
+                    iv + b"\x00" + f"{bucket}/{object_key}".encode(),
+                    hashlib.sha256).digest()
+
+
+def encrypt_for_put(data: bytes, headers: dict, kms: KMS | None,
+                    bucket: str = "", object_key: str = ""):
     """-> (stored_bytes, metadata_updates) or (data, {}) when no SSE."""
     h = {k.lower(): v for k, v in headers.items()}
     ssec_key = parse_ssec_key(headers)
     if ssec_key is not None:
-        sealed = seal(data, ssec_key)
+        iv = secrets.token_bytes(32)
+        obj_key = derive_object_key(ssec_key, iv, bucket, object_key)
+        sealed = seal(data, obj_key)
         return sealed, {
             META_ALGO: "SSE-C",
             META_KEY_MD5: base64.b64encode(
                 hashlib.md5(ssec_key).digest()).decode(),
+            META_SSEC_IV: base64.b64encode(iv).decode(),
             META_ACTUAL_SIZE: str(len(data)),
         }
     if h.get(H_SSE, "") in ("AES256", "aws:kms"):
@@ -138,7 +157,8 @@ def encrypt_for_put(data: bytes, headers: dict, kms: KMS | None):
 
 
 def decrypt_for_get(stored: bytes, metadata: dict, headers: dict,
-                    kms: KMS | None) -> bytes:
+                    kms: KMS | None, bucket: str = "",
+                    object_key: str = "") -> bytes:
     algo = metadata.get(META_ALGO, "")
     if not algo:
         return stored
@@ -149,6 +169,11 @@ def decrypt_for_get(stored: bytes, metadata: dict, headers: dict,
         md5 = base64.b64encode(hashlib.md5(key).digest()).decode()
         if md5 != metadata.get(META_KEY_MD5, ""):
             raise SSEError("SSE-C key does not match object key")
+        iv_b64 = metadata.get(META_SSEC_IV, "")
+        if iv_b64:
+            key = derive_object_key(key, base64.b64decode(iv_b64),
+                                    bucket, object_key)
+        # else: legacy object sealed directly with the customer key
         return unseal(stored, key)
     if algo == "SSE-S3":
         if kms is None:

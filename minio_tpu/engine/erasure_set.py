@@ -41,6 +41,7 @@ from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
                               StorageError)
 from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo, XLMeta,
                               new_uuid, normalize_version_id)
+from ..utils import streams
 from . import quorum as Q
 
 BLOCK_SIZE = 1 << 20          # blockSizeV2, cmd/object-api-common.go:40
@@ -169,11 +170,16 @@ class ErasureSet:
 
     # -- put -----------------------------------------------------------------
 
-    def put_object(self, bucket: str, obj: str, data: bytes, *,
+    def put_object(self, bucket: str, obj: str, data, *,
                    metadata: dict | None = None,
                    versioned: bool = False,
                    parity: int | None = None) -> FileInfo:
         """Erasure-code and store one object (single part).
+
+        `data` is bytes or a reader (.read(n)); a reader streams through
+        encode in O(BATCH_BLOCKS x BLOCK_SIZE) memory — the role of the
+        reference's blockwise streaming Encode
+        (/root/reference/cmd/erasure-encode.go:73).
 
         cf. erasureObjects.putObject, /root/reference/cmd/erasure-object.go:748.
         """
@@ -200,9 +206,21 @@ class ErasureSet:
         k = self.n - parity
         write_quorum = k + (1 if k == parity else 0)
 
+        # A streamed body: peek enough to decide inline-vs-streaming;
+        # small bodies collapse to the bytes path.
+        stream = None
+        if streams.is_reader(data):
+            stream = data
+            head = stream.read(SMALL_FILE_THRESHOLD + 1)
+            if len(head) <= SMALL_FILE_THRESHOLD:
+                data, stream = head, None
+            else:
+                data = head
+
         distribution = Q.hash_order(f"{bucket}/{obj}", self.n)
         meta = dict(metadata or {})
-        meta.setdefault("etag", _etag(data))
+        if stream is None:
+            meta.setdefault("etag", _etag(data))
         if upgraded:
             meta["x-mtpu-internal-erasure-upgraded"] = f"{offline}-offline"
         version_id = new_uuid() if versioned else ""
@@ -212,21 +230,25 @@ class ErasureSet:
             data_blocks=k, parity_blocks=parity, block_size=BLOCK_SIZE,
             index=0, distribution=distribution,
             checksums=[{"part": 1, "algo": algo, "hash": b""}])
+        # Object size: known up front for bytes, discovered at EOF for a
+        # stream — fi_for reads it at publish time (after the stream).
+        sizeref = {"size": len(data) if stream is None else None}
 
         def fi_for(drive_pos: int, data_dir: str,
                    inline: bytes | None) -> FileInfo:
+            size = sizeref["size"]
             ec = ErasureInfo(
                 data_blocks=k, parity_blocks=parity, block_size=BLOCK_SIZE,
                 index=distribution[drive_pos], distribution=distribution,
                 checksums=ec_base.checksums)
             return FileInfo(
                 volume=bucket, name=obj, version_id=version_id,
-                data_dir=data_dir, mod_time_ns=mod_time, size=len(data),
+                data_dir=data_dir, mod_time_ns=mod_time, size=size,
                 metadata=meta,
-                parts=[ObjectPartInfo(1, len(data), len(data))],
+                parts=[ObjectPartInfo(1, size, size)],
                 erasure=ec, inline_data=inline)
 
-        if len(data) <= SMALL_FILE_THRESHOLD:
+        if stream is None and len(data) <= SMALL_FILE_THRESHOLD:
             return self._put_inline(bucket, obj, data, fi_for, k, parity,
                                     distribution, write_quorum, algo)
 
@@ -236,7 +258,20 @@ class ErasureSet:
         tmp_id = f"put-{uuid.uuid4().hex}"
         failed = [d is None for d in self.drives]
 
-        for batch_shards in self._encode_stream(data, k, parity, algo):
+        md5 = hashlib.md5()
+        total = 0
+
+        def counted_chunks():
+            nonlocal total
+            for chunk, is_last in streams.batched_chunks(
+                    data, stream, BATCH_BLOCKS * BLOCK_SIZE):
+                if stream is not None:
+                    md5.update(chunk)    # bytes path already has its etag
+                total += len(chunk)
+                yield chunk, is_last
+
+        for batch_shards in self._encode_chunks(counted_chunks(), k,
+                                                parity, algo):
             # batch_shards: list of n framed byte strings in SHARD order.
             per_drive = Q.unshuffle_to_drives(batch_shards, distribution)
 
@@ -258,6 +293,10 @@ class ErasureSet:
                 self._cleanup_tmp(tmp_id)
                 raise ErrErasureWriteQuorum(
                     f"{self.n - sum(failed)} < {write_quorum}")
+
+        if stream is not None:
+            sizeref["size"] = total
+            meta.setdefault("etag", md5.hexdigest())
 
         def publish(pos):
             d = self.drives[pos]
@@ -327,7 +366,17 @@ class ErasureSet:
 
     def _encode_stream(self, data: bytes, k: int, m: int,
                        algo: str | None = None):
-        """Yield lists of n framed shard-chunks per batch of blocks.
+        """Yield lists of n framed shard-chunks per batch of blocks
+        from an in-memory object (small/compat path)."""
+        chunks = streams.batched_chunks(data, None,
+                                        BATCH_BLOCKS * BLOCK_SIZE)
+        yield from self._encode_chunks(chunks, k, m, algo)
+
+    def _encode_chunks(self, chunks, k: int, m: int,
+                       algo: str | None = None):
+        """Encode an iterator of (chunk, is_last) pairs — every chunk a
+        multiple of BLOCK_SIZE except the final one — yielding lists of
+        n framed shard-chunks.  Memory is O(chunk), never O(object).
 
         Full 1 MiB blocks are encoded as one batched device dispatch
         ((B, K, S) uint8); the partial tail block goes through the CPU
@@ -335,48 +384,48 @@ class ErasureSet:
         """
         if algo is None:
             algo = bitrot_io.write_algo()
-        size = len(data)
         shard_size = -(-BLOCK_SIZE // k)
-        n_full = size // BLOCK_SIZE
-        buf = np.frombuffer(data, dtype=np.uint8)
+        for chunk, is_last in chunks:
+            buf = np.frombuffer(chunk, dtype=np.uint8)
+            n_full = buf.size // BLOCK_SIZE
+            for start in range(0, n_full, BATCH_BLOCKS):
+                nb = min(BATCH_BLOCKS, n_full - start)
+                batch = buf[start * BLOCK_SIZE:(start + nb) * BLOCK_SIZE]
+                if BLOCK_SIZE % k == 0:
+                    blocks = batch.reshape(nb, k, shard_size)
+                else:
+                    # Non-power-of-two K: each block zero-pads to
+                    # K*shard_size (split padding rule,
+                    # cf. erasure-coding.go:81).
+                    blocks = np.zeros((nb, k * shard_size), dtype=np.uint8)
+                    blocks[:, :BLOCK_SIZE] = batch.reshape(nb, BLOCK_SIZE)
+                    blocks = blocks.reshape(nb, k, shard_size)
+                # Parity AND bitrot digests in ONE device dispatch
+                # (north-star config #5 PUT side, ops/fused.py); framing
+                # is then pure byte interleaving on the host.
+                if algo in fused.DEVICE_ALGOS:
+                    parity, digests = fused.encode_and_hash(blocks, k, m,
+                                                            algo=algo)
+                    digests = np.asarray(digests)
+                else:
+                    # Host-hashed algorithms (e.g. sha256): device
+                    # encodes, frame_shards_batch hashes.
+                    parity, digests = \
+                        self._codec(k, m).encode_blocks(blocks), None
+                parity = np.asarray(parity)
+                full = np.concatenate([blocks, parity], axis=1)
+                yield bitrot_io.frame_shards_batch(
+                    full.transpose(1, 0, 2), digests=digests, algo=algo)
 
-        for start in range(0, n_full, BATCH_BLOCKS):
-            nb = min(BATCH_BLOCKS, n_full - start)
-            batch = buf[start * BLOCK_SIZE:(start + nb) * BLOCK_SIZE]
-            if BLOCK_SIZE % k == 0:
-                blocks = batch.reshape(nb, k, shard_size)
-            else:
-                # Non-power-of-two K: each block zero-pads to K*shard_size
-                # (split padding rule, cf. erasure-coding.go:81).
-                blocks = np.zeros((nb, k * shard_size), dtype=np.uint8)
-                blocks[:, :BLOCK_SIZE] = batch.reshape(nb, BLOCK_SIZE)
-                blocks = blocks.reshape(nb, k, shard_size)
-            # Parity AND bitrot digests in ONE device dispatch (north-star
-            # config #5 PUT side, ops/fused.py); framing is then pure byte
-            # interleaving on the host.
-            if algo in fused.DEVICE_ALGOS:
-                parity, digests = fused.encode_and_hash(blocks, k, m,
-                                                        algo=algo)
-                digests = np.asarray(digests)
-            else:
-                # Host-hashed algorithms (e.g. sha256): device encodes,
-                # frame_shards_batch hashes.
-                parity, digests = self._codec(k, m).encode_blocks(blocks), None
-            parity = np.asarray(parity)
-            full = np.concatenate([blocks, parity], axis=1)  # (nb, k+m, S)
-            yield bitrot_io.frame_shards_batch(full.transpose(1, 0, 2),
-                                               digests=digests, algo=algo)
-
-        tail = buf[n_full * BLOCK_SIZE:]
-        if tail.size or size == 0:
-            if tail.size == 0:
-                return
-            cpu = self._cpu(k, m)
-            shards = cpu.encode_data(tail.tobytes())  # k+m arrays
-            tail_shard = shards[0].size
-            framed = [bitrot_io.frame_shard(s, tail_shard, algo)
-                      for s in shards]
-            yield framed
+            tail = buf[n_full * BLOCK_SIZE:]
+            if is_last and tail.size:
+                cpu = self._cpu(k, m)
+                shards = cpu.encode_data(tail.tobytes())  # k+m arrays
+                tail_shard = shards[0].size
+                yield [bitrot_io.frame_shard(s, tail_shard, algo)
+                       for s in shards]
+            if not is_last and tail.size:
+                raise ValueError("non-final chunk not BLOCK_SIZE aligned")
 
     # -- get -----------------------------------------------------------------
 
@@ -388,6 +437,16 @@ class ErasureSet:
         cf. GetObjectNInfo → getObjectWithFileInfo,
         /root/reference/cmd/erasure-object.go:221.
         """
+        fi, it = self.get_object_iter(bucket, obj, offset, length,
+                                      version_id)
+        return fi, b"".join(it)
+
+    def get_object_iter(self, bucket: str, obj: str, offset: int = 0,
+                        length: int = -1, version_id: str = ""):
+        """Streaming read: returns (fi, iterator of assembled byte
+        chunks), each chunk one device batch (<= BATCH_BLOCKS blocks) of
+        verified+decoded data — memory is O(batch), never O(object)
+        (the GetObjectReader role, cmd/object-api-utils.go:392-528)."""
         fi, metas, errs = self._read_metadata(bucket, obj, version_id)
         if fi.deleted:
             raise ErrObjectNotFound(f"{bucket}/{obj} (delete marker)")
@@ -400,32 +459,44 @@ class ErasureSet:
             raise StorageError(f"range [{offset}, {offset + length}) "
                                f"outside object of size {size}")
         if length == 0 or size == 0:
-            return fi, b""
+            return fi, iter(())
 
         if fi.inline_data is not None or (fi.parts and not fi.data_dir):
             data = self._read_inline(bucket, obj, fi, metas, version_id)
-            return fi, data[offset:offset + length]
+            return fi, iter((data[offset:offset + length],))
 
-        # Map the object byte range onto parts (each part an independent
-        # EC stream; cf. ObjectToPartOffset, cmd/erasure-metadata.go).
-        pieces = []
-        part_start = 0
-        remaining = length
-        pos = offset
-        for part in fi.parts:
-            part_end = part_start + part.size
-            if remaining <= 0:
-                break
-            if pos < part_end:
-                in_off = pos - part_start
-                in_len = min(remaining, part.size - in_off)
-                pieces.append(self._read_part(
-                    bucket, obj, fi, part_number=part.number,
-                    offset=in_off, length=in_len))
-                pos += in_len
-                remaining -= in_len
-            part_start = part_end
-        return fi, b"".join(pieces)
+        batch_bytes = BATCH_BLOCKS * BLOCK_SIZE
+
+        def gen():
+            # Map the object byte range onto parts (each part an
+            # independent EC stream; cf. ObjectToPartOffset,
+            # cmd/erasure-metadata.go), then walk each in-part range in
+            # batch-aligned segments.
+            part_start = 0
+            remaining = length
+            pos = offset
+            for part in fi.parts:
+                part_end = part_start + part.size
+                if remaining <= 0:
+                    return
+                if pos < part_end:
+                    in_off = pos - part_start
+                    in_len = min(remaining, part.size - in_off)
+                    seg = in_off
+                    stop = in_off + in_len
+                    while seg < stop:
+                        # segment ends at the next batch boundary so each
+                        # yield is one bounded device dispatch
+                        boundary = (seg // batch_bytes + 1) * batch_bytes
+                        seg_end = min(stop, boundary)
+                        yield self._read_part(
+                            bucket, obj, fi, part_number=part.number,
+                            offset=seg, length=seg_end - seg)
+                        seg = seg_end
+                    pos += in_len
+                    remaining -= in_len
+                part_start = part_end
+        return fi, gen()
 
     def _read_metadata(self, bucket, obj, version_id=""):
         version_id = normalize_version_id(version_id)

@@ -114,6 +114,25 @@ class ServerPools:
             raise ErrBucketNotFound(bucket)
         raise last or ErrObjectNotFound(f"{bucket}/{obj}")
 
+    def get_object_iter(self, bucket: str, obj: str, offset: int = 0,
+                        length: int = -1, version_id: str = ""):
+        """Streaming read: (fi, chunk iterator); falls back to a whole-
+        object read on backends without a streaming path."""
+        last: StorageError | None = None
+        for p in self.pools:
+            try:
+                if hasattr(p, "get_object_iter"):
+                    return p.get_object_iter(bucket, obj, offset, length,
+                                             version_id)
+                fi, data = p.get_object(bucket, obj, offset, length,
+                                        version_id)
+                return fi, iter((data,))
+            except (ErrObjectNotFound, ErrVersionNotFound) as e:
+                last = e
+        if not self.bucket_exists(bucket):
+            raise ErrBucketNotFound(bucket)
+        raise last or ErrObjectNotFound(f"{bucket}/{obj}")
+
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
         last: StorageError | None = None

@@ -23,6 +23,7 @@ from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
                               ErrUploadNotFound, ErrInvalidPart,
                               StorageError)
 from ..storage.xlmeta import FileInfo, ObjectPartInfo
+from ..utils import streams
 
 FS_META_DIR = ".mtpu.fs"           # per-bucket metadata + multipart staging
 
@@ -86,15 +87,28 @@ class FSObjectLayer:
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
         meta = dict(metadata or {})
-        meta.setdefault("etag", hashlib.md5(data).hexdigest())
         path = self._obj_path(bucket, obj)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".tmp-{uuid.uuid4().hex}"
+        md5 = hashlib.md5()
+        size = 0
         with open(tmp, "wb") as f:
-            f.write(data)
+            if streams.is_reader(data):
+                while True:
+                    piece = data.read(1 << 20)
+                    if not piece:
+                        break
+                    md5.update(piece)
+                    size += len(piece)
+                    f.write(piece)
+            else:
+                md5.update(data)
+                size = len(data)
+                f.write(data)
+        meta.setdefault("etag", md5.hexdigest())
         os.replace(tmp, path)                     # atomic publish
         fi = FileInfo(volume=bucket, name=obj, version_id="",
-                      mod_time_ns=time.time_ns(), size=len(data),
+                      mod_time_ns=time.time_ns(), size=size,
                       metadata=meta)
         self._write_meta(bucket, obj, fi)
         return fi

@@ -73,7 +73,9 @@ class S3Gateway:
         if "content-type" in meta:
             headers["Content-Type"] = meta["content-type"]
         try:
-            resp = self.cli.put_object(bucket, obj, data, headers=headers)
+            from ..utils.streams import ensure_bytes
+            resp = self.cli.put_object(bucket, obj, ensure_bytes(data),
+                                       headers=headers)
         except S3ClientError as e:
             raise _map_err(e) from None
         meta.setdefault("etag",

@@ -24,6 +24,16 @@ from dataclasses import dataclass, field
 from ..storage.errors import StorageError
 from . import policy as pol
 
+_LOGGER = None
+
+
+def _logger():
+    global _LOGGER
+    if _LOGGER is None:
+        from ..observe.logger import Logger
+        _LOGGER = Logger()
+    return _LOGGER
+
 IAM_PREFIX = "config/iam"
 
 
@@ -99,7 +109,15 @@ class IAMSys:
                     name = rel[len("policies/"):-len(".json")]
                     try:
                         policies[name] = pol.Policy(obj)
-                    except pol.PolicyError:
+                    except pol.PolicyError as e:
+                        # An unloadable policy silently disappearing
+                        # would strand every identity attached to it
+                        # with no diagnostic; make the drop loud (but
+                        # deduped — this loop re-runs on every reload).
+                        _logger().log_once(
+                            "error",
+                            f"IAM: dropping unparseable policy "
+                            f"{name!r}: {e}", key=f"iam-bad-policy:{name}")
                         continue
             self._users, self._groups, self._policies = \
                 users, groups, policies

@@ -87,7 +87,7 @@ class S3Handlers:
                  replication=None, scanner=None, kms=None,
                  compress_enabled: bool = False, tier_mgr=None):
         from ..bucket.metadata import BucketMetadataSys
-        from ..crypto.kms import StaticKMS
+        from ..crypto.kms import kms_from_env
         self.pools = pools
         try:
             pools.make_bucket(META_BUCKET)
@@ -97,7 +97,9 @@ class S3Handlers:
         self.notify = notify              # bucket.notify.NotificationSystem
         self.replication = replication    # bucket.replication.ReplicationPool
         self.scanner = scanner            # background.scanner.DataScanner
-        self.kms = kms if kms is not None else StaticKMS()
+        # None when no master key configured: SSE-S3 PUTs are rejected
+        # rather than sealed under a publicly-known key (ADVICE r2).
+        self.kms = kms if kms is not None else kms_from_env()
         self.compress_enabled = compress_enabled
         self.tier_mgr = tier_mgr          # bucket.tier.TierManager
 
@@ -139,7 +141,7 @@ class S3Handlers:
         if sse.is_encrypted(fi.metadata):
             try:
                 data = sse.decrypt_for_get(data, fi.metadata, headers,
-                                           self.kms)
+                                           self.kms, bucket, key)
             except sse.SSEError as e:
                 raise S3Error("AccessDenied", str(e)) from None
         data = cz.decompress(data, fi.metadata)
@@ -663,7 +665,8 @@ class S3Handlers:
             stored, cu = cz.compress(stored)
             transform_meta.update(cu)
         try:
-            stored, su = sse.encrypt_for_put(stored, h, self.kms)
+            stored, su = sse.encrypt_for_put(stored, h, self.kms,
+                                             bucket, key)
         except sse.SSEError as e:
             raise S3Error("InvalidArgument", str(e)) from None
         transform_meta.update(su)
@@ -705,8 +708,68 @@ class S3Handlers:
         metadata = dict(fi.metadata)
         metadata.pop("etag", None)
         if h.get("x-amz-metadata-directive", "COPY") == "REPLACE":
+            # REPLACE swaps the USER metadata only; the internal
+            # transform keys (compression marker, SSE envelope, client
+            # size) describe the stored bytes being copied and must ride
+            # along or the copy is unreadable.
             metadata = {k: v for k, v in h.items()
                         if k.startswith(AMZ_META_PREFIX)}
+            metadata.update({k: v for k, v in fi.metadata.items()
+                             if k.startswith("x-mtpu-internal-")})
+        from ..crypto import sse
+        src_algo = fi.metadata.get(sse.META_ALGO, "")
+        try:
+            dst_wants_sse = (sse.parse_ssec_key(h) is not None
+                             or h.get(sse.H_SSE, "") in ("AES256",
+                                                         "aws:kms"))
+        except sse.SSEError as e:
+            raise S3Error("InvalidArgument", str(e)) from None
+        if src_algo or dst_wants_sse:
+            # Ciphertext can't be copied verbatim (SSE-C sealing keys
+            # are bound to the source path; a dest SSE request needs a
+            # fresh seal), so run the full decrypt -> re-encrypt cycle
+            # (cf. CopyObject SSE handling, cmd/object-handlers.go
+            # CopyObjectHandler).  The SSE-C source key arrives in
+            # x-amz-copy-source-...-customer-* headers.
+            src_h = {
+                sse.H_SSEC_ALGO: h.get(
+                    "x-amz-copy-source-server-side-encryption-"
+                    "customer-algorithm", ""),
+                sse.H_SSEC_KEY: h.get(
+                    "x-amz-copy-source-server-side-encryption-"
+                    "customer-key", ""),
+                sse.H_SSEC_MD5: h.get(
+                    "x-amz-copy-source-server-side-encryption-"
+                    "customer-key-md5", ""),
+            }
+            try:
+                data = sse.decrypt_for_get(data, fi.metadata, src_h,
+                                           self.kms, src_bucket, src_key)
+            except sse.SSEError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+            for mk in (sse.META_ALGO, sse.META_KEY_MD5, sse.META_SSEC_IV,
+                       sse.META_KMS_KEY_ID, sse.META_SEALED_KEY,
+                       sse.META_ACTUAL_SIZE):
+                metadata.pop(mk, None)
+            eff_h = dict(h)
+            if src_algo == "SSE-S3" and not dst_wants_sse:
+                # AWS preserves SSE-S3 across copies unless the request
+                # says otherwise.
+                eff_h[sse.H_SSE] = "AES256"
+            stored_plain_len = len(data)   # post-compression plaintext
+            try:
+                data, su = sse.encrypt_for_put(data, eff_h, self.kms,
+                                               bucket, key)
+            except sse.SSEError as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            metadata.update(su)
+            compressed = bool(metadata.get("x-mtpu-internal-compression"))
+            if su and not compressed:
+                # client size = pre-seal length (sealing inflates the
+                # stored bytes; GET must announce the plaintext size)
+                metadata[self.CLIENT_SIZE_KEY] = str(stored_plain_len)
+            elif not su and not compressed:
+                metadata.pop(self.CLIENT_SIZE_KEY, None)
         versioned = self.bucket_versioning_enabled(bucket)
         try:
             out = self.pools.put_object(bucket, key, data, metadata=metadata,

@@ -98,6 +98,24 @@ def check_post_policy(policy_b64: bytes, fields: dict,
                     raise S3Error("EntityTooLarge"
                                   if file_size > hi else "EntityTooSmall")
 
+    # Every x-amz-* form field the client submitted must be covered by a
+    # policy condition — otherwise a signed policy could be replayed with
+    # extra metadata the signer never approved (cf. checkPostPolicy,
+    # cmd/postpolicyform.go: unknown x-amz-* input rejected).
+    declared: set[str] = set()
+    for cond in doc.get("conditions", []):
+        if isinstance(cond, dict):
+            declared.update(k.lower() for k in cond)
+        elif isinstance(cond, list) and len(cond) == 3:
+            declared.add(str(cond[1]).lstrip("$").lower())
+    exempt = {"x-amz-signature", "x-amz-algorithm"}
+    for name in fields:
+        low = name.lower()
+        if low.startswith("x-amz-") and low not in declared \
+                and low not in exempt:
+            raise S3Error("AccessDenied",
+                          f"form field {name} not declared in policy")
+
 
 def verify_post_signature(creds_lookup, fields: dict) -> str:
     """SigV4 POST signature: HMAC chain over the base64 policy.

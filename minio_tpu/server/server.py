@@ -311,7 +311,7 @@ class S3Server:
                     from ..iam.policy import Policy, PolicyError
                     try:
                         if Policy(data.decode()).is_allowed(
-                                action, resource, ctx):
+                                action, resource, ctx, principal="*"):
                             return
                     except (PolicyError, ValueError):
                         pass
@@ -668,7 +668,8 @@ class S3Server:
                     return False
                 action = ("s3:DeleteObjectVersion" if version_id
                           else "s3:DeleteObject")
-                return pol_obj.is_allowed(action, f"{bucket}/{key}")
+                return pol_obj.is_allowed(action, f"{bucket}/{key}",
+                                          principal="*")
             return can_anon
         if self.iam is None:
             return lambda key, version_id: False

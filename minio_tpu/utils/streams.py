@@ -1,0 +1,146 @@
+"""Streaming body plumbing: bounded readers for the O(batch) data path.
+
+The role of the reference's reader stack (hash.Reader internal/hash/
+reader.go:63, http chunked/aws-chunked decoding, GetObjectReader
+cmd/object-api-utils.go:392-528): request bodies flow from the socket to
+the erasure encoder in bounded chunks, with content hashes verified at
+EOF instead of after buffering the whole object, and responses flow back
+as an iterator of assembled ranges.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def is_reader(x) -> bool:
+    """Anything with .read(n) that is not already bytes-like."""
+    return (not isinstance(x, (bytes, bytearray, memoryview))
+            and hasattr(x, "read"))
+
+
+def ensure_bytes(x) -> bytes:
+    """Drain a reader (compat path for non-streaming backends)."""
+    if isinstance(x, (bytes, bytearray, memoryview)):
+        return bytes(x)
+    out = bytearray()
+    while True:
+        piece = x.read(1 << 20)
+        if not piece:
+            return bytes(out)
+        out += piece
+
+
+class BytesReader:
+    """bytes -> reader (tests, adapters)."""
+
+    def __init__(self, data: bytes):
+        self._mv = memoryview(data)
+        self._pos = 0
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            n = len(self._mv) - self._pos
+        out = self._mv[self._pos:self._pos + n]
+        self._pos += len(out)
+        return bytes(out)
+
+
+class LimitedReader:
+    """Reads exactly `limit` bytes from `raw` then reports EOF; a short
+    source raises IOError (truncated body)."""
+
+    def __init__(self, raw, limit: int):
+        self._raw = raw
+        self._left = limit
+
+    def read(self, n: int = -1) -> bytes:
+        if self._left <= 0:
+            return b""
+        if n is None or n < 0:
+            n = self._left
+        piece = self._raw.read(min(n, self._left))
+        if not piece and self._left:
+            raise IOError(f"body truncated ({self._left} bytes short)")
+        self._left -= len(piece)
+        return piece
+
+
+class HashVerifyReader:
+    """Pass-through reader that verifies the stream's SHA-256 at EOF
+    (the hash.Reader role, internal/hash/reader.go:63).  `on_mismatch`
+    is the exception type raised."""
+
+    def __init__(self, src, want_sha256_hex: str, exc=IOError):
+        self._src = src
+        self._want = want_sha256_hex
+        self._h = hashlib.sha256()
+        self._exc = exc
+        self._done = False
+
+    def read(self, n: int = -1) -> bytes:
+        piece = self._src.read(n)
+        if piece:
+            self._h.update(piece)
+        elif not self._done:
+            self._done = True
+            if self._h.hexdigest() != self._want:
+                raise self._exc("content sha256 mismatch")
+        return piece
+
+
+class HTTPChunkedReader:
+    """Streaming decoder for HTTP/1.1 chunked transfer encoding (not
+    aws-chunked — that is sigv4.StreamingBodyReader's job)."""
+
+    def __init__(self, rfile):
+        self._rf = rfile
+        self._chunk_left = 0
+        self._eof = False
+
+    def _next_chunk(self) -> None:
+        line = self._rf.readline().strip()
+        self._chunk_left = int(line.split(b";")[0], 16)
+        if self._chunk_left == 0:
+            self._rf.readline()          # trailing CRLF
+            self._eof = True
+
+    def read(self, n: int = -1) -> bytes:
+        if self._eof:
+            return b""
+        out = bytearray()
+        while n < 0 or len(out) < n:
+            if self._chunk_left == 0:
+                self._next_chunk()
+                if self._eof:
+                    break
+            want = self._chunk_left if n < 0 \
+                else min(self._chunk_left, n - len(out))
+            piece = self._rf.read(want)
+            if not piece:
+                raise IOError("truncated chunked body")
+            out += piece
+            self._chunk_left -= len(piece)
+            if self._chunk_left == 0:
+                self._rf.read(2)         # chunk CRLF
+        return bytes(out)
+
+
+def batched_chunks(head: bytes, stream, chunk_len: int):
+    """Yield (chunk, is_last) with every chunk exactly chunk_len bytes
+    except the final one (which may be empty when the total length is an
+    exact multiple).  `head` is bytes already consumed from `stream`."""
+    buf = bytearray(head)
+    eof = stream is None
+    while True:
+        while not eof and len(buf) < chunk_len:
+            piece = stream.read(chunk_len - len(buf))
+            if not piece:
+                eof = True
+            else:
+                buf += piece
+        if eof and len(buf) <= chunk_len:
+            yield bytes(buf), True       # final chunk (may be empty)
+            return
+        yield bytes(buf[:chunk_len]), False
+        del buf[:chunk_len]

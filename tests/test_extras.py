@@ -104,6 +104,18 @@ class TestPostUpload:
         conn.close()
         assert resp.status == 403
 
+    def test_undeclared_amz_field_rejected(self, stack):
+        # ADVICE r2: extra x-amz-meta-* fields not covered by a policy
+        # condition must be rejected (cf. checkPostPolicy).
+        srv, cli = stack
+        cli.make_bucket("forms")
+
+        def tamper(fields):
+            fields["x-amz-meta-sneaky"] = b"injected"
+        status, out = self._post(srv, cli, "forms", "up/y", b"y",
+                                 tamper=tamper)
+        assert status == 403, out
+
 
 class TestSnowball:
     def test_tar_auto_extract(self, stack):

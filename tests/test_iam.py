@@ -238,3 +238,114 @@ class TestSecurityRegressions:
         assert not p.is_allowed("s3:GetObject", "b/k",
                                 {"aws:SourceIp": "10.1.120.55"})
         assert not p.is_allowed("s3:GetObject", "b/k", {})
+
+
+class TestAdviceR2Policy:
+    """Regression tests for the round-2 advisor findings on the policy
+    engine: Principal matching and strict condition-operator parsing."""
+
+    def test_anonymous_requires_principal_star(self):
+        # An Allow without any Principal must not grant anonymous access
+        # when evaluated as a resource (bucket) policy.
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::b/*"}]})
+        assert not p.is_allowed("s3:GetObject", "b/k", principal="*")
+        # identity-policy evaluation (principal=None) is unaffected
+        assert p.is_allowed("s3:GetObject", "b/k")
+
+    def test_principal_star_grants_anonymous(self):
+        for principal_elem in ("*", {"AWS": "*"}, {"AWS": ["*"]}):
+            p = pol.Policy({"Statement": [{
+                "Effect": "Allow", "Principal": principal_elem,
+                "Action": "s3:GetObject",
+                "Resource": "arn:aws:s3:::b/*"}]})
+            assert p.is_allowed("s3:GetObject", "b/k", principal="*")
+
+    def test_principal_named_user_not_anonymous(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow",
+            "Principal": {"AWS": "arn:aws:iam:::user/alice"},
+            "Action": "s3:GetObject", "Resource": "arn:aws:s3:::b/*"}]})
+        assert not p.is_allowed("s3:GetObject", "b/k", principal="*")
+        assert p.is_allowed("s3:GetObject", "b/k", principal="alice")
+        assert not p.is_allowed("s3:GetObject", "b/k", principal="bob")
+
+    def test_unknown_condition_operator_rejected_at_parse(self):
+        with pytest.raises(pol.PolicyError):
+            pol.Policy({"Statement": [{
+                "Effect": "Deny", "Action": "s3:*",
+                "Resource": "arn:aws:s3:::*",
+                "Condition": {"ArnNotLike":
+                              {"aws:PrincipalArn": "arn:aws:iam::*"}}}]})
+
+    def test_string_not_like(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:ListBucket",
+            "Resource": "arn:aws:s3:::b",
+            "Condition": {"StringNotLike": {"s3:prefix": ["secret/*"]}}}]})
+        assert p.is_allowed("s3:ListBucket", "b", {"s3:prefix": "pub/x"})
+        assert not p.is_allowed("s3:ListBucket", "b",
+                                {"s3:prefix": "secret/x"})
+
+    def test_bad_principal_kind_rejected(self):
+        with pytest.raises(pol.PolicyError):
+            pol.Policy({"Statement": [{
+                "Effect": "Allow", "Principal": {"Service": "ec2"},
+                "Action": "s3:GetObject", "Resource": "arn:aws:s3:::b/*"}]})
+
+    def test_principalless_deny_still_binds_anonymous(self):
+        # A Deny without Principal must not be voided in resource-policy
+        # evaluation (that would fail open).
+        p = pol.Policy({"Statement": [
+            {"Effect": "Allow", "Principal": "*", "Action": "s3:*",
+             "Resource": "arn:aws:s3:::b/*"},
+            {"Effect": "Deny", "Action": "s3:DeleteObject",
+             "Resource": "arn:aws:s3:::b/*"}]})
+        assert p.is_allowed("s3:GetObject", "b/k", principal="*")
+        assert not p.is_allowed("s3:DeleteObject", "b/k", principal="*")
+
+    def test_not_principal_rejected(self):
+        with pytest.raises(pol.PolicyError):
+            pol.Policy({"Statement": [{
+                "Effect": "Deny", "NotPrincipal": {"AWS": "alice"},
+                "Action": "s3:*", "Resource": "arn:aws:s3:::b/*"}]})
+
+    def test_bool_numeric_date_conditions(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::b/*",
+            "Condition": {
+                "Bool": {"aws:SecureTransport": "true"},
+                "NumericLessThanEquals": {"s3:max-keys": "100"},
+                "DateGreaterThan":
+                    {"aws:CurrentTime": "2020-01-01T00:00:00Z"}}}]})
+        ok = {"aws:SecureTransport": "true", "s3:max-keys": "50",
+              "aws:CurrentTime": "2024-06-01T00:00:00Z"}
+        assert p.is_allowed("s3:GetObject", "b/k", ok)
+        assert not p.is_allowed("s3:GetObject", "b/k",
+                                {**ok, "aws:SecureTransport": "false"})
+        assert not p.is_allowed("s3:GetObject", "b/k",
+                                {**ok, "s3:max-keys": "500"})
+        assert not p.is_allowed(
+            "s3:GetObject", "b/k",
+            {**ok, "aws:CurrentTime": "2019-01-01T00:00:00Z"})
+
+    def test_empty_condition_values_rejected_at_parse(self):
+        for cond in ({"Bool": {"aws:SecureTransport": []}},
+                     {"NumericLessThan": {"s3:max-keys": []}},
+                     {"StringEquals": "notadict"}):
+            with pytest.raises(pol.PolicyError):
+                pol.Policy({"Statement": [{
+                    "Effect": "Allow", "Action": "s3:*",
+                    "Resource": "arn:aws:s3:::b/*", "Condition": cond}]})
+
+    def test_numeric_ordering_any_value_matches(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:ListBucket",
+            "Resource": "arn:aws:s3:::b",
+            "Condition": {"NumericLessThan":
+                          {"s3:max-keys": ["10", "1000"]}}}]})
+        assert p.is_allowed("s3:ListBucket", "b", {"s3:max-keys": "500"})
+        assert not p.is_allowed("s3:ListBucket", "b",
+                                {"s3:max-keys": "5000"})

@@ -284,3 +284,144 @@ class TestSelectEndToEnd:
                                              "select-type": "2"},
                                       body=req)
         assert status == 400 and b"SelectParseError" in body
+
+
+class TestAdviceR2Crypto:
+    """Round-2 advisor regressions: no zero-key KMS fallback, SSE-C
+    per-object key derivation."""
+
+    def test_kms_refuses_missing_and_zero_key(self, monkeypatch):
+        monkeypatch.delenv("MTPU_KMS_SECRET_KEY", raising=False)
+        with pytest.raises(KMSError):
+            StaticKMS()
+        with pytest.raises(KMSError):
+            StaticKMS(b"\x00" * 32)
+        from minio_tpu.crypto.kms import kms_from_env
+        assert kms_from_env() is None
+        monkeypatch.setenv("MTPU_KMS_SECRET_KEY", "11" * 32)
+        assert kms_from_env() is not None
+
+    def test_sse_s3_rejected_without_kms(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("MTPU_KMS_SECRET_KEY", raising=False)
+        drives = [LocalDrive(str(tmp_path / f"nd{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        srv = S3Server(pools, Credentials(ROOT, SECRET)).start()
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("nokms")
+            with pytest.raises(S3ClientError) as ei:
+                cli.put_object("nokms", "x", b"data", headers={
+                    "x-amz-server-side-encryption": "AES256"})
+            assert ei.value.code == "InvalidArgument"
+            # plain PUT still fine
+            cli.put_object("nokms", "y", b"data")
+            assert cli.get_object("nokms", "y") == b"data"
+        finally:
+            srv.shutdown()
+
+    def test_ssec_per_object_key_derivation(self):
+        ck = b"\x07" * 32
+        h = ssec_headers(ck)
+        s1, m1 = sse.encrypt_for_put(b"same plaintext", h, None, "b", "k1")
+        s2, m2 = sse.encrypt_for_put(b"same plaintext", h, None, "b", "k2")
+        assert m1[sse.META_SSEC_IV] != m2[sse.META_SSEC_IV]
+        # sealed under derived keys: raw customer key cannot unseal
+        with pytest.raises(sse.SSEError):
+            sse.unseal(s1, ck)
+        assert sse.decrypt_for_get(s1, m1, h, None, "b", "k1") \
+            == b"same plaintext"
+        # wrong object path -> wrong derived key
+        with pytest.raises(sse.SSEError):
+            sse.decrypt_for_get(s1, m1, h, None, "b", "k2")
+
+    def test_ssec_legacy_object_without_iv_still_readable(self):
+        ck = b"\x09" * 32
+        h = ssec_headers(ck)
+        # simulate a pre-derivation object: sealed directly with ck
+        blob = sse.seal(b"old object", ck)
+        meta = {sse.META_ALGO: "SSE-C",
+                sse.META_KEY_MD5: base64.b64encode(
+                    hashlib.md5(ck).digest()).decode()}
+        assert sse.decrypt_for_get(blob, meta, h, None, "b", "k") \
+            == b"old object"
+
+    def test_ssec_copy_object_reencrypts(self, stack):
+        # CopyObject of an SSE-C object: the sealing key is bound to the
+        # source path, so the server must decrypt with the copy-source
+        # key headers and re-encrypt for the destination.
+        srv, cli = stack
+        cli.make_bucket("cpy")
+        ck = b"\x33" * 32
+        h = ssec_headers(ck)
+        cli.put_object("cpy", "src", b"copy me sealed", headers=h)
+        copy_h = {
+            "x-amz-copy-source": "/cpy/src",
+            "x-amz-copy-source-server-side-encryption-customer-algorithm":
+                "AES256",
+            "x-amz-copy-source-server-side-encryption-customer-key":
+                base64.b64encode(ck).decode(),
+            "x-amz-copy-source-server-side-encryption-customer-key-md5":
+                base64.b64encode(hashlib.md5(ck).digest()).decode(),
+            **h,   # destination sealed under the same customer key
+        }
+        cli._check(*cli.request("PUT", "/cpy/dst", headers=copy_h))
+        st, _, data = cli.request("GET", "/cpy/dst", headers=h)
+        assert st == 200 and data == b"copy me sealed"
+        # without the source key the copy must fail, not produce garbage
+        st2, _, _ = cli.request(
+            "PUT", "/cpy/dst2", headers={"x-amz-copy-source": "/cpy/src"})
+        assert st2 == 403
+
+    def test_ssec_copy_to_plaintext(self, stack):
+        srv, cli = stack
+        cli.make_bucket("cpy2")
+        ck = b"\x44" * 32
+        cli.put_object("cpy2", "src", b"sealed source",
+                       headers=ssec_headers(ck))
+        copy_h = {
+            "x-amz-copy-source": "/cpy2/src",
+            "x-amz-copy-source-server-side-encryption-customer-algorithm":
+                "AES256",
+            "x-amz-copy-source-server-side-encryption-customer-key":
+                base64.b64encode(ck).decode(),
+            "x-amz-copy-source-server-side-encryption-customer-key-md5":
+                base64.b64encode(hashlib.md5(ck).digest()).decode(),
+        }
+        cli._check(*cli.request("PUT", "/cpy2/plain", headers=copy_h))
+        assert cli.get_object("cpy2", "plain") == b"sealed source"
+
+    def test_copy_plaintext_to_ssec_destination(self, stack):
+        # Dest SSE headers on a copy of a PLAINTEXT source must be
+        # honored, not silently dropped.
+        srv, cli = stack
+        cli.make_bucket("cpy3")
+        cli.put_object("cpy3", "plain", b"to be sealed")
+        ck = b"\x66" * 32
+        h = ssec_headers(ck)
+        cli._check(*cli.request(
+            "PUT", "/cpy3/sealed",
+            headers={"x-amz-copy-source": "/cpy3/plain", **h}))
+        # keyless GET refused; keyed GET round-trips
+        st, _, _ = cli.request("GET", "/cpy3/sealed")
+        assert st == 403
+        st2, _, data = cli.request("GET", "/cpy3/sealed", headers=h)
+        assert st2 == 200 and data == b"to be sealed"
+
+    def test_copy_preserves_sse_s3(self, stack):
+        srv, cli = stack
+        cli.make_bucket("cpy4")
+        cli.put_object("cpy4", "src", b"kms sealed",
+                       headers={"x-amz-server-side-encryption": "AES256"})
+        cli._check(*cli.request(
+            "PUT", "/cpy4/dst",
+            headers={"x-amz-copy-source": "/cpy4/src"}))
+        _, hh, data = cli._check(*cli.request("GET", "/cpy4/dst"))
+        assert data == b"kms sealed"
+        assert hh.get("x-amz-server-side-encryption") == "AES256"
+
+    def test_zero_key_escape_hatch_is_explicit(self):
+        with pytest.raises(KMSError):
+            StaticKMS(b"\x00" * 32)
+        k = StaticKMS(b"\x00" * 32, allow_insecure_zero_key=True)
+        kid, plain, sealed = k.generate_data_key()
+        assert k.decrypt_data_key(kid, sealed) == plain
