@@ -211,7 +211,14 @@ class ErasureSet:
         stream = None
         if streams.is_reader(data):
             stream = data
-            head = stream.read(SMALL_FILE_THRESHOLD + 1)
+            # Loop: a reader may legally return short reads before EOF.
+            head = bytearray()
+            while len(head) <= SMALL_FILE_THRESHOLD:
+                piece = stream.read(SMALL_FILE_THRESHOLD + 1 - len(head))
+                if not piece:
+                    break
+                head += piece
+            head = bytes(head)
             if len(head) <= SMALL_FILE_THRESHOLD:
                 data, stream = head, None
             else:
@@ -270,49 +277,55 @@ class ErasureSet:
                 total += len(chunk)
                 yield chunk, is_last
 
-        for batch_shards in self._encode_chunks(counted_chunks(), k,
-                                                parity, algo):
-            # batch_shards: list of n framed byte strings in SHARD order.
-            per_drive = Q.unshuffle_to_drives(batch_shards, distribution)
+        # try/finally: a reader that raises mid-stream (client
+        # disconnect, truncated body, hash mismatch at EOF) must not
+        # leak per-drive staging files — they only get swept again at
+        # drive startup.
+        try:
+            for batch_shards in self._encode_chunks(counted_chunks(), k,
+                                                    parity, algo):
+                # batch_shards: n framed byte strings in SHARD order.
+                per_drive = Q.unshuffle_to_drives(batch_shards,
+                                                  distribution)
 
-            def write_one(pos):
+                def write_one(pos):
+                    d = self.drives[pos]
+                    if d is None or failed[pos]:
+                        return
+                    d.append_file(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.1",
+                                  per_drive[pos])
+
+                futures = [self.pool.submit(write_one, pos)
+                           for pos in range(self.n)]
+                for pos, fut in enumerate(futures):
+                    try:
+                        fut.result()
+                    except Exception:  # noqa: BLE001
+                        failed[pos] = True
+                if sum(1 for f in failed if not f) < write_quorum:
+                    raise ErrErasureWriteQuorum(
+                        f"{self.n - sum(failed)} < {write_quorum}")
+
+            if stream is not None:
+                sizeref["size"] = total
+                meta.setdefault("etag", md5.hexdigest())
+
+            def publish(pos):
                 d = self.drives[pos]
                 if d is None or failed[pos]:
-                    return
-                d.append_file(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.1",
-                              per_drive[pos])
+                    raise ErrDiskNotFound("offline/failed")
+                d.rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}",
+                              fi_for(pos, data_dir, None), bucket, obj)
 
-            futures = [self.pool.submit(write_one, pos)
-                       for pos in range(self.n)]
-            for pos, fut in enumerate(futures):
-                try:
-                    fut.result()
-                except Exception:  # noqa: BLE001
-                    failed[pos] = True
-            if sum(1 for f in failed if not f) < write_quorum:
-                self._cleanup_tmp(tmp_id)
-                raise ErrErasureWriteQuorum(
-                    f"{self.n - sum(failed)} < {write_quorum}")
-
-        if stream is not None:
-            sizeref["size"] = total
-            meta.setdefault("etag", md5.hexdigest())
-
-        def publish(pos):
-            d = self.drives[pos]
-            if d is None or failed[pos]:
-                raise ErrDiskNotFound("offline/failed")
-            d.rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}", fi_for(pos, data_dir, None),
-                          bucket, obj)
-
-        res = self._map_drives_positions(publish)
-        errs = [e for _, e in res]
-        err = Q.reduce_write_quorum_errs(errs, write_quorum)
-        # Always sweep staging: drives that failed mid-stream (or failed
-        # publish) still hold their partial tmp shard files.
-        self._cleanup_tmp(tmp_id)
-        if err is not None:
-            raise err
+            res = self._map_drives_positions(publish)
+            errs = [e for _, e in res]
+            err = Q.reduce_write_quorum_errs(errs, write_quorum)
+            if err is not None:
+                raise err
+        finally:
+            # Always sweep staging: publish renames the winners away;
+            # failed/partial drives still hold tmp shard files.
+            self._cleanup_tmp(tmp_id)
         fi = fi_for(0, data_dir, None)
         # Partial success (quorum met, some drives failed): queue for MRF
         # heal so the stripe returns to full width without waiting for

@@ -24,7 +24,7 @@ from ..storage.errors import (ErrErasureWriteQuorum, ErrFileNotFound,
                               ErrPathNotFound, StorageError)
 from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo,
                               XLMeta, new_uuid)
-from ..utils import msgpackx
+from ..utils import msgpackx, streams
 from . import quorum as Q
 from .erasure_set import BLOCK_SIZE, ErasureSet
 
@@ -112,65 +112,89 @@ def _read_upload_fi(es: ErasureSet, bucket: str, obj: str,
 
 
 def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
-                    part_number: int, data: bytes) -> ObjectPartInfo:
+                    part_number: int, data) -> ObjectPartInfo:
     """Encode one part as its own EC stream into the upload's staging dir
-    (cf. PutObjectPart, erasure-multipart.go:400)."""
+    (cf. PutObjectPart, erasure-multipart.go:400).  `data` is bytes or a
+    reader — a reader streams through encode in O(batch) memory exactly
+    like ErasureSet.put_object."""
     if not 1 <= part_number <= MAX_PARTS:
         raise ErrInvalidPart(f"part number {part_number}")
     fi = _read_upload_fi(es, bucket, obj, upload_id)
     ec = fi.erasure
     k, m = ec.data_blocks, ec.parity_blocks
     path = _upload_path(bucket, obj, upload_id)
-    etag = hashlib.md5(data).hexdigest()
     write_quorum = k + (1 if k == m else 0)
+
+    stream = None
+    if streams.is_reader(data):
+        stream, data = data, b""
 
     # Stage under a unique name then rename into place, so a concurrent
     # re-upload of the same part can't interleave appends.
     stage = f"{path}/stage-{uuid.uuid4().hex}.{part_number}"
     algo = bitrot_io.write_algo()
     failed = [d is None for d in es.drives]
-    for batch_shards in es._encode_stream(data, k, m, algo):
-        per_drive = Q.unshuffle_to_drives(batch_shards, ec.distribution)
+    md5 = hashlib.md5()
+    total = 0
 
-        def write_one(pos):
+    def counted_chunks():
+        nonlocal total
+        from ..engine.erasure_set import BATCH_BLOCKS, BLOCK_SIZE
+        for chunk, is_last in streams.batched_chunks(
+                data, stream, BATCH_BLOCKS * BLOCK_SIZE):
+            md5.update(chunk)
+            total += len(chunk)
+            yield chunk, is_last
+
+    try:
+        for batch_shards in es._encode_chunks(counted_chunks(), k, m,
+                                              algo):
+            per_drive = Q.unshuffle_to_drives(batch_shards,
+                                              ec.distribution)
+
+            def write_one(pos):
+                d = es.drives[pos]
+                if d is None or failed[pos]:
+                    return
+                d.append_file(SYS_VOL, stage, per_drive[pos])
+
+            futures = [es.pool.submit(write_one, pos)
+                       for pos in range(es.n)]
+            for pos, fut in enumerate(futures):
+                try:
+                    fut.result()
+                except Exception:  # noqa: BLE001
+                    failed[pos] = True
+            if sum(1 for f in failed if not f) < write_quorum:
+                raise ErrErasureWriteQuorum(
+                    f"{es.n - sum(failed)} < {write_quorum}")
+
+        etag = md5.hexdigest()
+        part_meta = msgpackx.packb({
+            "n": part_number, "etag": etag, "size": total,
+            "as": total, "mt": time.time_ns(), "algo": algo})
+
+        def publish(pos):
             d = es.drives[pos]
             if d is None or failed[pos]:
-                return
-            d.append_file(SYS_VOL, stage, per_drive[pos])
+                raise ErrFileNotFound("offline/failed")
+            if total == 0:
+                d.create_file(SYS_VOL, f"{path}/part.{part_number}", b"")
+            else:
+                d.rename_file(SYS_VOL, stage, SYS_VOL,
+                              f"{path}/part.{part_number}")
+            d.write_all(SYS_VOL, f"{path}/part.{part_number}.meta",
+                        part_meta)
 
-        futures = [es.pool.submit(write_one, pos) for pos in range(es.n)]
-        for pos, fut in enumerate(futures):
-            try:
-                fut.result()
-            except Exception:  # noqa: BLE001
-                failed[pos] = True
-        if sum(1 for f in failed if not f) < write_quorum:
-            _cleanup_stage(es, stage)
-            raise ErrErasureWriteQuorum(
-                f"{es.n - sum(failed)} < {write_quorum}")
-
-    part_meta = msgpackx.packb({
-        "n": part_number, "etag": etag, "size": len(data),
-        "as": len(data), "mt": time.time_ns(), "algo": algo})
-
-    def publish(pos):
-        d = es.drives[pos]
-        if d is None or failed[pos]:
-            raise ErrFileNotFound("offline/failed")
-        if len(data) == 0:
-            d.create_file(SYS_VOL, f"{path}/part.{part_number}", b"")
-        else:
-            d.rename_file(SYS_VOL, stage, SYS_VOL,
-                          f"{path}/part.{part_number}")
-        d.write_all(SYS_VOL, f"{path}/part.{part_number}.meta", part_meta)
-
-    res = es._map_drives_positions(publish)
-    err = Q.reduce_write_quorum_errs([e for _, e in res], write_quorum)
-    _cleanup_stage(es, stage)
-    if err is not None:
-        raise err
-    return ObjectPartInfo(number=part_number, size=len(data),
-                          actual_size=len(data), etag=etag)
+        res = es._map_drives_positions(publish)
+        err = Q.reduce_write_quorum_errs([e for _, e in res],
+                                         write_quorum)
+        if err is not None:
+            raise err
+    finally:
+        _cleanup_stage(es, stage)
+    return ObjectPartInfo(number=part_number, size=total,
+                          actual_size=total, etag=etag)
 
 
 def _cleanup_stage(es: ErasureSet, stage: str) -> None:

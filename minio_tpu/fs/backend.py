@@ -92,21 +92,29 @@ class FSObjectLayer:
         tmp = path + f".tmp-{uuid.uuid4().hex}"
         md5 = hashlib.md5()
         size = 0
-        with open(tmp, "wb") as f:
-            if streams.is_reader(data):
-                while True:
-                    piece = data.read(1 << 20)
-                    if not piece:
-                        break
-                    md5.update(piece)
-                    size += len(piece)
-                    f.write(piece)
-            else:
-                md5.update(data)
-                size = len(data)
-                f.write(data)
-        meta.setdefault("etag", md5.hexdigest())
-        os.replace(tmp, path)                     # atomic publish
+        try:
+            with open(tmp, "wb") as f:
+                if streams.is_reader(data):
+                    while True:
+                        piece = data.read(1 << 20)
+                        if not piece:
+                            break
+                        md5.update(piece)
+                        size += len(piece)
+                        f.write(piece)
+                else:
+                    md5.update(data)
+                    size = len(data)
+                    f.write(data)
+            meta.setdefault("etag", md5.hexdigest())
+            os.replace(tmp, path)                 # atomic publish
+        except BaseException:
+            # a reader that errors mid-stream must not leak staging
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         fi = FileInfo(volume=bucket, name=obj, version_id="",
                       mod_time_ns=time.time_ns(), size=size,
                       metadata=meta)
@@ -223,8 +231,9 @@ class FSObjectLayer:
             raise ErrUploadNotFound(upload_id) from None
 
     def put_object_part(self, bucket: str, obj: str, upload_id: str,
-                        part_number: int, data: bytes) -> ObjectPartInfo:
+                        part_number: int, data) -> ObjectPartInfo:
         self._mp_info(bucket, upload_id)
+        data = streams.ensure_bytes(data)
         etag = hashlib.md5(data).hexdigest()
         with open(os.path.join(self._mp_dir(bucket, upload_id),
                                f"part.{part_number}"), "wb") as f:

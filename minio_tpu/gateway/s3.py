@@ -62,9 +62,11 @@ class S3Gateway:
 
     # -- objects -------------------------------------------------------------
 
-    def put_object(self, bucket: str, obj: str, data: bytes, *,
+    def put_object(self, bucket: str, obj: str, data, *,
                    metadata: dict | None = None, versioned: bool = False,
                    parity=None) -> FileInfo:
+        from ..utils.streams import ensure_bytes
+        data = ensure_bytes(data)
         headers = {}
         meta = dict(metadata or {})
         for k, v in meta.items():
@@ -73,9 +75,7 @@ class S3Gateway:
         if "content-type" in meta:
             headers["Content-Type"] = meta["content-type"]
         try:
-            from ..utils.streams import ensure_bytes
-            resp = self.cli.put_object(bucket, obj, ensure_bytes(data),
-                                       headers=headers)
+            resp = self.cli.put_object(bucket, obj, data, headers=headers)
         except S3ClientError as e:
             raise _map_err(e) from None
         meta.setdefault("etag",

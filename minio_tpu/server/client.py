@@ -61,6 +61,55 @@ class S3Client:
         finally:
             conn.close()
 
+    def put_object_stream(self, bucket: str, key: str, reader, size: int,
+                          headers: dict[str, str] | None = None) -> dict:
+        """Streamed PUT: body is a .read(n) reader sent with
+        Content-Length and an UNSIGNED-PAYLOAD signature — the body
+        never materializes client- or server-side."""
+        path = f"/{bucket}/{key}"
+        headers = dict(headers or {})
+        headers["Host"] = f"{self.host}:{self.port}"
+        headers["Content-Length"] = str(size)
+        auth = sign_request(self.creds, "PUT", path, {}, headers,
+                            "UNSIGNED-PAYLOAD")
+        headers.update(auth)
+        wire_path = urllib.parse.quote(path, safe="/~-._")
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=120)
+        try:
+            conn.request("PUT", wire_path, body=reader, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            _, h, _ = self._check(resp.status, dict(resp.getheaders()),
+                                  data)
+            return h
+        finally:
+            conn.close()
+
+    def get_object_stream(self, bucket: str, key: str,
+                          chunk_size: int = 1 << 20):
+        """Streamed GET: yields body chunks as they arrive."""
+        path = f"/{bucket}/{key}"
+        headers = {"Host": f"{self.host}:{self.port}"}
+        auth = sign_request(self.creds, "GET", path, {}, headers, b"")
+        headers.update(auth)
+        wire_path = urllib.parse.quote(path, safe="/~-._")
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=120)
+        try:
+            conn.request("GET", wire_path, headers=headers)
+            resp = conn.getresponse()
+            if resp.status not in (200, 206):
+                body = resp.read()
+                self._check(resp.status, dict(resp.getheaders()), body)
+            while True:
+                piece = resp.read(chunk_size)
+                if not piece:
+                    return
+                yield piece
+        finally:
+            conn.close()
+
     def _check(self, status, headers, data, ok=(200, 204, 206)):
         if status in ok:
             return status, headers, data

@@ -56,9 +56,13 @@ S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
 
 class Response:
     def __init__(self, status: int = 200, body: bytes = b"",
-                 headers: dict[str, str] | None = None):
+                 headers: dict[str, str] | None = None,
+                 body_iter=None):
+        """body_iter: optional iterator of byte chunks streamed to the
+        client instead of `body`; headers must carry Content-Length."""
         self.status = status
         self.body = body
+        self.body_iter = body_iter
         self.headers = headers or {}
 
 
@@ -512,6 +516,7 @@ class S3Handlers:
                 offset, length = parsed
                 partial = True
         data = b""
+        body_iter = None
         if not head:
             if transformed:
                 # Ranged reads on transformed objects decode the whole
@@ -522,9 +527,16 @@ class S3Handlers:
                                                 headers)
                 data = full[offset:offset + length]
             else:
+                # Untransformed data streams straight off the erasure
+                # engine in device-batch chunks — O(batch) memory
+                # (the GetObjectReader role without a cleanup stack).
                 try:
-                    fi, data = self.pools.get_object(bucket, key, offset,
-                                                     length, version_id)
+                    if hasattr(self.pools, "get_object_iter"):
+                        fi, body_iter = self.pools.get_object_iter(
+                            bucket, key, offset, length, version_id)
+                    else:        # FS/gateway layers: whole-object read
+                        fi, data = self.pools.get_object(
+                            bucket, key, offset, length, version_id)
                 except StorageError as e:
                     raise from_storage_error(e) from None
         elif transformed and sse.is_encrypted(fi.metadata):
@@ -553,7 +565,9 @@ class S3Handlers:
         else:
             h["Content-Length"] = str(size)
             status = 200
-        return Response(status, b"" if head else data, h)
+        if head:
+            return Response(status, b"", h)
+        return Response(status, data, h, body_iter=body_iter)
 
     def select_object_content(self, bucket: str, key: str, query: dict,
                               body: bytes,
@@ -579,16 +593,41 @@ class S3Handlers:
         return Response(200, out,
                         {"Content-Type": "application/octet-stream"})
 
-    def put_object(self, bucket: str, key: str, body: bytes,
+    def put_object(self, bucket: str, key: str, body,
                    headers: dict[str, str]) -> Response:
+        """`body` is bytes or a reader.  A reader streams straight into
+        the erasure engine in O(batch) memory; transforms that need the
+        whole object in memory (compression, SSE sealing, snowball
+        extract, Content-MD5 verification) drain it first."""
         if len(key) > MAX_KEY_LEN:
             raise S3Error("KeyTooLongError")
-        if len(body) > MAX_OBJECT_SIZE:
-            raise S3Error("EntityTooLarge")
         h = {k.lower(): v for k, v in headers.items()}
-        if "x-amz-copy-source" in h:
-            return self._copy_object(bucket, key, h)
+        from ..crypto import sse as _sse
+        from ..utils import streams
         from . import extract as ex
+        if "x-amz-copy-source" in h:
+            if streams.is_reader(body):
+                # Copy requests carry no meaningful body; drain so the
+                # keep-alive socket isn't left desynced.
+                while body.read(1 << 20):
+                    pass
+            return self._copy_object(bucket, key, h)
+        declared_size = (len(body) if isinstance(body, (bytes, bytearray))
+                         else int(h.get("content-length", 0) or 0))
+        if declared_size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        if streams.is_reader(body):
+            # Hard cap BEFORE any draining: an undeclared-length
+            # (chunked TE) body must not grow past the object limit, in
+            # memory or on disk.
+            body = streams.MaxSizeReader(
+                body, MAX_OBJECT_SIZE,
+                exc=lambda msg: S3Error("EntityTooLarge"))
+            if (ex.is_snowball_put(headers) or self.compress_enabled
+                    or h.get("content-md5") or h.get(_sse.H_SSE)
+                    or h.get(_sse.H_SSEC_ALGO)):
+                body = streams.ensure_bytes(body)
+                declared_size = len(body)
         if ex.is_snowball_put(headers):
             # Auto-extract a tar body into individual objects under the
             # key prefix (cf. PutObjectExtract, cmd/untar.go:100).
@@ -617,11 +656,22 @@ class S3Handlers:
         quota_raw = self.meta.get(bucket, "quota")
         if quota_raw is not None:
             from ..bucket import quota as bq
-            reason = bq.check_quota(self.pools, bucket, len(body),
-                                    bq.parse_quota_config(quota_raw),
-                                    self.scanner)
+            from ..utils import streams as _st
+            qcfg = bq.parse_quota_config(quota_raw)
+            reason = bq.check_quota(self.pools, bucket, declared_size,
+                                    qcfg, self.scanner)
             if reason:
                 raise S3Error("QuotaExceeded", reason)
+            if _st.is_reader(body) and not declared_size \
+                    and qcfg.get("quota", 0) > 0:
+                # Undeclared-length stream on a quota'd bucket: cap at
+                # the remaining allowance so chunked TE can't bypass it.
+                remaining = max(0, qcfg["quota"]
+                                - bq.current_bucket_bytes(
+                                    self.pools, bucket, self.scanner))
+                body = _st.MaxSizeReader(
+                    body, remaining,
+                    exc=lambda msg: S3Error("QuotaExceeded", msg))
 
         # Object-lock: existing protected version must not be silently
         # replaced (unversioned overwrite destroys it); default retention
@@ -684,7 +734,7 @@ class S3Handlers:
             self.tier_mgr.on_version_deleted(prev)
         etag = fi.metadata.get("etag", "")
         self._publish_event("s3:ObjectCreated:Put", bucket, key,
-                            size=len(body), etag=etag,
+                            size=self._logical_size(fi), etag=etag,
                             version_id=fi.version_id)
         if self.replication is not None:
             self.replication.on_put(bucket, key)

@@ -19,9 +19,11 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..engine.pools import ServerPools
+from ..utils import streams
 from .api_errors import S3Error
 from .handlers import Response, S3Handlers, error_response
-from .sigv4 import (STREAMING_PAYLOAD, Credentials, decode_streaming_body,
+from .sigv4 import (STREAMING_PAYLOAD, UNSIGNED_PAYLOAD, Credentials,
+                    StreamingSigV4Reader, decode_streaming_body,
                     verify_header_signature, verify_presigned)
 
 MAX_HEADER_BODY = 5 * 1024 ** 3      # max single PUT (5 GiB part limit)
@@ -75,7 +77,17 @@ class S3Server:
                     self.send_header("Content-Length", str(len(body)))
                 self.send_header("x-amz-request-id", self.request_id)
                 self.end_headers()
-                if self.command != "HEAD" and body:
+                if self.command == "HEAD":
+                    return
+                if resp.body_iter is not None:
+                    # Streamed body: chunks flow socket-ward as they
+                    # decode; a mid-stream failure can only sever the
+                    # connection (headers are gone), same as the
+                    # reference once the response has begun.
+                    for chunk in resp.body_iter:
+                        if chunk:
+                            self.wfile.write(chunk)
+                elif body:
                     self.wfile.write(body)
 
             def _handle(self):
@@ -97,6 +109,16 @@ class S3Server:
                         resp = outer._dispatch(self, path, query)
                 except S3Error as e:
                     resp = error_response(e, path, self.request_id)
+                    # A failed request may leave unread body bytes on
+                    # the socket (streaming PUTs); don't reuse it.
+                    self.close_connection = True
+                except streams.StreamError as e:
+                    # Malformed/truncated request body: 400-class, not
+                    # a handler crash.
+                    resp = error_response(
+                        S3Error("IncompleteBody", str(e)), path,
+                        self.request_id)
+                    self.close_connection = True
                 except Exception as e:  # noqa: BLE001
                     outer.log.error(f"handler crash: {e}",
                                     path=path, request_id=self.request_id)
@@ -104,20 +126,24 @@ class S3Server:
                         S3Error("InternalError",
                                 f"{type(e).__name__}: {e}"),
                         path, self.request_id)
+                    self.close_connection = True
                 finally:
                     outer.metrics.inflight.inc(-1)
                 dur = (_time.perf_counter() - t0)
                 api = f"{self.command} {path.split('/')[1] if '/' in path else ''}"
+                resp_size = (int(resp.headers.get("Content-Length", 0) or 0)
+                             if resp.body_iter is not None
+                             else len(resp.body or b""))
                 outer.metrics.observe_request(
                     self.command, resp.status, dur,
                     int(self.headers.get("Content-Length", 0) or 0),
-                    len(resp.body or b""))
+                    resp_size)
                 outer.tracer.trace(
                     method=self.command, path=path, status=resp.status,
                     duration_ms=dur * 1e3,
                     request_size=int(self.headers.get("Content-Length",
                                                       0) or 0),
-                    response_size=len(resp.body or b""),
+                    response_size=resp_size,
                     source_ip=self.client_address[0])
                 if outer.audit_targets:
                     from ..observe.logger import audit_entry
@@ -215,6 +241,64 @@ class S3Server:
         if payload_decl == STREAMING_PAYLOAD:
             body = decode_streaming_body(self._lookup_creds, headers, body)
         return body, ak
+
+    def _body_reader(self, req):
+        """The raw request body as a bounded reader (no buffering)."""
+        length = int(req.headers.get("Content-Length", 0) or 0)
+        if length > MAX_HEADER_BODY:
+            raise S3Error("EntityTooLarge")
+        if req.headers.get("Transfer-Encoding", "").lower() == "chunked":
+            # No declared length: bound the stream so chunked TE can't
+            # bypass the 5 GiB part limit.
+            return streams.MaxSizeReader(
+                streams.HTTPChunkedReader(req.rfile), MAX_HEADER_BODY,
+                exc=lambda msg: S3Error("EntityTooLarge"))
+        return streams.LimitedReader(req.rfile, length)
+
+    def _authenticate_streaming(self, req, path: str, query: dict):
+        """Auth for stream-eligible requests: verify the signature from
+        headers alone and return (body reader, access_key) — the body
+        never lands in server memory whole.  Signed-payload requests get
+        a SHA-256-verifying reader (hash checked at EOF, like the
+        reference's hash.Reader); aws-chunked bodies a per-chunk
+        signature-verifying decoder."""
+        headers = {k: v for k, v in req.headers.items()}
+        headers.setdefault("Host", f"{self.host}:{self.port}")
+        raw = self._body_reader(req)
+        if "X-Amz-Signature" in query:
+            ak = verify_presigned(self._lookup_creds, req.command, path,
+                                  query, headers)
+            self._check_session_token(
+                ak, query.get("X-Amz-Security-Token", [""])[0])
+            return raw, ak
+        auth = req.headers.get("Authorization", "")
+        if not auth:
+            return raw, ""
+        payload_decl, ak = verify_header_signature(
+            self._lookup_creds, req.command, path, query, headers,
+            body=None)
+        self._check_session_token(
+            ak, req.headers.get("x-amz-security-token", ""))
+        if payload_decl == STREAMING_PAYLOAD:
+            return StreamingSigV4Reader(self._lookup_creds, headers,
+                                        raw), ak
+        if payload_decl != UNSIGNED_PAYLOAD:
+            raw = streams.HashVerifyReader(
+                raw, payload_decl,
+                exc=lambda msg: S3Error("XAmzContentSHA256Mismatch"))
+        return raw, ak
+
+    @staticmethod
+    def _stream_eligible(method: str, path: str, query: dict) -> bool:
+        """Data PUTs (object body / multipart part) stream; small-body
+        subresource PUTs and everything else buffer as before."""
+        if method != "PUT":
+            return False
+        parts = path.lstrip("/").split("/", 1)
+        if len(parts) < 2 or not parts[1]:
+            return False                 # bucket-level PUT (config XML)
+        return not any(q in query for q in
+                       ("tagging", "retention", "legal-hold"))
 
     def _check_session_token(self, access_key: str, token: str) -> None:
         """STS credentials must present their session token."""
@@ -475,7 +559,11 @@ class S3Server:
         raise S3Error("MethodNotAllowed")
 
     def _dispatch(self, req, path: str, query: dict) -> Response:
-        body, access_key = self._authenticate(req, path, query)
+        if self._stream_eligible(req.command, path, query):
+            body, access_key = self._authenticate_streaming(req, path,
+                                                            query)
+        else:
+            body, access_key = self._authenticate(req, path, query)
         h = self.handlers
         method = req.command
         headers = {k: v for k, v in req.headers.items()}

@@ -13,6 +13,11 @@ from __future__ import annotations
 import hashlib
 
 
+class StreamError(IOError):
+    """Malformed or truncated request body; maps to a 400-class S3
+    error at the HTTP layer (IncompleteBody), not a 500."""
+
+
 def is_reader(x) -> bool:
     """Anything with .read(n) that is not already bytes-like."""
     return (not isinstance(x, (bytes, bytearray, memoryview))
@@ -61,8 +66,27 @@ class LimitedReader:
             n = self._left
         piece = self._raw.read(min(n, self._left))
         if not piece and self._left:
-            raise IOError(f"body truncated ({self._left} bytes short)")
+            raise StreamError(f"body truncated ({self._left} bytes short)")
         self._left -= len(piece)
+        return piece
+
+
+class MaxSizeReader:
+    """Pass-through reader that raises `exc` once more than `cap` bytes
+    have flowed — bounds bodies whose length is not declared up front
+    (Transfer-Encoding: chunked)."""
+
+    def __init__(self, src, cap: int, exc=None):
+        self._src = src
+        self._cap = cap
+        self._seen = 0
+        self._exc = exc or (lambda msg: StreamError(msg))
+
+    def read(self, n: int = -1) -> bytes:
+        piece = self._src.read(n)
+        self._seen += len(piece)
+        if self._seen > self._cap:
+            raise self._exc(f"body exceeds {self._cap} bytes")
         return piece
 
 
@@ -100,9 +124,17 @@ class HTTPChunkedReader:
 
     def _next_chunk(self) -> None:
         line = self._rf.readline().strip()
-        self._chunk_left = int(line.split(b";")[0], 16)
+        try:
+            self._chunk_left = int(line.split(b";")[0], 16)
+        except ValueError:
+            raise StreamError(f"bad chunk size line {line[:32]!r}") \
+                from None
         if self._chunk_left == 0:
-            self._rf.readline()          # trailing CRLF
+            # consume optional trailers up to the blank terminator line
+            while True:
+                line = self._rf.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
             self._eof = True
 
     def read(self, n: int = -1) -> bytes:
@@ -118,7 +150,7 @@ class HTTPChunkedReader:
                 else min(self._chunk_left, n - len(out))
             piece = self._rf.read(want)
             if not piece:
-                raise IOError("truncated chunked body")
+                raise StreamError("truncated chunked body")
             out += piece
             self._chunk_left -= len(piece)
             if self._chunk_left == 0:

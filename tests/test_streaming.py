@@ -196,3 +196,143 @@ class TestBoundedMemory:
                              capture_output=True, text=True, timeout=600)
         assert res.returncode == 0, res.stderr + res.stdout
         assert "OK" in res.stdout
+
+
+@pytest.fixture()
+def srv(tmp_path):
+    from minio_tpu.server.server import S3Server
+    from minio_tpu.server.sigv4 import Credentials
+    drives = [LocalDrive(str(tmp_path / f"s{i}")) for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    s = S3Server(pools, Credentials("strmadmin", "strmadmin-secret")).start()
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture()
+def cli(srv):
+    from minio_tpu.server.client import S3Client
+    return S3Client(srv.endpoint, "strmadmin", "strmadmin-secret")
+
+
+class TestHTTPStreaming:
+    def test_streamed_put_and_get(self, cli):
+        cli.make_bucket("hstrm")
+        size = 3 * BLOCK_SIZE + 777
+        r = PatternReader(size)
+        h = cli.put_object_stream("hstrm", "obj", r, size)
+        assert h["ETag"].strip('"') == r.md5.hexdigest()
+        got = hashlib.md5()
+        n = 0
+        for piece in cli.get_object_stream("hstrm", "obj"):
+            got.update(piece)
+            n += len(piece)
+        assert n == size and got.hexdigest() == r.md5.hexdigest()
+
+    def test_streamed_put_small_inline(self, cli):
+        cli.make_bucket("hstrm2")
+        r = PatternReader(5000)
+        cli.put_object_stream("hstrm2", "small", r, 5000)
+        assert hashlib.md5(
+            cli.get_object("hstrm2", "small")).hexdigest() \
+            == r.md5.hexdigest()
+
+    def test_signed_payload_mismatch_rejected(self, srv, cli):
+        """A signed (non-streaming) sha256 that doesn't match the body
+        must fail the PUT and store nothing."""
+        import http.client as hc
+        import urllib.parse
+        from minio_tpu.server.sigv4 import sign_request
+        cli.make_bucket("hstrm3")
+        body = b"actual body bytes" * 100
+        headers = {"Host": f"{cli.host}:{cli.port}",
+                   "Content-Length": str(len(body))}
+        # sign over a DIFFERENT payload -> declared hash mismatches
+        auth = sign_request(cli.creds, "PUT", "/hstrm3/bad", {}, headers,
+                            b"some other payload")
+        headers.update(auth)
+        conn = hc.HTTPConnection(cli.host, cli.port, timeout=30)
+        conn.request("PUT", "/hstrm3/bad", body=body, headers=headers)
+        resp = conn.getresponse()
+        out = resp.read()
+        conn.close()
+        assert resp.status == 400, out
+        assert b"XAmzContentSHA256Mismatch" in out
+        st, _, _ = cli.request("GET", "/hstrm3/bad")
+        assert st == 404
+
+    def test_aws_chunked_streaming_put(self, srv, cli):
+        """aws-chunked (STREAMING-AWS4-HMAC-SHA256-PAYLOAD) body decodes
+        and verifies chunk signatures on the fly."""
+        import datetime
+        import http.client as hc
+        from minio_tpu.server import sigv4
+        cli.make_bucket("hstrm4")
+        payload = pattern_bytes(2 * BLOCK_SIZE + 33, seed=9)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        scope = f"{amz_date[:8]}/{cli.creds.region}/s3/aws4_request"
+        headers = {"Host": f"{cli.host}:{cli.port}"}
+        auth = sigv4.sign_request(cli.creds, "PUT", "/hstrm4/chunked", {},
+                                  headers, sigv4.STREAMING_PAYLOAD,
+                                  now=now)
+        headers.update(auth)
+        seed_sig = auth["Authorization"].rsplit("Signature=", 1)[1]
+        wire = sigv4.encode_streaming_body(cli.creds, scope, amz_date,
+                                           seed_sig, payload,
+                                           chunk_size=256 * 1024)
+        headers["Content-Length"] = str(len(wire))
+        conn = hc.HTTPConnection(cli.host, cli.port, timeout=60)
+        conn.request("PUT", "/hstrm4/chunked", body=wire, headers=headers)
+        resp = conn.getresponse()
+        out = resp.read()
+        conn.close()
+        assert resp.status == 200, out
+        assert cli.get_object("hstrm4", "chunked") == payload
+
+    def test_streamed_multipart_part(self, cli):
+        cli.make_bucket("hstrm5")
+        upload_id = cli.create_multipart("hstrm5", "mp")
+        # stream a part via unsigned-payload PUT with partNumber query
+        part = pattern_bytes(6 * 1024 * 1024, seed=3)
+        etag1 = cli.upload_part("hstrm5", "mp", upload_id, 1, part)
+        etag2 = cli.upload_part("hstrm5", "mp", upload_id, 2, b"tail")
+        cli.complete_multipart("hstrm5", "mp", upload_id,
+                               [(1, etag1), (2, etag2)])
+        assert cli.get_object("hstrm5", "mp") == part + b"tail"
+
+    def test_chunked_te_capped_and_malformed_rejected(self, srv, cli):
+        """Transfer-Encoding: chunked with no Content-Length must not
+        bypass size limits, and a malformed chunk line is a 400."""
+        import http.client as hc
+        from minio_tpu.server.sigv4 import sign_request
+        cli.make_bucket("hstrm6")
+        headers = {"Host": f"{cli.host}:{cli.port}",
+                   "Transfer-Encoding": "chunked",
+                   "x-amz-content-sha256": "UNSIGNED-PAYLOAD"}
+        auth = sign_request(cli.creds, "PUT", "/hstrm6/mal", {}, headers,
+                            "UNSIGNED-PAYLOAD")
+        headers.update(auth)
+        conn = hc.HTTPConnection(cli.host, cli.port, timeout=30)
+        conn.putrequest("PUT", "/hstrm6/mal", skip_host=True,
+                        skip_accept_encoding=True)
+        for k, v in headers.items():
+            conn.putheader(k, v)
+        conn.endheaders()
+        conn.send(b"zz\r\ngarbage\r\n")        # malformed chunk size
+        resp = conn.getresponse()
+        out = resp.read()
+        conn.close()
+        assert resp.status == 400, out
+        assert b"IncompleteBody" in out
+
+    def test_copy_with_body_keeps_connection_sane(self, cli):
+        """A copy-source PUT whose request carries a body must drain it
+        (keep-alive socket reuse would otherwise desync)."""
+        cli.make_bucket("hstrm7")
+        cli.put_object("hstrm7", "src", b"copy me")
+        # put_object_stream sends a streamed body alongside copy-source
+        r = PatternReader(256 * 1024)
+        cli.put_object_stream("hstrm7", "dst", r, 256 * 1024,
+                              headers={"x-amz-copy-source": "/hstrm7/src"})
+        assert cli.get_object("hstrm7", "dst") == b"copy me"
