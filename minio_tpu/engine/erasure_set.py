@@ -47,6 +47,13 @@ from . import quorum as Q
 BLOCK_SIZE = 1 << 20          # blockSizeV2, cmd/object-api-common.go:40
 BATCH_BLOCKS = 32             # 1 MiB blocks per device dispatch (32 MiB data)
 
+# Lazily resolved once: whether this process has a real TPU (see
+# ErasureSet._use_device).  Tests can reset to force a path.
+_USE_DEVICE: bool | None = None
+
+# Whether the native host codec built + loaded (None = untried).
+_NATIVE_OK: bool | None = None
+
 
 def _etag(data: bytes) -> str:
     return hashlib.md5(data).hexdigest()
@@ -71,8 +78,14 @@ class ErasureSet:
                                else default_parity)
         self.set_index = set_index
         self.pool = ThreadPoolExecutor(max_workers=max(self.n, 4))
+        # Prefetch tasks (get_object_iter segments) WAIT on self.pool
+        # leaf tasks; giving them their own executor makes a nested-
+        # submit deadlock impossible no matter how many streams are
+        # concurrently draining.
+        self._iter_pool = ThreadPoolExecutor(max_workers=8)
         self._codec_cache: dict[tuple[int, int], ReedSolomonTPU] = {}
         self._cpu_cache: dict[tuple[int, int], ReedSolomonCPU] = {}
+        self._native_cache: dict[tuple[int, int], object] = {}
         # Namespace locks guard object mutations (cf. NSLock use at
         # cmd/erasure-object.go:930). Standalone default: in-process RW
         # locks; a distributed deployment injects an NSLockMap over the
@@ -95,6 +108,52 @@ class ErasureSet:
         self.metacache.bump(bucket)
 
     # -- codec helpers -------------------------------------------------------
+
+    @property
+    def _use_device(self) -> bool:
+        """Device codec on a real TPU; native AVX codec otherwise.
+
+        Off-TPU (tests, FS-like hosts, device loss) the XLA-CPU
+        bit-plane path would be the bottleneck; the native nibble-table
+        codec (ops/erasure_native.py) is the same code the reference's
+        assembly computes.  The TPU decision is made once per process.
+        """
+        global _USE_DEVICE
+        if _USE_DEVICE is None:
+            import jax
+            _USE_DEVICE = jax.default_backend() == "tpu"
+        return _USE_DEVICE
+
+    def _native(self, k: int, m: int):
+        """Host codec, degrading gracefully: native AVX kernel if the
+        toolchain builds it, else the portable XLA path — a missing g++
+        must slow the data path down, not break it."""
+        global _NATIVE_OK
+        key = (k, m)
+        if key in self._native_cache:
+            return self._native_cache[key]
+        if _NATIVE_OK is None:
+            try:
+                from native import rs_comparator
+                rs_comparator.load()
+                _NATIVE_OK = True
+            except Exception:  # noqa: BLE001 — no g++/ISA
+                _NATIVE_OK = False
+        if _NATIVE_OK:
+            from ..ops.erasure_native import ReedSolomonNative
+            codec = ReedSolomonNative(k, m)
+        else:
+            codec = self._codec(k, m)
+        self._native_cache[key] = codec
+        return codec
+
+    def _transform(self, k: int, m: int, x, sources, targets) -> np.ndarray:
+        """Backend-picking transform: (B, K, S) -> (B, T, S) numpy."""
+        if self._use_device:
+            return np.asarray(self._codec(k, m).transform_blocks(
+                x, tuple(sources), tuple(targets)))
+        return np.asarray(self._native(k, m).transform_blocks(
+            np.asarray(x), tuple(sources), tuple(targets)))
 
     def _codec(self, k: int, m: int) -> ReedSolomonTPU:
         if (k, m) not in self._codec_cache:
@@ -398,6 +457,23 @@ class ErasureSet:
         if algo is None:
             algo = bitrot_io.write_algo()
         shard_size = -(-BLOCK_SIZE // k)
+
+        def frame(blocks, parity, digests):
+            # np.asarray here is the device sync point; by the time we
+            # take it, the NEXT batch's dispatch is already in flight.
+            if digests is not None:
+                digests = np.asarray(digests)
+            parity = np.asarray(parity)
+            full = np.concatenate([blocks, parity], axis=1)
+            return bitrot_io.frame_shards_batch(
+                full.transpose(1, 0, 2), digests=digests, algo=algo)
+
+        # Double-buffered pipeline: dispatch batch i, then frame/yield
+        # batch i-1 while the device works — hides dispatch+transfer
+        # latency (large through the axon tunnel) behind host framing
+        # and the caller's disk writes, the role of the reference's
+        # in-flight parallelWriter (cmd/erasure-encode.go:36).
+        pending = None
         for chunk, is_last in chunks:
             buf = np.frombuffer(chunk, dtype=np.uint8)
             n_full = buf.size // BLOCK_SIZE
@@ -416,27 +492,34 @@ class ErasureSet:
                 # Parity AND bitrot digests in ONE device dispatch
                 # (north-star config #5 PUT side, ops/fused.py); framing
                 # is then pure byte interleaving on the host.
-                if algo in fused.DEVICE_ALGOS:
+                if algo in fused.DEVICE_ALGOS and self._use_device:
                     parity, digests = fused.encode_and_hash(blocks, k, m,
                                                             algo=algo)
-                    digests = np.asarray(digests)
-                else:
+                elif self._use_device:
                     # Host-hashed algorithms (e.g. sha256): device
                     # encodes, frame_shards_batch hashes.
                     parity, digests = \
                         self._codec(k, m).encode_blocks(blocks), None
-                parity = np.asarray(parity)
-                full = np.concatenate([blocks, parity], axis=1)
-                yield bitrot_io.frame_shards_batch(
-                    full.transpose(1, 0, 2), digests=digests, algo=algo)
+                else:
+                    # No TPU: native AVX codec; frame_shards_batch
+                    # hashes on the host.
+                    parity, digests = \
+                        self._native(k, m).encode_blocks(blocks), None
+                if pending is not None:
+                    yield frame(*pending)
+                pending = (blocks, parity, digests)
 
             tail = buf[n_full * BLOCK_SIZE:]
-            if is_last and tail.size:
-                cpu = self._cpu(k, m)
-                shards = cpu.encode_data(tail.tobytes())  # k+m arrays
-                tail_shard = shards[0].size
-                yield [bitrot_io.frame_shard(s, tail_shard, algo)
-                       for s in shards]
+            if is_last:
+                if pending is not None:
+                    yield frame(*pending)
+                    pending = None
+                if tail.size:
+                    cpu = self._cpu(k, m)
+                    shards = cpu.encode_data(tail.tobytes())  # k+m arrays
+                    tail_shard = shards[0].size
+                    yield [bitrot_io.frame_shard(s, tail_shard, algo)
+                           for s in shards]
             if not is_last and tail.size:
                 raise ValueError("non-final chunk not BLOCK_SIZE aligned")
 
@@ -480,35 +563,48 @@ class ErasureSet:
 
         batch_bytes = BATCH_BLOCKS * BLOCK_SIZE
 
+        # Map the object byte range onto parts (each part an independent
+        # EC stream; cf. ObjectToPartOffset, cmd/erasure-metadata.go),
+        # then walk each in-part range in batch-aligned segments.
+        segs: list[tuple[int, int, int]] = []   # (part_number, off, len)
+        part_start = 0
+        remaining = length
+        pos = offset
+        for part in fi.parts:
+            part_end = part_start + part.size
+            if remaining <= 0:
+                break
+            if pos < part_end:
+                in_off = pos - part_start
+                in_len = min(remaining, part.size - in_off)
+                seg = in_off
+                stop = in_off + in_len
+                while seg < stop:
+                    # segment ends at the next batch boundary so each
+                    # yield is one bounded device dispatch
+                    boundary = (seg // batch_bytes + 1) * batch_bytes
+                    seg_end = min(stop, boundary)
+                    segs.append((part.number, seg, seg_end - seg))
+                    seg = seg_end
+                pos += in_len
+                remaining -= in_len
+            part_start = part_end
+
         def gen():
-            # Map the object byte range onto parts (each part an
-            # independent EC stream; cf. ObjectToPartOffset,
-            # cmd/erasure-metadata.go), then walk each in-part range in
-            # batch-aligned segments.
-            part_start = 0
-            remaining = length
-            pos = offset
-            for part in fi.parts:
-                part_end = part_start + part.size
-                if remaining <= 0:
-                    return
-                if pos < part_end:
-                    in_off = pos - part_start
-                    in_len = min(remaining, part.size - in_off)
-                    seg = in_off
-                    stop = in_off + in_len
-                    while seg < stop:
-                        # segment ends at the next batch boundary so each
-                        # yield is one bounded device dispatch
-                        boundary = (seg // batch_bytes + 1) * batch_bytes
-                        seg_end = min(stop, boundary)
-                        yield self._read_part(
-                            bucket, obj, fi, part_number=part.number,
-                            offset=seg, length=seg_end - seg)
-                        seg = seg_end
-                    pos += in_len
-                    remaining -= in_len
-                part_start = part_end
+            # One-segment prefetch: segment i+1's drive reads + fused
+            # verify/decode dispatch run while segment i drains to the
+            # caller — hides device round-trips (large via the axon
+            # tunnel) behind socket writes.
+            fut = None
+            for pn, off, ln in segs:
+                nxt = self._iter_pool.submit(self._read_part, bucket,
+                                             obj, fi, part_number=pn,
+                                             offset=off, length=ln)
+                if fut is not None:
+                    yield fut.result()
+                fut = nxt
+            if fut is not None:
+                yield fut.result()
         return fi, gen()
 
     def _read_metadata(self, bucket, obj, version_id=""):
@@ -628,18 +724,20 @@ class ErasureSet:
             # ONE dispatch: digests of the K chosen rows + reconstruction
             # of the missing data rows from those same HBM-resident bytes.
             x = np.stack([rows[s][1] for s in sel], axis=1)  # (nb, K, S)
-            if algo in fused.DEVICE_ALGOS:
+            if algo in fused.DEVICE_ALGOS and self._use_device:
                 digests, dev_out = fused.verify_and_transform(
                     x, k, m, tuple(sel), tuple(missing), algo=algo)
                 digests = np.asarray(digests)
             else:
-                # Host-hashed algorithms: digest on host, reconstruct on
-                # device only if rows are missing.
+                # Host path (host-hashed algorithm or no TPU): digest on
+                # host, reconstruct via the backend picker only if rows
+                # are missing.
                 flat = x.reshape(nb * k, shard_size)
                 digests = bitrot_io._hash_batch(flat, algo).reshape(
                     nb, k, hs)
-                dev_out = self._codec(k, m).transform_blocks(
-                    x, tuple(sel), tuple(missing)) if missing else None
+                dev_out = self._transform(
+                    k, m, x, tuple(sel), tuple(missing)) if missing \
+                    else None
             bad = [sel[i] for i in range(k)
                    if not np.array_equal(digests[:, i], rows[sel[i]][0])]
             if not bad:
@@ -750,8 +848,7 @@ class ErasureSet:
         if missing and nb_full:
             avail = [s for s in range(k + m) if full_mat[s] is not None][:k]
             x = np.stack([full_mat[s] for s in avail], axis=1)  # (B, K, S)
-            out = np.asarray(self._codec(k, m).transform_blocks(
-                x, tuple(avail), tuple(missing)))
+            out = self._transform(k, m, x, tuple(avail), tuple(missing))
             for j, s in enumerate(missing):
                 full_mat[s] = out[:, j, :]
         if has_tail:
@@ -862,16 +959,45 @@ class ErasureSet:
         return sorted(names)
 
     def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
-        # Use the first drive that can serve the full version list.
-        for d in self.drives:
-            if d is None:
+        """Quorum-elected version history: every drive's xl.meta is
+        read and each version must be agreed on by a majority of the
+        responding drives — a stale drive must not serve a stale (or
+        resurrect a deleted) version history (cf. readAllFileInfo +
+        findFileInfoInQuorum, cmd/erasure-metadata-utils.go)."""
+        res = self._map_drives(
+            lambda d: d.read_all(bucket, f"{obj}/xl.meta"))
+        lists: list[list[FileInfo]] = []
+        for raw, err in res:
+            if err is not None or raw is None:
                 continue
             try:
-                raw = d.read_all(bucket, f"{obj}/xl.meta")
-                return XLMeta.from_bytes(raw).list_versions(bucket, obj)
+                lists.append(
+                    XLMeta.from_bytes(raw).list_versions(bucket, obj))
             except StorageError:
                 continue
-        raise ErrObjectNotFound(f"{bucket}/{obj}")
+        if not lists:
+            raise ErrObjectNotFound(f"{bucket}/{obj}")
+        # Quorum against the CONFIGURED stripe width, not the responder
+        # count — one reachable stale drive must not become its own
+        # majority.
+        quorum = self.n // 2 + 1
+        if len(lists) < quorum:
+            raise ErrErasureReadQuorum(
+                f"{bucket}/{obj}: {len(lists)}/{self.n} version lists")
+        counts: dict[tuple, int] = {}
+        keep: dict[tuple, FileInfo] = {}
+        for lst in lists:
+            for fi in lst:
+                key = (fi.version_id, fi.mod_time_ns, fi.data_dir,
+                       fi.size, fi.deleted, fi.metadata.get("etag", ""))
+                counts[key] = counts.get(key, 0) + 1
+                keep.setdefault(key, fi)
+        out = [keep[k] for k, c in counts.items() if c >= quorum]
+        if not out:
+            raise ErrObjectNotFound(f"{bucket}/{obj} (no version in "
+                                    "quorum)")
+        out.sort(key=lambda fi: (-fi.mod_time_ns, fi.version_id))
+        return out
 
     # -- internals -----------------------------------------------------------
 

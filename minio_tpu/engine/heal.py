@@ -353,8 +353,7 @@ def _reconstruct_rows(es: ErasureSet, fi: FileInfo,
         x = np.stack([rows[s][:n_full * shard_size].reshape(n_full,
                                                             shard_size)
                       for s in use], axis=1)  # (B, K, S)
-        y = np.asarray(es._codec(k, m).transform_blocks(
-            x, tuple(use), tuple(need)))  # (B, T, S)
+        y = es._transform(k, m, x, tuple(use), tuple(need))  # (B, T, S)
         for j in range(len(need)):
             out_rows[j][:n_full * shard_size] = y[:, j, :].reshape(-1)
     if tail_len:

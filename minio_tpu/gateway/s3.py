@@ -163,20 +163,32 @@ class S3Gateway:
 
     def new_multipart_upload(self, bucket: str, obj: str, *,
                              metadata=None, parity=None) -> str:
+        # Composite id: base64(obj) + "." + backend uid.  Must stay
+        # XML- and URL-safe — a fronting S3 server echoes it inside
+        # InitiateMultipartUploadResult.
+        import base64
         try:
-            return f"{obj}\x00{self.cli.create_multipart(bucket, obj)}"
+            tag = base64.urlsafe_b64encode(obj.encode()).decode()
+            return f"{tag}.{self.cli.create_multipart(bucket, obj)}"
         except S3ClientError as e:
             raise _map_err(e) from None
 
     @staticmethod
     def _split(upload_id: str) -> tuple[str, str]:
-        obj, _, uid = upload_id.partition("\x00")
+        import base64
+        tag, _, uid = upload_id.partition(".")
         if not uid:
             raise ErrUploadNotFound(upload_id)
+        try:
+            obj = base64.urlsafe_b64decode(tag.encode()).decode()
+        except (ValueError, UnicodeDecodeError):
+            raise ErrUploadNotFound(upload_id) from None
         return obj, uid
 
     def put_object_part(self, bucket: str, obj: str, upload_id: str,
-                        part_number: int, data: bytes) -> ObjectPartInfo:
+                        part_number: int, data) -> ObjectPartInfo:
+        from ..utils.streams import ensure_bytes
+        data = ensure_bytes(data)
         _, uid = self._split(upload_id)
         try:
             etag = self.cli.upload_part(bucket, obj, uid, part_number,

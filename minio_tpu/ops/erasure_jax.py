@@ -133,24 +133,33 @@ class ReedSolomonTPU:
 
     # -- core primitive -------------------------------------------------------
 
-    def _apply(self, mat_bits: np.ndarray, x: jax.Array, rows: int) -> jax.Array:
+    def _apply(self, mat_bits: np.ndarray, x: jax.Array, rows: int,
+               salt: jax.Array | None = None) -> jax.Array:
         mat = jnp.asarray(mat_bits, dtype=jnp.bfloat16)
         if self.use_pallas:
             from . import erasure_pallas
-            return erasure_pallas.gf_matmul_blocks(mat, x, rows)
+            return erasure_pallas.gf_matmul_blocks(mat, x, rows, salt=salt)
+        if salt is not None:
+            x = x ^ salt[0].astype(jnp.uint8)
         return _gf_matmul_blocks(mat, x, rows)
 
     # -- public API -----------------------------------------------------------
 
-    def encode_blocks(self, data: jax.Array | np.ndarray) -> jax.Array:
-        """(B, K, S) data shards -> (B, M, S) parity shards."""
+    def encode_blocks(self, data: jax.Array | np.ndarray,
+                      salt: jax.Array | None = None) -> jax.Array:
+        """(B, K, S) data shards -> (B, M, S) parity shards.
+
+        salt: benchmark-protocol scalar xor of the input inside the
+        kernel (see erasure_pallas.gf_matmul_blocks); production None.
+        """
         data = jnp.asarray(data, dtype=jnp.uint8)
         mat = _encode_matrix_bits(self.data_shards, self.parity_shards)
-        return self._apply(mat, data, self.parity_shards)
+        return self._apply(mat, data, self.parity_shards, salt=salt)
 
     def transform_blocks(self, shards: jax.Array | np.ndarray,
                          sources: tuple[int, ...],
-                         targets: tuple[int, ...]) -> jax.Array:
+                         targets: tuple[int, ...],
+                         salt: jax.Array | None = None) -> jax.Array:
         """(B, K, S) shards at rows `sources[:K]` -> (B, T, S) rows `targets`.
 
         The universal decode/heal primitive: reconstruct any target rows from
@@ -159,7 +168,7 @@ class ReedSolomonTPU:
         shards = jnp.asarray(shards, dtype=jnp.uint8)
         mat = _transform_matrix_bits(self.data_shards, self.parity_shards,
                                      tuple(sources), tuple(targets))
-        return self._apply(mat, shards, len(targets))
+        return self._apply(mat, shards, len(targets), salt=salt)
 
     def reconstruct_blocks(self, shards: list[jax.Array | np.ndarray | None],
                            data_only: bool = False) -> list[jax.Array]:

@@ -43,9 +43,7 @@ def _choose_tile_s(s: int) -> int | None:
     return None
 
 
-def _kernel(mat_ref, x_ref, out_ref, *, rows: int):
-    """One grid step: (C, TILE_S) uint8 shards -> (R, TILE_S) output shards."""
-    x = x_ref[0].astype(jnp.int32)                      # (C, TS)
+def _unpack_mm_pack(x, mat_ref, rows: int):
     planes = jnp.concatenate(
         [(x >> j) & 1 for j in range(8)], axis=0).astype(jnp.bfloat16)
     y = jnp.dot(mat_ref[...], planes,
@@ -54,24 +52,32 @@ def _kernel(mat_ref, x_ref, out_ref, *, rows: int):
     out = bits[0:rows]
     for j in range(1, 8):
         out = out | (bits[j * rows:(j + 1) * rows] << j)
-    out_ref[0] = out.astype(jnp.uint8)
+    return out.astype(jnp.uint8)
+
+
+def _kernel(mat_ref, x_ref, out_ref, *, rows: int):
+    """One grid step: (C, TILE_S) uint8 shards -> (R, TILE_S) output shards."""
+    x = x_ref[0].astype(jnp.int32)                      # (C, TS)
+    out_ref[0] = _unpack_mm_pack(x, mat_ref, rows)
+
+
+def _kernel_salted(salt_ref, mat_ref, x_ref, out_ref, *, rows: int):
+    """Benchmark-protocol variant: input bytes are xor-perturbed by a
+    per-dispatch scalar INSIDE the kernel (VMEM, zero extra HBM traffic)
+    so a timing loop can defeat CSE/hoisting without the host-side
+    128 MiB xor pass that used to dominate the measurement."""
+    x = (x_ref[0].astype(jnp.int32) ^ salt_ref[0]) & 0xFF
+    out_ref[0] = _unpack_mm_pack(x, mat_ref, rows)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("rows", "tile_s", "interpret"))
 def _pallas_gf_matmul(mat: jax.Array, x: jax.Array, rows: int,
-                      tile_s: int, interpret: bool = False) -> jax.Array:
+                      tile_s: int, interpret: bool = False,
+                      salt: jax.Array | None = None) -> jax.Array:
     b, c, s = x.shape
-    kernel = functools.partial(_kernel, rows=rows)
-    return pl.pallas_call(
-        kernel,
+    common = dict(
         grid=(b, s // tile_s),
-        in_specs=[
-            pl.BlockSpec((8 * rows, 8 * c), lambda i, j: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, c, tile_s), lambda i, j: (i, 0, j),
-                         memory_space=pltpu.VMEM),
-        ],
         out_specs=pl.BlockSpec((1, rows, tile_s), lambda i, j: (i, 0, j),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, rows, s), jnp.uint8),
@@ -80,16 +86,31 @@ def _pallas_gf_matmul(mat: jax.Array, x: jax.Array, rows: int,
             bytes_accessed=b * c * s + b * rows * s,
             transcendentals=0),
         interpret=interpret,
-    )(mat, x)
+    )
+    mat_spec = pl.BlockSpec((8 * rows, 8 * c), lambda i, j: (0, 0),
+                            memory_space=pltpu.VMEM)
+    x_spec = pl.BlockSpec((1, c, tile_s), lambda i, j: (i, 0, j),
+                          memory_space=pltpu.VMEM)
+    if salt is None:
+        return pl.pallas_call(
+            functools.partial(_kernel, rows=rows),
+            in_specs=[mat_spec, x_spec], **common)(mat, x)
+    return pl.pallas_call(
+        functools.partial(_kernel_salted, rows=rows),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), mat_spec,
+                  x_spec], **common)(salt, mat, x)
 
 
 def gf_matmul_blocks(mat_bits: jax.Array | np.ndarray, x: jax.Array,
-                     rows: int) -> jax.Array:
+                     rows: int, salt: jax.Array | None = None) -> jax.Array:
     """Fused-kernel GF(2^8) batched matmul; drop-in for the XLA path.
 
     mat_bits: (8R, 8C) plane-major bit matrix; x: (B, C, S) uint8 shards.
     Falls back to the portable XLA path when the geometry doesn't tile
     (shard size not a multiple of 128) or when off-TPU outside tests.
+
+    salt: optional (1,) int32 — xors every input byte inside the kernel
+    (benchmark protocol; production passes None and pays nothing).
     """
     from . import erasure_jax
 
@@ -99,5 +120,8 @@ def gf_matmul_blocks(mat_bits: jax.Array | np.ndarray, x: jax.Array,
     tile_s = _choose_tile_s(s)
     on_tpu = jax.default_backend() == "tpu"
     if (not on_tpu and not FORCE_INTERPRET) or tile_s is None or b == 0:
+        if salt is not None:
+            x = x ^ salt[0].astype(jnp.uint8)
         return erasure_jax._gf_matmul_blocks(mat, x, rows)
-    return _pallas_gf_matmul(mat, x, rows, tile_s, interpret=not on_tpu)
+    return _pallas_gf_matmul(mat, x, rows, tile_s, interpret=not on_tpu,
+                             salt=salt)

@@ -372,32 +372,75 @@ class S3Handlers:
         prefix = query.get("prefix", [""])[0]
         max_keys = min(int(query.get("max-keys", ["1000"])[0] or 1000),
                        1000)
+        key_marker = query.get("key-marker", [""])[0]
+        vid_marker = query.get("version-id-marker", [""])[0]
         self.head_bucket(bucket)
         root = ET.Element("ListVersionsResult", xmlns=S3_NS)
         _el(root, "Name", bucket)
         _el(root, "Prefix", prefix)
         _el(root, "MaxKeys", max_keys)
-        _el(root, "IsTruncated", "false")
+        if key_marker:
+            _el(root, "KeyMarker", key_marker)
+        if vid_marker:
+            _el(root, "VersionIdMarker", vid_marker)
+        truncated_el = _el(root, "IsTruncated", "false")
         count = 0
         lister = getattr(self.pools, "list_object_names", None)
         if lister is not None:
-            names = lister(bucket, prefix)[:max_keys]
+            names = lister(bucket, prefix)
         else:
-            names = [fi.name for fi in
-                     self.pools.list_objects(bucket, prefix,
-                                             max_keys=max_keys)]
+            # FS/gateway fallback: list_objects caps; grow the window
+            # until it covers the marker with a full page to spare, so
+            # big buckets page correctly instead of silently truncating.
+            cap = 100000
+            while True:
+                names = [fi.name for fi in
+                         self.pools.list_objects(bucket, prefix,
+                                                 max_keys=cap)]
+                after = ([n for n in names if n > key_marker]
+                         if key_marker else names)
+                if len(names) < cap or len(after) > max_keys:
+                    break
+                cap *= 2
+        names = sorted(n for n in names if n >= key_marker) \
+            if key_marker else sorted(names)
+        past_vid_marker = not vid_marker
+        last_emitted = ("", "")
         for name in names:
             try:
                 versions = self.pools.list_object_versions(bucket, name)
             except StorageError:
                 continue
+            if name == key_marker and vid_marker and not past_vid_marker:
+                # Marker version deleted between pages: losing the rest
+                # of the key's history is worse than re-emitting it —
+                # treat a missing marker as "start of key".
+                vids = {v.version_id or "null" for v in versions}
+                if vid_marker not in vids:
+                    past_vid_marker = True
             for v in versions:
+                vid = v.version_id or "null"
+                if name == key_marker:
+                    # resume strictly after the marker version
+                    if not past_vid_marker:
+                        if vid == vid_marker:
+                            past_vid_marker = True
+                        continue
+                    if not vid_marker:
+                        continue        # key-marker alone: skip its key
                 if count >= max_keys:
-                    break
+                    # markers name the LAST RETURNED item (AWS
+                    # semantics); the next page resumes strictly after
+                    truncated_el.text = "true"
+                    _el(root, "NextKeyMarker", last_emitted[0])
+                    _el(root, "NextVersionIdMarker", last_emitted[1])
+                    return Response(200, _xml(root),
+                                    {"Content-Type": "application/xml"})
+                last_emitted = (name, vid)
                 tag = "DeleteMarker" if v.deleted else "Version"
                 e = _el(root, tag)
                 _el(e, "Key", v.name or name)
-                _el(e, "VersionId", v.version_id or "null")
+                _el(e, "VersionId", vid)
                 _el(e, "IsLatest", "true" if v.is_latest else "false")
                 _el(e, "LastModified", _iso(v.mod_time_ns))
                 if not v.deleted:
@@ -534,6 +577,14 @@ class S3Handlers:
                     if hasattr(self.pools, "get_object_iter"):
                         fi, body_iter = self.pools.get_object_iter(
                             bucket, key, offset, length, version_id)
+                        # Pull the FIRST chunk eagerly: once headers are
+                        # on the wire a failure can only sever the
+                        # connection, so quorum/bitrot errors that
+                        # surface immediately must still become S3
+                        # error responses.
+                        import itertools
+                        first = next(body_iter, b"")
+                        body_iter = itertools.chain((first,), body_iter)
                     else:        # FS/gateway layers: whole-object read
                         fi, data = self.pools.get_object(
                             bucket, key, offset, length, version_id)
@@ -612,8 +663,11 @@ class S3Handlers:
                 while body.read(1 << 20):
                     pass
             return self._copy_object(bucket, key, h)
+        # aws-chunked bodies declare the PAYLOAD length separately; the
+        # wire Content-Length includes chunk headers + signatures.
         declared_size = (len(body) if isinstance(body, (bytes, bytearray))
-                         else int(h.get("content-length", 0) or 0))
+                         else int(h.get("x-amz-decoded-content-length")
+                                  or h.get("content-length") or 0))
         if declared_size > MAX_OBJECT_SIZE:
             raise S3Error("EntityTooLarge")
         if streams.is_reader(body):
@@ -656,20 +710,19 @@ class S3Handlers:
         quota_raw = self.meta.get(bucket, "quota")
         if quota_raw is not None:
             from ..bucket import quota as bq
-            from ..utils import streams as _st
             qcfg = bq.parse_quota_config(quota_raw)
             reason = bq.check_quota(self.pools, bucket, declared_size,
                                     qcfg, self.scanner)
             if reason:
                 raise S3Error("QuotaExceeded", reason)
-            if _st.is_reader(body) and not declared_size \
+            if streams.is_reader(body) and not declared_size \
                     and qcfg.get("quota", 0) > 0:
                 # Undeclared-length stream on a quota'd bucket: cap at
                 # the remaining allowance so chunked TE can't bypass it.
                 remaining = max(0, qcfg["quota"]
                                 - bq.current_bucket_bytes(
                                     self.pools, bucket, self.scanner))
-                body = _st.MaxSizeReader(
+                body = streams.MaxSizeReader(
                     body, remaining,
                     exc=lambda msg: S3Error("QuotaExceeded", msg))
 

@@ -280,8 +280,17 @@ class S3Server:
         self._check_session_token(
             ak, req.headers.get("x-amz-security-token", ""))
         if payload_decl == STREAMING_PAYLOAD:
-            return StreamingSigV4Reader(self._lookup_creds, headers,
-                                        raw), ak
+            decoded = StreamingSigV4Reader(self._lookup_creds, headers,
+                                           raw)
+            declared = int(req.headers.get("x-amz-decoded-content-length",
+                                           0) or 0)
+            if declared:
+                # The declared decoded length feeds quota/size admission
+                # (handlers.put_object); hold the stream to it.
+                decoded = streams.ExactLengthReader(
+                    decoded, declared,
+                    exc=lambda msg: S3Error("IncompleteBody", msg))
+            return decoded, ak
         if payload_decl != UNSIGNED_PAYLOAD:
             raw = streams.HashVerifyReader(
                 raw, payload_decl,

@@ -40,12 +40,34 @@ def _hh_batch(blocks: np.ndarray) -> np.ndarray:
     return highwayhash256_batch(blocks)
 
 
-def _mxh_batch(blocks: np.ndarray) -> np.ndarray:
-    if blocks.size >= _DEVICE_HASH_THRESHOLD:
-        from ..ops.mxhash_jax import mxh256_batch_jax
-        return np.asarray(mxh256_batch_jax(blocks))
+_MXH_NATIVE = None       # None = untried; False = unavailable
+
+
+def _mxh_host(blocks: np.ndarray) -> np.ndarray:
+    """Host mxh256: native AVX-VNNI kernel (native/mxh256.cc) when the
+    toolchain/ISA allows, else the numpy spec path."""
+    global _MXH_NATIVE
+    if _MXH_NATIVE is None:
+        try:
+            from native.mxh_native import mxh256_rows_native
+            _MXH_NATIVE = mxh256_rows_native
+        except Exception:  # noqa: BLE001 — no g++/ISA: spec path
+            _MXH_NATIVE = False
+    if _MXH_NATIVE:
+        return _MXH_NATIVE(blocks)
     from ..ops.mxhash import mxh256_batch
     return mxh256_batch(blocks)
+
+
+def _mxh_batch(blocks: np.ndarray) -> np.ndarray:
+    # Device dispatch only where there IS a device — on CPU backends the
+    # native host kernel beats the XLA emulation ~50x.
+    if blocks.size >= _DEVICE_HASH_THRESHOLD:
+        import jax
+        if jax.default_backend() == "tpu":
+            from ..ops.mxhash_jax import mxh256_batch_jax
+            return np.asarray(mxh256_batch_jax(blocks))
+    return _mxh_host(blocks)
 
 
 def _hashlib_batch(name: str, digest_size: int):

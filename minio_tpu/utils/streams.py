@@ -71,6 +71,30 @@ class LimitedReader:
         return piece
 
 
+class ExactLengthReader:
+    """Pass-through reader that enforces the stream decodes to EXACTLY
+    `want` bytes — a client-declared decoded length (aws-chunked
+    x-amz-decoded-content-length) is only trustworthy for admission
+    checks (quota, size caps) if something verifies it."""
+
+    def __init__(self, src, want: int, exc=None):
+        self._src = src
+        self._want = want
+        self._seen = 0
+        self._exc = exc or (lambda msg: StreamError(msg))
+
+    def read(self, n: int = -1) -> bytes:
+        piece = self._src.read(n)
+        self._seen += len(piece)
+        if self._seen > self._want:
+            raise self._exc(
+                f"body longer than declared ({self._seen} > {self._want})")
+        if not piece and self._seen != self._want:
+            raise self._exc(
+                f"body shorter than declared ({self._seen} < {self._want})")
+        return piece
+
+
 class MaxSizeReader:
     """Pass-through reader that raises `exc` once more than `cap` bytes
     have flowed — bounds bodies whose length is not declared up front
@@ -162,8 +186,18 @@ def batched_chunks(head: bytes, stream, chunk_len: int):
     """Yield (chunk, is_last) with every chunk exactly chunk_len bytes
     except the final one (which may be empty when the total length is an
     exact multiple).  `head` is bytes already consumed from `stream`."""
+    if stream is None:
+        # Pure-bytes source: zero-copy memoryview windows (the caller's
+        # numpy frombuffer views them without materializing).
+        mv = memoryview(head)
+        pos = 0
+        while len(mv) - pos > chunk_len:
+            yield mv[pos:pos + chunk_len], False
+            pos += chunk_len
+        yield mv[pos:], True
+        return
     buf = bytearray(head)
-    eof = stream is None
+    eof = False
     while True:
         while not eof and len(buf) < chunk_len:
             piece = stream.read(chunk_len - len(buf))

@@ -1,0 +1,113 @@
+// Native mxh256 — the host-side fast path for the TPU-native bitrot
+// checksum (spec: minio_tpu/ops/mxhash.py; device: ops/mxhash_jax.py).
+//
+// Role (SURVEY.md §2.12): where the reference leans on Go-assembly
+// highwayhash for bitrot hashing (cmd/bitrot.go:39, go.mod:47), the
+// host tier here computes the same digests the TPU writes, so CPU-only
+// deployments and host verify paths are not bound by a slow emulation.
+//
+// Math per 256-byte chunk: h[j] = sum_i s8(x[i]) * A[i][j], exact int32,
+// j in 0..7; serialized little-endian; levels shrink 8x until 32 bytes;
+// final digest ^= 32-byte length tag (passed in by the caller).
+//
+// AVX-512-VNNI: vpdpbusd is u8 x s8; bytes are spec'd as s8.  For any
+// byte, u8(x ^ 0x80) == s8(x) + 128, so
+//   h[j] = vnni_sum(x ^ 0x80, A_j) - 128 * colsum(A_j).
+// The caller passes A transposed (8 x 256, one row per output word) and
+// the precomputed 128*colsum correction.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#if defined(__AVX512VNNI__) && defined(__AVX512BW__)
+#include <immintrin.h>
+#define MXH_ISA "avx512vnni"
+#else
+#define MXH_ISA "scalar"
+#endif
+
+extern "C" {
+
+const char* mxh_isa() { return MXH_ISA; }
+
+// One level chunk: x = 256 bytes, at (8,256) row-major, corr[8].
+static inline void chunk_words(const uint8_t* x, const int8_t* at,
+                               const int32_t* corr, int32_t* out) {
+#if defined(__AVX512VNNI__) && defined(__AVX512BW__)
+  const __m512i bias = _mm512_set1_epi8((char)0x80);
+  __m512i x0 = _mm512_xor_si512(
+      _mm512_loadu_si512((const void*)(x)), bias);
+  __m512i x1 = _mm512_xor_si512(
+      _mm512_loadu_si512((const void*)(x + 64)), bias);
+  __m512i x2 = _mm512_xor_si512(
+      _mm512_loadu_si512((const void*)(x + 128)), bias);
+  __m512i x3 = _mm512_xor_si512(
+      _mm512_loadu_si512((const void*)(x + 192)), bias);
+  for (int j = 0; j < 8; ++j) {
+    const int8_t* a = at + (size_t)j * 256;
+    __m512i acc = _mm512_setzero_si512();
+    acc = _mm512_dpbusd_epi32(acc, x0,
+                              _mm512_loadu_si512((const void*)(a)));
+    acc = _mm512_dpbusd_epi32(acc, x1,
+                              _mm512_loadu_si512((const void*)(a + 64)));
+    acc = _mm512_dpbusd_epi32(acc, x2,
+                              _mm512_loadu_si512((const void*)(a + 128)));
+    acc = _mm512_dpbusd_epi32(acc, x3,
+                              _mm512_loadu_si512((const void*)(a + 192)));
+    out[j] = _mm512_reduce_add_epi32(acc) - corr[j];
+  }
+#else
+  for (int j = 0; j < 8; ++j) {
+    const int8_t* a = at + (size_t)j * 256;
+    int32_t acc = 0;
+    for (int i = 0; i < 256; ++i) acc += (int32_t)(int8_t)x[i] * a[i];
+    out[j] = acc;
+  }
+  (void)corr;
+#endif
+}
+
+// One tree level over a contiguous row: in (len bytes) -> out
+// (32 * ceil(len/256) bytes, or 32 if len == 0).  Tail chunk zero-pads.
+static size_t level(const uint8_t* in, size_t len, const int8_t* at,
+                    const int32_t* corr, uint8_t* out) {
+  size_t nc = len ? (len + 255) / 256 : 1;
+  uint8_t tail[256];
+  for (size_t c = 0; c < nc; ++c) {
+    const uint8_t* src = in + c * 256;
+    size_t have = (c * 256 <= len) ? len - c * 256 : 0;
+    if (have < 256) {
+      std::memset(tail, 0, sizeof(tail));
+      if (have) std::memcpy(tail, src, have);
+      src = tail;
+    }
+    chunk_words(src, at, corr, (int32_t*)(out + c * 32));
+  }
+  return nc * 32;
+}
+
+// rows: (n, len) contiguous; at: (8,256) int8; corr: int32[8];
+// tag: 32-byte length tag for `len`; out: (n, 32).
+void mxh256_rows(const uint8_t* rows, size_t n, size_t len,
+                 const int8_t* at, const int32_t* corr,
+                 const uint8_t* tag, uint8_t* out,
+                 uint8_t* scratch /* >= 32*ceil(len/256) bytes, x2 */) {
+  size_t max_lvl = len ? (len + 255) / 256 * 32 : 32;
+  uint8_t* bufa = scratch;
+  uint8_t* bufb = scratch + max_lvl;
+  for (size_t r = 0; r < n; ++r) {
+    size_t cur_len = level(rows + r * len, len, at, corr, bufa);
+    uint8_t* cur = bufa;
+    uint8_t* nxt = bufb;
+    while (cur_len != 32) {
+      size_t nl = level(cur, cur_len, at, corr, nxt);
+      uint8_t* t = cur; cur = nxt; nxt = t;
+      cur_len = nl;
+    }
+    uint8_t* dst = out + r * 32;
+    for (int i = 0; i < 32; ++i) dst[i] = cur[i] ^ tag[i];
+  }
+}
+
+}  // extern "C"

@@ -324,3 +324,57 @@ class TestDeleteListVersions:
         s.delete_object("b", "b")
         names = [fi.name for fi in s.list_objects("b")]
         assert names == ["a/x", "a/y", "c/deep/obj"]
+
+
+class TestQuorumListVersions:
+    def test_stale_drive_does_not_pollute_version_list(self, tmp_path):
+        """A drive holding an outdated xl.meta must not add or shadow
+        versions (VERDICT r2 weak #4 / next #6)."""
+        from minio_tpu.engine.erasure_set import ErasureSet
+        from minio_tpu.storage.drive import LocalDrive
+        import shutil
+
+        drives = [LocalDrive(str(tmp_path / f"q{i}")) for i in range(4)]
+        es = ErasureSet(drives)
+        es.make_bucket("qv")
+        es.put_object("qv", "obj", b"v1" * 100, versioned=True)
+        # snapshot drive 0's metadata (one version), then write v2
+        stale = bytes(drives[0].read_all("qv", "obj/xl.meta"))
+        fi2 = es.put_object("qv", "obj", b"v2" * 100, versioned=True)
+        versions = es.list_object_versions("qv", "obj")
+        assert len(versions) == 2
+        # revert drive 0 to the stale meta: still 2 versions via quorum
+        import os
+        path = os.path.join(str(tmp_path / "q0"), "qv", "obj", "xl.meta")
+        with open(path, "wb") as f:
+            f.write(stale)
+        versions = es.list_object_versions("qv", "obj")
+        assert len(versions) == 2
+        assert {v.version_id for v in versions} >= {fi2.version_id}
+
+    def test_minority_fabricated_version_dropped(self, tmp_path):
+        from minio_tpu.engine.erasure_set import ErasureSet
+        from minio_tpu.storage.drive import LocalDrive
+        import os
+
+        drives = [LocalDrive(str(tmp_path / f"f{i}")) for i in range(4)]
+        es = ErasureSet(drives)
+        es.make_bucket("fv")
+        es.put_object("fv", "obj", b"real" * 50, versioned=True)
+        # a single corrupted/divergent drive invents a bogus history:
+        # copy drive 1's meta over drive 0's... then modify drive 0's
+        # to a DIFFERENT object state by writing v-extra only there
+        stale = bytes(drives[0].read_all("fv", "obj/xl.meta"))
+        es.drives[1] = es.drives[2] = es.drives[3] = None
+        try:
+            es.put_object("fv", "obj", b"solo" * 50, versioned=True)
+        except Exception:
+            pass
+        finally:
+            es.drives[1] = LocalDrive(str(tmp_path / "f1"))
+            es.drives[2] = LocalDrive(str(tmp_path / "f2"))
+            es.drives[3] = LocalDrive(str(tmp_path / "f3"))
+        versions = es.list_object_versions("fv", "obj")
+        # the solo write (if it succeeded at all) lives on one drive
+        # only; quorum must keep just the original version
+        assert len(versions) == 1

@@ -284,6 +284,42 @@ class TestListVersionsAndTools:
         assert data.count(b"<Version>") == 2
         assert data.count(b"<DeleteMarker>") == 1
 
+    def test_list_versions_paging(self, stack):
+        """key-marker / version-id-marker paging walks the full history
+        exactly once (VERDICT r2 item 6)."""
+        import re
+        srv, cli = stack
+        cli.make_bucket("pgv")
+        cli.set_versioning("pgv", True)
+        for key in ("a", "b", "c"):
+            for v in range(3):
+                cli.put_object("pgv", key, f"{key}{v}".encode())
+        seen = []
+        key_marker, vid_marker = "", ""
+        for _ in range(20):
+            q = {"versions": "", "max-keys": "2"}
+            if key_marker:
+                q["key-marker"] = key_marker
+            if vid_marker:
+                q["version-id-marker"] = vid_marker
+            status, _, data = cli.request("GET", "/pgv", query=q)
+            assert status == 200
+            body = data.decode()
+            for m in re.finditer(
+                    r"<Version><Key>([^<]+)</Key>"
+                    r"<VersionId>([^<]+)</VersionId>", body):
+                seen.append((m.group(1), m.group(2)))
+            if "<IsTruncated>true</IsTruncated>" not in body:
+                break
+            key_marker = re.search(
+                r"<NextKeyMarker>([^<]+)</NextKeyMarker>", body).group(1)
+            vid_marker = re.search(
+                r"<NextVersionIdMarker>([^<]+)</NextVersionIdMarker>",
+                body).group(1)
+        assert len(seen) == 9
+        assert len(set(seen)) == 9          # no duplicates across pages
+        assert [k for k, _ in seen] == sorted(k for k, _ in seen)
+
     def test_xlmeta_inspect_tool(self, stack, tmp_path):
         import glob
         from minio_tpu.tools.xlmeta_inspect import inspect

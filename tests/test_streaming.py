@@ -336,3 +336,43 @@ class TestHTTPStreaming:
         cli.put_object_stream("hstrm7", "dst", r, 256 * 1024,
                               headers={"x-amz-copy-source": "/hstrm7/src"})
         assert cli.get_object("hstrm7", "dst") == b"copy me"
+
+
+class TestConcurrentStreams:
+    def test_many_concurrent_streamed_gets_no_deadlock(self, tmp_path):
+        """More concurrent GET streams than pool workers must all make
+        progress (prefetch tasks run on a dedicated executor; nesting
+        them in the shard pool deadlocked)."""
+        import concurrent.futures as cf
+        drives = [LocalDrive(str(tmp_path / f"c{i}")) for i in range(4)]
+        es = ErasureSet(drives)
+        es.make_bucket("conc")
+        raw = pattern_bytes(2 * BLOCK_SIZE + 17)
+        for i in range(3):
+            es.put_object("conc", f"o{i}", raw)
+
+        def drain(i):
+            _, it = es.get_object_iter("conc", f"o{i % 3}")
+            return sum(len(c) for c in it)
+
+        with cf.ThreadPoolExecutor(max_workers=8) as ex:
+            futs = [ex.submit(drain, i) for i in range(8)]
+            done, not_done = cf.wait(futs, timeout=60)
+            assert not not_done, "streamed GETs deadlocked"
+            assert all(f.result() == len(raw) for f in done)
+
+    def test_first_chunk_failure_is_an_error_response(self, srv, cli):
+        """If the read fails before any data can decode, the client
+        must get an S3 error — not a 200 with a severed body."""
+        cli.make_bucket("hstrm8")
+        size = 2 * BLOCK_SIZE
+        cli.put_object_stream("hstrm8", "obj", PatternReader(size), size)
+        # take 3 of 4 drives offline: below read quorum
+        es = srv.pools.pools[0].sets[0]
+        saved = list(es.drives)
+        es.drives[0] = es.drives[1] = es.drives[2] = None
+        try:
+            st, _, data = cli.request("GET", "/hstrm8/obj")
+            assert st >= 400, (st, data[:100])
+        finally:
+            es.drives[:] = saved

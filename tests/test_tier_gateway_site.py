@@ -171,6 +171,31 @@ class TestGateways:
         finally:
             backend_srv.shutdown()
 
+    def test_s3_gateway_multipart_through_server(self, tmp_path):
+        """Part uploads through a fronting server arrive as streamed
+        readers; the gateway must drain them before re-signing."""
+        backend_pools = make_pools(tmp_path, "bs")
+        backend_srv = S3Server(backend_pools,
+                               Credentials(ROOT, SECRET)).start()
+        gw_srv = None
+        try:
+            gw = S3Gateway(backend_srv.endpoint, ROOT, SECRET)
+            gw_srv = S3Server(gw, Credentials("gwroot",
+                                              "gwroot-secret")).start()
+            cli = S3Client(gw_srv.endpoint, "gwroot", "gwroot-secret")
+            cli.make_bucket("mpsrv")
+            uid = cli.create_multipart("mpsrv", "big")
+            p1 = payload(5 << 20, 8)
+            e1 = cli.upload_part("mpsrv", "big", uid, 1, p1)
+            e2 = cli.upload_part("mpsrv", "big", uid, 2, b"tail")
+            cli.complete_multipart("mpsrv", "big", uid,
+                                   [(1, e1), (2, e2)])
+            assert cli.get_object("mpsrv", "big") == p1 + b"tail"
+        finally:
+            if gw_srv:
+                gw_srv.shutdown()
+            backend_srv.shutdown()
+
     def test_nas_gateway(self, tmp_path):
         nas = NASGateway(str(tmp_path / "mount"))
         nas.make_bucket("share")
