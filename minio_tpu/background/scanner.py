@@ -40,6 +40,21 @@ class DataScanner:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._last_usage: DataUsage | None = None
+        # Restart path: union persisted dirt back in so buckets marked
+        # before a crash/restart still get their full rescan
+        # (cf. dataUpdateTracker load, cmd/data-update-tracker.go:59).
+        es = self._first_es()
+        if es is not None:
+            try:
+                self.dirty.load(es)
+            except Exception:  # noqa: BLE001 — scanning must still run
+                pass
+
+    def _first_es(self):
+        try:
+            return self.pools.pools[0].sets[0]
+        except (AttributeError, IndexError):
+            return None
 
     # -- one cycle -----------------------------------------------------------
 
@@ -107,6 +122,14 @@ class DataScanner:
                     usage.persist(es)
                 except StorageError:
                     continue
+        # The cycle consumed this round's dirt; checkpoint the (now
+        # usually empty) pending set so a restart resumes correctly.
+        es = self._first_es()
+        if es is not None:
+            try:
+                self.dirty.save(es)
+            except Exception:  # noqa: BLE001
+                pass
         return usage
 
     def latest_usage(self) -> DataUsage | None:

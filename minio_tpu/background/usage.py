@@ -99,9 +99,16 @@ class DataUsage:
 
 class DirtyTracker:
     """Which buckets changed since the last scan cycle — lets the scanner
-    skip untouched trees the way the reference's bloom filter does."""
+    skip untouched trees the way the reference's bloom filter does.
+
+    Persisted (save/load below) so restarts don't lose pending dirt:
+    the reference's dataUpdateTracker survives restarts the same way
+    (cmd/data-update-tracker.go:59).  Writes stay in-memory-hot; the
+    scanner saves each cycle and loads (union) at start, mirroring the
+    reference's periodic save interval."""
 
     _global = None
+    PERSIST_PATH = "dirty-buckets.json"
 
     def __init__(self):
         self._mu = threading.Lock()
@@ -128,3 +135,39 @@ class DirtyTracker:
     def is_dirty(self, bucket: str) -> bool:
         with self._mu:
             return bucket in self._dirty
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, es) -> None:
+        """Write the pending dirty set to every live drive's sys volume
+        (quorum-tolerant: any surviving copy restores the state)."""
+        import json
+
+        from ..storage.drive import SYS_VOL
+        with self._mu:
+            blob = json.dumps({"dirty": sorted(self._dirty),
+                               "stamp": self._stamp}).encode()
+
+        def put(d):
+            d.write_all(SYS_VOL, self.PERSIST_PATH, blob)
+        es._map_drives(put)
+
+    def load(self, es) -> None:
+        """Union persisted dirt from EVERY readable drive copy — a
+        drive that was offline at save time holds an older file and
+        must not shadow newer dirt (restart path)."""
+        import json
+
+        from ..storage.drive import SYS_VOL
+        from ..storage.errors import StorageError
+        for d in es.drives:
+            if d is None:
+                continue
+            try:
+                obj = json.loads(d.read_all(SYS_VOL, self.PERSIST_PATH))
+            except (StorageError, ValueError):
+                continue
+            with self._mu:
+                self._dirty.update(obj.get("dirty", []))
+                for k, v in obj.get("stamp", {}).items():
+                    self._stamp.setdefault(k, v)

@@ -54,6 +54,27 @@ _USE_DEVICE: bool | None = None
 # Whether the native host codec built + loaded (None = untried).
 _NATIVE_OK: bool | None = None
 
+# Process-wide mesh for multi-device codec placement (built lazily).
+_MESH = None
+
+
+def _mesh_mode() -> bool:
+    """Whether the engine places codec work on a multi-device mesh.
+
+    Auto (MTPU_MESH unset): on when >1 jax device is attached — the
+    WithAutoGoroutines role (cmd/erasure-coding.go:63), scaling the
+    shard math across chips without configuration.  MTPU_MESH=1/0
+    forces (tests use 1 to exercise the SPMD path on the virtual CPU
+    mesh, where auto would stay off for speed)."""
+    import os
+    v = os.environ.get("MTPU_MESH", "")
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    import jax
+    return jax.default_backend() == "tpu" and len(jax.devices()) > 1
+
 
 def _etag(data: bytes) -> str:
     return hashlib.md5(data).hexdigest()
@@ -147,8 +168,56 @@ class ErasureSet:
         self._native_cache[key] = codec
         return codec
 
+    def _sharded(self, k: int, m: int):
+        """Mesh codec (parallel/sharded.py) cached per geometry over the
+        process-wide device mesh."""
+        global _MESH
+        key = ("sharded", k, m)
+        if key not in self._native_cache:
+            from ..parallel.sharded import ShardedCodec, make_mesh
+            if _MESH is None:
+                _MESH = make_mesh()
+            self._native_cache[key] = ShardedCodec(k, m, _MESH)
+        return self._native_cache[key]
+
+    def _mesh_encode(self, k: int, m: int, blocks) -> np.ndarray | None:
+        """Mesh-placed encode, or None when the geometry doesn't tile
+        (caller falls back to the single-device path)."""
+        sc = self._sharded(k, m)
+        baxis = sc.mesh.shape["blocks"]
+        lanes = sc.mesh.shape["lanes"]
+        blocks = np.asarray(blocks)
+        nb, kk, s = blocks.shape
+        if s % lanes:
+            return None
+        pad = (-nb) % baxis
+        if pad:
+            blocks = np.concatenate(
+                [blocks, np.zeros((pad, kk, s), np.uint8)])
+        return np.asarray(sc.encode_blocks(blocks))[:nb]
+
+    def _mesh_transform(self, k: int, m: int, x, sources,
+                        targets) -> np.ndarray | None:
+        sc = self._sharded(k, m)
+        baxis = sc.mesh.shape["blocks"]
+        lanes = sc.mesh.shape["lanes"]
+        x = np.asarray(x)
+        nb, rows, s = x.shape
+        if rows % lanes:
+            return None                     # drive rows don't tile
+        pad = (-nb) % baxis
+        if pad:
+            x = np.concatenate([x, np.zeros((pad, rows, s), np.uint8)])
+        out = np.asarray(sc.reconstruct_blocks(x, tuple(sources),
+                                               tuple(targets)))
+        return out[:nb]
+
     def _transform(self, k: int, m: int, x, sources, targets) -> np.ndarray:
         """Backend-picking transform: (B, K, S) -> (B, T, S) numpy."""
+        if _mesh_mode():
+            out = self._mesh_transform(k, m, x, sources, targets)
+            if out is not None:
+                return out
         if self._use_device:
             return np.asarray(self._codec(k, m).transform_blocks(
                 x, tuple(sources), tuple(targets)))
@@ -492,7 +561,14 @@ class ErasureSet:
                 # Parity AND bitrot digests in ONE device dispatch
                 # (north-star config #5 PUT side, ops/fused.py); framing
                 # is then pure byte interleaving on the host.
-                if algo in fused.DEVICE_ALGOS and self._use_device:
+                parity = digests = None
+                if _mesh_mode():
+                    # Multi-device: place the shard matmul on the mesh
+                    # (blocks x lanes SPMD); digests hash on host.
+                    parity = self._mesh_encode(k, m, blocks)
+                if parity is not None:
+                    digests = None
+                elif algo in fused.DEVICE_ALGOS and self._use_device:
                     parity, digests = fused.encode_and_hash(blocks, k, m,
                                                             algo=algo)
                 elif self._use_device:
@@ -724,7 +800,8 @@ class ErasureSet:
             # ONE dispatch: digests of the K chosen rows + reconstruction
             # of the missing data rows from those same HBM-resident bytes.
             x = np.stack([rows[s][1] for s in sel], axis=1)  # (nb, K, S)
-            if algo in fused.DEVICE_ALGOS and self._use_device:
+            if algo in fused.DEVICE_ALGOS and self._use_device \
+                    and not _mesh_mode():
                 digests, dev_out = fused.verify_and_transform(
                     x, k, m, tuple(sel), tuple(missing), algo=algo)
                 digests = np.asarray(digests)

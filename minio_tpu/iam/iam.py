@@ -194,6 +194,55 @@ class IAMSys:
         self._put(f"groups/{name}.json", g)
         self._broadcast_reload()
 
+    def remove_group_members(self, name: str,
+                             members: list[str]) -> None:
+        with self._mu:
+            g = self._groups.get(name)
+            if g is None:
+                raise KeyError(name)
+            g["members"] = sorted(set(g["members"]) - set(members))
+            for m in members:
+                u = self._users.get(m)
+                if u is not None and name in u.groups:
+                    u.groups.remove(name)
+                    self._put(f"users/{m}.json", u.__dict__)
+        self._put(f"groups/{name}.json", g)
+        self._broadcast_reload()
+
+    def remove_group(self, name: str) -> None:
+        """Delete a group; refuses while it still has members
+        (cf. RemoveGroup, cmd/admin-handlers-users.go)."""
+        with self._mu:
+            g = self._groups.get(name)
+            if g is None:
+                raise KeyError(name)
+            if g["members"]:
+                raise ValueError(f"group {name!r} is not empty")
+            del self._groups[name]
+        self._del(f"groups/{name}.json")
+        self._broadcast_reload()
+
+    def set_group_policy(self, name: str, policies: list[str]) -> None:
+        with self._mu:
+            g = self._groups.get(name)
+            if g is None:
+                raise KeyError(name)
+            g["policies"] = list(policies)
+        self._put(f"groups/{name}.json", g)
+        self._broadcast_reload()
+
+    def list_groups(self) -> list[str]:
+        with self._mu:
+            return sorted(self._groups)
+
+    def group_info(self, name: str) -> dict:
+        with self._mu:
+            g = self._groups.get(name)
+            if g is None:
+                raise KeyError(name)
+            return {"name": name, "members": list(g["members"]),
+                    "policies": list(g["policies"])}
+
     # -- policies ------------------------------------------------------------
 
     def set_policy(self, name: str, doc: dict | str) -> None:
@@ -202,6 +251,32 @@ class IAMSys:
             self._policies[name] = p
         self._put(f"policies/{name}.json", p.doc)
         self._broadcast_reload()
+
+    def remove_policy(self, name: str) -> None:
+        if name in pol.CANNED:
+            # Built-ins always reappear on reload; refusing beats a
+            # deletion that silently reverts (the reference also
+            # refuses, cmd/admin-handlers-users.go RemoveCannedPolicy).
+            raise ValueError(f"cannot delete built-in policy {name!r}")
+        with self._mu:
+            if name not in self._policies:
+                raise KeyError(name)
+            del self._policies[name]
+        self._del(f"policies/{name}.json")
+        self._broadcast_reload()
+
+    def list_policies(self) -> list[str]:
+        with self._mu:
+            return sorted(self._policies)
+
+    def get_policy_doc(self, name: str) -> dict:
+        # _policies is seeded with the canned set at load(), so one
+        # lookup covers both built-in and stored policies.
+        with self._mu:
+            p = self._policies.get(name)
+        if p is None:
+            raise KeyError(name)
+        return p.doc
 
     def attach_policy(self, access_key: str, names: list[str]) -> None:
         with self._mu:

@@ -51,20 +51,46 @@ def main(argv: list[str] | None = None) -> int:
     pools = ServerPools([sets])
     creds = Credentials(os.environ.get("MTPU_ROOT_USER", "minioadmin"),
                         os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin"))
-    srv = S3Server(pools, creds, host=args.host, port=args.port).start()
-    print(f"minio_tpu server on {srv.endpoint} "
-          f"({len(paths)} drives, set={sets.set_drive_count})", flush=True)
+
+    # Full subsystem stack, the newAllSubsystems role
+    # (cmd/server-main.go:441): IAM, scanner, notifications.
+    from ..background.scanner import DataScanner
+    from ..bucket.notify import NotificationSystem
+    from ..iam.iam import IAMSys
+    iam = IAMSys(pools)
+    scanner = DataScanner(pools)
+    notify = NotificationSystem()
 
     import threading
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    try:
-        # Event.wait is race-free against a signal arriving between the
-        # check and the sleep (unlike signal.pause()).
-        while not stop.wait(timeout=1.0):
-            pass
-    except KeyboardInterrupt:
-        pass
+    port = args.port
+    while True:
+        srv = S3Server(pools, creds, host=args.host, port=port,
+                       iam=iam, scanner=scanner, notify=notify).start()
+        port = srv.port                  # keep the port across restarts
+        print(f"minio_tpu server on {srv.endpoint} "
+              f"({len(paths)} drives, set={sets.set_drive_count})",
+              flush=True)
+        try:
+            # Event.wait is race-free against a signal arriving between
+            # the check and the sleep (unlike signal.pause()); the admin
+            # service endpoint shuts the listener down itself, flagged
+            # via service_event.
+            while not stop.wait(timeout=1.0):
+                if srv.service_event:
+                    break
+        except KeyboardInterrupt:
+            break
+        if srv.service_event == "restart" and not stop.is_set():
+            print("minio_tpu: service restart requested", flush=True)
+            srv.service_event = ""
+            # The admin handler schedules its own shutdown ~0.25 s out;
+            # join it here so the port is released before rebinding
+            # (shutdown is idempotent).
+            srv.shutdown()
+            continue
+        break
     srv.shutdown()
     return 0
 

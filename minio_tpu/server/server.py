@@ -59,6 +59,7 @@ class S3Server:
         self.audit_targets: list = []
         self.scanner = scanner
         self.config = None                 # lazy ConfigSys (admin API)
+        self.service_event = ""            # "" | "restart" | "stop"
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -228,12 +229,27 @@ class S3Server:
             self._check_session_token(
                 ak, query.get("X-Amz-Security-Token", [""])[0])
             return body, ak
+        from . import sigv2
+        if sigv2.is_v2_presigned(query):
+            ak = sigv2.verify_presigned_v2(self._lookup_creds,
+                                           req.command, path, query,
+                                           headers)
+            self._check_session_token(
+                ak, query.get("SecurityToken", [""])[0]
+                or req.headers.get("x-amz-security-token", ""))
+            return body, ak
         auth = req.headers.get("Authorization", "")
         if not auth:
             # Anonymous: allowed only where the bucket policy grants it
             # (the PolicySys role, cmd/bucket-policy.go) — _authorize
             # makes that call with access_key "".
             return body, ""
+        if sigv2.is_v2_header(auth):
+            ak = sigv2.verify_header_v2(self._lookup_creds, req.command,
+                                        path, query, headers)
+            self._check_session_token(
+                ak, req.headers.get("x-amz-security-token", ""))
+            return body, ak
         payload_decl, ak = verify_header_signature(
             self._lookup_creds, req.command, path, query, headers, body)
         self._check_session_token(
@@ -271,9 +287,26 @@ class S3Server:
             self._check_session_token(
                 ak, query.get("X-Amz-Security-Token", [""])[0])
             return raw, ak
+        from . import sigv2
+        if sigv2.is_v2_presigned(query):
+            ak = sigv2.verify_presigned_v2(self._lookup_creds,
+                                           req.command, path, query,
+                                           headers)
+            self._check_session_token(
+                ak, query.get("SecurityToken", [""])[0]
+                or req.headers.get("x-amz-security-token", ""))
+            return raw, ak
         auth = req.headers.get("Authorization", "")
         if not auth:
             return raw, ""
+        if sigv2.is_v2_header(auth):
+            # V2 signs no payload hash; the body streams unverified
+            # (exactly the reference's V2 semantics).
+            ak = sigv2.verify_header_v2(self._lookup_creds, req.command,
+                                        path, query, headers)
+            self._check_session_token(
+                ak, req.headers.get("x-amz-security-token", ""))
+            return raw, ak
         payload_decl, ak = verify_header_signature(
             self._lookup_creds, req.command, path, query, headers,
             body=None)
@@ -420,27 +453,103 @@ class S3Server:
 
     # -- admin API (cf. registerAdminRouter, cmd/admin-router.go:40) ---------
 
+    # Endpoint -> madmin-style admin policy action (cf. AdminAction
+    # constants, github.com/minio/pkg/iam/policy/admin-action.go).
+    _ADMIN_ACTIONS = {
+        "info": "admin:ServerInfo",
+        "datausage": "admin:DataUsageInfo",
+        "heal": "admin:Heal",
+        "trace": "admin:ServerTrace",
+        "console": "admin:ConsoleLog",
+        "users": "admin:*User",          # method-refined below
+        "groups": "admin:*Group",
+        "policies": "admin:*Policy",
+        "config": "admin:ConfigUpdate",
+        "config-help": "admin:ConfigUpdate",
+        "profile": "admin:Profiling",
+        "service": "admin:ServiceRestart",
+    }
+
+    def _admin_authorize(self, access_key: str, sub: str,
+                         method: str) -> None:
+        """Root always; otherwise an IAM identity whose policies allow
+        the endpoint's admin: action (cf. checkAdminRequestAuth,
+        cmd/admin-handler-utils.go — non-root admins are first-class)."""
+        if access_key == self.creds.access_key:
+            return
+        if self.iam is None or not access_key:
+            raise S3Error("AccessDenied", "admin API requires credentials")
+        ident = self.iam.lookup(access_key)
+        if ident is None:
+            raise S3Error("InvalidAccessKeyId")
+        base = self._ADMIN_ACTIONS.get(sub.split("/")[0], "admin:*")
+        if base == "admin:*User":
+            base = {"GET": "admin:ListUsers", "POST": "admin:CreateUser",
+                    "DELETE": "admin:DeleteUser"}.get(method,
+                                                      "admin:CreateUser")
+        elif base == "admin:*Group":
+            base = {"GET": "admin:ListGroups",
+                    "POST": "admin:AddUserToGroup",
+                    "DELETE": "admin:RemoveUserFromGroup"}.get(
+                method, "admin:AddUserToGroup")
+        elif base == "admin:*Policy":
+            base = {"GET": "admin:GetPolicy", "POST": "admin:CreatePolicy",
+                    "DELETE": "admin:DeletePolicy"}.get(
+                method, "admin:CreatePolicy")
+        if not self.iam.is_allowed(ident, base, "*"):
+            raise S3Error("AccessDenied", f"{base} denied")
+
     def _dispatch_admin(self, access_key: str, method: str, path: str,
                         query: dict, body: bytes) -> Response:
         import json as _json
         import time as _time
-        if access_key != self.creds.access_key:
-            # Admin surface is root-only here (the reference also allows
-            # admin-policy users; root covers the parity need).
-            raise S3Error("AccessDenied", "admin API requires root")
         sub = path[len("/minio/admin/v1/"):].strip("/")
+        self._admin_authorize(access_key, sub, method)
         j = lambda obj, status=200: Response(
             status, _json.dumps(obj).encode(),
             {"Content-Type": "application/json"})
 
         if sub == "info" and method == "GET":
+            # madmin.InfoMessage shape (cf. ServerInfoHandler,
+            # cmd/admin-handlers.go + madmin-go InfoMessage).
             from ..observe.health import cluster_health
             ok, detail = cluster_health(self.pools)
-            return j({"mode": "online" if ok else "degraded",
-                      "buckets": len([b for b in self.pools.list_buckets()
-                                      if b != ".mtpu.sys"]),
-                      "deploymentId": self.pools.deployment_id,
-                      "sets": detail["sets"]})
+            n_buckets = len([b for b in self.pools.list_buckets()
+                             if b != ".mtpu.sys"])
+            n_objects = usage_size = 0
+            if self.scanner is not None:
+                u = self.scanner.latest_usage()
+                if u is not None:
+                    for b, bu in u.buckets.items():
+                        n_objects += bu.objects
+                        usage_size += bu.bytes
+            drives = []
+            for pi, pool in enumerate(self.pools.pools):
+                for si, s in enumerate(pool.sets):
+                    for di, d in enumerate(s.drives):
+                        drives.append({
+                            "pool_index": pi, "set_index": si,
+                            "drive_index": di,
+                            "state": "ok" if d is not None else "offline",
+                            "endpoint": getattr(d, "root", ""),
+                        })
+            return j({
+                "mode": "online" if ok else "degraded",
+                "deploymentID": self.pools.deployment_id,
+                "buckets": {"count": n_buckets},
+                "objects": {"count": n_objects},
+                "usage": {"size": usage_size},
+                "servers": [{
+                    "state": "online",
+                    "endpoint": f"{self.host}:{self.port}",
+                    "drives": drives,
+                }],
+                "backend": {"backendType": "Erasure",
+                            "sets": detail["sets"]},
+                # back-compat keys (round-2 admin clients/tests)
+                "deploymentId": self.pools.deployment_id,
+                "sets": detail["sets"],
+            })
         if sub == "datausage" and method == "GET":
             if self.scanner is None:
                 return j({"error": "scanner not running"}, 503)
@@ -487,15 +596,72 @@ class S3Server:
             if method == "DELETE":
                 self.iam.remove_user(query.get("accessKey", [""])[0])
                 return j({"ok": True})
-        if sub == "policies" and method == "POST":
+        if sub == "policies":
             if self.iam is None:
                 return j({"error": "IAM not enabled"}, 501)
-            req_obj = _json.loads(body or b"{}")
-            try:
-                self.iam.set_policy(req_obj["name"], req_obj["policy"])
-            except (KeyError, ValueError) as e:
-                raise S3Error("InvalidArgument", str(e)) from None
-            return j({"ok": True})
+            if method == "GET":
+                name = query.get("name", [""])[0]
+                if name:
+                    try:
+                        return j({"name": name,
+                                  "policy": self.iam.get_policy_doc(name)})
+                    except KeyError:
+                        return j({"error": f"no policy {name!r}"}, 404)
+                return j({"policies": self.iam.list_policies()})
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                try:
+                    self.iam.set_policy(req_obj["name"],
+                                        req_obj["policy"])
+                except (KeyError, ValueError) as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return j({"ok": True})
+            if method == "DELETE":
+                try:
+                    self.iam.remove_policy(query.get("name", [""])[0])
+                except KeyError as e:
+                    return j({"error": f"no policy {e}"}, 404)
+                except ValueError as e:     # built-in policy
+                    return j({"error": str(e)}, 409)
+                return j({"ok": True})
+        if sub == "groups":
+            # Group CRUD + policy attach (cf. cmd/admin-handlers-users.go
+            # UpdateGroupMembers/SetPolicyForUserOrGroup).
+            if self.iam is None:
+                return j({"error": "IAM not enabled"}, 501)
+            if method == "GET":
+                name = query.get("name", [""])[0]
+                if name:
+                    try:
+                        return j(self.iam.group_info(name))
+                    except KeyError:
+                        return j({"error": f"no group {name!r}"}, 404)
+                return j({"groups": self.iam.list_groups()})
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                try:
+                    name = req_obj["name"]
+                    if req_obj.get("removeMembers"):
+                        self.iam.remove_group_members(
+                            name, req_obj["removeMembers"])
+                    else:
+                        self.iam.add_group(name,
+                                           req_obj.get("members", []),
+                                           req_obj.get("policies"))
+                    if "setPolicies" in req_obj:
+                        self.iam.set_group_policy(name,
+                                                  req_obj["setPolicies"])
+                except KeyError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return j({"ok": True})
+            if method == "DELETE":
+                try:
+                    self.iam.remove_group(query.get("name", [""])[0])
+                except KeyError as e:
+                    return j({"error": f"no group {e}"}, 404)
+                except ValueError as e:
+                    return j({"error": str(e)}, 409)
+                return j({"ok": True})
         if sub == "config":
             if not hasattr(self, "config") or self.config is None:
                 from ..config.config import ConfigSys
@@ -542,8 +708,27 @@ class S3Server:
                 return Response(200, buf.getvalue().encode(),
                                 {"Content-Type": "text/plain"})
         if sub == "service" and method == "POST":
-            return j({"action": query.get("action", ["status"])[0],
-                      "acknowledged": True, "at": _time.time()})
+            # Real semantics (cf. ServiceHandler, cmd/admin-handlers.go):
+            # stop/restart shut the listener down after this response
+            # flushes; the CLI serve loop (server/__main__.py) re-builds
+            # the server when service_event == "restart".
+            action = query.get("action", ["status"])[0]
+            if action == "status":
+                return j({"action": "status",
+                          "serviceEvent": self.service_event,
+                          "at": _time.time()})
+            if action not in ("restart", "stop"):
+                raise S3Error("InvalidArgument",
+                              f"unknown service action {action!r}")
+            self.service_event = action
+            import threading as _threading
+
+            def _later():
+                _time.sleep(0.25)        # let the response flush
+                self.shutdown()
+            _threading.Thread(target=_later, daemon=True).start()
+            return j({"action": action, "acknowledged": True,
+                      "at": _time.time()})
         raise S3Error("MethodNotAllowed",
                       f"unknown admin endpoint {sub!r}")
 
@@ -625,6 +810,12 @@ class S3Server:
         action = form.get("Action", [""])[0]
         if action == "AssumeRoleWithWebIdentity":
             return self._handle_sts_web_identity(form)
+        if action == "AssumeRoleWithClientGrants":
+            # Same OIDC token flow, legacy field names
+            # (cf. AssumeRoleWithClientGrants, cmd/sts-handlers.go:99).
+            return self._handle_sts_web_identity(
+                form, token_field="Token",
+                action_name="AssumeRoleWithClientGrants")
         if action != "AssumeRole":
             raise S3Error("NotImplemented", "unknown STS action")
         if self.iam is None:
@@ -690,16 +881,19 @@ class S3Server:
         return Response(200, xml_body,
                         {"Content-Type": "application/xml"})
 
-    def _handle_sts_web_identity(self, form: dict) -> Response:
-        """AssumeRoleWithWebIdentity: token-authenticated (unsigned) STS
-        (cf. cmd/sts-handlers.go:99 OIDC flow)."""
+    def _handle_sts_web_identity(
+            self, form: dict, token_field: str = "WebIdentityToken",
+            action_name: str = "AssumeRoleWithWebIdentity") -> Response:
+        """AssumeRoleWithWebIdentity / AssumeRoleWithClientGrants:
+        token-authenticated (unsigned) STS (cf. cmd/sts-handlers.go:48-115
+        — ClientGrants is the same OIDC validation with legacy naming)."""
         from ..iam.iam import Identity
         from ..iam.oidc import OIDCError
         if self.iam is None or getattr(self, "oidc", None) is None:
             raise S3Error("NotImplemented", "OIDC is not configured")
-        token = form.get("WebIdentityToken", [""])[0]
+        token = form.get(token_field, [""])[0]
         if not token:
-            raise S3Error("InvalidArgument", "missing WebIdentityToken")
+            raise S3Error("InvalidArgument", f"missing {token_field}")
         try:
             claims = self.oidc.validate(token)
         except OIDCError as e:
@@ -715,8 +909,7 @@ class S3Server:
             raise S3Error("InvalidArgument",
                           "DurationSeconds must be an integer") from None
         ident = self.iam.assume_role(parent, duration)
-        return self._sts_credentials_xml("AssumeRoleWithWebIdentity",
-                                         ident)
+        return self._sts_credentials_xml(action_name, ident)
 
     def _handle_post_upload(self, bucket: str, content_type: str,
                             body: bytes) -> Response:

@@ -26,7 +26,13 @@ from dataclasses import dataclass, field
 from ..utils import msgpackx
 from .errors import ErrFileCorrupt, ErrFileVersionNotFound
 
-XL_MAGIC = b"XLM1"
+XL_MAGIC = b"XLM1"       # legacy: crc32 (4B BE) integrity
+XL_MAGIC2 = b"XLM2"      # current: xxhash64 (8B BE) integrity
+
+try:                     # resolved once; read AND write key off the same flag
+    import xxhash as _xxhash
+except ImportError:      # pragma: no cover — baked into the target env
+    _xxhash = None
 
 # Version types (cf. VersionType in xl-storage-format-v2.go).
 VT_OBJECT = 1
@@ -185,18 +191,37 @@ class XLMeta:
     # -- serialization -------------------------------------------------------
 
     def to_bytes(self) -> bytes:
+        """New writes use XLM2: xxhash64 integrity trailer-in-header
+        (the reference's choice for multi-MB inline-data metadata blobs,
+        cmd/xl-storage-format-v2.go:719 — CRC32 at 4 bytes is weak
+        there).  XLM1 (crc32) stays readable."""
         payload = msgpackx.packb({"v": 1, "versions": self.versions})
+        if _xxhash is not None:
+            digest = _xxhash.xxh64(payload).intdigest()
+            return XL_MAGIC2 + struct.pack(">Q", digest) + payload
         crc = binascii.crc32(payload) & 0xFFFFFFFF
         return XL_MAGIC + struct.pack(">I", crc) + payload
 
     @classmethod
     def from_bytes(cls, buf: bytes) -> "XLMeta":
-        if len(buf) < 8 or buf[:4] != XL_MAGIC:
+        if len(buf) >= 12 and buf[:4] == XL_MAGIC2:
+            if _xxhash is None:
+                # Environment lost the module after XLM2 was written:
+                # a typed storage error keeps quorum accounting sane.
+                raise ErrFileCorrupt(
+                    "xl.meta is XLM2 but xxhash is unavailable")
+            want = struct.unpack(">Q", buf[4:12])[0]
+            payload = buf[12:]
+            if _xxhash.xxh64(payload).intdigest() != want:
+                raise ErrFileCorrupt("xl.meta checksum mismatch")
+        elif len(buf) >= 8 and buf[:4] == XL_MAGIC:
+            # legacy rounds 1-3 format
+            crc = struct.unpack(">I", buf[4:8])[0]
+            payload = buf[8:]
+            if binascii.crc32(payload) & 0xFFFFFFFF != crc:
+                raise ErrFileCorrupt("xl.meta checksum mismatch")
+        else:
             raise ErrFileCorrupt("bad xl.meta header")
-        crc = struct.unpack(">I", buf[4:8])[0]
-        payload = buf[8:]
-        if binascii.crc32(payload) & 0xFFFFFFFF != crc:
-            raise ErrFileCorrupt("xl.meta checksum mismatch")
         try:
             obj = msgpackx.unpackb(payload)
         except msgpackx.MsgpackError as e:

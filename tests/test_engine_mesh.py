@@ -1,0 +1,81 @@
+"""Multi-device codec in the PRODUCTION engine path (VERDICT r2 item 4):
+with MTPU_MESH=1 the ErasureSet places encode/reconstruct on the virtual
+8-device CPU mesh (parallel/sharded.py) — put/get/heal must be
+byte-identical to the single-device path."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine import heal as heal_mod
+from minio_tpu.engine.erasure_set import BLOCK_SIZE, ErasureSet
+from minio_tpu.storage.drive import LocalDrive
+
+
+@pytest.fixture()
+def mesh_env(monkeypatch):
+    monkeypatch.setenv("MTPU_MESH", "1")
+    yield
+    # codecs cache per-set; sets are per-test so nothing leaks
+
+
+def _payload(size, seed=11):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+class TestMeshEngine:
+    def test_put_get_byte_identical_to_single_device(self, tmp_path,
+                                                     monkeypatch):
+        data = _payload(3 * BLOCK_SIZE + 12345)
+
+        monkeypatch.setenv("MTPU_MESH", "0")
+        es_single = ErasureSet(
+            [LocalDrive(str(tmp_path / f"s{i}")) for i in range(4)])
+        es_single.make_bucket("b")
+        es_single.put_object("b", "obj", data)
+
+        monkeypatch.setenv("MTPU_MESH", "1")
+        es_mesh = ErasureSet(
+            [LocalDrive(str(tmp_path / f"m{i}")) for i in range(4)])
+        es_mesh.make_bucket("b")
+        fi = es_mesh.put_object("b", "obj", data)
+        assert fi.size == len(data)
+
+        # bytes on disk identical: same framing, same parity
+        for i in range(4):
+            a = (tmp_path / f"s{i}" / "b" / "obj").glob("*/part.1")
+            b = (tmp_path / f"m{i}" / "b" / "obj").glob("*/part.1")
+            fa, fb = next(iter(a), None), next(iter(b), None)
+            assert fa is not None and fb is not None
+            assert fa.read_bytes() == fb.read_bytes(), f"drive {i}"
+
+        _, got = es_mesh.get_object("b", "obj")
+        assert got == data
+
+    def test_degraded_get_on_mesh(self, tmp_path, mesh_env):
+        data = _payload(2 * BLOCK_SIZE + 999, seed=3)
+        es = ErasureSet(
+            [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)])
+        es.make_bucket("b")
+        es.put_object("b", "obj", data)
+        es.drives[0] = None            # force reconstruct path
+        _, got = es.get_object("b", "obj")
+        assert hashlib.md5(got).hexdigest() == \
+            hashlib.md5(data).hexdigest()
+
+    def test_heal_on_mesh(self, tmp_path, mesh_env):
+        import shutil
+        data = _payload(BLOCK_SIZE + 77, seed=5)
+        es = ErasureSet(
+            [LocalDrive(str(tmp_path / f"h{i}")) for i in range(4)])
+        es.make_bucket("b")
+        es.put_object("b", "obj", data)
+        shutil.rmtree(str(tmp_path / "h2"))
+        es.drives[2] = LocalDrive(str(tmp_path / "h2"))
+        heal_mod.heal_bucket(es, "b")
+        results = list(heal_mod.heal_object(es, "b", "obj"))
+        assert any(2 in r.healed_drives for r in results)
+        _, got = es.get_object("b", "obj")
+        assert got == data

@@ -133,7 +133,7 @@ class TestAdminAPI:
         status, _, data = cli.request("GET", "/minio/admin/v1/info")
         assert status == 200
         info = json.loads(data)
-        assert info["mode"] == "online" and info["buckets"] == 1
+        assert info["mode"] == "online" and info["buckets"]["count"] == 1
         status, _, data = cli.request("GET", "/minio/admin/v1/datausage")
         assert status == 200
         usage = json.loads(data)
@@ -192,3 +192,104 @@ class TestAdminAPI:
         assert status == 200
         msgs = [e["message"] for e in json.loads(data)["log"]]
         assert "hello from test" in msgs
+
+
+class TestAdminBreadth:
+    """Round-3 admin surface: non-root admins, groups CRUD, policy CRUD,
+    madmin-shaped info, real service semantics (VERDICT r2 item 7)."""
+
+    def test_non_root_admin_via_policy(self, stack):
+        srv, cli, _ = stack
+        import json
+        srv.iam.set_policy("ops-admin", {"Statement": [
+            {"Effect": "Allow",
+             "Action": ["admin:ServerInfo", "admin:ListUsers"],
+             "Resource": "*"}]})
+        srv.iam.add_user("opsuser", "opsuser-secret1", ["ops-admin"])
+        ops = S3Client(srv.endpoint, "opsuser", "opsuser-secret1")
+        status, _, data = ops.request("GET", "/minio/admin/v1/info")
+        assert status == 200
+        assert json.loads(data)["backend"]["backendType"] == "Erasure"
+        status, _, _ = ops.request("GET", "/minio/admin/v1/users")
+        assert status == 200
+        # not granted: user creation and service control
+        status, _, _ = ops.request(
+            "POST", "/minio/admin/v1/users",
+            body=json.dumps({"accessKey": "x", "secretKey": "x" * 12}
+                            ).encode())
+        assert status == 403
+        status, _, _ = ops.request("POST", "/minio/admin/v1/service",
+                                   query={"action": "restart"})
+        assert status == 403
+
+    def test_group_crud_endpoints(self, stack):
+        srv, cli, _ = stack
+        import json
+        srv.iam.add_user("gmember", "gmember-secret1", [])
+        body = json.dumps({"name": "readers", "members": ["gmember"],
+                           "policies": ["readonly"]}).encode()
+        status, _, _ = cli.request("POST", "/minio/admin/v1/groups",
+                                   body=body)
+        assert status == 200
+        _, _, data = cli.request("GET", "/minio/admin/v1/groups")
+        assert "readers" in json.loads(data)["groups"]
+        _, _, data = cli.request("GET", "/minio/admin/v1/groups",
+                                 query={"name": "readers"})
+        info = json.loads(data)
+        assert info["members"] == ["gmember"]
+        assert info["policies"] == ["readonly"]
+        # membership grants the group's policy
+        ident = srv.iam.lookup("gmember")
+        assert srv.iam.is_allowed(ident, "s3:GetObject", "any/k")
+        # non-empty delete refused; empty delete works
+        status, _, _ = cli.request("DELETE", "/minio/admin/v1/groups",
+                                   query={"name": "readers"})
+        assert status == 409
+        cli.request("POST", "/minio/admin/v1/groups", body=json.dumps(
+            {"name": "readers", "removeMembers": ["gmember"]}).encode())
+        status, _, _ = cli.request("DELETE", "/minio/admin/v1/groups",
+                                   query={"name": "readers"})
+        assert status == 200
+
+    def test_policy_crud_endpoints(self, stack):
+        srv, cli, _ = stack
+        import json
+        doc = {"Statement": [{"Effect": "Allow", "Action": "s3:GetObject",
+                              "Resource": "arn:aws:s3:::pub/*"}]}
+        cli.request("POST", "/minio/admin/v1/policies", body=json.dumps(
+            {"name": "pub-read", "policy": doc}).encode())
+        _, _, data = cli.request("GET", "/minio/admin/v1/policies")
+        assert "pub-read" in json.loads(data)["policies"]
+        _, _, data = cli.request("GET", "/minio/admin/v1/policies",
+                                 query={"name": "pub-read"})
+        assert json.loads(data)["policy"]["Statement"][0]["Action"] \
+            == "s3:GetObject"
+        status, _, _ = cli.request("DELETE", "/minio/admin/v1/policies",
+                                   query={"name": "pub-read"})
+        assert status == 200
+        status, _, _ = cli.request("GET", "/minio/admin/v1/policies",
+                                   query={"name": "pub-read"})
+        assert status == 404
+
+    def test_service_restart_shuts_listener(self, tmp_path):
+        import json
+        import time
+        drives = [LocalDrive(str(tmp_path / f"svc{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        srv = S3Server(pools, Credentials(ROOT, SECRET)).start()
+        cli = S3Client(srv.endpoint, ROOT, SECRET)
+        status, _, data = cli.request("POST", "/minio/admin/v1/service",
+                                      query={"action": "restart"})
+        assert status == 200 and json.loads(data)["acknowledged"]
+        assert srv.service_event == "restart"
+        # the listener actually goes down (the CLI loop would rebuild)
+        deadline = time.time() + 5
+        down = False
+        while time.time() < deadline:
+            try:
+                cli.list_buckets()
+                time.sleep(0.1)
+            except Exception:  # noqa: BLE001
+                down = True
+                break
+        assert down, "listener still serving after restart request"

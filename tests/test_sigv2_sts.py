@@ -1,0 +1,181 @@
+"""SigV2 (header + presigned) and STS AssumeRoleWithClientGrants
+(VERDICT r2 item 8).  cf. cmd/signature-v2.go, cmd/sts-handlers.go:99."""
+
+import http.client
+import re
+import urllib.parse
+
+import pytest
+
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.iam.iam import IAMSys
+from minio_tpu.iam.oidc import OpenIDConfig, make_hs256_token
+from minio_tpu.server import sigv2
+from minio_tpu.server.client import S3Client
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ROOT, SECRET = "v2admin", "v2admin-secret1"
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    iam = IAMSys(pools)
+    oidc = OpenIDConfig(hs256_secret=b"sts-secret", audience="mtpu")
+    srv = S3Server(pools, Credentials(ROOT, SECRET), iam=iam,
+                   oidc=oidc).start()
+    cli = S3Client(srv.endpoint, ROOT, SECRET)
+    yield srv, cli
+    srv.shutdown()
+
+
+def _v2_request(srv, creds, method, path, query=None, body=b"",
+                headers=None, presigned=False):
+    headers = dict(headers or {})
+    q = {k: [v] for k, v in (query or {}).items()}
+    if presigned:
+        q = sigv2.presign_v2(creds, method, path, query=q)
+        url = path + "?" + urllib.parse.urlencode(
+            {k: v[0] for k, v in q.items()})
+    else:
+        headers = sigv2.sign_header_v2(creds, method, path, q, headers)
+        qs = urllib.parse.urlencode({k: v[0] for k, v in q.items()})
+        url = path + ("?" + qs if qs else "")
+    conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+    try:
+        conn.request(method, url, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class TestSigV2:
+    def test_header_signed_roundtrip(self, stack):
+        srv, cli = stack
+        cli.make_bucket("v2b")
+        creds = Credentials(ROOT, SECRET)
+        st, out = _v2_request(srv, creds, "PUT", "/v2b/obj",
+                              body=b"v2 signed",
+                              headers={"Content-Type": "text/plain",
+                                       "x-amz-meta-via": "v2"})
+        assert st == 200, out
+        st, out = _v2_request(srv, creds, "GET", "/v2b/obj")
+        assert st == 200 and out == b"v2 signed"
+        # metadata survived (amz headers participate in the signature)
+        assert cli.head_object("v2b", "obj").get("x-amz-meta-via") == "v2"
+
+    def test_wrong_secret_rejected(self, stack):
+        srv, cli = stack
+        cli.make_bucket("v2c")
+        bad = Credentials(ROOT, "wrong-secret-123")
+        st, out = _v2_request(srv, bad, "GET", "/v2c")
+        assert st == 403 and b"SignatureDoesNotMatch" in out
+
+    def test_tampered_amz_header_rejected(self, stack):
+        srv, cli = stack
+        cli.make_bucket("v2d")
+        creds = Credentials(ROOT, SECRET)
+        headers = sigv2.sign_header_v2(creds, "PUT", "/v2d/k",
+                                       {}, {"x-amz-meta-a": "1"})
+        headers["x-amz-meta-a"] = "2"        # tamper after signing
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+        conn.request("PUT", "/v2d/k", body=b"x", headers=headers)
+        resp = conn.getresponse()
+        out = resp.read()
+        conn.close()
+        assert resp.status == 403, out
+
+    def test_presigned_get(self, stack):
+        srv, cli = stack
+        cli.make_bucket("v2e")
+        cli.put_object("v2e", "pre", b"presigned v2")
+        creds = Credentials(ROOT, SECRET)
+        st, out = _v2_request(srv, creds, "GET", "/v2e/pre",
+                              presigned=True)
+        assert st == 200 and out == b"presigned v2"
+
+    def test_presigned_expired(self, stack):
+        srv, cli = stack
+        cli.make_bucket("v2f")
+        cli.put_object("v2f", "pre", b"x")
+        creds = Credentials(ROOT, SECRET)
+        q = sigv2.presign_v2(creds, "GET", "/v2f/pre", expires_in=-10)
+        url = "/v2f/pre?" + urllib.parse.urlencode(
+            {k: v[0] for k, v in q.items()})
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+        conn.request("GET", url)
+        resp = conn.getresponse()
+        out = resp.read()
+        conn.close()
+        assert resp.status == 403, out
+
+    def test_subresource_in_signature(self, stack):
+        """uploads/uploadId subresources enter CanonicalizedResource."""
+        srv, cli = stack
+        cli.make_bucket("v2g")
+        creds = Credentials(ROOT, SECRET)
+        st, out = _v2_request(srv, creds, "POST", "/v2g/mp",
+                              query={"uploads": ""})
+        assert st == 200, out
+        uid = re.search(rb"<UploadId>([^<]+)</UploadId>", out).group(1)
+        assert uid
+
+
+class TestClientGrants:
+    def test_assume_role_with_client_grants(self, stack):
+        srv, cli = stack
+        cli.make_bucket("cgb")
+        cli.put_object("cgb", "k", b"cg data")
+        token = make_hs256_token(
+            b"sts-secret",
+            {"iss": "test-idp", "aud": "mtpu", "sub": "cg-app",
+             "policy": "readonly"})
+        body = urllib.parse.urlencode({
+            "Action": "AssumeRoleWithClientGrants",
+            "Version": "2011-06-15", "Token": token}).encode()
+        st, _, data = cli.request("POST", "/", body=body)
+        assert st == 200, data
+        txt = data.decode()
+        assert "<AssumeRoleWithClientGrantsResponse" in txt
+        ak = re.search(r"<AccessKeyId>([^<]+)", txt).group(1)
+        sk = re.search(r"<SecretAccessKey>([^<]+)", txt).group(1)
+        tok = re.search(r"<SessionToken>([^<]+)", txt).group(1)
+        sts_cli = S3Client(srv.endpoint, ak, sk)
+        st, _, out = sts_cli.request(
+            "GET", "/cgb/k", headers={"x-amz-security-token": tok})
+        assert st == 200 and out == b"cg data"
+        # readonly: writes denied
+        st, _, _ = sts_cli.request(
+            "PUT", "/cgb/new", body=b"x",
+            headers={"x-amz-security-token": tok})
+        assert st == 403
+
+    def test_bad_token_rejected(self, stack):
+        srv, cli = stack
+        body = urllib.parse.urlencode({
+            "Action": "AssumeRoleWithClientGrants",
+            "Version": "2011-06-15", "Token": "garbage.token.here"}
+        ).encode()
+        st, _, data = cli.request("POST", "/", body=body)
+        assert st == 403, data
+
+
+class TestV2StsToken:
+    def test_v2_presigned_sts_requires_token(self, stack):
+        """STS credentials must present their session token on V2
+        presigned URLs too (review r3 finding)."""
+        srv, cli = stack
+        cli.make_bucket("v2sts")
+        cli.put_object("v2sts", "k", b"x")
+        srv.iam.add_user("parent2", "parent2-secret1", ["readwrite"])
+        from minio_tpu.iam.iam import Identity
+        ident = srv.iam.assume_role(srv.iam.lookup("parent2"), 3600)
+        creds = Credentials(ident.access_key, ident.secret_key)
+        st, out = _v2_request(srv, creds, "GET", "/v2sts/k",
+                              presigned=True)
+        assert st == 403, out           # token missing -> rejected

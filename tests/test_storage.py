@@ -330,3 +330,52 @@ class TestFormat:
     def test_quorum(self):
         assert quorum_formatted([{}, {"a": 1}, {"a": 1}, None]) is False
         assert quorum_formatted([{"a": 1}] * 3 + [None]) is True
+
+
+class TestXLMetaIntegrity:
+    def test_xxhash64_roundtrip_and_corruption(self):
+        from minio_tpu.storage.xlmeta import XLMeta, XL_MAGIC2
+        from minio_tpu.storage.errors import ErrFileCorrupt
+        m = XLMeta([{"id": "", "mt": 1, "size": 3}])
+        raw = m.to_bytes()
+        assert raw[:4] == XL_MAGIC2              # new writes: xxhash64
+        assert XLMeta.from_bytes(raw).versions == m.versions
+        bad = bytearray(raw)
+        bad[-1] ^= 1
+        import pytest as _pytest
+        with _pytest.raises(ErrFileCorrupt):
+            XLMeta.from_bytes(bytes(bad))
+
+    def test_legacy_crc32_meta_still_readable(self):
+        import binascii
+        import struct
+        from minio_tpu.storage.xlmeta import XLMeta, XL_MAGIC
+        from minio_tpu.utils import msgpackx
+        payload = msgpackx.packb({"v": 1, "versions": [{"id": "x"}]})
+        crc = binascii.crc32(payload) & 0xFFFFFFFF
+        legacy = XL_MAGIC + struct.pack(">I", crc) + payload
+        assert XLMeta.from_bytes(legacy).versions == [{"id": "x"}]
+
+
+class TestDirtyPersistence:
+    def test_dirty_set_survives_restart(self, tmp_path):
+        """Buckets marked dirty before a restart still get a full
+        rescan after it (VERDICT r2 item 9)."""
+        from minio_tpu.background.scanner import DataScanner
+        from minio_tpu.background.usage import DirtyTracker
+        from minio_tpu.engine.pools import ServerPools
+        from minio_tpu.engine.sets import ErasureSets
+        from minio_tpu.storage.drive import LocalDrive
+
+        drives = [LocalDrive(str(tmp_path / f"dp{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        pools.make_bucket("dirtyb")
+        t1 = DirtyTracker()
+        s1 = DataScanner(pools, dirty=t1)
+        s1.scan_cycle()                  # persists the (empty) baseline
+        t1.mark("dirtyb")
+        t1.save(pools.pools[0].sets[0])  # the periodic checkpoint
+        # "restart": a fresh tracker + scanner over the same drives
+        t2 = DirtyTracker()
+        DataScanner(pools, dirty=t2)
+        assert t2.is_dirty("dirtyb")
