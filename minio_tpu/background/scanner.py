@@ -49,6 +49,8 @@ class DataScanner:
                 self.dirty.load(es)
             except Exception:  # noqa: BLE001 — scanning must still run
                 pass
+            # mark-triggered checkpoints between cycles (debounced)
+            self.dirty.bind(es)
 
     def _first_es(self):
         try:

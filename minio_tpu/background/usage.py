@@ -110,10 +110,15 @@ class DirtyTracker:
     _global = None
     PERSIST_PATH = "dirty-buckets.json"
 
+    SAVE_INTERVAL = 5.0      # debounce for mark-triggered checkpoints
+
     def __init__(self):
         self._mu = threading.Lock()
         self._dirty: set[str] = set()
         self._stamp: dict[str, float] = {}
+        self._es = None                 # persistence target (bind())
+        self._last_save = 0.0
+        self._save_timer: threading.Timer | None = None
 
     @classmethod
     def shared(cls) -> "DirtyTracker":
@@ -121,10 +126,50 @@ class DirtyTracker:
             cls._global = cls()
         return cls._global
 
+    def bind(self, es) -> None:
+        """Attach a drive set for mark-triggered checkpoints — without
+        this, dirt marked between scan cycles would only persist at the
+        NEXT cycle end (i.e. after it was already consumed)."""
+        self._es = es
+
     def mark(self, bucket: str) -> None:
         with self._mu:
             self._dirty.add(bucket)
             self._stamp[bucket] = time.time()
+        self._maybe_persist()
+
+    def _maybe_persist(self) -> None:
+        es = self._es
+        if es is None:
+            return
+        now = time.time()
+        with self._mu:
+            due = now - self._last_save >= self.SAVE_INTERVAL
+            if due:
+                self._last_save = now
+            elif self._save_timer is None:
+                # trailing-edge save so the LAST mark of a burst lands
+                delay = self.SAVE_INTERVAL - (now - self._last_save)
+                t = threading.Timer(max(delay, 0.05), self._timer_save)
+                t.daemon = True
+                self._save_timer = t
+                t.start()
+        if due:
+            try:
+                self.save(es)
+            except Exception:  # noqa: BLE001 — persistence is advisory
+                pass
+
+    def _timer_save(self) -> None:
+        with self._mu:
+            self._save_timer = None
+            self._last_save = time.time()
+        es = self._es
+        if es is not None:
+            try:
+                self.save(es)
+            except Exception:  # noqa: BLE001
+                pass
 
     def snapshot_and_clear(self) -> set[str]:
         with self._mu:

@@ -637,6 +637,14 @@ class ErasureSet:
             data = self._read_inline(bucket, obj, fi, metas, version_id)
             return fi, iter((data[offset:offset + length],))
 
+        from ..storage import xlmeta_v1
+        if xlmeta_v1.is_v1(fi):
+            # Legacy format-v1 object: unframed shard files with
+            # whole-file bitrot, 10 MiB blocks (migration read path,
+            # cmd/xl-storage-format-v1.go + cmd/bitrot-whole.go).
+            data = self._read_v1_object(bucket, obj, fi)
+            return fi, iter((data[offset:offset + length],))
+
         batch_bytes = BATCH_BLOCKS * BLOCK_SIZE
 
         # Map the object byte range onto parts (each part an independent
@@ -682,6 +690,87 @@ class ErasureSet:
             if fut is not None:
                 yield fut.result()
         return fi, gen()
+
+    def _read_v1_object(self, bucket, obj, fi) -> bytes:
+        """Whole-object read of a legacy (xl.json) object: per-drive
+        UNFRAMED part files verified by whole-file digest, per-block
+        reconstruction via the CPU oracle (v1 is a migration path, not
+        a hot path)."""
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        bs = fi.erasure.block_size
+        dist = fi.erasure.distribution
+        out = bytearray()
+        from ..storage import xlmeta_v1
+        # v1 checksums are per-drive: each drive's xl.json carries the
+        # whole-file hash of ITS shard — parse once per drive, not once
+        # per (drive, part).
+        own_sums: list[list[dict] | None] = []
+        for d in self.drives:
+            if d is None:
+                own_sums.append(None)
+                continue
+            try:
+                own = xlmeta_v1.parse_xl_json(
+                    d.read_all(bucket, f"{obj}/{xlmeta_v1.XL_JSON}"),
+                    bucket, obj)
+                own_sums.append(own.erasure.checksums)
+            except StorageError:
+                own_sums.append(None)             # unverifiable: accept
+
+        for part in fi.parts:
+
+            def read_row(pos: int):
+                d = self.drives[pos]
+                if d is None:
+                    return None
+                try:
+                    raw = d.read_file(bucket,
+                                      f"{obj}/part.{part.number}")
+                except StorageError:
+                    return None
+                for c in own_sums[pos] or ():
+                    if c.get("name") == f"part.{part.number}" \
+                            and c.get("hash"):
+                        algo = c.get("algo", "highwayhash256")
+                        if bitrot_io.whole_file_digest(
+                                raw, algo) != c["hash"]:
+                            return None           # corrupt shard
+                return raw
+
+            rows: list[bytes | None] = [None] * (k + m)
+            for pos in range(self.n):
+                if pos < len(dist):
+                    raw = read_row(pos)
+                    if raw is not None:
+                        rows[dist[pos] - 1] = raw
+            if sum(1 for r in rows if r is not None) < k:
+                raise ErrErasureReadQuorum(
+                    f"{bucket}/{obj} part {part.number} (v1)")
+            # Per-block chunks: v1 sizes each block's shard as
+            # ceil(cur_block/k) with the final block shorter.
+            remaining = part.size
+            offs = [0] * (k + m)
+            while remaining > 0:
+                cur = min(bs, remaining)
+                chunk = -(-cur // k)
+                block_rows: list[np.ndarray | None] = []
+                for s, r in enumerate(rows):
+                    if r is None:
+                        block_rows.append(None)
+                        continue
+                    block_rows.append(np.frombuffer(
+                        r[offs[s]:offs[s] + chunk], dtype=np.uint8))
+                    offs[s] += chunk
+                missing = [s for s in range(k) if block_rows[s] is None]
+                if missing:
+                    rec = self._cpu(k, m).reconstruct(block_rows,
+                                                      data_only=True)
+                    for s in missing:
+                        block_rows[s] = rec[s]
+                blk = np.concatenate(block_rows[:k])[:cur]
+                out += blk.tobytes()
+                remaining -= cur
+        return bytes(out)
 
     def _read_metadata(self, bucket, obj, version_id=""):
         version_id = normalize_version_id(version_id)
@@ -1052,6 +1141,21 @@ class ErasureSet:
                     XLMeta.from_bytes(raw).list_versions(bucket, obj))
             except StorageError:
                 continue
+        if not lists:
+            # legacy xl.json objects: one unversioned entry per drive
+            from ..storage import xlmeta_v1
+            res = self._map_drives(
+                lambda d: d.read_all(bucket,
+                                     f"{obj}/{xlmeta_v1.XL_JSON}"))
+            for raw, err in res:
+                if err is not None or raw is None:
+                    continue
+                try:
+                    fi = xlmeta_v1.parse_xl_json(raw, bucket, obj)
+                    fi.is_latest = True
+                    lists.append([fi])
+                except StorageError:
+                    continue
         if not lists:
             raise ErrObjectNotFound(f"{bucket}/{obj}")
         # Quorum against the CONFIGURED stripe width, not the responder

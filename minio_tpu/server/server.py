@@ -235,7 +235,8 @@ class S3Server:
                                            req.command, path, query,
                                            headers)
             self._check_session_token(
-                ak, query.get("SecurityToken", [""])[0]
+                ak, query.get("X-Amz-Security-Token",
+                              query.get("SecurityToken", [""]))[0]
                 or req.headers.get("x-amz-security-token", ""))
             return body, ak
         auth = req.headers.get("Authorization", "")
@@ -293,7 +294,8 @@ class S3Server:
                                            req.command, path, query,
                                            headers)
             self._check_session_token(
-                ak, query.get("SecurityToken", [""])[0]
+                ak, query.get("X-Amz-Security-Token",
+                              query.get("SecurityToken", [""]))[0]
                 or req.headers.get("x-amz-security-token", ""))
             return raw, ak
         auth = req.headers.get("Authorization", "")

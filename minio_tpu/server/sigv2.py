@@ -35,7 +35,11 @@ RESOURCE_LIST = (
 
 
 def canonicalized_resource(path: str, query: dict[str, list[str]]) -> str:
-    out = path or "/"
+    # V2 clients sign the PERCENT-ENCODED resource (the reference uses
+    # the escaped path); callers pass the decoded path and we re-encode
+    # canonically so both sides agree for keys with spaces/unicode.
+    from .sigv4 import uri_encode
+    out = uri_encode(path or "/", encode_slash=False)
     parts = []
     for k in sorted(query):
         if k not in RESOURCE_LIST:

@@ -1,0 +1,109 @@
+"""Page-cache-bypass I/O (the internal/disk + O_DIRECT role).
+
+The reference opens shard files O_DIRECT with aligned buffers and
+fdatasync (cmd/xl-storage.go:1424,1533; internal/disk) so object bytes
+don't double-buffer through the page cache — both for predictable
+memory behavior and so benchmarks measure drives, not cache.
+
+Modes (env MTPU_ODIRECT, a config knob like the reference's
+MINIO_DRIVE_SYNC):
+  - "fadvise" (default): buffered I/O + POSIX_FADV_DONTNEED after bulk
+    transfers — portable cache-bypass-after-the-fact.
+  - "direct": O_DIRECT aligned reads for bulk data (page-aligned scratch
+    via mmap), fadvise on writes; falls back to buffered when alignment
+    or the filesystem refuses.
+  - "off": plain buffered I/O (tests that assert on page-cache warmth).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+
+ALIGN = 4096
+BULK = 128 * 1024          # below this, cache behavior is irrelevant
+
+
+def mode() -> str:
+    m = os.environ.get("MTPU_ODIRECT", "fadvise")
+    return m if m in ("off", "fadvise", "direct") else "fadvise"
+
+
+def drop_cache(fd: int) -> None:
+    """Advise the kernel to evict this file's pages (post-I/O)."""
+    try:
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    except (AttributeError, OSError):
+        pass
+
+
+def read_range(path: str, offset: int, length: int) -> bytes:
+    """Read [offset, offset+length) (length < 0 = to EOF) honoring the
+    configured cache mode.  Raises FileNotFoundError/IsADirectoryError
+    like open()."""
+    m = mode()
+    if length < 0:
+        length = max(os.path.getsize(path) - offset, 0)
+    if m == "direct" and length >= BULK:
+        data = _direct_read(path, offset, length)
+        if data is not None:
+            return data
+    with open(path, "rb") as f:
+        if offset:
+            f.seek(offset)
+        data = f.read(length)
+        if m != "off" and length >= BULK:
+            drop_cache(f.fileno())
+        return data
+
+
+def _direct_read(path: str, offset: int, length: int) -> bytes | None:
+    """O_DIRECT read with page-aligned scratch; None -> caller falls
+    back to buffered (unsupported fs, EINVAL, ...)."""
+    if not hasattr(os, "O_DIRECT"):
+        return None
+    a_off = offset & ~(ALIGN - 1)
+    a_end = (offset + length + ALIGN - 1) & ~(ALIGN - 1)
+    need = a_end - a_off
+    try:
+        fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
+    except OSError:
+        return None
+    try:
+        buf = mmap.mmap(-1, need)      # anonymous maps are page-aligned
+        view = memoryview(buf)
+        try:
+            os.lseek(fd, a_off, os.SEEK_SET)
+            got = 0
+            while got < need:
+                with view[got:] as window:
+                    n = os.readv(fd, [window])
+                if n <= 0:
+                    break              # EOF (file shorter than aligned end)
+                got += n
+            lo = offset - a_off
+            hi = min(lo + length, got)
+            out = b"" if hi <= lo else bytes(view[lo:hi])
+            return out
+        finally:
+            view.release()
+            buf.close()
+    except OSError:
+        return None
+    finally:
+        os.close(fd)
+
+
+def write_done(fd: int, nbytes: int) -> None:
+    """Post-write cache policy for bulk shard writes (the write side of
+    the O_DIRECT role: staged shard bytes should not linger in cache).
+
+    Dirty pages can't be evicted, so sync first — fdatasync per batch
+    also spreads the publish-time fsync cost across the stream, like
+    the reference's O_DIRECT+fdatasync writer (cmd/xl-storage.go:1533)."""
+    if mode() != "off" and nbytes >= BULK:
+        try:
+            os.fdatasync(fd)
+        except OSError:
+            pass
+        drop_cache(fd)

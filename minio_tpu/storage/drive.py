@@ -26,7 +26,7 @@ import shutil
 import threading
 import uuid
 
-from . import bitrot_io
+from . import bitrot_io, diskio
 from .errors import (ErrDiskNotFound, ErrFileAccessDenied, ErrFileCorrupt,
                      ErrFileNotFound, ErrFileVersionNotFound, ErrIsNotRegular,
                      ErrPathNotFound, ErrVolumeExists, ErrVolumeNotEmpty,
@@ -179,6 +179,7 @@ class LocalDrive:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
+            diskio.write_done(f.fileno(), len(data))
 
     def append_file(self, vol: str, path: str, data: bytes) -> None:
         """Append to a staged shard file (streaming writes land batch by
@@ -188,15 +189,17 @@ class LocalDrive:
         os.makedirs(os.path.dirname(p), exist_ok=True)
         with open(p, "ab") as f:
             f.write(data)
+            f.flush()
+            diskio.write_done(f.fileno(), len(data))
 
     def read_file(self, vol: str, path: str, offset: int = 0,
                   length: int = -1) -> bytes:
+        """Bulk shard reads honor the page-cache-bypass mode
+        (storage/diskio.py — the odirect-read role,
+        cmd/xl-storage.go:1424)."""
         p = self._file_path(vol, path)
         try:
-            with open(p, "rb") as f:
-                if offset:
-                    f.seek(offset)
-                return f.read() if length < 0 else f.read(length)
+            return diskio.read_range(p, offset, length)
         except FileNotFoundError:
             raise ErrFileNotFound(f"{vol}/{path}") from None
         except IsADirectoryError:
@@ -257,9 +260,26 @@ class LocalDrive:
     def read_version(self, vol: str, obj: str, version_id: str = "",
                      read_data: bool = False) -> FileInfo:
         """ReadVersion (cf. /root/reference/cmd/xl-storage.go:1183):
-        returns FileInfo; inline data always included when present."""
+        returns FileInfo; inline data always included when present.
+
+        Falls back to the legacy xl.json (format v1) when no xl.meta
+        exists — the migration read path, cmd/xl-storage-format-v1.go."""
         self._check_vol(vol)
-        meta = self._read_xlmeta(vol, obj)
+        try:
+            meta = self._read_xlmeta(vol, obj)
+        except ErrFileNotFound:
+            from . import xlmeta_v1
+            try:
+                raw = self.read_all(vol,
+                                    os.path.join(obj, xlmeta_v1.XL_JSON))
+            except ErrFileNotFound:
+                raise ErrFileNotFound(f"{vol}/{obj}") from None
+            fi = xlmeta_v1.parse_xl_json(raw, vol, obj)
+            if version_id and fi.version_id != version_id:
+                from .errors import ErrFileVersionNotFound
+                raise ErrFileVersionNotFound(
+                    f"{vol}/{obj}@{version_id}") from None
+            return fi
         fi = meta.get(version_id, vol, obj)
         return fi
 

@@ -37,14 +37,15 @@ def _v2_request(srv, creds, method, path, query=None, body=b"",
                 headers=None, presigned=False):
     headers = dict(headers or {})
     q = {k: [v] for k, v in (query or {}).items()}
+    wire_path = urllib.parse.quote(path, safe="/~-._")
     if presigned:
         q = sigv2.presign_v2(creds, method, path, query=q)
-        url = path + "?" + urllib.parse.urlencode(
+        url = wire_path + "?" + urllib.parse.urlencode(
             {k: v[0] for k, v in q.items()})
     else:
         headers = sigv2.sign_header_v2(creds, method, path, q, headers)
         qs = urllib.parse.urlencode({k: v[0] for k, v in q.items()})
-        url = path + ("?" + qs if qs else "")
+        url = wire_path + ("?" + qs if qs else "")
     conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
     try:
         conn.request(method, url, body=body, headers=headers)
@@ -179,3 +180,18 @@ class TestV2StsToken:
         st, out = _v2_request(srv, creds, "GET", "/v2sts/k",
                               presigned=True)
         assert st == 403, out           # token missing -> rejected
+
+
+class TestV2Encoding:
+    def test_key_with_spaces_and_unicode(self, stack):
+        """V2 signs the percent-encoded resource; keys needing encoding
+        must still authenticate (review r3 finding)."""
+        srv, cli = stack
+        cli.make_bucket("v2enc")
+        creds = Credentials(ROOT, SECRET)
+        for key in ("a b.txt", "sp+plus", "uni-éé.bin"):
+            st, out = _v2_request(srv, creds, "PUT", f"/v2enc/{key}",
+                                  body=b"enc")
+            assert st == 200, (key, out)
+            st, out = _v2_request(srv, creds, "GET", f"/v2enc/{key}")
+            assert st == 200 and out == b"enc", key

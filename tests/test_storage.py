@@ -379,3 +379,55 @@ class TestDirtyPersistence:
         t2 = DirtyTracker()
         DataScanner(pools, dirty=t2)
         assert t2.is_dirty("dirtyb")
+
+
+class TestDiskIO:
+    @pytest.mark.parametrize("mode", ["off", "fadvise", "direct"])
+    def test_read_modes_equivalent(self, tmp_path, monkeypatch, mode):
+        """All cache modes return identical bytes, aligned or not
+        (the O_DIRECT-role knob, cmd/xl-storage.go:1424,1533)."""
+        from minio_tpu.storage import diskio
+        monkeypatch.setenv("MTPU_ODIRECT", mode)
+        p = str(tmp_path / "blob")
+        data = bytes(range(256)) * 2048          # 512 KiB, > BULK
+        with open(p, "wb") as f:
+            f.write(data)
+        assert diskio.read_range(p, 0, -1) == data
+        assert diskio.read_range(p, 0, len(data)) == data
+        # unaligned offset/length crossing alignment boundaries
+        assert diskio.read_range(p, 4097, 140000) == data[4097:4097 + 140000]
+        # read past EOF trims
+        assert diskio.read_range(p, len(data) - 10, 10 ** 6) == data[-10:]
+
+    def test_drive_read_file_uses_modes(self, tmp_path, monkeypatch):
+        from minio_tpu.storage.drive import LocalDrive
+        monkeypatch.setenv("MTPU_ODIRECT", "direct")
+        d = LocalDrive(str(tmp_path / "dd"))
+        d.make_volume("v")
+        blob = b"\xab" * 300000
+        d.create_file("v", "big", blob)
+        assert d.read_file("v", "big") == blob
+        assert d.read_file("v", "big", 4096, 131072) == \
+            blob[4096:4096 + 131072]
+
+
+    def test_mark_persists_without_manual_save(self, tmp_path,
+                                               monkeypatch):
+        """A mark between scan cycles checkpoints itself (debounced) —
+        no manual save() needed (review r3 finding)."""
+        from minio_tpu.background.scanner import DataScanner
+        from minio_tpu.background.usage import DirtyTracker
+        from minio_tpu.engine.pools import ServerPools
+        from minio_tpu.engine.sets import ErasureSets
+        from minio_tpu.storage.drive import LocalDrive
+
+        monkeypatch.setattr(DirtyTracker, "SAVE_INTERVAL", 0.0)
+        drives = [LocalDrive(str(tmp_path / f"mp{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        pools.make_bucket("autod")
+        t1 = DirtyTracker()
+        DataScanner(pools, dirty=t1)      # binds the tracker
+        t1.mark("autod")                  # product path: engine mark
+        t2 = DirtyTracker()
+        DataScanner(pools, dirty=t2)
+        assert t2.is_dirty("autod")

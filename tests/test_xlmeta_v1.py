@@ -1,0 +1,101 @@
+"""Legacy xl.json (format v1) read path: unframed shards + whole-file
+bitrot + 10 MiB blocks (cf. cmd/xl-storage-format-v1.go,
+cmd/bitrot-whole.go).  VERDICT r2 missing #9."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine.erasure_set import ErasureSet
+from minio_tpu.ops.erasure_cpu import ReedSolomonCPU
+from minio_tpu.storage import bitrot_io, xlmeta_v1
+from minio_tpu.storage.drive import LocalDrive
+from minio_tpu.storage.xlmeta import ErasureInfo, FileInfo, ObjectPartInfo
+
+
+def _write_v1_object(drives, bucket, obj, data, k=2, m=2):
+    """Synthesize the on-disk layout an old v1 deployment would leave."""
+    cpu = ReedSolomonCPU(k, m)
+    shards = cpu.encode_data(data)            # k+m arrays, ceil-padded
+    dist = list(range(1, k + m + 1))
+    for pos, d in enumerate(drives):
+        shard = shards[dist[pos] - 1].tobytes()
+        d.create_file(bucket, f"{obj}/part.1", shard)
+        fi = FileInfo(
+            volume=bucket, name=obj, version_id="", data_dir="legacy",
+            mod_time_ns=1_700_000_000_000_000_000, size=len(data),
+            metadata={"content-type": "text/plain"},
+            parts=[ObjectPartInfo(1, len(data), len(data))],
+            erasure=ErasureInfo(
+                data_blocks=k, parity_blocks=m,
+                block_size=10 * 1024 * 1024, index=pos + 1,
+                distribution=dist,
+                checksums=[{
+                    "part": 1, "name": "part.1",
+                    "algo": "highwayhash256",
+                    "hash": bitrot_io.whole_file_digest(
+                        shard, "highwayhash256")}]))
+        d.write_all(bucket, f"{obj}/{xlmeta_v1.XL_JSON}",
+                    xlmeta_v1.make_xl_json(fi))
+
+
+@pytest.fixture()
+def es(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"v1d{i}")) for i in range(4)]
+    s = ErasureSet(drives)
+    s.make_bucket("legacy")
+    return s
+
+
+class TestV1Read:
+    def test_v1_object_readable(self, es):
+        data = b"written by a v1 deployment" * 1000
+        _write_v1_object(es.drives, "legacy", "old-obj", data)
+        fi, got = es.get_object("legacy", "old-obj")
+        assert got == data
+        assert fi.metadata["content-type"] == "text/plain"
+        assert xlmeta_v1.is_v1(fi)
+
+    def test_v1_head_and_versions(self, es):
+        data = b"v1 head" * 100
+        _write_v1_object(es.drives, "legacy", "h", data)
+        fi = es.head_object("legacy", "h")
+        assert fi.size == len(data)
+        versions = es.list_object_versions("legacy", "h")
+        assert len(versions) == 1 and versions[0].size == len(data)
+
+    def test_v1_corrupt_shard_reconstructs(self, es):
+        data = b"corruption-tolerant v1" * 500
+        _write_v1_object(es.drives, "legacy", "c", data)
+        # corrupt drive 0's shard ON DISK; whole-file hash must reject
+        # it and the read reconstructs from the parity rows
+        p = es.drives[0]._file_path("legacy", "c/part.1")
+        raw = bytearray(open(p, "rb").read())
+        raw[10] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        _, got = es.get_object("legacy", "c")
+        assert got == data
+
+    def test_v1_below_quorum_errors(self, es):
+        from minio_tpu.storage.errors import ErrErasureReadQuorum
+        data = b"x" * 4000
+        _write_v1_object(es.drives, "legacy", "q", data)
+        es.drives[0] = es.drives[1] = es.drives[2] = None
+        with pytest.raises(ErrErasureReadQuorum):
+            es.get_object("legacy", "q")
+
+    def test_make_parse_roundtrip(self):
+        fi = FileInfo(
+            volume="b", name="o", version_id="", data_dir="legacy",
+            mod_time_ns=1_700_000_000_000_000_000, size=7,
+            metadata={"k": "v"},
+            parts=[ObjectPartInfo(1, 7, 7)],
+            erasure=ErasureInfo(data_blocks=2, parity_blocks=2,
+                                block_size=10 << 20, index=1,
+                                distribution=[1, 2, 3, 4],
+                                checksums=[{"part": 1, "name": "part.1",
+                                            "algo": "highwayhash256",
+                                            "hash": b"\x01" * 32}]))
+        out = xlmeta_v1.parse_xl_json(xlmeta_v1.make_xl_json(fi), "b", "o")
+        assert out.size == 7 and out.erasure.data_blocks == 2
+        assert out.erasure.checksums[0]["hash"] == b"\x01" * 32
+        assert out.metadata["k"] == "v" and xlmeta_v1.is_v1(out)
