@@ -155,6 +155,14 @@ class DirtyTracker:
                 self._save_timer = t
                 t.start()
         if due:
+            # Off the request path: mark() is called from PUT handlers;
+            # the fan-out write must not add drive latency to a request.
+            t = threading.Thread(target=self._safe_save, daemon=True)
+            t.start()
+
+    def _safe_save(self) -> None:
+        es = self._es
+        if es is not None:
             try:
                 self.save(es)
             except Exception:  # noqa: BLE001 — persistence is advisory
@@ -164,12 +172,7 @@ class DirtyTracker:
         with self._mu:
             self._save_timer = None
             self._last_save = time.time()
-        es = self._es
-        if es is not None:
-            try:
-                self.save(es)
-            except Exception:  # noqa: BLE001
-                pass
+        self._safe_save()
 
     def snapshot_and_clear(self) -> set[str]:
         with self._mu:

@@ -715,20 +715,25 @@ class ErasureSet:
                     bucket, obj)
                 own_sums.append(own.erasure.checksums)
             except StorageError:
-                own_sums.append(None)             # unverifiable: accept
+                # No readable xl.json = no digest to verify against:
+                # treat the drive's shards as MISSING and reconstruct
+                # around them — serving unverifiable bytes risks silent
+                # corruption (the drive most likely to have lost its
+                # metadata is the damaged one).
+                own_sums.append(None)
 
         for part in fi.parts:
 
             def read_row(pos: int):
                 d = self.drives[pos]
-                if d is None:
-                    return None
+                if d is None or own_sums[pos] is None:
+                    return None                   # offline/unverifiable
                 try:
                     raw = d.read_file(bucket,
                                       f"{obj}/part.{part.number}")
                 except StorageError:
                     return None
-                for c in own_sums[pos] or ():
+                for c in own_sums[pos]:
                     if c.get("name") == f"part.{part.number}" \
                             and c.get("hash"):
                         algo = c.get("algo", "highwayhash256")

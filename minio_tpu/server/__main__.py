@@ -31,6 +31,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--port", type=int, default=9000)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--set-drive-count", type=int, default=None)
+    ap.add_argument("--certs-dir",
+                    default=os.environ.get("MTPU_CERTS_DIR", ""),
+                    help="dir with public.crt/private.key -> serve HTTPS")
     args = ap.parse_args(argv)
 
     # Startup self-test guards (hard-fail like cmd/erasure-coding.go:158,
@@ -64,10 +67,21 @@ def main(argv: list[str] | None = None) -> int:
     import threading
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    certs = None
+    if args.certs_dir:
+        cert = os.path.join(args.certs_dir, "public.crt")
+        key = os.path.join(args.certs_dir, "private.key")
+        if not (os.path.exists(cert) and os.path.exists(key)):
+            print(f"--certs-dir: missing {cert} or {key}",
+                  file=sys.stderr)
+            return 2
+        certs = (cert, key)
+
     port = args.port
     while True:
         srv = S3Server(pools, creds, host=args.host, port=port,
-                       iam=iam, scanner=scanner, notify=notify).start()
+                       iam=iam, scanner=scanner, notify=notify,
+                       certs=certs).start()
         port = srv.port                  # keep the port across restarts
         print(f"minio_tpu server on {srv.endpoint} "
               f"({len(paths)} drives, set={sets.set_drive_count})",

@@ -71,6 +71,116 @@ ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("NoSuchNotificationConfiguration", 404, "The specified bucket does not have a notification configuration."),
     _E("SelectParseError", 400, "The SQL expression could not be parsed."),
     _E("InvalidObjectState", 403, "The operation is not valid for the object's storage class."),
+    # -- breadth batch (cf. cmd/api-errors.go; AWS-public code table) --------
+    _E("AccessForbidden", 403, "Access forbidden."),
+    _E("AllAccessDisabled", 403, "All access to this resource has been disabled."),
+    _E("AmbiguousGrantByEmailAddress", 400, "The email address you provided is associated with more than one account."),
+    _E("BadRequest", 400, "400 BadRequest."),
+    _E("BucketTaggingNotFound", 404, "The TagSet does not exist."),
+    _E("CredentialTypeMismatch", 400, "The provided credential type does not match the request."),
+    _E("CrossLocationLoggingProhibited", 403, "Cross-location logging not allowed."),
+    _E("ExpiredPresignRequest", 403, "Request has expired."),
+    _E("IllegalLocationConstraintException", 400, "The specified location-constraint is not valid."),
+    _E("IllegalVersioningConfigurationException", 400, "The versioning configuration specified in the request is invalid."),
+    _E("IncorrectNumberOfFilesInPostRequest", 400, "POST requires exactly one file upload per request."),
+    _E("InlineDataTooLarge", 400, "Inline data exceeds the maximum allowed size."),
+    _E("InsecureClientRequest", 400, "Cannot respond to plain-text request from TLS-encrypted server."),
+    _E("InvalidAddressingHeader", 400, "You must specify the Anonymous role."),
+    _E("InvalidBucketState", 409, "The request is not valid with the current state of the bucket."),
+    _E("InvalidCopyDest", 400, "This copy request is illegal because it is trying to copy an object to itself without changing the object's metadata, storage class, website redirect location or encryption attributes."),
+    _E("InvalidCopySource", 400, "Copy Source must mention the source bucket and key: sourcebucket/sourcekey."),
+    _E("InvalidDuration", 400, "Duration provided in the request is invalid."),
+    _E("InvalidEncryptionAlgorithmError", 400, "The encryption request you specified is not valid. The valid value is AES256."),
+    _E("InvalidEncryptionMethod", 400, "The encryption method specified is not supported."),
+    _E("InvalidLifecycleWithObjectLock", 400, "The lifecycle configuration is not valid with object lock enabled."),
+    _E("InvalidLocationConstraint", 400, "The specified location constraint is not valid."),
+    _E("InvalidMaxKeys", 400, "Argument maxKeys must be an integer between 0 and 2147483647."),
+    _E("InvalidMaxParts", 400, "Part number must be an integer between 1 and 10000, inclusive."),
+    _E("InvalidMaxUploads", 400, "Argument max-uploads must be an integer between 0 and 2147483647."),
+    _E("InvalidPartNumberMarker", 400, "Argument partNumberMarker must be an integer."),
+    _E("InvalidPayer", 403, "All access to this object has been disabled."),
+    _E("InvalidPolicyDocument", 400, "The content of the form does not meet the conditions specified in the policy document."),
+    _E("InvalidPrefix", 400, "Invalid prefix."),
+    _E("InvalidRegion", 400, "Region does not match."),
+    _E("InvalidSecurity", 403, "The provided security credentials are not valid."),
+    _E("InvalidSOAPRequest", 400, "The SOAP request body is invalid."),
+    _E("InvalidStorageClass", 400, "The storage class you specified is not valid."),
+    _E("InvalidTag", 400, "The tag provided was not a valid tag. This error can occur if the tag did not pass input validation."),
+    _E("InvalidTargetBucketForLogging", 400, "The target bucket for logging does not exist."),
+    _E("InvalidToken", 400, "The provided token is malformed or otherwise invalid."),
+    _E("InvalidURI", 400, "Couldn't parse the specified URI."),
+    _E("InvalidVersionId", 400, "Invalid version id specified."),
+    _E("KMSNotConfigured", 501, "Server side encryption specified but KMS is not configured."),
+    _E("MalformedACLError", 400, "The XML you provided was not well-formed or did not validate against our published schema."),
+    _E("MalformedDate", 400, "Invalid date format header, expected to be in ISO8601, RFC1123 or RFC1123Z time format."),
+    _E("MalformedPolicy", 400, "Policy has invalid resource."),
+    _E("MalformedPOSTRequest", 400, "The body of your POST request is not well-formed multipart/form-data."),
+    _E("MaxMessageLengthExceeded", 400, "Your request was too big."),
+    _E("MaxPostPreDataLengthExceededError", 400, "Your POST request fields preceding the upload file were too large."),
+    _E("MetadataTooLarge", 400, "Your metadata headers exceed the maximum allowed metadata size."),
+    _E("MissingAttachment", 400, "A SOAP attachment was expected, but none were found."),
+    _E("MissingContentMD5", 400, "Missing required header for this request: Content-Md5."),
+    _E("MissingRequestBodyError", 400, "Request body is empty."),
+    _E("MissingSecurityElement", 400, "The SOAP 1.1 request is missing a security element."),
+    _E("MissingSecurityHeader", 400, "Your request was missing a required header."),
+    _E("NoLoggingStatusForKey", 400, "There is no such thing as a logging status subresource for a key."),
+    _E("NoSuchCORSConfiguration", 404, "The CORS configuration does not exist."),
+    _E("NoSuchWebsiteConfiguration", 404, "The specified bucket does not have a website configuration."),
+    _E("NotSignedUp", 403, "Your account is not signed up."),
+    _E("OperationAborted", 409, "A conflicting conditional operation is currently in progress against this resource. Please try again."),
+    _E("OperationTimedOut", 503, "A timeout occurred while trying to lock a resource, please reduce your request rate."),
+    _E("PermanentRedirect", 301, "The bucket you are attempting to access must be addressed using the specified endpoint. Please send all future requests to this endpoint."),
+    _E("Redirect", 307, "Temporary redirect."),
+    _E("RequestIsNotMultiPartContent", 400, "Bucket POST must be of the enclosure-type multipart/form-data."),
+    _E("RequestTimeout", 400, "Your socket connection to the server was not read from or written to within the timeout period."),
+    _E("RequestTorrentOfBucketError", 400, "Requesting the torrent file of a bucket is not permitted."),
+    _E("RestoreAlreadyInProgress", 409, "Object restore is already in progress."),
+    _E("ServerNotInitialized", 503, "Server not initialized, please try again."),
+    _E("TemporaryRedirect", 307, "You are being redirected to the bucket while DNS updates."),
+    _E("TokenRefreshRequired", 400, "The provided token must be refreshed."),
+    _E("TooManyBuckets", 400, "You have attempted to create more buckets than allowed."),
+    _E("UnexpectedContent", 400, "This request does not support content."),
+    _E("UnresolvableGrantByEmailAddress", 400, "The email address you provided does not match any account on record."),
+    _E("UserKeyMustBeSpecified", 400, "The bucket POST must contain the specified field name. If it is specified, please check the order of the fields."),
+    _E("ObjectLockConfigurationNotAllowed", 400, "Object Lock configuration cannot be enabled on existing buckets."),
+    _E("InvalidRetentionMode", 400, "Unknown WORM mode directive."),
+    _E("InvalidLegalHoldStatus", 400, "The legal hold status you specified is not valid."),
+    _E("ObjectLockInvalidHeaders", 400, "x-amz-object-lock-retain-until-date and x-amz-object-lock-mode must both be supplied."),
+    _E("PastObjectLockRetainDate", 400, "the retain until date must be in the future."),
+    _E("UnknownWORMModeDirective", 400, "Unknown WORM mode directive."),
+    _E("NoSuchServiceAccount", 404, "The specified service account is not found."),
+    _E("AdminInvalidAccessKey", 400, "The access key is invalid."),
+    _E("AdminInvalidSecretKey", 400, "The secret key is invalid."),
+    _E("AdminNoSuchUser", 404, "The specified user does not exist."),
+    _E("AdminNoSuchGroup", 404, "The specified group does not exist."),
+    _E("AdminNoSuchPolicy", 404, "The canned policy does not exist."),
+    _E("AdminGroupNotEmpty", 400, "The specified group is not empty - cannot remove it."),
+    _E("AdminConfigBadJSON", 400, "JSON configuration provided is of incorrect format."),
+    _E("HealNotImplemented", 501, "This server does not implement heal functionality."),
+    _E("HealNoSuchProcess", 404, "No such heal process is running on the server."),
+    _E("HealInvalidClientToken", 400, "Client token mismatch."),
+    _E("BackendDown", 503, "Remote backend is unreachable."),
+    _E("ParentIsObject", 400, "Object-prefix is already an object, please choose a different object-prefix name."),
+    _E("StorageFull", 507, "Storage backend has reached its minimum free drive threshold. Please delete a few objects to proceed."),
+    _E("ObjectExistsAsDirectory", 409, "Object name already exists as a directory."),
+    _E("PreconditionRequired", 428, "At least one precondition header is required for this request."),
+    _E("UnsupportedNotification", 400, "MinIO server does not support Topic or Cloud Function based notifications."),
+    _E("ContentSHA256Mismatch", 400, "The provided 'x-amz-content-sha256' header does not match what was computed."),
+    _E("LifecycleNotAllowed", 400, "Lifecycle configuration is not allowed on this bucket."),
+    _E("ReplicationNeedsVersioningError", 400, "Versioning must be 'Enabled' on the bucket to apply a replication configuration."),
+    _E("ReplicationBucketNeedsVersioningError", 400, "Versioning must be 'Enabled' on the bucket to add a replication target."),
+    _E("RemoteTargetNotFoundError", 404, "The remote target does not exist."),
+    _E("ReplicationRemoteConnectionError", 503, "Remote service connection error - please check remote service credentials and target bucket."),
+    _E("TransitionStorageClassNotFoundError", 404, "The transition storage class was not found."),
+    _E("NoSuchObjectLockRetention", 404, "The specified object does not have a Retention configuration."),
+    _E("NoSuchObjectLegalHold", 404, "The specified object does not have a LegalHold configuration."),
+    _E("ObjectRestoreAlreadyInProgress", 409, "Object restore is already in progress."),
+    _E("InvalidDecompressedSize", 400, "The data provided is unfit for decompression."),
+    _E("AddUserInvalidArgument", 400, "User is not allowed to be same as admin access key."),
+    _E("PolicyTooLarge", 400, "Policy exceeds the maximum allowed document size."),
+    _E("BusyOperation", 409, "A conflicting operation is in progress."),
+    _E("ClientDisconnected", 499, "Client disconnected before response was ready."),
+    _E("InvalidSessionToken", 403, "The provided session token is invalid."),
 ]}
 
 

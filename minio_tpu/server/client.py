@@ -26,11 +26,26 @@ class S3ClientError(Exception):
 
 class S3Client:
     def __init__(self, endpoint: str, access_key: str, secret_key: str,
-                 region: str = "us-east-1"):
+                 region: str = "us-east-1", verify_tls: bool = True):
         u = urllib.parse.urlsplit(endpoint)
         self.host = u.hostname
-        self.port = u.port or 80
+        self.tls = u.scheme == "https"
+        self.port = u.port or (443 if self.tls else 80)
+        self.verify_tls = verify_tls
         self.creds = Credentials(access_key, secret_key, region)
+
+    def _connect(self, timeout: float = 60):
+        if not self.tls:
+            return http.client.HTTPConnection(self.host, self.port,
+                                              timeout=timeout)
+        import ssl
+        ctx = ssl.create_default_context()
+        if not self.verify_tls:
+            # explicit opt-out only (tests with self-signed certs)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return http.client.HTTPSConnection(self.host, self.port,
+                                           timeout=timeout, context=ctx)
 
     # -- core ----------------------------------------------------------------
 
@@ -52,7 +67,7 @@ class S3Client:
             url = wire_path + ("?" + qs if qs else "")
         else:
             url = wire_path + "?" + raw_query
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
+        conn = self._connect(60)
         try:
             conn.request(method, url, body=body, headers=headers)
             resp = conn.getresponse()
@@ -74,8 +89,7 @@ class S3Client:
                             "UNSIGNED-PAYLOAD")
         headers.update(auth)
         wire_path = urllib.parse.quote(path, safe="/~-._")
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=120)
+        conn = self._connect(120)
         try:
             conn.request("PUT", wire_path, body=reader, headers=headers)
             resp = conn.getresponse()
@@ -94,8 +108,7 @@ class S3Client:
         auth = sign_request(self.creds, "GET", path, {}, headers, b"")
         headers.update(auth)
         wire_path = urllib.parse.quote(path, safe="/~-._")
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=120)
+        conn = self._connect(120)
         try:
             conn.request("GET", wire_path, headers=headers)
             resp = conn.getresponse()

@@ -37,7 +37,7 @@ class S3Server:
                  trace_sink=None, iam=None, notify=None,
                  replication=None, scanner=None, kms=None,
                  compress_enabled: bool = False, tier_mgr=None,
-                 oidc=None):
+                 oidc=None, certs: tuple[str, str] | None = None):
         self.oidc = oidc                   # iam.oidc.OpenIDConfig | None
         self.pools = pools
         self.creds = creds                 # root credentials (policy bypass)
@@ -162,7 +162,40 @@ class S3Server:
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
 
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        class _TLSThreadingHTTPServer(ThreadingHTTPServer):
+            """TLS handshakes run in the per-connection WORKER thread —
+            wrapping the listening socket would park the accept loop in
+            a blocking handshake, letting one silent client stall the
+            whole endpoint."""
+            ssl_context = None
+
+            def finish_request(self, request, client_address):
+                if self.ssl_context is not None:
+                    import socket as _socket
+                    import ssl as _ssl
+                    request.settimeout(10)       # bound the handshake
+                    try:
+                        request = self.ssl_context.wrap_socket(
+                            request, server_side=True)
+                        request.settimeout(60)
+                    except (_ssl.SSLError, OSError):
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
+                super().finish_request(request, client_address)
+
+        self._httpd = _TLSThreadingHTTPServer((host, port), _Handler)
+        self.tls = certs is not None
+        if certs is not None:
+            # HTTPS front door (the reference serves S3 and all three
+            # RPC planes over TLS; internal/http server + certs dir).
+            import ssl
+            cert_file, key_file = certs
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_file, key_file)
+            self._httpd.ssl_context = ctx
         self.port = self._httpd.server_port
         self.host = host
         self._thread: threading.Thread | None = None
@@ -181,7 +214,8 @@ class S3Server:
 
     @property
     def endpoint(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     # -- auth + dispatch -----------------------------------------------------
 

@@ -94,16 +94,20 @@ def _direct_read(path: str, offset: int, length: int) -> bytes | None:
         os.close(fd)
 
 
-def write_done(fd: int, nbytes: int) -> None:
+def write_done(fd: int, nbytes: int) -> bool:
     """Post-write cache policy for bulk shard writes (the write side of
     the O_DIRECT role: staged shard bytes should not linger in cache).
 
     Dirty pages can't be evicted, so sync first — fdatasync per batch
     also spreads the publish-time fsync cost across the stream, like
-    the reference's O_DIRECT+fdatasync writer (cmd/xl-storage.go:1533)."""
+    the reference's O_DIRECT+fdatasync writer (cmd/xl-storage.go:1533).
+    Returns True when it synced the fd (callers then skip their own
+    fsync)."""
     if mode() != "off" and nbytes >= BULK:
         try:
             os.fdatasync(fd)
         except OSError:
-            pass
+            return False
         drop_cache(fd)
+        return True
+    return False

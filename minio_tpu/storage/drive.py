@@ -26,7 +26,7 @@ import shutil
 import threading
 import uuid
 
-from . import bitrot_io, diskio
+from . import bitrot_io, diskio, oscounters
 from .errors import (ErrDiskNotFound, ErrFileAccessDenied, ErrFileCorrupt,
                      ErrFileNotFound, ErrFileVersionNotFound, ErrIsNotRegular,
                      ErrPathNotFound, ErrVolumeExists, ErrVolumeNotEmpty,
@@ -64,6 +64,7 @@ class LocalDrive:
         self._meta_lock = threading.Lock()
         self.disk_id: str = ""
         self.endpoint = root
+        self._osc = oscounters.Counters()   # per-drive syscall stats
 
     # -- path helpers --------------------------------------------------------
 
@@ -127,6 +128,10 @@ class LocalDrive:
     def write_all(self, vol: str, path: str, data: bytes) -> None:
         """Atomic small-file write (tmp + rename + fsync)."""
         self._check_vol(vol)
+        with self._osc.timed("write"):
+            return self._write_all(vol, path, data)
+
+    def _write_all(self, vol: str, path: str, data: bytes) -> None:
         p = self._file_path(vol, path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = os.path.join(self.root, SYS_VOL, TMP_DIR,
@@ -138,6 +143,10 @@ class LocalDrive:
         os.replace(tmp, p)
 
     def read_all(self, vol: str, path: str) -> bytes:
+        with self._osc.timed('read'):
+            return self._read_all_impl(vol, path)
+
+    def _read_all_impl(self, vol: str, path: str) -> bytes:
         p = self._file_path(vol, path)
         try:
             with open(p, "rb") as f:
@@ -150,6 +159,10 @@ class LocalDrive:
             raise ErrFileAccessDenied(f"{vol}/{path}") from None
 
     def delete(self, vol: str, path: str, recursive: bool = False) -> None:
+        with self._osc.timed('delete'):
+            return self._delete_impl(vol, path, recursive)
+
+    def _delete_impl(self, vol: str, path: str, recursive: bool = False) -> None:
         p = self._file_path(vol, path)
         if not os.path.exists(p):
             raise ErrFileNotFound(f"{vol}/{path}")
@@ -167,6 +180,10 @@ class LocalDrive:
     # -- shard-file ops ------------------------------------------------------
 
     def create_file(self, vol: str, path: str, data: bytes) -> None:
+        with self._osc.timed('write'):
+            return self._create_file_impl(vol, path, data)
+
+    def _create_file_impl(self, vol: str, path: str, data: bytes) -> None:
         """Write a (bitrot-framed) shard file; parents auto-created.
 
         The engine stages shard files under the tmp volume and publishes
@@ -178,10 +195,16 @@ class LocalDrive:
         with open(p, "wb") as f:
             f.write(data)
             f.flush()
-            os.fsync(f.fileno())
-            diskio.write_done(f.fileno(), len(data))
+            # write_done syncs (fdatasync) before dropping cache; only
+            # fsync ourselves when it didn't run (small/off-mode writes)
+            if not diskio.write_done(f.fileno(), len(data)):
+                os.fsync(f.fileno())
 
     def append_file(self, vol: str, path: str, data: bytes) -> None:
+        with self._osc.timed('write'):
+            return self._append_file_impl(vol, path, data)
+
+    def _append_file_impl(self, vol: str, path: str, data: bytes) -> None:
         """Append to a staged shard file (streaming writes land batch by
         batch; rename_data fsyncs staged files before publishing)."""
         self._check_vol(vol)
@@ -193,6 +216,11 @@ class LocalDrive:
             diskio.write_done(f.fileno(), len(data))
 
     def read_file(self, vol: str, path: str, offset: int = 0,
+                  length: int = -1) -> bytes:
+        with self._osc.timed('read'):
+            return self._read_file_impl(vol, path, offset, length)
+
+    def _read_file_impl(self, vol: str, path: str, offset: int = 0,
                   length: int = -1) -> bytes:
         """Bulk shard reads honor the page-cache-bypass mode
         (storage/diskio.py — the odirect-read role,
@@ -477,6 +505,9 @@ class LocalDrive:
             "endpoint": self.endpoint,
             "id": self.disk_id,
             "online": True,
+            # process-wide per-syscall-class counters/timings
+            # (cmd/os-instrumented.go role)
+            "os": self._osc.snapshot(),
         }
 
     def get_disk_id(self) -> str:

@@ -1,0 +1,45 @@
+"""Per-OS-call counters/timings for the storage layer.
+
+The cmd/os-instrumented.go role: every syscall class the drive layer
+issues is counted and timed, so `disk_info()`/admin metrics can show
+where drive time goes (complements the per-API EWMAs in
+storage/health_wrap.py, the xlStorageDiskIDCheck role)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+class Counters:
+    """One instance per drive, so per-drive numbers actually attribute
+    to the drive (a process-wide singleton would report identical
+    aggregates under every drive and overcount N x when summed)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._counts: dict[str, int] = defaultdict(int)
+        self._seconds: dict[str, float] = defaultdict(float)
+
+    @contextmanager
+    def timed(self, op: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._mu:
+                self._counts[op] += 1
+                self._seconds[op] += dt
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {op: {"count": self._counts[op],
+                         "total_ms": round(self._seconds[op] * 1e3, 3)}
+                    for op in sorted(self._counts)}
+
+    def reset(self) -> None:
+        with self._mu:
+            self._counts.clear()
+            self._seconds.clear()

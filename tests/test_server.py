@@ -288,3 +288,56 @@ class TestKeyEncoding:
             assert cli.get_object("enc", key) == key.encode()
         keys, _ = cli.list_objects("enc", prefix="a b/")
         assert keys == ["a b/c d.txt"]
+
+
+class TestTLS:
+    def test_https_front_door(self, tmp_path):
+        """TLS listener (the reference serves S3 + RPC planes over
+        HTTPS; internal/http + certs dir)."""
+        import datetime
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        from minio_tpu.engine.pools import ServerPools
+        from minio_tpu.engine.sets import ErasureSets
+        from minio_tpu.server.client import S3Client
+        from minio_tpu.server.server import S3Server
+        from minio_tpu.server.sigv4 import Credentials
+        from minio_tpu.storage.drive import LocalDrive
+
+        key = rsa.generate_private_key(public_exponent=65537,
+                                       key_size=2048)
+        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,
+                                             "127.0.0.1")])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (x509.CertificateBuilder()
+                .subject_name(name).issuer_name(name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - datetime.timedelta(minutes=1))
+                .not_valid_after(now + datetime.timedelta(days=1))
+                .sign(key, hashes.SHA256()))
+        cert_file = tmp_path / "public.crt"
+        key_file = tmp_path / "private.key"
+        cert_file.write_bytes(cert.public_bytes(
+            serialization.Encoding.PEM))
+        key_file.write_bytes(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+
+        drives = [LocalDrive(str(tmp_path / f"t{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        srv = S3Server(pools, Credentials("tlsroot", "tlsroot-secret1"),
+                       certs=(str(cert_file), str(key_file))).start()
+        try:
+            assert srv.endpoint.startswith("https://")
+            cli = S3Client(srv.endpoint, "tlsroot", "tlsroot-secret1",
+                           verify_tls=False)     # self-signed test cert
+            cli.make_bucket("tlsb")
+            cli.put_object("tlsb", "k", b"over tls")
+            assert cli.get_object("tlsb", "k") == b"over tls"
+        finally:
+            srv.shutdown()

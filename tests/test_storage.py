@@ -428,6 +428,31 @@ class TestDiskIO:
         t1 = DirtyTracker()
         DataScanner(pools, dirty=t1)      # binds the tracker
         t1.mark("autod")                  # product path: engine mark
-        t2 = DirtyTracker()
-        DataScanner(pools, dirty=t2)
-        assert t2.is_dirty("autod")
+        # checkpoint runs off the request path (background thread)
+        import time as _time
+        deadline = _time.time() + 5
+        found = False
+        while _time.time() < deadline and not found:
+            t2 = DirtyTracker()
+            DataScanner(pools, dirty=t2)
+            found = t2.is_dirty("autod")
+            if not found:
+                _time.sleep(0.05)
+        assert found
+
+
+class TestOSCounters:
+    def test_drive_ops_are_counted(self, tmp_path):
+        from minio_tpu.storage.drive import LocalDrive
+        d = LocalDrive(str(tmp_path / "oc"))
+        d.make_volume("v")
+        d.create_file("v", "f", b"x" * 1000)
+        d.read_file("v", "f")
+        d.write_all("v", "meta", b"{}")
+        d.read_all("v", "meta")
+        d.delete("v", "f")
+        snap = d._osc.snapshot()
+        assert snap["read"]["count"] >= 2
+        assert snap["write"]["count"] >= 2
+        assert snap["delete"]["count"] >= 1
+        assert d.disk_info()["os"]["read"]["count"] >= 2
