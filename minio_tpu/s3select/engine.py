@@ -46,6 +46,16 @@ def read_json_lines(data: bytes) -> list[dict]:
     return out
 
 
+def read_parquet(data: bytes) -> list[dict]:
+    """Parquet input (the simdjson/parquet reader role,
+    internal/s3select/parquet): decoded via pyarrow into the same
+    record-dict rows the CSV/JSON readers produce."""
+    import io
+
+    import pyarrow.parquet as pq
+    return pq.read_table(io.BytesIO(data)).to_pylist()
+
+
 # -- output writers ----------------------------------------------------------
 
 def write_csv(rows: list[dict], delimiter: str = ",") -> bytes:
@@ -140,6 +150,8 @@ def parse_select_request(body: bytes) -> dict:
     if in_ser is not None:
         if in_ser.find("JSON") is not None:
             opts["input"] = "json"
+        if in_ser.find("Parquet") is not None:
+            opts["input"] = "parquet"
         csv_el = in_ser.find("CSV")
         if csv_el is not None:
             opts["header"] = (csv_el.findtext("FileHeaderInfo", "USE")
@@ -157,7 +169,9 @@ def parse_select_request(body: bytes) -> dict:
 def execute_select(data: bytes, opts: dict) -> bytes:
     """Run the query; returns the full event-stream response body."""
     query = parse(opts["expression"])
-    if opts["input"] == "json":
+    if opts["input"] == "parquet":
+        records = read_parquet(data)
+    elif opts["input"] == "json":
         records = read_json_lines(data)
     else:
         records = read_csv(data, header=opts["header"],

@@ -33,19 +33,23 @@ class S3Client:
         self.port = u.port or (443 if self.tls else 80)
         self.verify_tls = verify_tls
         self.creds = Credentials(access_key, secret_key, region)
+        self._ssl_ctx = None             # built once, lazily
 
     def _connect(self, timeout: float = 60):
         if not self.tls:
             return http.client.HTTPConnection(self.host, self.port,
                                               timeout=timeout)
-        import ssl
-        ctx = ssl.create_default_context()
-        if not self.verify_tls:
-            # explicit opt-out only (tests with self-signed certs)
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
+        if self._ssl_ctx is None:
+            import ssl
+            ctx = ssl.create_default_context()
+            if not self.verify_tls:
+                # explicit opt-out only (tests with self-signed certs)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self._ssl_ctx = ctx
         return http.client.HTTPSConnection(self.host, self.port,
-                                           timeout=timeout, context=ctx)
+                                           timeout=timeout,
+                                           context=self._ssl_ctx)
 
     # -- core ----------------------------------------------------------------
 

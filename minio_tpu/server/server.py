@@ -171,7 +171,6 @@ class S3Server:
 
             def finish_request(self, request, client_address):
                 if self.ssl_context is not None:
-                    import socket as _socket
                     import ssl as _ssl
                     request.settimeout(10)       # bound the handshake
                     try:
@@ -184,6 +183,17 @@ class S3Server:
                         except OSError:
                             pass
                         return
+                    try:
+                        super().finish_request(request, client_address)
+                    finally:
+                        # shutdown_request() operates on the ORIGINAL
+                        # socket (detached by wrap_socket); close the
+                        # TLS socket here so close_notify is sent.
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                    return
                 super().finish_request(request, client_address)
 
         self._httpd = _TLSThreadingHTTPServer((host, port), _Handler)
@@ -504,6 +514,8 @@ class S3Server:
         "config-help": "admin:ConfigUpdate",
         "profile": "admin:Profiling",
         "service": "admin:ServiceRestart",
+        "tier": "admin:SetTier",
+        "inspect": "admin:InspectData",
     }
 
     def _admin_authorize(self, access_key: str, sub: str,
@@ -743,6 +755,58 @@ class S3Server:
                     "cumulative").print_stats(50)
                 return Response(200, buf.getvalue().encode(),
                                 {"Content-Type": "text/plain"})
+        if sub == "tier":
+            # Tier admin (cf. AddTierHandler/ListTierHandler,
+            # cmd/admin-handlers-pools.go + tier config).
+            tm = self.handlers.tier_mgr
+            if tm is None:
+                return j({"error": "tiering not enabled"}, 501)
+            if method == "GET":
+                return j({"tiers": tm.list_tiers()})
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                try:
+                    name = req_obj["name"]
+                    kind = req_obj.get("type", "fs")
+                    if kind == "fs":
+                        from ..bucket.tier import DirTierBackend
+                        backend = DirTierBackend(req_obj["path"])
+                    elif kind == "s3":
+                        from ..bucket.tier import S3TierBackend
+                        backend = S3TierBackend(
+                            req_obj["endpoint"], req_obj["accessKey"],
+                            req_obj["secretKey"], req_obj["bucket"])
+                    else:
+                        raise S3Error("InvalidArgument",
+                                      f"unknown tier type {kind!r}")
+                    tm.add_tier(name, backend)
+                except KeyError as e:
+                    raise S3Error("InvalidArgument",
+                                  f"missing field {e}") from None
+                return j({"ok": True})
+        if sub.startswith("inspect") and method == "GET":
+            # Raw per-drive metadata download for debugging
+            # (cf. InspectDataHandler, cmd/admin-handlers.go).
+            bucket = query.get("volume", query.get("bucket", [""]))[0]
+            obj = query.get("file", query.get("object", [""]))[0]
+            if not bucket or not obj:
+                raise S3Error("InvalidArgument", "volume and file required")
+            copies = []
+            for pi, pool in enumerate(self.pools.pools):
+                for si, s in enumerate(getattr(pool, "sets", [pool])):
+                    for di, d in enumerate(getattr(s, "drives", [])):
+                        if d is None:
+                            continue
+                        try:
+                            raw = d.read_all(bucket, f"{obj}/xl.meta")
+                        except Exception:  # noqa: BLE001
+                            continue
+                        copies.append({"pool": pi, "set": si, "drive": di,
+                                       "endpoint": getattr(d, "root", ""),
+                                       "xl_meta_hex": raw.hex()})
+            if not copies:
+                return j({"error": "no xl.meta found"}, 404)
+            return j({"volume": bucket, "file": obj, "copies": copies})
         if sub == "service" and method == "POST":
             # Real semantics (cf. ServiceHandler, cmd/admin-handlers.go):
             # stop/restart shut the listener down after this response

@@ -293,3 +293,34 @@ class TestAdminBreadth:
                 down = True
                 break
         assert down, "listener still serving after restart request"
+
+
+class TestAdminTierInspect:
+    def test_tier_admin_endpoints(self, stack, tmp_path):
+        import json
+        srv, cli, _ = stack
+        # wire a tier manager into the handlers for this server
+        from minio_tpu.bucket.tier import TierManager
+        srv.handlers.tier_mgr = TierManager(srv.pools)
+        st, _, _ = cli.request("POST", "/minio/admin/v1/tier",
+                               body=json.dumps({
+                                   "name": "warm", "type": "fs",
+                                   "path": str(tmp_path / "warm")}).encode())
+        assert st == 200
+        st, _, data = cli.request("GET", "/minio/admin/v1/tier")
+        assert st == 200 and "WARM" in json.loads(data)["tiers"]
+
+    def test_inspect_endpoint(self, stack):
+        import json
+        srv, cli, _ = stack
+        cli.make_bucket("insp2")
+        cli.put_object("insp2", "obj", b"inspect me" * 100)
+        st, _, data = cli.request("GET", "/minio/admin/v1/inspect",
+                                  query={"volume": "insp2",
+                                         "file": "obj"})
+        assert st == 200, data
+        out = json.loads(data)
+        assert len(out["copies"]) == 4
+        raw = bytes.fromhex(out["copies"][0]["xl_meta_hex"])
+        from minio_tpu.storage.xlmeta import XLMeta
+        assert XLMeta.from_bytes(raw).versions

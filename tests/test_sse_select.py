@@ -425,3 +425,43 @@ class TestAdviceR2Crypto:
         k = StaticKMS(b"\x00" * 32, allow_insecure_zero_key=True)
         kid, plain, sealed = k.generate_data_key()
         assert k.decrypt_data_key(kid, sealed) == plain
+
+
+class TestParquetSelect:
+    def _parquet_bytes(self):
+        import io
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        table = pa.table({"name": ["ada", "bob", "cat"],
+                          "score": [90, 60, 75],
+                          "team": ["x", "y", "x"]})
+        buf = io.BytesIO()
+        pq.write_table(table, buf)
+        return buf.getvalue()
+
+    def test_parquet_input_via_engine(self):
+        from minio_tpu.s3select.engine import execute_select
+        opts = {"expression":
+                "SELECT name FROM S3Object s WHERE s.score > 70",
+                "input": "parquet", "header": True, "delimiter": ",",
+                "output": "csv", "out_delimiter": ","}
+        out = execute_select(self._parquet_bytes(), opts)
+        assert b"ada" in out and b"cat" in out and b"bob" not in out
+
+    def test_parquet_over_http(self, stack):
+        srv, cli = stack
+        cli.make_bucket("pqsel")
+        cli.put_object("pqsel", "t.parquet", self._parquet_bytes())
+        body = (
+            b"<SelectObjectContentRequest>"
+            b"<Expression>SELECT s.name, s.score FROM S3Object s "
+            b"WHERE s.team = 'x'</Expression>"
+            b"<ExpressionType>SQL</ExpressionType>"
+            b"<InputSerialization><Parquet/></InputSerialization>"
+            b"<OutputSerialization><CSV/></OutputSerialization>"
+            b"</SelectObjectContentRequest>")
+        st, _, data = cli.request("POST", "/pqsel/t.parquet",
+                                  query={"select": "", "select-type": "2"},
+                                  body=body)
+        assert st == 200, data
+        assert b"ada" in data and b"cat" in data and b"bob" not in data
