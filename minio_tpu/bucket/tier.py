@@ -91,12 +91,68 @@ class TierManager:
         self._tiers: dict[str, object] = {}
         self._journal: list[dict] = []
         self._load_journal()
+        # Re-register tiers persisted by add_tier(config=...) so
+        # transitioned objects survive a service restart.
+        self.load_persisted_tiers()
 
     # -- registry ------------------------------------------------------------
 
-    def add_tier(self, name: str, backend) -> None:
+    TIER_CONFIG_PATH = "tier/config.json"
+
+    def add_tier(self, name: str, backend, config: dict | None = None,
+                 replace: bool = False) -> None:
+        """Register a warm tier.  Duplicates are refused unless
+        `replace` — silently swapping a live tier's backend orphans
+        every already-transitioned object (cf. the reference rejecting
+        duplicate tier names).  `config` (serializable dict) persists
+        the registration across restarts."""
+        key = name.upper()
         with self._mu:
-            self._tiers[name.upper()] = backend
+            if key in self._tiers and not replace:
+                raise ValueError(f"tier {name!r} already exists")
+            self._tiers[key] = backend
+        if config is not None:
+            self._persist_config(key, config)
+
+    def _persist_config(self, name: str, config: dict) -> None:
+        import json as _json
+        try:
+            raw = self._read_sys(self.TIER_CONFIG_PATH)
+            configs = _json.loads(raw) if raw else {}
+        except Exception:  # noqa: BLE001
+            configs = {}
+        configs[name] = config
+        self._write_sys(self.TIER_CONFIG_PATH,
+                        _json.dumps(configs).encode())
+
+    def load_persisted_tiers(self) -> list[str]:
+        """Rebuild tier backends recorded by add_tier(config=...) —
+        called at server construction so transitioned objects survive a
+        service restart."""
+        import json as _json
+        try:
+            raw = self._read_sys(self.TIER_CONFIG_PATH)
+            configs = _json.loads(raw) if raw else {}
+        except Exception:  # noqa: BLE001
+            return []
+        loaded = []
+        for name, cfg in configs.items():
+            kind = cfg.get("type", "fs")
+            try:
+                if kind == "fs":
+                    backend = DirTierBackend(cfg["path"])
+                elif kind == "s3":
+                    backend = S3TierBackend(cfg["endpoint"],
+                                            cfg["accessKey"],
+                                            cfg["secretKey"],
+                                            cfg["bucket"])
+                else:
+                    continue
+                self.add_tier(name, backend, replace=True)
+                loaded.append(name)
+            except (KeyError, OSError):
+                continue
+        return loaded
 
     def get_tier(self, name: str):
         with self._mu:
@@ -153,6 +209,29 @@ class TierManager:
         return True
 
     # -- delete journal (cf. cmd/tier-journal.go) ----------------------------
+
+    def _write_sys(self, path: str, payload: bytes) -> None:
+        for pool in getattr(self.pools, "pools", []):
+            for es in getattr(pool, "sets", [pool]):
+                try:
+                    for d in es.drives:
+                        if d is not None:
+                            d.write_all(SYS_VOL, path, payload)
+                    return
+                except StorageError:
+                    continue
+
+    def _read_sys(self, path: str) -> bytes | None:
+        for pool in getattr(self.pools, "pools", []):
+            for es in getattr(pool, "sets", [pool]):
+                for d in es.drives:
+                    if d is None:
+                        continue
+                    try:
+                        return d.read_all(SYS_VOL, path)
+                    except StorageError:
+                        continue
+        return None
 
     def _save_journal(self) -> None:
         payload = json.dumps(self._journal).encode()

@@ -38,8 +38,10 @@ def _level(rows: jax.Array) -> jax.Array:
     h = jnp.matmul(chunks, _matrix_a_dev(),
                    preferred_element_type=jnp.int32)        # (n, nc, 8)
     # Serialize words little-endian: byte k of word w -> offset 4w + k.
-    b = jnp.stack([(h >> (8 * k)) & 0xFF for k in range(4)], axis=-1)
-    return b.astype(jnp.uint8).reshape(n, -1)
+    # bitcast_convert_type appends a (4,) LE byte dim — one op instead
+    # of the 4x shift/mask/stack chain (verified bit-identical on chip).
+    b = jax.lax.bitcast_convert_type(h, jnp.uint8)          # (n, nc, 8, 4)
+    return b.reshape(n, -1)
 
 
 def mxh256_rows(x: jax.Array) -> jax.Array:

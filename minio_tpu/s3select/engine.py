@@ -50,8 +50,6 @@ def read_parquet(data: bytes) -> list[dict]:
     """Parquet input (the simdjson/parquet reader role,
     internal/s3select/parquet): decoded via pyarrow into the same
     record-dict rows the CSV/JSON readers produce."""
-    import io
-
     import pyarrow.parquet as pq
     return pq.read_table(io.BytesIO(data)).to_pylist()
 
@@ -68,8 +66,25 @@ def write_csv(rows: list[dict], delimiter: str = ",") -> bytes:
     return buf.getvalue().encode()
 
 
+def _json_default(v):
+    """Non-JSON-native values from richer inputs (Parquet carries
+    datetime/Decimal/bytes columns routinely) serialize instead of
+    500ing the Select."""
+    import base64
+    import datetime
+    import decimal
+    if isinstance(v, (datetime.datetime, datetime.date, datetime.time)):
+        return v.isoformat()
+    if isinstance(v, decimal.Decimal):
+        return float(v)
+    if isinstance(v, (bytes, bytearray)):
+        return base64.b64encode(bytes(v)).decode()
+    return str(v)
+
+
 def write_json_lines(rows: list[dict]) -> bytes:
-    return b"".join(json.dumps(r).encode() + b"\n" for r in rows)
+    return b"".join(json.dumps(r, default=_json_default).encode() + b"\n"
+                    for r in rows)
 
 
 # -- AWS event-stream framing ------------------------------------------------

@@ -779,10 +779,17 @@ class S3Server:
                     else:
                         raise S3Error("InvalidArgument",
                                       f"unknown tier type {kind!r}")
-                    tm.add_tier(name, backend)
+                    # config persists the registration across restarts;
+                    # duplicates are refused (409) — replacing a live
+                    # tier's backend would orphan transitioned objects
+                    cfg = {k: v for k, v in req_obj.items()
+                           if k != "name"}
+                    tm.add_tier(name, backend, config=cfg)
                 except KeyError as e:
                     raise S3Error("InvalidArgument",
                                   f"missing field {e}") from None
+                except ValueError as e:
+                    return j({"error": str(e)}, 409)
                 return j({"ok": True})
         if sub.startswith("inspect") and method == "GET":
             # Raw per-drive metadata download for debugging

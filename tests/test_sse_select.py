@@ -465,3 +465,42 @@ class TestParquetSelect:
                                   body=body)
         assert st == 200, data
         assert b"ada" in data and b"cat" in data and b"bob" not in data
+
+    def test_parquet_rich_types_to_json_output(self):
+        """datetime/decimal/bytes columns must serialize, not 500."""
+        import datetime
+        import decimal
+        import io as _io
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from minio_tpu.s3select.engine import execute_select
+        table = pa.table({
+            "ts": [datetime.datetime(2024, 5, 1, 12, 0)],
+            "amount": [decimal.Decimal("1.25")],
+            "blob": [b"\x00\x01"],
+            "name": ["row1"]})
+        buf = _io.BytesIO()
+        pq.write_table(table, buf)
+        opts = {"expression": "SELECT * FROM S3Object",
+                "input": "parquet", "header": True, "delimiter": ",",
+                "output": "json", "out_delimiter": ","}
+        out = execute_select(buf.getvalue(), opts)
+        assert b"2024-05-01" in out and b"1.25" in out and b"row1" in out
+
+    def test_tier_duplicate_and_restart_persistence(self, tmp_path):
+        """Tier registry refuses duplicates and survives a rebuild."""
+        import pytest as _pytest
+        from minio_tpu.bucket.tier import DirTierBackend, TierManager
+        from minio_tpu.engine.pools import ServerPools
+        from minio_tpu.engine.sets import ErasureSets
+        from minio_tpu.storage.drive import LocalDrive
+        drives = [LocalDrive(str(tmp_path / f"td{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        tm = TierManager(pools)
+        tm.add_tier("warm", DirTierBackend(str(tmp_path / "w1")),
+                    config={"type": "fs", "path": str(tmp_path / "w1")})
+        with _pytest.raises(ValueError):
+            tm.add_tier("warm", DirTierBackend(str(tmp_path / "w2")))
+        # "restart": a fresh manager over the same drives re-registers
+        tm2 = TierManager(pools)
+        assert "WARM" in tm2.list_tiers()
