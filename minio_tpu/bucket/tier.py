@@ -85,8 +85,12 @@ class S3TierBackend:
 
 
 class TierManager:
-    def __init__(self, pools):
+    def __init__(self, pools, kms=None):
         self.pools = pools
+        if kms is None:
+            from ..crypto.kms import kms_from_env
+            kms = kms_from_env()
+        self.kms = kms
         self._mu = threading.Lock()
         self._tiers: dict[str, object] = {}
         self._journal: list[dict] = []
@@ -107,34 +111,91 @@ class TierManager:
         duplicate tier names).  `config` (serializable dict) persists
         the registration across restarts."""
         key = name.upper()
+        # One lock over check + persist + register: persist-then-crash
+        # must not leave a live in-memory tier with no durable
+        # registration, and two concurrent adds must not race the
+        # config read-modify-write (admin-rare op; holding the mutex
+        # across the sys-volume write is fine).
         with self._mu:
             if key in self._tiers and not replace:
                 raise ValueError(f"tier {name!r} already exists")
+            if config is not None:
+                self._persist_config(key, config)
             self._tiers[key] = backend
-        if config is not None:
-            self._persist_config(key, config)
+
+    _SECRET_FIELDS = ("accessKey", "secretKey", "sessionToken")
 
     def _persist_config(self, name: str, config: dict) -> None:
-        import json as _json
-        try:
-            raw = self._read_sys(self.TIER_CONFIG_PATH)
-            configs = _json.loads(raw) if raw else {}
-        except Exception:  # noqa: BLE001
-            configs = {}
+        # strict: an existing blob we cannot unseal must abort the
+        # read-modify-write — overwriting it would destroy every other
+        # tier's still-recoverable sealed registration.
+        configs = self._load_configs(strict=True)
         configs[name] = config
-        self._write_sys(self.TIER_CONFIG_PATH,
-                        _json.dumps(configs).encode())
+        # Tier configs carry remote credentials; the reference persists
+        # them sealed with the cluster KMS (cmd/tier.go saveTierConfig).
+        # Refuse to write credentials in the clear when no KMS is
+        # configured rather than leak them to every drive's sys volume.
+        has_secrets = any(c.get(f) for c in configs.values()
+                          for f in self._SECRET_FIELDS)
+        if self.kms is not None:
+            from ..crypto.kms import seal_with_kms
+            blob = json.dumps(seal_with_kms(
+                self.kms, json.dumps(configs).encode(),
+                b"tier-config")).encode()
+        elif has_secrets:
+            raise StorageError(
+                "refusing to persist tier credentials unencrypted: "
+                "configure a KMS (MTPU_KMS_SECRET_KEY)")
+        else:
+            blob = json.dumps(configs).encode()
+        self._write_sys(self.TIER_CONFIG_PATH, blob)
+
+    def _load_configs(self, strict: bool = False) -> dict:
+        """Read the persisted tier-config map, unsealing if needed.
+        strict=True (the persist path's read-modify-write) raises
+        StorageError instead of returning {} whenever an existing blob
+        might still be recoverable — undecryptable (missing/rotated
+        KMS key), unparseable, or unreadable because drives are
+        flapping; writers must not clobber recoverable configs. Only
+        a genuinely absent file yields {} in strict mode."""
+        from ..crypto.kms import is_sealed_doc, unseal_with_kms
+        try:
+            raw = self._read_sys(self.TIER_CONFIG_PATH, strict=strict)
+            if not raw:
+                return {}
+            doc = json.loads(raw)
+        except StorageError:
+            raise
+        except Exception:  # noqa: BLE001
+            if strict:
+                raise StorageError(
+                    "tier config exists but does not parse; refusing "
+                    "to overwrite it") from None
+            return {}
+        if is_sealed_doc(doc):
+            if self.kms is None:
+                if strict:
+                    raise StorageError(
+                        "tier config is sealed but no KMS is "
+                        "configured; refusing to overwrite it")
+                return {}
+            try:
+                return json.loads(
+                    unseal_with_kms(self.kms, doc, b"tier-config"))
+            except Exception:  # noqa: BLE001
+                if strict:
+                    raise StorageError(
+                        "tier config cannot be unsealed with the "
+                        "configured KMS key; refusing to overwrite "
+                        "it") from None
+                return {}
+        return doc if isinstance(doc, dict) else {}
 
     def load_persisted_tiers(self) -> list[str]:
         """Rebuild tier backends recorded by add_tier(config=...) —
         called at server construction so transitioned objects survive a
         service restart."""
-        import json as _json
-        try:
-            raw = self._read_sys(self.TIER_CONFIG_PATH)
-            configs = _json.loads(raw) if raw else {}
-        except Exception:  # noqa: BLE001
-            return []
+        configs = self._load_configs()
         loaded = []
         for name, cfg in configs.items():
             kind = cfg.get("type", "fs")
@@ -221,7 +282,14 @@ class TierManager:
                 except StorageError:
                     continue
 
-    def _read_sys(self, path: str) -> bytes | None:
+    def _read_sys(self, path: str, strict: bool = False) -> bytes | None:
+        """First drive's copy, or None when the file does not exist.
+        strict=True: if NO drive returns the file but some failed with
+        an error other than not-found, raise — the file may exist but
+        be temporarily unreadable, and callers doing read-modify-write
+        must not treat that as absence."""
+        from ..storage.errors import (ErrFileNotFound, ErrVolumeNotFound)
+        saw_real_error = False
         for pool in getattr(self.pools, "pools", []):
             for es in getattr(pool, "sets", [pool]):
                 for d in es.drives:
@@ -229,8 +297,15 @@ class TierManager:
                         continue
                     try:
                         return d.read_all(SYS_VOL, path)
-                    except StorageError:
+                    except (ErrFileNotFound, ErrVolumeNotFound):
                         continue
+                    except StorageError:
+                        saw_real_error = True
+                        continue
+        if strict and saw_real_error:
+            raise StorageError(
+                f"{path}: unreadable on every drive (non-notfound "
+                "errors seen); refusing to treat as absent")
         return None
 
     def _save_journal(self) -> None:

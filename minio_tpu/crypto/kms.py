@@ -79,6 +79,30 @@ class StaticKMS(KMS):
             raise KMSError(f"unseal failed: {e}") from None
 
 
+def seal_with_kms(kms: KMS, plaintext: bytes,
+                  context: bytes = b"") -> dict:
+    """Seal a config blob under a fresh KMS data key -> JSON-able doc.
+    One audited sealing format for every subsystem that persists
+    secrets (tier configs, etc.); the payload framing is sse.seal's."""
+    from .sse import seal
+    key_id, pk, sealed_key = kms.generate_data_key(context)
+    return {"v": 2, "keyId": key_id, "sealedKey": sealed_key.hex(),
+            "ciphertext": seal(plaintext, pk).hex()}
+
+
+def unseal_with_kms(kms: KMS, doc: dict, context: bytes = b"") -> bytes:
+    """Inverse of seal_with_kms. Raises KMSError/SSEError on mismatch."""
+    from .sse import unseal
+    pk = kms.decrypt_data_key(doc["keyId"],
+                              bytes.fromhex(doc["sealedKey"]), context)
+    return unseal(bytes.fromhex(doc["ciphertext"]), pk)
+
+
+def is_sealed_doc(doc) -> bool:
+    return (isinstance(doc, dict) and doc.get("v") == 2
+            and "ciphertext" in doc and "sealedKey" in doc)
+
+
 def kms_from_env() -> StaticKMS | None:
     """A keyed KMS if the environment provides one, else None — callers
     must then reject SSE-S3/SSE-KMS requests instead of silently sealing

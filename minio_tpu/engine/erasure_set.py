@@ -733,6 +733,10 @@ class ErasureSet:
                                       f"{obj}/part.{part.number}")
                 except StorageError:
                     return None
+                # A shard we cannot verify is a shard we must not
+                # trust: a part with no (or an empty) recorded digest
+                # is treated like a missing xl.json above — return
+                # None and reconstruct around it.
                 for c in own_sums[pos]:
                     if c.get("name") == f"part.{part.number}" \
                             and c.get("hash"):
@@ -740,7 +744,8 @@ class ErasureSet:
                         if bitrot_io.whole_file_digest(
                                 raw, algo) != c["hash"]:
                             return None           # corrupt shard
-                return raw
+                        return raw
+                return None                       # unverifiable shard
 
             rows: list[bytes | None] = [None] * (k + m)
             for pos in range(self.n):
@@ -1163,13 +1168,6 @@ class ErasureSet:
                     continue
         if not lists:
             raise ErrObjectNotFound(f"{bucket}/{obj}")
-        # Quorum against the CONFIGURED stripe width, not the responder
-        # count — one reachable stale drive must not become its own
-        # majority.
-        quorum = self.n // 2 + 1
-        if len(lists) < quorum:
-            raise ErrErasureReadQuorum(
-                f"{bucket}/{obj}: {len(lists)}/{self.n} version lists")
         counts: dict[tuple, int] = {}
         keep: dict[tuple, FileInfo] = {}
         for lst in lists:
@@ -1178,6 +1176,31 @@ class ErasureSet:
                        fi.size, fi.deleted, fi.metadata.get("etag", ""))
                 counts[key] = counts.get(key, 0) + 1
                 keep.setdefault(key, fi)
+        # Read quorum = the erasure geometry's data_blocks, taken from
+        # the LATEST erasure-bearing version and applied to every
+        # version — matching objectQuorumFromMeta
+        # (cf. /root/reference/cmd/erasure-metadata.go:389-417, which
+        # derives ONE read quorum from the latest FileInfo; the k==m
+        # "+1" there applies to WRITE quorum only). A version readable
+        # at k shards must stay listable with only k metadata copies
+        # reachable — lifecycle/replication iterating versions must
+        # not skip durable objects. Objects with no erasure-bearing
+        # version (pure delete-marker history) fall back to a simple
+        # majority.
+        # ... but only a latest FileInfo that is ITSELF present on at
+        # least half the drives may set the quorum (getLatestFileInfo,
+        # cmd/erasure-healing-common.go:196) — unquorate metadata from
+        # one stale/corrupt drive must not become its own majority.
+        quorum = self.n // 2 + 1
+        trust_floor = max(self.n // 2, 1)
+        for key, fi in sorted(keep.items(),
+                              key=lambda kv: -kv[1].mod_time_ns):
+            if fi.erasure is not None and counts[key] >= trust_floor:
+                quorum = fi.erasure.data_blocks
+                break
+        if len(lists) < quorum:
+            raise ErrErasureReadQuorum(
+                f"{bucket}/{obj}: {len(lists)}/{self.n} version lists")
         out = [keep[k] for k, c in counts.items() if c >= quorum]
         if not out:
             raise ErrObjectNotFound(f"{bucket}/{obj} (no version in "

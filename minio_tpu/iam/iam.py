@@ -110,14 +110,18 @@ class IAMSys:
                     try:
                         policies[name] = pol.Policy(obj)
                     except pol.PolicyError as e:
-                        # An unloadable policy silently disappearing
-                        # would strand every identity attached to it
-                        # with no diagnostic; make the drop loud (but
-                        # deduped — this loop re-runs on every reload).
+                        # An unloadable policy must not silently vanish:
+                        # dropping it voids its Deny statements
+                        # (fail-open). Degrade to deny-all so attached
+                        # identities fail closed, and say so (deduped —
+                        # this loop re-runs on every reload).
                         _logger().log_once(
                             "error",
-                            f"IAM: dropping unparseable policy "
-                            f"{name!r}: {e}", key=f"iam-bad-policy:{name}")
+                            f"IAM: policy {name!r} failed to parse "
+                            f"({e}); degrading it to deny-all for "
+                            f"attached identities",
+                            key=f"iam-bad-policy:{name}")
+                        policies[name] = pol.deny_all_policy()
                         continue
             self._users, self._groups, self._policies = \
                 users, groups, policies

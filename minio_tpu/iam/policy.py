@@ -42,7 +42,18 @@ SUPPORTED_CONDITION_OPS = frozenset({
     "DateEquals", "DateNotEquals",
     "DateLessThan", "DateLessThanEquals",
     "DateGreaterThan", "DateGreaterThanEquals",
+    "ArnEquals", "ArnNotEquals", "ArnLike", "ArnNotLike",
+    "Null",
 })
+
+
+def _base_op(op: str) -> str:
+    """Strip the AWS `IfExists` suffix (valid on everything but Null —
+    `NullIfExists` is NOT stripped, so it fails the supported-ops check
+    at parse time exactly as AWS rejects it)."""
+    if op.endswith("IfExists") and op[:-len("IfExists")] != "Null":
+        return op[:-len("IfExists")]
+    return op
 
 
 def _compare(suffix: str, got: float, want: list[float]) -> bool:
@@ -104,7 +115,7 @@ class Statement:
                           for r in _as_list(d.get("Resource"))]
         self.conditions = d.get("Condition", {}) or {}
         for op, kv in self.conditions.items():
-            if op not in SUPPORTED_CONDITION_OPS:
+            if _base_op(op) not in SUPPORTED_CONDITION_OPS:
                 raise PolicyError(f"unsupported condition operator {op!r}")
             if not isinstance(kv, dict):
                 raise PolicyError(f"condition {op!r} must map keys to "
@@ -161,10 +172,25 @@ class Statement:
         """Subset of AWS condition operators over request context keys
         (e.g. {"StringEquals": {"s3:prefix": ["a/"]}})."""
         for op, kv in self.conditions.items():
+            if_exists = _base_op(op) != op
+            op = _base_op(op)
+            # Arn* operators are String*/StringLike over the ARN text
+            # (cf. github.com/minio/pkg/condition newFunctions).
+            op = {"ArnEquals": "StringEquals",
+                  "ArnNotEquals": "StringNotEquals",
+                  "ArnLike": "StringLike",
+                  "ArnNotLike": "StringNotLike"}.get(op, op)
             for key, want in kv.items():
                 got = ctx.get(key)
                 want = [str(w) for w in _as_list(want)]
-                if op == "StringEquals":
+                if got is None and if_exists:
+                    continue    # IfExists: absent key passes
+                if op == "Null":
+                    # "true" ⇒ key must be absent; "false" ⇒ present.
+                    want_null = str(want[0]).lower() == "true"
+                    if (got is None) != want_null:
+                        return False
+                elif op == "StringEquals":
                     if got is None or str(got) not in want:
                         return False
                 elif op == "StringNotEquals":
@@ -259,6 +285,16 @@ class Policy:
 
     def to_json(self) -> str:
         return json.dumps(self.doc)
+
+
+def deny_all_policy() -> Policy:
+    """Fail-closed stand-in for a stored policy that no longer parses:
+    attached identities lose access entirely rather than losing the
+    broken policy's Deny statements (dropping a policy wholesale would
+    be fail-open for its Denies)."""
+    return Policy({"Version": "2012-10-17",
+                   "Statement": [{"Effect": "Deny", "Action": ["s3:*"],
+                                  "Resource": ["*"]}]})
 
 
 def merge_allowed(policies: list[Policy], action: str, resource: str,

@@ -21,6 +21,9 @@ ALGORITHM = "AWS4-HMAC-SHA256"
 STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
 UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
 MAX_SKEW = datetime.timedelta(minutes=15)
+# Largest accepted aws-chunked chunk: bounds per-connection buffering of
+# unverified payload (SDKs emit <=1 MiB chunks).
+MAX_CHUNK_SIZE = 16 * 1024 * 1024
 
 
 def _hmac(key: bytes, msg: str) -> bytes:
@@ -376,6 +379,17 @@ class StreamingSigV4Reader:
             size = int(size_hex, 16)
         except ValueError:
             raise S3Error("IncompleteBody", "bad chunk size") from None
+        # Bound per-chunk buffering: the declared chunk size is
+        # untrusted, and the whole chunk is buffered before its
+        # signature verifies — without a cap one authenticated PUT
+        # declaring a multi-GiB chunk defeats the O(batch) memory
+        # bound (the reference's signV4ChunkedReader hashes into the
+        # caller's bounded buffer). AWS SDKs emit <=1 MiB chunks;
+        # 16 MiB leaves generous headroom.
+        if size > MAX_CHUNK_SIZE:
+            raise S3Error("EntityTooLarge",
+                          f"chunk of {size} bytes exceeds the "
+                          f"{MAX_CHUNK_SIZE}-byte chunk limit")
         chunk_sig = ""
         if ext.startswith("chunk-signature="):
             chunk_sig = ext[len("chunk-signature="):]

@@ -378,3 +378,23 @@ class TestQuorumListVersions:
         # the solo write (if it succeeded at all) lives on one drive
         # only; quorum must keep just the original version
         assert len(versions) == 1
+
+    def test_durable_version_listable_at_data_blocks_copies(self, tmp_path):
+        """ADVICE r3: a version still readable at k shards must stay
+        listable with only k metadata copies reachable — listing quorum
+        is data_blocks (objectQuorumFromMeta), not a responder
+        majority."""
+        from minio_tpu.engine.erasure_set import ErasureSet
+        from minio_tpu.storage.drive import LocalDrive
+
+        drives = [LocalDrive(str(tmp_path / f"k{i}")) for i in range(4)]
+        es = ErasureSet(drives)          # EC 2+2
+        es.make_bucket("kb")
+        fi = es.put_object("kb", "obj", b"d" * 5000, versioned=True)
+        # two drives offline: 2 of 4 metadata copies reachable == k
+        es.drives[0] = None
+        es.drives[1] = None
+        _, got = es.get_object("kb", "obj")          # GET succeeds at k
+        assert got == b"d" * 5000
+        versions = es.list_object_versions("kb", "obj")
+        assert [v.version_id for v in versions] == [fi.version_id]

@@ -276,8 +276,60 @@ class TestAdviceR2Policy:
             pol.Policy({"Statement": [{
                 "Effect": "Deny", "Action": "s3:*",
                 "Resource": "arn:aws:s3:::*",
-                "Condition": {"ArnNotLike":
+                "Condition": {"BinaryEquals":
                               {"aws:PrincipalArn": "arn:aws:iam::*"}}}]})
+
+    def test_arn_operators(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Deny", "Action": "s3:*",
+            "Resource": "arn:aws:s3:::*",
+            "Condition": {"ArnNotLike":
+                          {"aws:PrincipalArn": "arn:aws:iam::1:*"}}}]})
+        assert not p.is_allowed(
+            "s3:GetObject", "b/k",
+            {"aws:PrincipalArn": "arn:aws:iam::2:user/eve"})
+        # matching ARN escapes the Deny (but nothing Allows)
+        assert not p.is_allowed(
+            "s3:GetObject", "b/k",
+            {"aws:PrincipalArn": "arn:aws:iam::1:user/me"})
+
+    def test_null_operator(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:ListBucket",
+            "Resource": "arn:aws:s3:::b",
+            "Condition": {"Null": {"s3:prefix": "true"}}}]})
+        assert p.is_allowed("s3:ListBucket", "b", {})
+        assert not p.is_allowed("s3:ListBucket", "b",
+                                {"s3:prefix": "x/"})
+
+    def test_null_if_exists_rejected(self):
+        # AWS has no NullIfExists; it must fail parse, not evaluate
+        # with absent-key-passes semantics.
+        with pytest.raises(pol.PolicyError):
+            pol.Policy({"Statement": [{
+                "Effect": "Allow", "Action": "s3:ListBucket",
+                "Resource": "arn:aws:s3:::b",
+                "Condition": {"NullIfExists": {"s3:prefix": "false"}}}]})
+
+    def test_if_exists_suffix(self):
+        p = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:ListBucket",
+            "Resource": "arn:aws:s3:::b",
+            "Condition": {"StringEqualsIfExists":
+                          {"s3:prefix": ["pub/"]}}}]})
+        assert p.is_allowed("s3:ListBucket", "b", {})          # absent key
+        assert p.is_allowed("s3:ListBucket", "b", {"s3:prefix": "pub/"})
+        assert not p.is_allowed("s3:ListBucket", "b",
+                                {"s3:prefix": "priv/"})
+
+    def test_deny_all_fallback_policy(self):
+        p = pol.deny_all_policy()
+        assert not p.is_allowed("s3:GetObject", "b/k")
+        # its Deny wins even merged with an Allow-everything policy
+        allow = pol.Policy({"Statement": [{
+            "Effect": "Allow", "Action": "s3:*",
+            "Resource": "arn:aws:s3:::*"}]})
+        assert not pol.merge_allowed([allow, p], "s3:GetObject", "b/k")
 
     def test_string_not_like(self):
         p = pol.Policy({"Statement": [{

@@ -279,6 +279,30 @@ class TestAuth:
         with pytest.raises(S3Error):
             decode_streaming_body(creds, headers, bad)
 
+    def test_streaming_reader_caps_declared_chunk_size(self):
+        """ADVICE r3: a declared multi-GiB chunk must be rejected before
+        it is buffered, not after — the chunk-size header is untrusted."""
+        import io
+        from minio_tpu.server.api_errors import S3Error
+        from minio_tpu.server import sigv4 as s4
+
+        creds = Credentials(ACCESS, SECRET)
+        amz_date = "20260101T000000Z"
+        scope = f"20260101/{creds.region}/s3/aws4_request"
+        # A header declaring 5 GiB followed by barely any data: the
+        # reader must fail fast on the size, not sit in _fill trying to
+        # buffer 5 GiB.
+        raw = io.BytesIO(b"140000000;chunk-signature=" + b"ab" * 32 +
+                         b"\r\n" + b"x" * 1024)
+        headers = {"authorization":
+                   f"AWS4-HMAC-SHA256 Credential={ACCESS}/{scope}, "
+                   f"SignedHeaders=host, Signature={'ab' * 32}",
+                   "x-amz-date": amz_date}
+        rd = s4.StreamingSigV4Reader(creds, headers, raw)
+        with pytest.raises(S3Error) as ei:
+            rd.read(100)
+        assert ei.value.api.code == "EntityTooLarge"
+
 
 class TestKeyEncoding:
     def test_unicode_and_space_keys(self, cli):

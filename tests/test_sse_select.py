@@ -504,3 +504,51 @@ class TestParquetSelect:
         # "restart": a fresh manager over the same drives re-registers
         tm2 = TierManager(pools)
         assert "WARM" in tm2.list_tiers()
+
+    def test_tier_credentials_sealed_with_kms(self, tmp_path, monkeypatch):
+        """ADVICE r3: tier configs carrying remote credentials must not
+        hit the sys volume in plaintext — sealed when a KMS is
+        configured, refused when not."""
+        import pytest as _pytest
+        from minio_tpu.bucket.tier import DirTierBackend, TierManager
+        from minio_tpu.crypto.kms import StaticKMS
+        from minio_tpu.engine.pools import ServerPools
+        from minio_tpu.engine.sets import ErasureSets
+        from minio_tpu.storage.drive import LocalDrive
+        from minio_tpu.storage.errors import StorageError
+
+        monkeypatch.delenv("MTPU_KMS_SECRET_KEY", raising=False)
+        drives = [LocalDrive(str(tmp_path / f"sd{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        s3cfg = {"type": "s3", "endpoint": "http://127.0.0.1:1",
+                 "accessKey": "AKSECRETID", "secretKey": "sswordpa",
+                 "bucket": "warm"}
+
+        # no KMS: refuse to persist credentials in the clear
+        tm = TierManager(pools, kms=None)
+        with _pytest.raises(StorageError):
+            tm.add_tier("remote", object(), config=s3cfg)
+
+        # the failed persist must leave nothing registered in memory
+        assert "REMOTE" not in tm.list_tiers()
+
+        kms = StaticKMS(master_key=b"\x11" * 32)
+        tm = TierManager(pools, kms=kms)
+        tm.add_tier("remote", object(), config=s3cfg)
+        raw = drives[0].read_all(
+            __import__("minio_tpu.storage.drive",
+                       fromlist=["SYS_VOL"]).SYS_VOL,
+            TierManager.TIER_CONFIG_PATH)
+        assert b"AKSECRETID" not in raw and b"sswordpa" not in raw
+        # same-KMS restart round-trips the registration
+        tm2 = TierManager(pools, kms=kms)
+        assert "REMOTE" in tm2.list_tiers()
+        # keyless restart cannot read it back — and must not crash
+        tm3 = TierManager(pools, kms=None)
+        assert "REMOTE" not in tm3.list_tiers()
+        # ...and a keyless writer must NOT clobber the sealed blob
+        with _pytest.raises(StorageError):
+            tm3.add_tier("warm2", object(),
+                         config={"type": "fs", "path": str(tmp_path)})
+        tm4 = TierManager(pools, kms=kms)
+        assert "REMOTE" in tm4.list_tiers()

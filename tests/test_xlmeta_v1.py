@@ -75,6 +75,28 @@ class TestV1Read:
         _, got = es.get_object("legacy", "c")
         assert got == data
 
+    def test_v1_missing_checksum_shard_not_trusted(self, es):
+        """ADVICE r3: a v1 shard whose xl.json carries no (or an empty)
+        checksum entry for the part must be reconstructed around, not
+        served unverified — then corrupt it and prove the corruption
+        cannot reach the reader."""
+        import json
+        data = b"stripped checksums" * 500
+        _write_v1_object(es.drives, "legacy", "nc", data)
+        # strip drive 0's checksum entry and corrupt its shard: if the
+        # unverifiable shard were trusted, the GET would return garbage
+        mp = es.drives[0]._file_path("legacy", f"nc/{xlmeta_v1.XL_JSON}")
+        doc = json.loads(open(mp, "rb").read())
+        for c in doc.get("checksum", []):
+            c["hash"] = ""
+        open(mp, "w").write(json.dumps(doc))
+        p = es.drives[0]._file_path("legacy", "nc/part.1")
+        raw = bytearray(open(p, "rb").read())
+        raw[3] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        _, got = es.get_object("legacy", "nc")
+        assert got == data
+
     def test_v1_below_quorum_errors(self, es):
         from minio_tpu.storage.errors import ErrErasureReadQuorum
         data = b"x" * 4000
