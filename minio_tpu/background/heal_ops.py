@@ -51,10 +51,19 @@ class HealSequence:
         self.state = "running"
         self.started = time.time()
         try:
+            from ..engine import heal as H
+            # Format heal is bucket-independent: once per set, before
+            # any bucket/object work (it restores the sys volume every
+            # write stages through).
+            for pool in self.pools.pools:
+                for es in getattr(pool, "sets", [pool]):
+                    try:
+                        H.heal_format(es)
+                    except StorageError:
+                        pass
             buckets = ([self.bucket] if self.bucket
                        else self.pools.list_buckets())
             for bucket in buckets:
-                from ..engine import heal as H
                 for pool in self.pools.pools:
                     sets = getattr(pool, "sets", [pool])
                     for es in sets:

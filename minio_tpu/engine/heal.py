@@ -429,6 +429,40 @@ def _heal_data(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
                 pass
 
 
+def heal_format(es: ErasureSet) -> list[int]:
+    """Restore format.json + the system volume on drives that lost
+    them (wiped/replaced disk) — the HealFormat step that must precede
+    bucket/object healing, because every write stages through the sys
+    volume's tmp dir (cf. HealFormat, cmd/format-erasure.go:798).
+    Returns healed positions."""
+    from ..storage.format import load_format, new_format, save_format
+    fmts: list[dict | None] = []
+    for d in es.drives:
+        if d is None:
+            fmts.append(None)
+            continue
+        try:
+            fmts.append(load_format(d))
+        except StorageError:
+            fmts.append(None)
+    ref = next((f for f in fmts if f), None)
+    if ref is None:
+        return []
+    layout = ref["xl"]["sets"]
+    healed = []
+    for pos, (d, f) in enumerate(zip(es.drives, fmts)):
+        if d is None or f is not None:
+            continue
+        try:
+            d.init_sys_volume()
+            save_format(d, new_format(ref["id"], layout,
+                                      layout[es.set_index][pos]))
+            healed.append(pos)
+        except StorageError:
+            continue
+    return healed
+
+
 def heal_bucket(es: ErasureSet, bucket: str) -> list[int]:
     """Create the bucket volume on drives missing it; returns healed
     positions (cf. HealBucket, /root/reference/cmd/erasure-bucket.go)."""

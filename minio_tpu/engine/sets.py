@@ -29,7 +29,12 @@ class ErasureSets:
     def __init__(self, drives: list[LocalDrive | None],
                  set_drive_count: int,
                  default_parity: int | None = None,
-                 deployment_id: str | None = None):
+                 deployment_id: str | None = None,
+                 nslock=None, preloaded_format: dict | None = None):
+        """preloaded_format: a format already loaded+verified by the
+        cluster boot (wait_format) — skips a second full-deployment
+        format scan, which in a cluster is one RPC round-trip per
+        remote drive."""
         if set_drive_count < 2:
             raise ValueError("set_drive_count must be >= 2")
         if len(drives) % set_drive_count != 0:
@@ -40,11 +45,12 @@ class ErasureSets:
         self.set_count = len(drives) // set_drive_count
         rows = [drives[i * set_drive_count:(i + 1) * set_drive_count]
                 for i in range(self.set_count)]
-        fmt = init_format_sets(rows, deployment_id)
+        fmt = (preloaded_format if preloaded_format is not None
+               else init_format_sets(rows, deployment_id))
         self.deployment_id = fmt["id"]
         self._dep_key = uuid.UUID(self.deployment_id).bytes
         self.sets = [ErasureSet(row, default_parity=default_parity,
-                                set_index=i)
+                                set_index=i, nslock=nslock)
                      for i, row in enumerate(rows)]
 
     # -- placement -----------------------------------------------------------

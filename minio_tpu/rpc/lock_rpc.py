@@ -10,12 +10,18 @@ interchangeably.
 from __future__ import annotations
 
 from ..cluster.local_locker import LocalLocker
-from .rest import NetworkError, RPCClient, RPCServer
+from .rest import DEFAULT_PLANE_VERSIONS, NetworkError, RPCClient, RPCServer
+
+#: Lock plane wire version (cf. lockRESTVersion,
+#: cmd/lock-rest-server-common.go:25).
+LOCK_RPC_VERSION = "v2"
+DEFAULT_PLANE_VERSIONS["lock"] = LOCK_RPC_VERSION
 
 _LOCK_METHODS = ["lock", "unlock", "rlock", "runlock", "refresh"]
 
 
-def register_lock_rpc(server: RPCServer, locker: LocalLocker) -> None:
+def register_lock_rpc(server, locker: LocalLocker) -> None:
+    server.register_plane("lock", LOCK_RPC_VERSION)
     def make_handler(method: str):
         def handler(payload: dict):
             return bool(getattr(locker, method)(

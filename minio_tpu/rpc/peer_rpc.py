@@ -14,7 +14,12 @@ from __future__ import annotations
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from .rest import NetworkError, RPCClient, RPCServer
+from .rest import DEFAULT_PLANE_VERSIONS, NetworkError, RPCClient, RPCServer
+
+#: Peer (control) plane wire version (cf. peerRESTVersion,
+#: cmd/peer-rest-common.go:21).
+PEER_RPC_VERSION = "v2"
+DEFAULT_PLANE_VERSIONS["peer"] = PEER_RPC_VERSION
 
 
 class PeerRegistry:
@@ -40,7 +45,8 @@ class PeerRegistry:
                 "version": "minio-tpu-dev"}
 
 
-def register_peer_rpc(server: RPCServer, registry: PeerRegistry) -> None:
+def register_peer_rpc(server, registry: PeerRegistry) -> None:
+    server.register_plane("peer", PEER_RPC_VERSION)
     server.register("peer.reload",
                     lambda p: registry.reload(p.get("subsystem", "")))
     server.register("peer.server_info", lambda p: registry.server_info())
@@ -96,7 +102,9 @@ def verify_cluster_config(peers: list[RPCClient], token_check: dict) -> list:
     return bad
 
 
-def register_bootstrap_rpc(server: RPCServer, expected: dict) -> None:
+def register_bootstrap_rpc(server, expected: dict) -> None:
+    server.register_plane("peer", PEER_RPC_VERSION)
+
     def verify(payload: dict) -> dict:
         mismatches = {k: (v, payload.get(k))
                       for k, v in expected.items() if payload.get(k) != v}

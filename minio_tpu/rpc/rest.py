@@ -1,17 +1,32 @@
-"""RPC core: msgpack-over-HTTP POST with bearer auth + health checking.
+"""RPC core: msgpack-over-HTTP POST with bearer auth, plane versioning
+and health checking.
 
 The internal/rest equivalent (/root/reference/internal/rest/client.go:76,126):
-every RPC is POST /rpc/v{N}/{method} with an msgpack body and a bearer
-token; the client runs a background health-check loop that flips the
-endpoint online/offline (consulted before use, so a dead peer costs one
-failed call, not one per request), with a NetworkError taxonomy distinct
-from application errors.
+every RPC is POST /rpc/{plane}/{version}/{method} with an msgpack body
+and a bearer token; the client runs a background health-check loop that
+flips the endpoint online/offline (consulted before use, so a dead peer
+costs one failed call, not one per request), with a NetworkError
+taxonomy distinct from application errors.
+
+Plane versioning mirrors the reference's hard compatibility gates
+(storageRESTVersion cmd/storage-rest-common.go:21, peerRESTVersion
+cmd/peer-rest-common.go:21, lockRESTVersion
+cmd/lock-rest-server-common.go:25): each plane (storage/peer/lock/...)
+declares its wire version; a request whose path carries a different
+version is rejected with a typed RPCVersionMismatch BEFORE any method
+dispatch, so a mixed-version cluster fails loudly at the first call
+instead of corrupting state with a changed wire format.
 
 Wire format: request body msgpack map; response 200 + msgpack payload, or
 5xx/4xx + msgpack {"err": <storage error class>, "msg": ...} re-raised
 as the matching exception class on the client (the analogue of the
 reference's errors-over-the-wire string table,
-cmd/storage-rest-server.go).
+cmd/storage-rest-server.go). Version mismatches ride status 426.
+
+The router is transport-independent: RPCServer gives it its own
+listener (tests, dedicated RPC port), while a cluster node mounts the
+same router under the S3 front door's port — the reference likewise
+serves all inter-node planes on the main server port, routed by path.
 """
 
 from __future__ import annotations
@@ -24,16 +39,32 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..storage import errors as se
 from ..utils import msgpackx
 
-RPC_VERSION = "v1"
-HEALTH_METHOD = "health"
+HEALTH_METHOD = "health.health"
 _ERR_CLASSES = {
     name: cls for name, cls in vars(se).items()
     if isinstance(cls, type) and issubclass(cls, se.StorageError)}
+
+#: Client-side default plane versions; each plane module overrides its
+#: own entry at import (single source of truth per plane).
+DEFAULT_PLANE_VERSIONS: dict[str, str] = {"health": "v1"}
 
 
 class NetworkError(Exception):
     """Transport-level failure (connect/timeout/HTTP) — NOT an application
     error; quorum logic treats these as drive-offline."""
+
+
+class RPCVersionMismatch(Exception):
+    """Peer speaks a different plane version — a hard deployment error
+    (mixed binaries), never retried (cf. the reference's
+    IsNetworkOrHostDown NOT matching version-path 404s; it fails the
+    boot instead)."""
+
+    def __init__(self, plane: str, got: str, want: str):
+        self.plane, self.got, self.want = plane, got, want
+        super().__init__(
+            f"rpc plane {plane!r}: peer wants {want}, client speaks "
+            f"{got} — upgrade the older node")
 
 
 def pack_error(e: Exception) -> bytes:
@@ -43,20 +74,85 @@ def pack_error(e: Exception) -> bytes:
 def unpack_error(data: bytes) -> Exception:
     try:
         obj = msgpackx.unpackb(data)
+        if obj.get("err") == "RPCVersionMismatch":
+            return RPCVersionMismatch(obj.get("plane", "?"),
+                                      obj.get("got", "?"),
+                                      obj.get("want", "?"))
         cls = _ERR_CLASSES.get(obj.get("err", ""), se.StorageError)
         return cls(obj.get("msg", ""))
     except Exception:  # noqa: BLE001
         return se.StorageError(data[:200])
 
 
-class RPCServer:
-    """Serves a method table over HTTP. Methods get (payload dict) and
-    return a msgpack-able object; raising a StorageError maps to a typed
-    error response."""
+class RPCRouter:
+    """Method table + plane version gate, independent of transport.
 
-    def __init__(self, token: str, host: str = "127.0.0.1", port: int = 0):
+    Methods are registered under "plane.name"; requests arrive as
+    POST /minio/rpc/{plane}/{version}/{name} — under the reserved
+    /minio/ prefix so a bucket named "rpc" can never shadow the plane
+    (the reference mounts its planes at /minio/storage|peer|lock the
+    same way, cmd/routers.go:27-39). An unknown plane is 404; a known
+    plane at the wrong version is a typed 426."""
+
+    def __init__(self, token: str):
         self.token = token
-        self._methods: dict[str, callable] = {HEALTH_METHOD: lambda p: {"ok": True}}
+        self._planes: dict[str, str] = {"health": "v1"}
+        self._methods: dict[str, callable] = {
+            HEALTH_METHOD: lambda p: {"ok": True}}
+
+    def register_plane(self, plane: str, version: str) -> None:
+        self._planes[plane] = version
+
+    def register(self, name: str, fn) -> None:
+        plane = name.split(".", 1)[0]
+        self._planes.setdefault(plane, "v1")
+        self._methods[name] = fn
+
+    def handle(self, path: str, auth_header: str,
+               body: bytes) -> tuple[int, bytes]:
+        """-> (http status, msgpack body). Auth first, always."""
+        import hmac as _hmac
+        if not _hmac.compare_digest(auth_header or "",
+                                    f"Bearer {self.token}"):
+            return 403, pack_error(
+                se.ErrFileAccessDenied("bad rpc token"))
+        parts = path.strip("/").split("/")
+        # ["minio", "rpc", plane, version, method]
+        if len(parts) != 5 or parts[0] != "minio" or parts[1] != "rpc":
+            return 404, pack_error(
+                se.StorageError(f"no such path {path}"))
+        _, _, plane, version, method = parts
+        want = self._planes.get(plane)
+        if want is None:
+            return 404, pack_error(
+                se.StorageError(f"no such rpc plane {plane!r}"))
+        if version != want:
+            return 426, msgpackx.packb(
+                {"err": "RPCVersionMismatch", "plane": plane,
+                 "got": version, "want": want})
+        fn = self._methods.get(f"{plane}.{method}")
+        if fn is None:
+            return 404, pack_error(
+                se.StorageError(f"no such method {plane}.{method}"))
+        try:
+            payload = msgpackx.unpackb(body) if body else {}
+            return 200, msgpackx.packb(fn(payload))
+        except se.StorageError as e:
+            return 500, pack_error(e)
+        except Exception as e:  # noqa: BLE001
+            return 500, pack_error(se.StorageError(
+                f"{type(e).__name__}: {e}"))
+
+
+class RPCServer:
+    """Serves an RPCRouter on its own listener. Methods get (payload
+    dict) and return a msgpack-able object; raising a StorageError maps
+    to a typed error response."""
+
+    def __init__(self, token: str, host: str = "127.0.0.1", port: int = 0,
+                 router: RPCRouter | None = None):
+        self.router = router or RPCRouter(token)
+        self.token = token
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -66,49 +162,25 @@ class RPCServer:
                 pass
 
             def do_POST(self):
-                import hmac as _hmac
-                got = self.headers.get("Authorization", "")
-                want = f"Bearer {outer.token}"
-                if not _hmac.compare_digest(got, want):
-                    self._reply(403, pack_error(
-                        se.ErrFileAccessDenied("bad rpc token")))
-                    return
-                prefix = f"/rpc/{RPC_VERSION}/"
-                if not self.path.startswith(prefix):
-                    self._reply(404, pack_error(
-                        se.StorageError(f"no such path {self.path}")))
-                    return
-                method = self.path[len(prefix):]
-                fn = outer._methods.get(method)
-                if fn is None:
-                    self._reply(404, pack_error(
-                        se.StorageError(f"no such method {method}")))
-                    return
                 length = int(self.headers.get("Content-Length", 0) or 0)
                 body = self.rfile.read(length) if length else b""
-                try:
-                    payload = msgpackx.unpackb(body) if body else {}
-                    result = fn(payload)
-                    self._reply(200, msgpackx.packb(result))
-                except se.StorageError as e:
-                    self._reply(500, pack_error(e))
-                except Exception as e:  # noqa: BLE001
-                    self._reply(500, pack_error(se.StorageError(
-                        f"{type(e).__name__}: {e}")))
-
-            def _reply(self, status: int, body: bytes):
+                status, out = outer.router.handle(
+                    self.path, self.headers.get("Authorization", ""), body)
                 self.send_response(status)
-                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Length", str(len(out)))
                 self.send_header("Content-Type", "application/msgpack")
                 self.end_headers()
-                self.wfile.write(body)
+                self.wfile.write(out)
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self.host, self.port = host, self._httpd.server_port
         self._thread: threading.Thread | None = None
 
     def register(self, name: str, fn) -> None:
-        self._methods[name] = fn
+        self.router.register(name, fn)
+
+    def register_plane(self, plane: str, version: str) -> None:
+        self.router.register_plane(plane, version)
 
     def start(self) -> "RPCServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -132,15 +204,25 @@ class RPCClient:
     checker (started lazily) probes `health` every `check_interval`
     seconds and flips it back online when the peer answers
     (cf. internal/rest/client.go:76-124).
+
+    `versions` maps plane -> version string for the request path;
+    planes default to DEFAULT_PLANE_VERSIONS (each plane module sets
+    its entry, so client and server share one constant).
     """
 
     def __init__(self, endpoint: str, token: str, timeout: float = 10.0,
-                 check_interval: float = 1.0):
+                 check_interval: float = 1.0,
+                 versions: dict[str, str] | None = None,
+                 tls_context=None):
         host, _, port = endpoint.partition(":")
         self.host, self.port = host, int(port)
         self.token = token
         self.timeout = timeout
         self.check_interval = check_interval
+        self.tls_context = tls_context     # ssl.SSLContext -> HTTPS
+        self.versions = dict(DEFAULT_PLANE_VERSIONS)
+        if versions:
+            self.versions.update(versions)
         self._online = True
         self._checker_running = False
         self._lock = threading.Lock()
@@ -177,13 +259,23 @@ class RPCClient:
 
     # -- calls ---------------------------------------------------------------
 
+    def _path_for(self, method: str) -> str:
+        plane, _, name = method.partition(".")
+        ver = self.versions.get(plane, "v1")
+        return f"/minio/rpc/{plane}/{ver}/{name}"
+
     def _raw_call(self, method: str, payload: dict,
                   timeout: float | None = None) -> object:
         body = msgpackx.packb(payload)
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=timeout or self.timeout)
+        if self.tls_context is not None:
+            conn = http.client.HTTPSConnection(
+                self.host, self.port, timeout=timeout or self.timeout,
+                context=self.tls_context)
+        else:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=timeout or self.timeout)
         try:
-            conn.request("POST", f"/rpc/{RPC_VERSION}/{method}", body=body,
+            conn.request("POST", self._path_for(method), body=body,
                          headers={"Authorization": f"Bearer {self.token}",
                                   "Content-Type": "application/msgpack"})
             resp = conn.getresponse()
@@ -199,7 +291,8 @@ class RPCClient:
 
     def call(self, method: str, payload: dict | None = None) -> object:
         """RPC with offline short-circuit (a StorageError from the peer
-        does NOT mark it offline — only transport failures do)."""
+        does NOT mark it offline — only transport failures do; an
+        RPCVersionMismatch is a deployment error, not a health event)."""
         if not self._online:
             raise NetworkError(f"{self.host}:{self.port} is offline")
         try:

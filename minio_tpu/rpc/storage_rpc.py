@@ -16,7 +16,14 @@ from __future__ import annotations
 from ..storage.drive import LocalDrive
 from ..storage.errors import ErrDiskNotFound
 from ..storage.xlmeta import FileInfo
-from .rest import NetworkError, RPCClient, RPCServer
+from .rest import DEFAULT_PLANE_VERSIONS, NetworkError, RPCClient, RPCServer
+
+#: Storage plane wire version — bump on ANY change to the method table,
+#: argument encoding, or FileInfo wire shape (the reference's
+#: storageRESTVersion, cmd/storage-rest-common.go:21, is at v40 for the
+#: same reason: a version bump per wire change).
+STORAGE_RPC_VERSION = "v2"
+DEFAULT_PLANE_VERSIONS["storage"] = STORAGE_RPC_VERSION
 
 _DRIVE_METHODS = [
     "make_volume", "list_volumes", "stat_volume", "delete_volume",
@@ -24,12 +31,14 @@ _DRIVE_METHODS = [
     "read_file", "rename_file", "file_size", "read_version",
     "write_metadata", "update_metadata", "rename_data", "delete_version",
     "list_dir", "walk_dir", "verify_file", "disk_info", "get_disk_id",
-    "list_raw", "clear_tmp",
+    "list_raw", "clear_tmp", "init_sys_volume",
 ]
 
 
-def register_storage_rpc(server: RPCServer, drives: list[LocalDrive]) -> None:
-    """Expose `drives` (this node's local drives) on an RPCServer."""
+def register_storage_rpc(server, drives: list[LocalDrive]) -> None:
+    """Expose `drives` (this node's local drives) on an RPCServer or
+    RPCRouter."""
+    server.register_plane("storage", STORAGE_RPC_VERSION)
 
     def make_handler(method: str):
         def handler(payload: dict):
@@ -70,6 +79,7 @@ class RemoteDrive:
         self._idx = drive_idx
         # Engine identity string (endpoint/path) for logs & format checks.
         self.path = path or f"{client.host}:{client.port}/drive{drive_idx}"
+        self.root = self.path            # LocalDrive-parity for messages
 
     def is_online(self) -> bool:
         return self._client.is_online()

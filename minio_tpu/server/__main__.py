@@ -41,19 +41,101 @@ def main(argv: list[str] | None = None) -> int:
     from ..ops.selftest import run_startup_self_tests
     run_startup_self_tests()
 
+    from .server import S3Server
+    from .sigv4 import Credentials
+
+    creds = Credentials(os.environ.get("MTPU_ROOT_USER", "minioadmin"),
+                        os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin"))
+    endpoint_args = args.drives.split()
+    cluster_mode = any("://" in a for a in endpoint_args)
+
+    certs = None
+    if args.certs_dir:
+        cert = os.path.join(args.certs_dir, "public.crt")
+        key = os.path.join(args.certs_dir, "private.key")
+        if not (os.path.exists(cert) and os.path.exists(key)):
+            print(f"--certs-dir: missing {cert} or {key}",
+                  file=sys.stderr)
+            return 2
+        certs = (cert, key)
+
+    if cluster_mode:
+        # Distributed boot: URL endpoints, every node launched with the
+        # same list (cf. serverMain distributed path,
+        # cmd/server-main.go:441). The front door starts first; S3
+        # serves 503 until format quorum + peer verify complete.
+        from .cluster import boot_cluster_node
+
+        if certs is not None and not all(
+                a.startswith("https://") for a in endpoint_args):
+            # TLS without https endpoints would serve the planes over
+            # TLS while peers dial plaintext — fail loudly, don't
+            # silently downgrade either side.
+            print("--certs-dir requires https:// cluster endpoints",
+                  file=sys.stderr)
+            return 2
+        if certs is None and any(a.startswith("https://")
+                                 for a in endpoint_args):
+            print("https:// endpoints require --certs-dir",
+                  file=sys.stderr)
+            return 2
+
+        def factory(node):
+            srv = S3Server(None, creds, host=args.host, port=args.port,
+                           rpc_router=node.router, certs=certs).start()
+            print(f"minio_tpu cluster node on {srv.endpoint} "
+                  f"(first={node.is_first}, "
+                  f"{len(node.local_drives)} local / "
+                  f"{len(node.endpoints)} total drives, "
+                  f"set={node.set_drive_count}) — waiting for cluster",
+                  flush=True)
+            return srv
+
+        import threading
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        while True:
+            try:
+                node, srv0, pools = boot_cluster_node(
+                    endpoint_args, args.host, args.port, creds,
+                    set_drive_count=args.set_drive_count,
+                    server_factory=factory, certs_dir=args.certs_dir,
+                    timeout=float(os.environ.get("MTPU_BOOT_TIMEOUT",
+                                                 "120")))
+            except Exception as e:  # noqa: BLE001
+                print(f"minio_tpu: cluster boot failed: {e}",
+                      file=sys.stderr, flush=True)
+                return 1
+            print(f"minio_tpu cluster node ready on {srv0.endpoint} "
+                  f"(deployment ok)", flush=True)
+            try:
+                while not stop.wait(timeout=1.0):
+                    if srv0.service_event:
+                        break
+            except KeyboardInterrupt:
+                break
+            if srv0.service_event == "restart" and not stop.is_set():
+                # Full re-boot: tear down, rejoin the cluster (format
+                # adopt + peer verify run again), same as the
+                # standalone restart loop.
+                print("minio_tpu: service restart requested", flush=True)
+                srv0.shutdown()
+                node.close()
+                continue
+            break
+        srv0.shutdown()
+        node.close()
+        return 0
+
     from ..engine.pools import ServerPools
     from ..engine.sets import ErasureSets
     from ..storage.drive import LocalDrive
-    from .server import S3Server
-    from .sigv4 import Credentials
 
     paths = expand_ellipses(args.drives)
     drives = [LocalDrive(p) for p in paths]
     sets = ErasureSets(drives,
                        set_drive_count=args.set_drive_count or len(drives))
     pools = ServerPools([sets])
-    creds = Credentials(os.environ.get("MTPU_ROOT_USER", "minioadmin"),
-                        os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin"))
 
     # Full subsystem stack, the newAllSubsystems role
     # (cmd/server-main.go:441): IAM, scanner, notifications.
@@ -67,16 +149,6 @@ def main(argv: list[str] | None = None) -> int:
     import threading
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    certs = None
-    if args.certs_dir:
-        cert = os.path.join(args.certs_dir, "public.crt")
-        key = os.path.join(args.certs_dir, "private.key")
-        if not (os.path.exists(cert) and os.path.exists(key)):
-            print(f"--certs-dir: missing {cert} or {key}",
-                  file=sys.stderr)
-            return 2
-        certs = (cert, key)
-
     port = args.port
     while True:
         srv = S3Server(pools, creds, host=args.host, port=port,

@@ -1,0 +1,292 @@
+"""Multi-node cluster bootstrap: URL endpoints -> a serving node.
+
+The serverMain distributed path (/root/reference/cmd/server-main.go:441 +
+cmd/prepare-storage.go:298 + cmd/bootstrap-peer-server.go): every node
+is launched with the SAME endpoint list (`http://host{1...N}/drive{1...M}`)
+plus its own address; it
+
+1. starts its front door FIRST (S3 + all RPC planes on one port, routed
+   by path — cmd/routers.go:27-39) so peers can reach its storage plane
+   while it waits,
+2. waits for format quorum: the FIRST node (owner of endpoint[0])
+   formats the whole deployment — local drives directly, remote drives
+   through the storage plane — while every other node polls until the
+   format lands on its local drives (the reference's firstDisk /
+   errNotFirstDisk retry loop),
+3. verifies cluster config against every peer (deployment id, layout
+   hash, root access key — verifyServerSystemConfig),
+4. builds the mixed Local/Remote erasure sets with a dsync-backed
+   namespace lock over one locker per node, and binds the object layer.
+
+The RPC bearer token is derived from the root credentials, so nodes
+booted with the same MTPU_ROOT_USER/PASSWORD authenticate to each other
+and nothing else does (the reference signs internode requests with the
+root credentials the same way).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+import time
+
+from ..cluster.local_locker import LocalLocker
+from ..cluster.nslock import NSLockMap
+from ..rpc.lock_rpc import RemoteLocker, register_lock_rpc
+from ..rpc.peer_rpc import (NotificationSys, PeerRegistry,
+                            register_bootstrap_rpc, register_peer_rpc,
+                            verify_cluster_config)
+from ..rpc.rest import RPCClient, RPCRouter
+from ..rpc.storage_rpc import RemoteDrive, register_storage_rpc
+from ..storage.drive import LocalDrive
+from ..storage.errors import StorageError
+from ..storage.format import load_format
+from ..topology.endpoints import Endpoint, parse_cluster_endpoints
+
+
+class ClusterBootError(RuntimeError):
+    pass
+
+
+def internode_token(secret_key: str) -> str:
+    """Shared-credential bearer token for the RPC planes."""
+    return hmac.new(secret_key.encode(), b"mtpu-internode",
+                    hashlib.sha256).hexdigest()
+
+
+def layout_digest(endpoints: list[Endpoint], set_drive_count: int) -> str:
+    """Every node must agree on the global drive order — a node booted
+    with a reordered endpoint list would place shards wrong."""
+    h = hashlib.sha256()
+    for ep in endpoints:
+        h.update(repr(ep).encode())
+        h.update(b"\x00")
+    h.update(str(set_drive_count).encode())
+    return h.hexdigest()
+
+
+class ClusterNode:
+    """One server process's view of the deployment."""
+
+    def __init__(self, endpoint_args: list[str], my_host: str,
+                 my_port: int, creds, set_drive_count: int | None = None,
+                 certs_dir: str = ""):
+        self.creds = creds
+        self.token = internode_token(creds.secret_key)
+        eps, size, nodes = parse_cluster_endpoints(endpoint_args,
+                                                   set_drive_count)
+        # https endpoints: peers are dialed over TLS, trusting the
+        # deployment cert (shared certs dir — the reference trusts
+        # certs/CAs the same way).
+        tls_ctx = None
+        if eps and eps[0].scheme == "https":
+            import ssl
+            tls_ctx = ssl.create_default_context()
+            ca = f"{certs_dir}/public.crt" if certs_dir else ""
+            import os as _os
+            if ca and _os.path.exists(ca):
+                tls_ctx.load_verify_locations(ca)
+            tls_ctx.check_hostname = False
+        self.tls_context = tls_ctx
+        self.endpoints = eps
+        self.set_drive_count = size
+        self.nodes = nodes
+        self.my_host, self.my_port = my_host, my_port
+        mine = [ep.is_local(my_host, my_port) for ep in eps]
+        if not any(mine):
+            raise ClusterBootError(
+                f"none of the endpoints are local to "
+                f"{my_host}:{my_port}")
+        # Node identity = the node entry owning my first local endpoint.
+        self.my_node = next(ep.node for ep, m in zip(eps, mine) if m)
+        self.is_first = eps[0].is_local(my_host, my_port)
+
+        # Per-node local endpoint lists, in global order: drive_idx on
+        # the storage plane is the position within the SERVING node's
+        # list, which every node derives identically from the shared
+        # endpoint list.
+        self.node_locals: dict[tuple[str, int], list[Endpoint]] = {}
+        for ep in eps:
+            self.node_locals.setdefault(ep.node, []).append(ep)
+
+        # My drives (served to peers + used directly).
+        self.local_drives = [LocalDrive(ep.path) for ep in eps
+                             if ep.is_local(my_host, my_port)]
+
+        # Peers (every node but me).
+        self.peer_clients: dict[tuple[str, int], RPCClient] = {
+            node: RPCClient(f"{node[0]}:{node[1]}", self.token,
+                            check_interval=1.0,
+                            tls_context=self.tls_context)
+            for node in nodes if node != self.my_node}
+
+        # The router every plane mounts on (served under the S3 port).
+        self.router = RPCRouter(self.token)
+        register_storage_rpc(self.router, self.local_drives)
+        self.locker = LocalLocker()
+        register_lock_rpc(self.router, self.locker)
+        self.peer_registry = PeerRegistry()
+        register_peer_rpc(self.router, self.peer_registry)
+        self.layout_sha = layout_digest(eps, size)
+        register_bootstrap_rpc(self.router, {
+            "layout_sha": self.layout_sha,
+            "access_key": creds.access_key})
+        self.notification = NotificationSys(
+            list(self.peer_clients.values()))
+
+    def close(self) -> None:
+        """Stop peer health-check loops (restart/shutdown path)."""
+        for cli in self.peer_clients.values():
+            cli.close()
+
+    # -- drive construction --------------------------------------------------
+
+    def build_drives(self) -> list:
+        """The global drive list: LocalDrive for mine, RemoteDrive for
+        every other node's, in endpoint order."""
+        out = []
+        local_iter = iter(self.local_drives)
+        for ep in self.endpoints:
+            if ep.is_local(self.my_host, self.my_port):
+                out.append(next(local_iter))
+            else:
+                cli = self.peer_clients[ep.node]
+                idx = self.node_locals[ep.node].index(ep)
+                out.append(RemoteDrive(cli, idx, path=repr(ep)))
+        return out
+
+    # -- format phase --------------------------------------------------------
+
+    def _rows(self, drives: list) -> list[list]:
+        k = self.set_drive_count
+        return [drives[i:i + k] for i in range(0, len(drives), k)]
+
+    def wait_format(self, drives: list, timeout: float = 60.0,
+                    poll: float = 0.3) -> dict:
+        """Format-quorum wait -> the deployment's reference format.
+
+        First node: formats the whole deployment once every drive
+        answers (fresh format needs ALL drives — the reference prints
+        "Waiting for all other servers to be online" in exactly this
+        loop); an already-formatted deployment loads at QUORUM, so one
+        dead peer never blocks a restart. Other nodes: poll ANY of
+        their local drives until the first node's format lands — only
+        one surviving formatted local drive is needed, the rest heal
+        into their recorded slots (errNotFirstDisk retry,
+        cmd/prepare-storage.go:298)."""
+        from ..storage.format import init_format_sets
+        deadline = time.monotonic() + timeout
+        last_err: Exception | None = None
+        while time.monotonic() < deadline:
+            if self.is_first:
+                try:
+                    return init_format_sets(self._rows(drives))
+                except StorageError as e:
+                    last_err = e          # peers not all up yet: retry
+            else:
+                fmt = None
+                for d in self.local_drives:
+                    try:
+                        fmt = load_format(d)
+                    except StorageError as e:
+                        last_err = e
+                    if fmt is not None:
+                        break
+                if fmt is not None:
+                    # Adopt + verify my position; heals my unformatted
+                    # drives into their recorded slots.
+                    try:
+                        return init_format_sets(self._rows(drives))
+                    except StorageError as e:
+                        last_err = e
+            time.sleep(poll)
+        raise ClusterBootError(
+            f"format quorum not reached in {timeout:.0f}s "
+            f"(first={self.is_first}): {last_err}")
+
+    def wait_peers_verified(self, deployment_id: str,
+                            timeout: float = 60.0,
+                            poll: float = 0.3) -> None:
+        """Every peer must agree on layout + credentials before we
+        serve (verifyServerSystemConfig, cmd/bootstrap-peer-server.go).
+        Peers still booting are retried until the deadline."""
+        from ..rpc.rest import RPCVersionMismatch
+        from ..storage.errors import ErrFileAccessDenied
+        check = {"layout_sha": self.layout_sha,
+                 "access_key": self.creds.access_key}
+        deadline = time.monotonic() + timeout
+        clients = list(self.peer_clients.values())
+        while True:
+            bad = verify_cluster_config(clients, check)
+            # Hard deployment errors fail FAST with the real cause:
+            # a config mismatch response, a 403 (different root
+            # credentials -> different bearer token), or a plane
+            # version mismatch (mixed binaries). Only transport
+            # errors mean "peer still booting".
+            hard = [b for b in bad
+                    if not isinstance(b[1], Exception)
+                    or isinstance(b[1], (ErrFileAccessDenied,
+                                         RPCVersionMismatch))]
+            if hard:
+                who = ", ".join(f"{c.host}:{c.port} {info}"
+                                for c, info in hard)
+                raise ClusterBootError(
+                    f"cluster config mismatch: {who}")
+            if not bad:
+                return
+            if time.monotonic() >= deadline:
+                who = ", ".join(f"{c.host}:{c.port}" for c, _ in bad)
+                raise ClusterBootError(
+                    f"peers unreachable for bootstrap verify: {who}")
+            time.sleep(poll)
+
+    # -- object layer --------------------------------------------------------
+
+    def build_object_layer(self, drives: list, default_parity=None,
+                           fmt: dict | None = None):
+        """Mixed-drive sets with a cluster-wide namespace lock: dsync
+        over one locker per NODE (mine direct, peers via the lock
+        plane), the reference's granularity
+        (cmd/namespace-lock.go:224). `fmt` is the format wait_format
+        already loaded — skips a second full-deployment scan."""
+        from ..engine.pools import ServerPools
+        from ..engine.sets import ErasureSets
+        lockers = [self.locker] + [RemoteLocker(cli)
+                                   for cli in self.peer_clients.values()]
+        nslock = NSLockMap(lockers=lockers if self.peer_clients else None)
+        sets = ErasureSets(drives, set_drive_count=self.set_drive_count,
+                           default_parity=default_parity, nslock=nslock,
+                           preloaded_format=fmt)
+        self.nslock = nslock
+        return ServerPools([sets])
+
+
+def boot_cluster_node(endpoint_args: list[str], my_host: str,
+                      my_port: int, creds,
+                      set_drive_count: int | None = None,
+                      server_factory=None, timeout: float = 60.0,
+                      certs_dir: str = ""):
+    """Full boot sequence -> (node, server, pools).
+
+    server_factory(node) must return a STARTED S3Server with
+    node.router mounted (the CLI passes its own; tests can wrap)."""
+    node = ClusterNode(endpoint_args, my_host, my_port, creds,
+                       set_drive_count, certs_dir=certs_dir)
+    server = server_factory(node)
+    try:
+        drives = node.build_drives()
+        fmt = node.wait_format(drives, timeout=timeout)
+        node.wait_peers_verified(fmt["id"], timeout=timeout)
+        pools = node.build_object_layer(drives, fmt=fmt)
+        from ..background.scanner import DataScanner
+        from ..iam.iam import IAMSys
+        iam = IAMSys(pools)
+        node.peer_registry.on_reload("iam", iam.load)
+        server.bind_object_layer(pools, iam=iam,
+                                 scanner=DataScanner(pools))
+        return node, server, pools
+    except Exception:
+        server.shutdown()
+        node.close()
+        raise

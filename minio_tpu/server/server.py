@@ -32,21 +32,31 @@ MAX_HEADER_BODY = 5 * 1024 ** 3      # max single PUT (5 GiB part limit)
 class S3Server:
     """Owns the object layer, creds and the HTTP plumbing."""
 
-    def __init__(self, pools: ServerPools, creds: Credentials,
+    def __init__(self, pools: ServerPools | None, creds: Credentials,
                  host: str = "127.0.0.1", port: int = 0,
                  trace_sink=None, iam=None, notify=None,
                  replication=None, scanner=None, kms=None,
                  compress_enabled: bool = False, tier_mgr=None,
-                 oidc=None, certs: tuple[str, str] | None = None):
+                 oidc=None, certs: tuple[str, str] | None = None,
+                 rpc_router=None):
         self.oidc = oidc                   # iam.oidc.OpenIDConfig | None
         self.pools = pools
         self.creds = creds                 # root credentials (policy bypass)
         self.iam = iam                     # IAMSys | None
-        self.handlers = S3Handlers(pools, notify=notify,
-                                   replication=replication,
-                                   scanner=scanner, kms=kms,
-                                   compress_enabled=compress_enabled,
-                                   tier_mgr=tier_mgr)
+        # Inter-node RPC planes mount under the S3 port (the reference
+        # serves storage/peer/lock REST on the main server port too,
+        # routed by path prefix — cmd/routers.go:27-39). pools may be
+        # None during cluster boot: the front door must be up so peers
+        # can reach OUR storage plane while WE wait for format quorum;
+        # S3 requests get 503 ServerNotInitialized until
+        # bind_object_layer() installs the engine.
+        self.rpc_router = rpc_router
+        self._handler_opts = dict(notify=notify, replication=replication,
+                                  scanner=scanner, kms=kms,
+                                  compress_enabled=compress_enabled,
+                                  tier_mgr=tier_mgr)
+        self.handlers = (S3Handlers(pools, **self._handler_opts)
+                         if pools is not None else None)
         self.trace_sink = trace_sink
         from ..observe.logger import Logger, RingTarget
         from ..observe.metrics import MetricsRegistry
@@ -98,10 +108,29 @@ class S3Server:
                 path = urllib.parse.unquote(parsed.path)
                 query = urllib.parse.parse_qs(parsed.query,
                                               keep_blank_values=True)
+                if path.startswith("/minio/rpc/") and \
+                        outer.rpc_router is not None:
+                    # Inter-node plane: bearer-token auth + msgpack,
+                    # handled by the router — no S3 middleware, no
+                    # S3 signature (cf. storageRESTServer auth,
+                    # cmd/storage-rest-server.go).
+                    length = int(self.headers.get("Content-Length",
+                                                  0) or 0)
+                    body = self.rfile.read(length) if length else b""
+                    status, out = outer.rpc_router.handle(
+                        path, self.headers.get("Authorization", ""),
+                        body)
+                    self._respond(Response(
+                        status, out,
+                        {"Content-Type": "application/msgpack"}))
+                    return
                 t0 = _time.perf_counter()
                 outer.metrics.inflight.inc(1)
                 access_key = ""
                 try:
+                    if outer.handlers is None and \
+                            not path.startswith("/minio/health/"):
+                        raise S3Error("ServerNotInitialized")
                     if path.startswith("/minio/admin/"):
                         resp = outer._dispatch(self, path, query)
                     elif path.startswith("/minio/"):
@@ -211,6 +240,19 @@ class S3Server:
         self._thread: threading.Thread | None = None
 
     # -- lifecycle -----------------------------------------------------------
+
+    def bind_object_layer(self, pools: ServerPools, iam=None,
+                          scanner=None) -> None:
+        """Install the engine after boot (cluster mode: the listener is
+        up first so peers can reach our RPC planes during format wait;
+        cf. newObjectLayer assignment, cmd/server-main.go:441)."""
+        self.pools = pools
+        if iam is not None:
+            self.iam = iam
+        if scanner is not None:
+            self.scanner = scanner
+            self._handler_opts["scanner"] = scanner
+        self.handlers = S3Handlers(pools, **self._handler_opts)
 
     def start(self) -> "S3Server":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -845,8 +887,13 @@ class S3Server:
         import json as _json
 
         from ..observe.health import cluster_health
-        if path in ("/minio/health/live", "/minio/health/ready"):
+        if path == "/minio/health/live":
             return Response(200)
+        if path == "/minio/health/ready":
+            # ready = object layer bound (cluster boot done)
+            return Response(200 if self.pools is not None else 503)
+        if self.pools is None:
+            return Response(503)
         if path == "/minio/health/cluster":
             maint = int(query.get("maintenance", ["0"])[0] or 0)
             ok, detail = cluster_health(self.pools, maint)

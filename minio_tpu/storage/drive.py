@@ -91,6 +91,15 @@ class LocalDrive:
 
     # -- volume ops ----------------------------------------------------------
 
+    def init_sys_volume(self) -> None:
+        """Recreate the reserved system volume skeleton (tmp/multipart/
+        bucket-meta dirs). A replaced/wiped drive loses it at runtime;
+        format heal calls this before rewriting format.json
+        (cf. makeFormatErasureMetaVolumes, cmd/format-erasure.go)."""
+        for sub in (TMP_DIR, MULTIPART_DIR, BUCKET_META_DIR):
+            os.makedirs(os.path.join(self.root, SYS_VOL, sub),
+                        exist_ok=True)
+
     def make_volume(self, vol: str) -> None:
         p = self._vol_path(vol)
         if os.path.isdir(p):

@@ -61,12 +61,37 @@ def init_format_sets(drives: list[list[LocalDrive]],
 
     Returns the reference format (with "this" cleared). Existing formatted
     drives are verified against their recorded position instead.
+
+    Unreachable drives (read error, dead peer) are tolerated when a
+    QUORUM of drives carries a consistent format — a restarting node
+    must not be blocked by one dead peer (waitForFormatErasure's
+    quorum, cmd/prepare-storage.go:298). A FRESH format still requires
+    every drive reachable, exactly like the reference's "Waiting for
+    all other servers to be online" loop — formatting around an
+    unreachable partition could mint two deployments.
     """
     deployment_id = deployment_id or str(uuid.uuid4())
-    existing = [[load_format(d) if d is not None else None for d in row]
-                for row in drives]
-    ref = next((f for row in existing for f in row if f), None)
+    _UNREACHABLE = object()
+
+    def probe(d):
+        if d is None:
+            return None
+        try:
+            return load_format(d)
+        except ErrFileCorrupt:
+            raise
+        except Exception:  # noqa: BLE001  (ErrDiskNotFound, transport)
+            return _UNREACHABLE
+
+    existing = [[probe(d) for d in row] for row in drives]
+    flat = [f for row in existing for f in row]
+    ref = next((f for f in flat if f not in (None, _UNREACHABLE)), None)
     if ref is None:
+        if any(f is _UNREACHABLE for f in flat):
+            raise ErrDiskNotFound(
+                "fresh format needs every drive online "
+                f"({sum(1 for f in flat if f is _UNREACHABLE)} "
+                "unreachable)")
         sets = [[str(uuid.uuid4()) for _ in row] for row in drives]
         for s, row in enumerate(drives):
             for d, drive in enumerate(row):
@@ -76,7 +101,13 @@ def init_format_sets(drives: list[list[LocalDrive]],
         return out
 
     # Partially/fully formatted: adopt the reference layout, heal fresh
-    # drives into their slots (cf. formatErasureFixLosingDisks).
+    # drives into their slots (cf. formatErasureFixLosingDisks); a
+    # quorum of drives must agree before we trust the layout.
+    formatted = sum(1 for f in flat if f not in (None, _UNREACHABLE))
+    if formatted < len(flat) // 2 + 1:
+        raise ErrDiskNotFound(
+            f"format quorum not reached: {formatted}/{len(flat)} "
+            "drives carry a format")
     sets = ref["xl"]["sets"]
     deployment_id = ref["id"]
     for s, row in enumerate(drives):
@@ -84,10 +115,17 @@ def init_format_sets(drives: list[list[LocalDrive]],
             if drive is None:
                 continue
             fmt = existing[s][d]
+            if fmt is _UNREACHABLE:
+                continue           # dead peer: heal when it returns
             if fmt is None:
-                # Unformatted drive in a formatted cluster: heal format.
-                save_format(drive,
-                            new_format(deployment_id, sets, sets[s][d]))
+                # Unformatted drive in a formatted cluster: heal
+                # format (best effort — it may have just gone down).
+                try:
+                    save_format(drive,
+                                new_format(deployment_id, sets,
+                                           sets[s][d]))
+                except Exception:  # noqa: BLE001
+                    pass
                 continue
             if fmt["id"] != deployment_id:
                 raise ErrFileCorrupt(

@@ -95,6 +95,130 @@ def choose_set_drive_count(arg_counts: list[int],
     return max(valid)
 
 
+class Endpoint:
+    """One drive endpoint: a bare local path, or a host-qualified URL
+    ``http://host:port/path`` naming the node that serves the drive
+    (cf. Endpoint, /root/reference/cmd/endpoint.go:54)."""
+
+    __slots__ = ("scheme", "host", "port", "path")
+
+    def __init__(self, raw: str):
+        if "://" in raw:
+            import urllib.parse
+            u = urllib.parse.urlsplit(raw)
+            if u.scheme not in ("http", "https"):
+                raise TopologyError(f"bad endpoint scheme {raw!r}")
+            if not u.hostname or not u.port:
+                raise TopologyError(
+                    f"endpoint {raw!r} needs explicit host:port")
+            if not u.path or u.path == "/":
+                raise TopologyError(f"endpoint {raw!r} has no path")
+            self.scheme = u.scheme
+            self.host = u.hostname
+            self.port = int(u.port)
+            self.path = u.path
+        else:
+            self.scheme = ""
+            self.host = ""
+            self.port = 0
+            self.path = raw
+
+    @property
+    def is_url(self) -> bool:
+        return bool(self.scheme)
+
+    @property
+    def node(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def is_local(self, my_host: str, my_port: int) -> bool:
+        """Does this process serve this drive? Loopback names are
+        unified; otherwise hosts compare literally (the reference
+        resolves interface IPs, cmd/endpoint.go:241 — DNS-free envs
+        compare names)."""
+        if not self.is_url:
+            return True
+        if self.port != my_port:
+            return False
+        loop = ("127.0.0.1", "localhost", "::1")
+        if self.host in loop and my_host in loop + ("0.0.0.0", ""):
+            return True
+        return self.host == my_host
+
+    def __repr__(self):
+        if self.is_url:
+            return f"{self.scheme}://{self.host}:{self.port}{self.path}"
+        return self.path
+
+    def __eq__(self, other):
+        return repr(self) == repr(other)
+
+    def __hash__(self):
+        return hash(repr(self))
+
+
+def parse_cluster_endpoints(args: list[str],
+                            custom_set_count: int | None = None):
+    """Expand + parse CLI endpoint args into the cluster layout.
+
+    -> (endpoints, set_drive_count, nodes) where `endpoints` is the
+    ordered global drive list, and `nodes` the unique (host, port)
+    list in first-appearance order (node 0 = format leader,
+    cf. firstDisk in cmd/prepare-storage.go:298).
+
+    URL mode lays sets out HOST-AWARE: every node contributes
+    set_drive_count / n_nodes drives to every set (the symmetric
+    distribution of docs/distributed/DESIGN.md + getSetIndexes'
+    symmetry rule, cmd/endpoint-ellipses.go:178) — so losing one node
+    costs every set the same shard count, bounded by parity, instead
+    of wiping some sets whole."""
+    per_arg = expand_endpoints(args)
+    eps = [Endpoint(e) for lst in per_arg for e in lst]
+    kinds = {ep.is_url for ep in eps}
+    if len(kinds) > 1:
+        raise TopologyError("cannot mix URL and local-path endpoints")
+    if not eps[0].is_url:
+        counts = [len(x) for x in per_arg]
+        size = choose_set_drive_count(counts, custom_set_count)
+        return eps, size, []
+
+    nodes: list[tuple[str, int]] = []
+    by_node: dict[tuple[str, int], list[Endpoint]] = {}
+    for ep in eps:
+        if ep.node not in by_node:
+            nodes.append(ep.node)
+        by_node.setdefault(ep.node, []).append(ep)
+    per_node = [len(by_node[n]) for n in nodes]
+    if len(set(per_node)) != 1:
+        raise TopologyError(
+            f"asymmetric deployment: drives per node {per_node}")
+    n_nodes, total = len(nodes), len(eps)
+    valid = [s for s in SET_SIZES
+             if total % s == 0 and s % n_nodes == 0]
+    if custom_set_count is not None:
+        if total % custom_set_count != 0 \
+                or custom_set_count % n_nodes != 0 \
+                or custom_set_count not in SET_SIZES:
+            raise TopologyError(
+                f"custom set drive count {custom_set_count} "
+                f"incompatible with {total} drives on {n_nodes} nodes")
+        size = custom_set_count
+    elif valid:
+        size = max(valid)
+    else:
+        raise TopologyError(
+            f"no valid erasure-set size for {total} drives on "
+            f"{n_nodes} nodes; valid sizes: {SET_SIZES}")
+    # Interleave: set s takes drives [s*q:(s+1)*q] from every node.
+    q = size // n_nodes
+    n_sets = total // size
+    ordered: list[Endpoint] = []
+    for s in range(n_sets):
+        for node in nodes:
+            ordered.extend(by_node[node][s * q:(s + 1) * q])
+    return ordered, size, nodes
+
+
 def layout_pool(args: list[str], custom_set_count: int | None = None,
                 sizes: list[int] | None = None) -> list[list[str]]:
     """Full pool layout: expand ellipses and slice into sets.

@@ -1,0 +1,198 @@
+"""Multi-PROCESS cluster boot: the VERDICT r3 top item.
+
+Spawns real `python -m minio_tpu.server` subprocesses over URL
+endpoints (`http://127.0.0.1:PORT/path`), so format bootstrap, peer
+verify, storage/lock/peer RPC and cross-process healing run over real
+sockets between separate interpreters — the subtle-bug reservoir the
+reference covers with buildscripts/verify-healing.sh (3 nodes, wipe a
+drive, heal, byte-compare).
+"""
+
+import glob
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from minio_tpu.server.client import S3Client
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _wait_ready(port, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    url = f"http://127.0.0.1:{port}/minio/health/ready"
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(0.25)
+    raise TimeoutError(f"node on :{port} never became ready")
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """2 server subprocesses x 4 drives -> one EC set of 8."""
+    ports = _free_ports(2)
+    args = [f"http://127.0.0.1:{p}{tmp_path}/n{i}/d{{1...4}}"
+            for i, p in enumerate(ports, 1)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["MTPU_BOOT_TIMEOUT"] = "90"
+    procs = []
+    logs = []
+    try:
+        for i, p in enumerate(ports):
+            log = open(tmp_path / f"node{i}.log", "wb")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "minio_tpu.server",
+                 "--drives", " ".join(args), "--port", str(p)],
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+                cwd=ROOT))
+        for p in ports:
+            _wait_ready(p)
+        yield ports, tmp_path
+    finally:
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+        for log in logs:
+            log.close()
+        for i in range(len(ports)):
+            sys.stderr.write(
+                (tmp_path / f"node{i}.log").read_text(errors="replace"))
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+class TestClusterBoot:
+    def test_boot_put_get_wipe_heal(self, cluster):
+        ports, tmp = cluster
+        c1 = S3Client(f"http://127.0.0.1:{ports[0]}",
+                      "minioadmin", "minioadmin")
+        c2 = S3Client(f"http://127.0.0.1:{ports[1]}",
+                      "minioadmin", "minioadmin")
+
+        # cross-process PUT/GET: write via node 1, read via node 2
+        c1.make_bucket("clus")
+        blobs = {f"o{i}": payload(200_000 + i * 1000, seed=i)
+                 for i in range(3)}
+        for name, data in blobs.items():
+            c1.put_object("clus", name, data)
+        for name, data in blobs.items():
+            assert c2.get_object("clus", name) == data
+
+        # shards must land on BOTH nodes (host-aware set layout)
+        for node_dir in ("n1", "n2"):
+            files = [p for p in glob.glob(
+                f"{tmp}/{node_dir}/d*/clus/**", recursive=True)
+                if os.path.isfile(p)]
+            assert files, f"no shards on {node_dir}"
+
+        # wipe one of node 2's drives entirely (data + format + sys)
+        victim = f"{tmp}/n2/d1"
+        for entry in os.listdir(victim):
+            shutil.rmtree(os.path.join(victim, entry),
+                          ignore_errors=True)
+        assert not os.listdir(victim)
+
+        # degraded reads still work from both processes
+        for name, data in blobs.items():
+            assert c1.get_object("clus", name) == data
+            assert c2.get_object("clus", name) == data
+
+        # heal driven from node 1 (the OTHER process) restores the
+        # wiped drive over the storage RPC plane
+        st, _, body = c1.request("POST", "/minio/admin/v3/heal/",
+                                 query={})
+        assert st == 200, body
+        deadline = time.monotonic() + 60
+        seqs = []
+        while time.monotonic() < deadline:
+            _, _, body = c1.request("GET", "/minio/admin/v3/heal/",
+                                    query={})
+            seqs = json.loads(body)["sequences"]
+            if seqs and seqs[-1]["state"] in ("done", "failed"):
+                break
+            time.sleep(0.25)
+        assert seqs and seqs[-1]["state"] == "done", seqs
+        assert not seqs[-1]["failures"], seqs
+
+        restored = [p for p in glob.glob(f"{victim}/**", recursive=True)
+                    if os.path.isfile(p)]
+        assert any("clus/" in p and p.endswith("xl.meta")
+                   for p in restored), restored
+        # glob skips dot-dirs; format.json lives under .mtpu.sys/
+        assert os.path.exists(
+            os.path.join(victim, ".mtpu.sys", "format.json")), \
+            "format.json not healed"
+
+        # byte-identical restore, via both processes
+        for name, data in blobs.items():
+            assert c1.get_object("clus", name) == data
+            assert c2.get_object("clus", name) == data
+
+    def test_rejects_mixed_root_credentials(self, tmp_path):
+        """A node booted with different root creds must not join: its
+        bearer token differs AND bootstrap verify rejects it."""
+        ports = _free_ports(2)
+        args = [f"http://127.0.0.1:{p}{tmp_path}/m{i}/d{{1...4}}"
+                for i, p in enumerate(ports, 1)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["MTPU_BOOT_TIMEOUT"] = "6"
+        env2 = dict(env)
+        env2["MTPU_ROOT_USER"] = "otheradmin"
+        env2["MTPU_ROOT_PASSWORD"] = "otherpassword"
+        p1 = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--drives", " ".join(args), "--port", str(ports[0])],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            cwd=ROOT)
+        p2 = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--drives", " ".join(args), "--port", str(ports[1])],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env2,
+            cwd=ROOT)
+        try:
+            # Neither node can complete boot: the mismatched node's
+            # RPC token is rejected, so format quorum never arrives.
+            rc2 = p2.wait(timeout=60)
+            assert rc2 != 0
+        finally:
+            for pr in (p1, p2):
+                pr.terminate()
+                try:
+                    pr.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pr.kill()

@@ -38,19 +38,56 @@ def payload(size, seed=0):
 class TestRPCCore:
     def test_call_roundtrip_and_typed_errors(self):
         srv = RPCServer(TOKEN).start()
-        srv.register("echo", lambda p: {"got": p.get("x")})
+        srv.register("t.echo", lambda p: {"got": p.get("x")})
 
         def boom(p):
             raise ErrFileNotFound("nope")
-        srv.register("boom", boom)
+        srv.register("t.boom", boom)
         try:
             cli = RPCClient(srv.endpoint, TOKEN)
-            assert cli.call("echo", {"x": [1, "two", b"three"]}) == \
+            assert cli.call("t.echo", {"x": [1, "two", b"three"]}) == \
                 {"got": [1, "two", b"three"]}
             with pytest.raises(ErrFileNotFound):
-                cli.call("boom")
+                cli.call("t.boom")
             # app errors do NOT mark the peer offline
             assert cli.is_online()
+        finally:
+            srv.shutdown()
+
+    def test_plane_version_mismatch_typed_rejection(self):
+        """VERDICT r3 #4: a peer speaking an older plane version must be
+        rejected with a typed error BEFORE method dispatch, on the wire
+        (cf. storageRESTVersion gate, cmd/storage-rest-common.go:21)."""
+        from minio_tpu.rpc.rest import RPCVersionMismatch
+        from minio_tpu.rpc.storage_rpc import STORAGE_RPC_VERSION
+        srv = RPCServer(TOKEN).start()
+        d = None
+        try:
+            register_storage_rpc(srv, [])
+            # client pinned to a stale version (an old binary)
+            cli = RPCClient(srv.endpoint, TOKEN,
+                            versions={"storage": "v0"})
+            with pytest.raises(RPCVersionMismatch) as ei:
+                cli.call("storage.list_volumes", {"drive": 0})
+            assert ei.value.plane == "storage"
+            assert ei.value.want == STORAGE_RPC_VERSION
+            assert ei.value.got == "v0"
+            # a mismatch is a deployment error, NOT a health event
+            assert cli.is_online()
+            # current-version client on the same server works
+            cli2 = RPCClient(srv.endpoint, TOKEN)
+            with pytest.raises(ErrDiskNotFound):
+                cli2.call("storage.list_volumes", {"drive": 5})
+        finally:
+            srv.shutdown()
+
+    def test_unknown_plane_404(self):
+        srv = RPCServer(TOKEN).start()
+        try:
+            from minio_tpu.storage.errors import StorageError
+            cli = RPCClient(srv.endpoint, TOKEN)
+            with pytest.raises(StorageError):
+                cli.call("nosuchplane.method")
         finally:
             srv.shutdown()
 
@@ -60,7 +97,7 @@ class TestRPCCore:
             cli = RPCClient(srv.endpoint, "wrong")
             from minio_tpu.storage.errors import StorageError
             with pytest.raises(StorageError):
-                cli.call("health")
+                cli.call("health.health")
         finally:
             srv.shutdown()
 
@@ -68,14 +105,14 @@ class TestRPCCore:
         srv = RPCServer(TOKEN).start()
         port = srv.port
         cli = RPCClient(srv.endpoint, TOKEN, check_interval=0.1)
-        assert cli.call("health")["ok"]
+        assert cli.call("health.health")["ok"]
         srv.shutdown()
         with pytest.raises(NetworkError):
-            cli.call("health")
+            cli.call("health.health")
         assert not cli.is_online()
         # second call short-circuits without touching the network
         with pytest.raises(NetworkError):
-            cli.call("health")
+            cli.call("health.health")
         # bring a server back on the SAME port; checker flips us online
         srv2 = RPCServer(TOKEN, port=port).start()
         try:
@@ -83,7 +120,7 @@ class TestRPCCore:
             while not cli.is_online() and time.monotonic() < deadline:
                 time.sleep(0.05)
             assert cli.is_online()
-            assert cli.call("health")["ok"]
+            assert cli.call("health.health")["ok"]
         finally:
             cli.close()
             srv2.shutdown()

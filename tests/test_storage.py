@@ -331,6 +331,45 @@ class TestFormat:
         assert quorum_formatted([{}, {"a": 1}, {"a": 1}, None]) is False
         assert quorum_formatted([{"a": 1}] * 3 + [None]) is True
 
+    def test_adopt_tolerates_unreachable_minority(self, tmp_path):
+        """A formatted deployment must (re)load with a dead drive — one
+        dead peer cannot block a node restart (waitForFormatErasure's
+        quorum, cmd/prepare-storage.go:298)."""
+        drives = [[LocalDrive(str(tmp_path / f"q{d}")) for d in range(4)]]
+        fmt = init_format_sets(drives)
+
+        class DeadDrive:
+            root = "dead"
+
+            def read_all(self, vol, path):
+                from minio_tpu.storage.errors import ErrDiskNotFound
+                raise ErrDiskNotFound("dead peer")
+
+            def write_all(self, vol, path, data):
+                from minio_tpu.storage.errors import ErrDiskNotFound
+                raise ErrDiskNotFound("dead peer")
+
+        row = [LocalDrive(str(tmp_path / f"q{d}")) for d in range(3)]
+        row.append(DeadDrive())
+        fmt2 = init_format_sets([row])
+        assert fmt2["id"] == fmt["id"]
+
+    def test_fresh_format_requires_all_drives(self, tmp_path):
+        """Formatting a FRESH deployment around an unreachable drive
+        could mint two deployments — it must wait instead."""
+        from minio_tpu.storage.errors import ErrDiskNotFound
+
+        class DeadDrive:
+            root = "dead"
+
+            def read_all(self, vol, path):
+                raise ErrDiskNotFound("dead peer")
+
+        row = [LocalDrive(str(tmp_path / f"f{d}")) for d in range(3)]
+        row.append(DeadDrive())
+        with pytest.raises(ErrDiskNotFound):
+            init_format_sets([row])
+
 
 class TestXLMetaIntegrity:
     def test_xxhash64_roundtrip_and_corruption(self):
