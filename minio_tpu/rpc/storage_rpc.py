@@ -85,9 +85,15 @@ class RemoteDrive:
         return self._client.is_online()
 
     def _call(self, method: str, *args, **kwargs):
-        wire_args = [
-            {"__fi__": a.to_obj(), "vol": a.volume, "name": a.name}
-            if isinstance(a, FileInfo) else a for a in args]
+        def wire(a):
+            if isinstance(a, FileInfo):
+                return {"__fi__": a.to_obj(), "vol": a.volume,
+                        "name": a.name}
+            if isinstance(a, (memoryview, bytearray)) or \
+                    type(a).__name__ == "ndarray":
+                return bytes(a)       # zero-copy buffers -> wire bytes
+            return a
+        wire_args = [wire(a) for a in args]
         try:
             result = self._client.call(
                 f"storage.{method}",

@@ -129,9 +129,14 @@ class ClusterNode:
         self.peer_registry = PeerRegistry()
         register_peer_rpc(self.router, self.peer_registry)
         self.layout_sha = layout_digest(eps, size)
-        register_bootstrap_rpc(self.router, {
+        # Mutated in place after wait_format adds the deployment id —
+        # the verify handler only enforces keys it already knows, so a
+        # peer that has not formatted yet is lenient about the id and
+        # strict once it has one.
+        self.bootstrap_expected = {
             "layout_sha": self.layout_sha,
-            "access_key": creds.access_key})
+            "access_key": creds.access_key}
+        register_bootstrap_rpc(self.router, self.bootstrap_expected)
         self.notification = NotificationSys(
             list(self.peer_clients.values()))
 
@@ -213,8 +218,8 @@ class ClusterNode:
         Peers still booting are retried until the deadline."""
         from ..rpc.rest import RPCVersionMismatch
         from ..storage.errors import ErrFileAccessDenied
-        check = {"layout_sha": self.layout_sha,
-                 "access_key": self.creds.access_key}
+        self.bootstrap_expected["deployment_id"] = deployment_id
+        check = dict(self.bootstrap_expected)
         deadline = time.monotonic() + timeout
         clients = list(self.peer_clients.values())
         while True:

@@ -202,19 +202,55 @@ def frame_shards_batch(shards: np.ndarray,
     single vectorized pass (the hot PUT path). Pass `digests`
     ((n_shards, n_blocks, 32), e.g. from ops.fused.encode_and_hash) to skip
     hashing entirely — framing is then pure byte interleaving."""
-    n_shards, n_blocks, shard_size = shards.shape
-    if digests is None:
-        flat = shards.reshape(n_shards * n_blocks, shard_size)
-        digests = _hash_batch(flat, algo).reshape(n_shards, n_blocks,
-                                                  digest_size(algo))
-    out = []
-    for i in range(n_shards):
-        buf = bytearray()
-        for b in range(n_blocks):
-            buf += digests[i, b].tobytes()
-            buf += shards[i, b].tobytes()
-        out.append(bytes(buf))
-    return out
+    views = frame_shard_views(None, None, digests, algo, shards=shards)
+    return [bytes(v) for v in views]
+
+
+def frame_shard_views(blocks: np.ndarray | None,
+                      parity: np.ndarray | None,
+                      digests: np.ndarray | None,
+                      algo: str = DEFAULT_ALGO,
+                      shards: np.ndarray | None = None) -> list[np.ndarray]:
+    """The ONE implementation of the on-disk frame layout
+    ([32B digest | shard bytes] per block), producing zero-copy
+    per-shard views over a single (n_shards, n_blocks, hs+S) buffer.
+
+    Two input shapes: `shards` already shard-major
+    ((n_shards, n_blocks, S)), or `blocks`/`parity` in the codec's
+    block-major layout ((n_blocks, K, S) and (n_blocks, M, S)) —
+    the latter avoids the caller materializing a transposed copy.
+    Digests, when absent, are hashed from the contiguous inputs."""
+    hs = digest_size(algo)
+    if shards is not None:
+        n_shards, n_blocks, shard_size = shards.shape
+        framed = np.empty((n_shards, n_blocks, hs + shard_size),
+                          dtype=np.uint8)
+        framed[:, :, hs:] = shards
+        if digests is None:
+            flat = np.ascontiguousarray(shards).reshape(
+                n_shards * n_blocks, shard_size)
+            digests = _hash_batch(flat, algo).reshape(
+                n_shards, n_blocks, hs)
+        framed[:, :, :hs] = digests
+        return [framed[i].reshape(-1) for i in range(n_shards)]
+
+    nb, k, shard_size = blocks.shape
+    m = parity.shape[1]
+    framed = np.empty((k + m, nb, hs + shard_size), dtype=np.uint8)
+    framed[:k, :, hs:] = blocks.transpose(1, 0, 2)
+    framed[k:, :, hs:] = parity.transpose(1, 0, 2)
+    if digests is not None:
+        framed[:, :, :hs] = digests
+    else:
+        # Hash blocks/parity in their native contiguous layouts (no
+        # big strided reads); only the 32-byte digests transpose.
+        bd = _hash_batch(np.ascontiguousarray(blocks).reshape(
+            nb * k, shard_size), algo).reshape(nb, k, hs)
+        pd = _hash_batch(np.ascontiguousarray(parity).reshape(
+            nb * m, shard_size), algo).reshape(nb, m, hs)
+        framed[:k, :, :hs] = bd.transpose(1, 0, 2)
+        framed[k:, :, :hs] = pd.transpose(1, 0, 2)
+    return [framed[i].reshape(-1) for i in range(k + m)]
 
 
 def unframe_shard(data: bytes, shard_size: int, verify: bool = True,

@@ -29,6 +29,18 @@ def mode() -> str:
     return m if m in ("off", "fadvise", "direct") else "fadvise"
 
 
+def osync() -> bool:
+    """Synchronous durability (fsync/fdatasync on the write path).
+
+    Default OFF, matching the reference: MinIO only fsyncs when
+    MINIO_FS_OSYNC is set (cf. globalFSOSync, cmd/globals.go) —
+    durability otherwise comes from writing the stripe to a quorum of
+    independent drives, and a torn write on one drive is caught by
+    bitrot verification and healed from parity. Per-append fdatasync
+    costs ~1-3 ms x drives x batches and dominated PUT latency."""
+    return os.environ.get("MTPU_OSYNC", "off") == "on"
+
+
 def drop_cache(fd: int) -> None:
     """Advise the kernel to evict this file's pages (post-I/O)."""
     try:
@@ -101,8 +113,11 @@ def write_done(fd: int, nbytes: int) -> bool:
     Dirty pages can't be evicted, so sync first — fdatasync per batch
     also spreads the publish-time fsync cost across the stream, like
     the reference's O_DIRECT+fdatasync writer (cmd/xl-storage.go:1533).
-    Returns True when it synced the fd (callers then skip their own
-    fsync)."""
+    Returns True when the durability policy is satisfied (callers then
+    skip their own fsync) — which includes osync()=off, where no sync
+    is wanted at all."""
+    if not osync():
+        return True
     if mode() != "off" and nbytes >= BULK:
         try:
             os.fdatasync(fd)

@@ -50,6 +50,20 @@ def _is_valid_volname(vol: str) -> bool:
     return bool(vol) and "/" not in vol and vol not in (".", "..")
 
 
+def _ensure_parent(p: str) -> None:
+    """makedirs(dirname(p)) with the common cases first: one mkdir
+    syscall when the grandparent exists, none when the parent does —
+    os.makedirs stat-walks every ancestor on EVERY call, which adds up
+    on the per-drive hot path."""
+    d = os.path.dirname(p)
+    try:
+        os.mkdir(d)
+    except FileExistsError:
+        pass
+    except FileNotFoundError:
+        os.makedirs(d, exist_ok=True)
+
+
 class LocalDrive:
     """One local drive rooted at `root`."""
 
@@ -142,7 +156,7 @@ class LocalDrive:
 
     def _write_all(self, vol: str, path: str, data: bytes) -> None:
         p = self._file_path(vol, path)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
+        _ensure_parent(p)
         tmp = os.path.join(self.root, SYS_VOL, TMP_DIR,
                            f"wa-{uuid.uuid4().hex}")
         with open(tmp, "wb") as f:
@@ -218,7 +232,7 @@ class LocalDrive:
         batch; rename_data fsyncs staged files before publishing)."""
         self._check_vol(vol)
         p = self._file_path(vol, path)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
+        _ensure_parent(p)
         with open(p, "ab") as f:
             f.write(data)
             f.flush()
@@ -286,11 +300,23 @@ class LocalDrive:
             raise ErrFileNotFound(f"{vol}/{obj}") from None
         return XLMeta.from_bytes(buf)
 
-    def _write_xlmeta(self, vol: str, obj: str, meta: XLMeta) -> None:
+    def _write_xlmeta(self, vol: str, obj: str, meta: XLMeta,
+                      new: bool = False) -> None:
         if not meta.versions:
             # Last version gone: remove the whole object dir.
             obj_dir = self._file_path(vol, obj)
             self._move_to_trash(obj_dir)
+            return
+        if new:
+            # First xl.meta for this object: no reader can hold it yet,
+            # so skip the tmp+rename dance (one fs metadata op instead
+            # of two on the PUT hot path). A torn write is caught by
+            # the xl.meta integrity checksum and reads as missing,
+            # which quorum + heal already handle.
+            p = self._file_path(vol, os.path.join(obj, XL_META_FILE))
+            _ensure_parent(p)
+            with self._osc.timed("write"), open(p, "wb") as f:
+                f.write(meta.to_bytes())
             return
         self.write_all(vol, os.path.join(obj, XL_META_FILE), meta.to_bytes())
 
@@ -353,10 +379,11 @@ class LocalDrive:
         """
         self._check_vol(dst_vol)
         with self._meta_lock:
+            fresh = False
             try:
                 meta = self._read_xlmeta(dst_vol, dst_obj)
             except ErrFileNotFound:
-                meta = XLMeta()
+                meta, fresh = XLMeta(), True
             except ErrFileCorrupt:
                 meta = XLMeta()  # heal path will rewrite; don't block PUT
             # Non-versioned overwrite of the null version: free old datadir.
@@ -374,22 +401,25 @@ class LocalDrive:
                 src = self._file_path(src_vol, src_dir)
                 if not os.path.isdir(src):
                     raise ErrFileNotFound(f"{src_vol}/{src_dir}")
-                # Durability before visibility: staged part files were
-                # written with plain appends; flush them (and the dir
-                # entry) before the rename makes the version readable.
-                for name in os.listdir(src):
-                    fp = os.path.join(src, name)
-                    if os.path.isfile(fp):
-                        fd = os.open(fp, os.O_RDONLY)
-                        try:
-                            os.fsync(fd)
-                        finally:
-                            os.close(fd)
-                dfd = os.open(src, os.O_RDONLY)
-                try:
-                    os.fsync(dfd)
-                finally:
-                    os.close(dfd)
+                # Durability before visibility (osync mode only —
+                # default matches the reference's no-fsync data path,
+                # see diskio.osync): staged part files were written
+                # with plain appends; flush them (and the dir entry)
+                # before the rename makes the version readable.
+                if diskio.osync():
+                    for name in os.listdir(src):
+                        fp = os.path.join(src, name)
+                        if os.path.isfile(fp):
+                            fd = os.open(fp, os.O_RDONLY)
+                            try:
+                                os.fsync(fd)
+                            finally:
+                                os.close(fd)
+                    dfd = os.open(src, os.O_RDONLY)
+                    try:
+                        os.fsync(dfd)
+                    finally:
+                        os.close(dfd)
                 dst = self._file_path(dst_vol,
                                       os.path.join(dst_obj, fi.data_dir))
                 os.makedirs(os.path.dirname(dst), exist_ok=True)
@@ -397,7 +427,7 @@ class LocalDrive:
                     self._move_to_trash(dst)
                 os.replace(src, dst)
             meta.add_version(fi)
-            self._write_xlmeta(dst_vol, dst_obj, meta)
+            self._write_xlmeta(dst_vol, dst_obj, meta, new=fresh)
             if old_dd:
                 self._remove_data_dir(dst_vol, dst_obj, old_dd)
 

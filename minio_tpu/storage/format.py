@@ -101,13 +101,18 @@ def init_format_sets(drives: list[list[LocalDrive]],
         return out
 
     # Partially/fully formatted: adopt the reference layout, heal fresh
-    # drives into their slots (cf. formatErasureFixLosingDisks); a
-    # quorum of drives must agree before we trust the layout.
+    # drives into their slots (cf. formatErasureFixLosingDisks). The
+    # quorum gate guards against trusting a layout only a MINORITY
+    # claims while other drives are unreachable (they might hold the
+    # real one). When every drive answered there is nothing hidden:
+    # a crashed fresh format (ref on 2 of 8, rest blank) must heal to
+    # completion, not wedge behind a majority it can never reach.
     formatted = sum(1 for f in flat if f not in (None, _UNREACHABLE))
-    if formatted < len(flat) // 2 + 1:
+    unreachable = sum(1 for f in flat if f is _UNREACHABLE)
+    if unreachable and formatted < len(flat) // 2 + 1:
         raise ErrDiskNotFound(
             f"format quorum not reached: {formatted}/{len(flat)} "
-            "drives carry a format")
+            f"drives carry a format ({unreachable} unreachable)")
     sets = ref["xl"]["sets"]
     deployment_id = ref["id"]
     for s, row in enumerate(drives):

@@ -95,6 +95,32 @@ def choose_set_drive_count(arg_counts: list[int],
     return max(valid)
 
 
+_LOCAL_NAMES: set[str] | None = None
+
+
+def _local_names() -> set[str]:
+    """Names/addresses this machine answers to (hostname, FQDN, and
+    their resolved addresses) — cached; best-effort under no DNS."""
+    global _LOCAL_NAMES
+    if _LOCAL_NAMES is None:
+        import socket
+        names = {"127.0.0.1", "localhost", "::1"}
+        for get in (socket.gethostname, socket.getfqdn):
+            try:
+                name = get()
+            except OSError:
+                continue
+            if name:
+                names.add(name)
+                try:
+                    for info in socket.getaddrinfo(name, None):
+                        names.add(info[4][0])
+                except OSError:
+                    pass
+        _LOCAL_NAMES = names
+    return _LOCAL_NAMES
+
+
 class Endpoint:
     """One drive endpoint: a bare local path, or a host-qualified URL
     ``http://host:port/path`` naming the node that serves the drive
@@ -132,10 +158,13 @@ class Endpoint:
         return (self.host, self.port)
 
     def is_local(self, my_host: str, my_port: int) -> bool:
-        """Does this process serve this drive? Loopback names are
-        unified; otherwise hosts compare literally (the reference
-        resolves interface IPs, cmd/endpoint.go:241 — DNS-free envs
-        compare names)."""
+        """Does this process serve this drive? The port must match; the
+        host matches literally, as a loopback alias, or — when the
+        server binds a wildcard/loopback default — as any name or
+        address this machine answers to (the reference resolves
+        interface IPs the same way, cmd/endpoint.go:241), so
+        `--drives http://host{1...3}/...` works with the default
+        --host on every node."""
         if not self.is_url:
             return True
         if self.port != my_port:
@@ -143,7 +172,11 @@ class Endpoint:
         loop = ("127.0.0.1", "localhost", "::1")
         if self.host in loop and my_host in loop + ("0.0.0.0", ""):
             return True
-        return self.host == my_host
+        if self.host == my_host:
+            return True
+        if my_host in loop + ("0.0.0.0", ""):
+            return self.host in _local_names()
+        return False
 
     def __repr__(self):
         if self.is_url:

@@ -26,6 +26,31 @@ def packb(obj) -> bytes:
     return bytes(out)
 
 
+# The C extension, when present, is wire-identical for our subset and
+# ~20x faster — xl.meta pack/unpack sits on the per-drive PUT/GET hot
+# path (the reference generates msgp codecs for the same reason). The
+# pure-Python codec above stays as the portable fallback and the
+# format's executable spec.
+try:
+    import msgpack as _cmsgpack
+
+    def packb(obj) -> bytes:  # noqa: F811
+        try:
+            return _cmsgpack.packb(obj, use_bin_type=True)
+        except Exception as e:  # noqa: BLE001
+            raise MsgpackError(str(e)) from None
+
+    def _c_unpackb(data):
+        try:
+            return _cmsgpack.unpackb(
+                bytes(data), raw=False, strict_map_key=False)
+        except Exception as e:  # noqa: BLE001
+            raise MsgpackError(str(e)) from None
+except ImportError:
+    _cmsgpack = None
+    _c_unpackb = None
+
+
 def _pack(obj, out: bytearray) -> None:
     if obj is None:
         out.append(0xC0)
@@ -215,6 +240,8 @@ class _Unpacker:
 
 
 def unpackb(buf: bytes):
+    if _c_unpackb is not None:
+        return _c_unpackb(buf)
     u = _Unpacker(bytes(buf))
     obj = u.unpack()
     if u.pos != len(u.buf):
