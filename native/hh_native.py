@@ -1,0 +1,82 @@
+"""ctypes loader for the native HighwayHash-256 kernel
+(native/highwayhash.cc).
+
+Same build pattern as mxh_native: compiled on first use with
+-O3 -march=native; callers catch ImportError/OSError and fall back to
+the numpy/JAX spec paths. ctypes releases the GIL for the whole batch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "highwayhash.cc")
+_SO = os.path.join(_DIR, "build", "libhighwayhash.so")
+
+_lib = None
+
+
+def _build() -> str:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, text=True)
+    return _SO
+
+
+def load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_build())
+        lib.hh_isa.restype = ctypes.c_char_p
+        lib.hh256_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.hh256.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+            ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def isa() -> str:
+    return load().hh_isa().decode()
+
+
+def _key_bytes(key: bytes | None) -> bytes:
+    if key is None:
+        from minio_tpu.ops.highwayhash import MAGIC_KEY
+        key = MAGIC_KEY
+    if len(key) != 32:
+        raise ValueError("key must be 32 bytes")
+    return key
+
+
+def hh256_rows_native(rows: np.ndarray,
+                      key: bytes | None = None) -> np.ndarray:
+    """(n, L) uint8 -> (n, 32) HighwayHash-256 digests (magic key)."""
+    lib = load()
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    n, ln = rows.shape
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.hh256_rows(rows.ctypes.data, n, ln, _key_bytes(key),
+                   out.ctypes.data)
+    return out
+
+
+def hh256_native(data: bytes | bytearray | memoryview,
+                 key: bytes | None = None) -> bytes:
+    """One-shot digest of an arbitrary buffer (whole-file verify)."""
+    lib = load()
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(32, dtype=np.uint8)
+    lib.hh256(buf.ctypes.data, buf.size, _key_bytes(key), out.ctypes.data)
+    return out.tobytes()
