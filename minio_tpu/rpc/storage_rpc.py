@@ -22,7 +22,7 @@ from .rest import DEFAULT_PLANE_VERSIONS, NetworkError, RPCClient, RPCServer
 #: argument encoding, or FileInfo wire shape (the reference's
 #: storageRESTVersion, cmd/storage-rest-common.go:21, is at v40 for the
 #: same reason: a version bump per wire change).
-STORAGE_RPC_VERSION = "v2"
+STORAGE_RPC_VERSION = "v3"     # v3: walk_page (paged listing walks)
 DEFAULT_PLANE_VERSIONS["storage"] = STORAGE_RPC_VERSION
 
 _DRIVE_METHODS = [
@@ -30,8 +30,8 @@ _DRIVE_METHODS = [
     "write_all", "read_all", "delete", "create_file", "append_file",
     "read_file", "rename_file", "file_size", "read_version",
     "write_metadata", "update_metadata", "rename_data", "delete_version",
-    "list_dir", "walk_dir", "verify_file", "disk_info", "get_disk_id",
-    "list_raw", "clear_tmp", "init_sys_volume",
+    "list_dir", "walk_dir", "walk_page", "verify_file", "disk_info",
+    "get_disk_id", "list_raw", "clear_tmp", "init_sys_volume",
 ]
 
 
@@ -59,6 +59,9 @@ def register_storage_rpc(server, drives: list[LocalDrive]) -> None:
                         "name": result.name}
             if method == "walk_dir":
                 return [[name, raw] for name, raw in result]
+            if method == "walk_page":
+                entries, eof = result
+                return [[[name, raw] for name, raw in entries], eof]
             return result
         return handler
 
@@ -111,6 +114,9 @@ def _add_method(name: str):
         result = self._call(name, *args, **kwargs)
         if name == "walk_dir":
             return [(n, raw) for n, raw in result]
+        if name == "walk_page":
+            entries, eof = result
+            return [(n, raw) for n, raw in entries], eof
         return result
     method.__name__ = name
     setattr(RemoteDrive, name, method)

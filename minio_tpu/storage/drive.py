@@ -517,6 +517,57 @@ class LocalDrive:
                         pass
                 dirnames[:] = []  # don't descend into data dirs
 
+    def walk_page(self, vol: str, prefix: str = "", after: str = "",
+                  limit: int = 1000):
+        """One bounded page of the lexical walk: up to `limit`
+        (object_name, xl.meta bytes) entries with name > `after`,
+        plus an eof flag. Subtrees that cannot contain names past
+        `after` are pruned, so paging a huge bucket never re-reads
+        what earlier pages covered (the WalkDir + resume-marker role,
+        cf. cmd/metacache-walk.go:60 with WalkDirOptions.ForwardTo)."""
+        base = self._check_vol(vol)
+        start = self._file_path(vol, prefix) if prefix else base
+        walk_root = start if os.path.isdir(start) \
+            else os.path.dirname(start)
+        out: list[tuple[str, bytes]] = []
+
+        def descend(dirpath: str) -> bool:
+            """-> False when the page filled mid-subtree (not eof)."""
+            try:
+                names = sorted(os.listdir(dirpath))
+            except OSError:
+                return True
+            if XL_META_FILE in names:
+                rel = os.path.relpath(dirpath, base).replace(os.sep, "/")
+                if (not prefix or rel.startswith(prefix)) and rel > after:
+                    try:
+                        with open(os.path.join(dirpath, XL_META_FILE),
+                                  "rb") as f:
+                            out.append((rel, f.read()))
+                    except OSError:
+                        pass
+                return True          # object dir: don't enter data dirs
+            for name in names:
+                sub = os.path.join(dirpath, name)
+                if not os.path.isdir(sub):
+                    continue
+                rel = os.path.relpath(sub, base).replace(os.sep, "/")
+                # Prune: every name under rel starts with rel+"/";
+                # skip when that whole range sorts <= after.
+                if after and rel + "/" < after[:len(rel) + 1]:
+                    continue
+                if len(out) >= limit:
+                    return False
+                if not descend(sub):
+                    return False
+            return True
+
+        if not os.path.isdir(walk_root):
+            return [], True
+        # descend() checks the limit before every recursion, so out
+        # never exceeds it.
+        return out, descend(walk_root)
+
     # -- bitrot verify -------------------------------------------------------
 
     def verify_file(self, vol: str, path: str, shard_size: int,
