@@ -1,39 +1,56 @@
 """Data scanner: perpetual namespace crawl with usage + heal triggering.
 
-The cmd/data-scanner.go:96 equivalent: each cycle walks the namespace
-(quorum-merged listing per set), accumulates the data-usage tree, and
-queues objects whose stripe looks unhealthy (missing metadata on some
-drives) for heal. Dirty buckets (DirtyTracker) are scanned every cycle;
-clean ones every `full_scan_every` cycles — the bloom-filter skip.
-Sleeps adaptively between objects (scannerSleeper analogue) so the crawl
-yields to foreground traffic.
+The cmd/data-scanner.go:49,96 equivalent: each cycle walks the
+namespace (quorum-merged listing per set), accumulates the data-usage
+tree, and queues objects whose stripe looks unhealthy (missing
+metadata on some drives) for heal. Every `deep_every` cycles (the
+reference's 1-in-healObjectSelectProb deep mode) the scan ALSO
+bitrot-verifies each object's shard files on every live drive, so an
+IDLE server detects and heals silent corruption without any client
+read ever touching the object. Dirty buckets (DirtyTracker) are
+scanned every cycle; clean ones every `full_scan_every` cycles — the
+bloom-filter skip. The loop sleeps adaptively (scannerSleeper role):
+the idle wait stretches with how long the last cycle took, so a busy
+deployment crawls gently and an idle one stays prompt.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
-from ..storage.errors import StorageError
+from ..storage.errors import ErrFileCorrupt, StorageError
 from .usage import DataUsage, DirtyTracker
 
 
 class ScanStats:
     def __init__(self):
         self.cycles = 0
+        self.deep_cycles = 0
         self.objects_scanned = 0
+        self.objects_verified = 0
         self.heals_triggered = 0
+        self.corruption_found = 0
         self.last_cycle_s = 0.0
 
 
 class DataScanner:
     def __init__(self, pools, *, heal_fn=None,
                  full_scan_every: int = 16,
+                 deep_every: int | None = None,
                  object_sleep: float = 0.0,
                  dirty: DirtyTracker | None = None):
         self.pools = pools
-        self.heal_fn = heal_fn         # (bucket, obj, version_id) -> None
+        # (bucket, obj, version_id) -> None; default: the engine heal
+        self.heal_fn = heal_fn if heal_fn is not None else self._heal
         self.full_scan_every = full_scan_every
+        # Deep (bitrot-verify) cadence: 1 in deep_every cycles
+        # (cf. data-scanner.go:49 healDeepScan cycling).
+        if deep_every is None:
+            deep_every = int(os.environ.get("MTPU_SCANNER_DEEP_EVERY",
+                                            "16"))
+        self.deep_every = max(1, deep_every)
         self.object_sleep = object_sleep
         self.dirty = dirty or DirtyTracker.shared()
         self.stats = ScanStats()
@@ -58,6 +75,18 @@ class DataScanner:
         except (AttributeError, IndexError):
             return None
 
+    def _heal(self, bucket: str, obj: str, version_id: str) -> None:
+        """Default heal hook: the engine's object heal on the owning
+        set of every pool."""
+        from ..engine import heal as H
+        for pool in self.pools.pools:
+            try:
+                es = pool.set_for(obj) if hasattr(pool, "set_for") \
+                    else pool
+                H.heal_object(es, bucket, obj, version_id)
+            except StorageError:
+                continue
+
     # -- one cycle -----------------------------------------------------------
 
     def _object_needs_heal(self, es, bucket: str, name: str) -> bool:
@@ -76,6 +105,8 @@ class DataScanner:
     def scan_cycle(self, deep: bool = False) -> DataUsage:
         t0 = time.time()
         self.stats.cycles += 1
+        if deep:
+            self.stats.deep_cycles += 1
         cycle = self.stats.cycles
         dirty = self.dirty.snapshot_and_clear()
         usage = DataUsage()
@@ -102,7 +133,25 @@ class DataScanner:
                     for fi in infos:
                         self.stats.objects_scanned += 1
                         usage.account(bucket, fi.name, fi.size)
-                        if self.heal_fn is not None and \
+                        if deep:
+                            # Bitrot-verify every shard and repair in
+                            # place (healObject with deep scan mode,
+                            # cmd/erasure-healing.go:244) — silent
+                            # corruption heals on an IDLE server.
+                            self.stats.objects_verified += 1
+                            try:
+                                from ..engine import heal as H
+                                results = H.heal_object(
+                                    es, bucket, fi.name, deep=True)
+                                healed = [r for r in results
+                                          if r.healed_drives]
+                                if healed:
+                                    self.stats.corruption_found += 1
+                                    self.stats.heals_triggered += 1
+                            except (StorageError,
+                                    ErrFileCorrupt):
+                                pass
+                        elif self.heal_fn is not None and \
                                 self._object_needs_heal(es, bucket, fi.name):
                             self.stats.heals_triggered += 1
                             try:
@@ -147,14 +196,29 @@ class DataScanner:
 
     # -- background loop -----------------------------------------------------
 
-    def start(self, interval: float = 60.0) -> "DataScanner":
+    def start(self, interval: float | None = None) -> "DataScanner":
+        """Perpetual lifecycle (wired into server startup): normal
+        cycles at an adaptive cadence, a deep (bitrot-verify) cycle
+        every `deep_every`-th (cf. the perpetual runDataScanner loop,
+        cmd/data-scanner.go:96)."""
+        if interval is None:
+            interval = float(os.environ.get("MTPU_SCANNER_INTERVAL",
+                                            "60"))
+
         def loop():
-            while not self._stop.wait(interval):
+            wait = interval
+            while not self._stop.wait(wait):
+                deep = (self.stats.cycles + 1) % self.deep_every == 0
                 try:
-                    self.scan_cycle()
+                    self.scan_cycle(deep=deep)
                 except Exception:  # noqa: BLE001 — scanner must survive
-                    continue
-        self._thread = threading.Thread(target=loop, daemon=True)
+                    pass
+                # Adaptive cadence: never busier than ~10% duty cycle —
+                # a cycle that took 30s earns a >=300s breather, an
+                # instant cycle keeps the configured interval.
+                wait = max(interval, self.stats.last_cycle_s * 10)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mtpu-scanner")
         self._thread.start()
         return self
 

@@ -60,8 +60,8 @@ class Metacache:
         self._mu = threading.Lock()
         self._gen: dict[str, int] = {}          # bucket -> generation
         # (bucket, prefix, gen) -> state dict:
-        #   {"at": ts, "segs": [[first, last, count, seq]],
-        #    "done": bool, "last": str}
+        #   {"at": ts, "segs": [[last_name, seq], ...],
+        #    "done": bool, "last": str, "next_seq": int}
         self._idx: dict[tuple, dict] = {}
         self._seg_cache: tuple | None = None    # (path, entries) LRU-1
         self._persisted_paths: dict[str, set] = {}
@@ -218,6 +218,13 @@ class Metacache:
         state["next_seq"] = seq + 1
         path = f"{self._base_path(bucket, prefix)}/{seq}.seg"
         self._write_sys(bucket, path, self._pack_entries(entries))
+        # Seed the LRU so the caller's rescan serves these entries
+        # from memory instead of re-reading + decompressing what we
+        # hold right now — and so a persist that failed on every drive
+        # (ENOSPC) still makes forward progress in-process instead of
+        # looping through the lost-segment path.
+        with self._mu:
+            self._seg_cache = (path, list(entries))
         state["segs"].append([entries[-1].name, seq])
         state["last"] = entries[-1].name
         self._persist_index(bucket, prefix, state)
@@ -319,15 +326,23 @@ class Metacache:
                                      and state["segs"][-1][1] > seen_seq):
                     continue                      # rescan new segments
                 info: dict = {}
-                pending = list(islice(
-                    self._stream(bucket, prefix, after=state["last"],
-                                 info=info), SEG_ENTRIES))
+                stream = self._stream(bucket, prefix,
+                                      after=state["last"], info=info)
+                pending = list(islice(stream, SEG_ENTRIES))
                 if info["failed"]:
-                    # Degraded walk: serve this page live but cache
-                    # NOTHING — a truncated listing must not persist
-                    # as authoritative (nor mark the cache done).
-                    out.extend(fi for fi in pending
-                               if fi.name > marker)
+                    # Degraded walk: serve the FULL requested page
+                    # live (keep draining the same stream up to
+                    # max_keys) but cache NOTHING — a truncated
+                    # listing must not persist as authoritative (nor
+                    # mark the cache done).
+                    for fi in pending:
+                        if fi.name > marker:
+                            out.append(fi)
+                    for fi in stream:
+                        if fi.name > marker:
+                            out.append(fi)
+                        if len(out) > max_keys:
+                            break
                     return out[:max_keys]
                 if len(pending) < SEG_ENTRIES:
                     state["done"] = True

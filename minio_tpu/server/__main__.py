@@ -117,13 +117,18 @@ def main(argv: list[str] | None = None) -> int:
             if srv0.service_event == "restart" and not stop.is_set():
                 # Full re-boot: tear down, rejoin the cluster (format
                 # adopt + peer verify run again), same as the
-                # standalone restart loop.
+                # standalone restart loop. Each boot builds a fresh
+                # scanner; stop the outgoing one.
                 print("minio_tpu: service restart requested", flush=True)
                 srv0.shutdown()
+                if srv0.scanner is not None:
+                    srv0.scanner.stop()
                 node.close()
                 continue
             break
         srv0.shutdown()
+        if srv0.scanner is not None:
+            srv0.scanner.stop()
         node.close()
         return 0
 
@@ -143,7 +148,10 @@ def main(argv: list[str] | None = None) -> int:
     from ..bucket.notify import NotificationSystem
     from ..iam.iam import IAMSys
     iam = IAMSys(pools)
-    scanner = DataScanner(pools)
+    # Perpetual scanner lifecycle: an idle server crawls, accounts
+    # usage, heals missing metadata, and bitrot-verifies every
+    # deep_every-th cycle (cf. initDataScanner, cmd/server-main.go:441).
+    scanner = DataScanner(pools).start()
     notify = NotificationSystem()
 
     import threading
@@ -175,9 +183,10 @@ def main(argv: list[str] | None = None) -> int:
             # join it here so the port is released before rebinding
             # (shutdown is idempotent).
             srv.shutdown()
-            continue
+            continue             # scanner keeps running across restarts
         break
     srv.shutdown()
+    scanner.stop()
     return 0
 
 

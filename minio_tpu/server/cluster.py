@@ -289,7 +289,7 @@ def boot_cluster_node(endpoint_args: list[str], my_host: str,
         iam = IAMSys(pools)
         node.peer_registry.on_reload("iam", iam.load)
         server.bind_object_layer(pools, iam=iam,
-                                 scanner=DataScanner(pools))
+                                 scanner=DataScanner(pools).start())
         return node, server, pools
     except Exception:
         server.shutdown()

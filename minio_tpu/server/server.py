@@ -261,6 +261,10 @@ class S3Server:
         return self
 
     def shutdown(self) -> None:
+        # The scanner's lifecycle belongs to the process (__main__) —
+        # a service RESTART tears this server down but must keep (or
+        # rebuild) the scanner; stopping it here would end background
+        # healing for the life of the process.
         self._httpd.shutdown()
         self._httpd.server_close()
 

@@ -531,27 +531,44 @@ class LocalDrive:
             else os.path.dirname(start)
         out: list[tuple[str, bytes]] = []
 
+        def emit(dirpath: str, rel: str) -> bool:
+            if (not prefix or rel.startswith(prefix)) and rel > after:
+                if len(out) >= limit:
+                    return False
+                try:
+                    with open(os.path.join(dirpath, XL_META_FILE),
+                              "rb") as f:
+                        out.append((rel, f.read()))
+                except OSError:
+                    pass
+            return True
+
         def descend(dirpath: str) -> bool:
             """-> False when the page filled mid-subtree (not eof)."""
             try:
-                names = sorted(os.listdir(dirpath))
+                names = os.listdir(dirpath)
             except OSError:
                 return True
-            if XL_META_FILE in names:
-                rel = os.path.relpath(dirpath, base).replace(os.sep, "/")
-                if (not prefix or rel.startswith(prefix)) and rel > after:
-                    try:
-                        with open(os.path.join(dirpath, XL_META_FILE),
-                                  "rb") as f:
-                            out.append((rel, f.read()))
-                    except OSError:
-                        pass
-                return True          # object dir: don't enter data dirs
+            # Global lexical order: an object dir d emits exactly "d";
+            # a container dir d emits names starting "d/". Siblings
+            # must therefore be visited in (name if object else
+            # name+"/") order — plain name order would emit "x/..."
+            # before sibling "x!a" even though '!' < '/'.
+            items = []
             for name in names:
                 sub = os.path.join(dirpath, name)
                 if not os.path.isdir(sub):
                     continue
+                is_obj = os.path.isfile(os.path.join(sub, XL_META_FILE))
+                items.append((name if is_obj else name + "/", name,
+                              is_obj, sub))
+            items.sort()
+            for key, name, is_obj, sub in items:
                 rel = os.path.relpath(sub, base).replace(os.sep, "/")
+                if is_obj:
+                    if not emit(sub, rel):
+                        return False
+                    continue         # object dir: don't enter data dirs
                 # Prune: every name under rel starts with rel+"/";
                 # skip when that whole range sorts <= after.
                 if after and rel + "/" < after[:len(rel) + 1]:
@@ -564,8 +581,12 @@ class LocalDrive:
 
         if not os.path.isdir(walk_root):
             return [], True
-        # descend() checks the limit before every recursion, so out
-        # never exceeds it.
+        if os.path.isfile(os.path.join(walk_root, XL_META_FILE)):
+            # the prefix IS an object
+            rel = os.path.relpath(walk_root, base).replace(os.sep, "/")
+            return ([], True) if not emit(walk_root, rel) else (out, True)
+        # descend() checks the limit before every append/recursion, so
+        # out never exceeds it.
         return out, descend(walk_root)
 
     # -- bitrot verify -------------------------------------------------------

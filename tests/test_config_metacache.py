@@ -242,3 +242,30 @@ class TestMetacache:
         cache._seg_cache = None
         all2 = cache.list("lb", max_keys=100)
         assert [fi.name for fi in all2] == [f"o{i:03d}" for i in range(35)]
+
+    def test_walk_page_lexical_order_with_tricky_names(self, tmp_path):
+        """Names sorting below '/' next to a same-prefix directory must
+        come out in true lexical order, or resume markers drop them
+        (code-review r4)."""
+        from minio_tpu.storage.drive import LocalDrive
+        from minio_tpu.storage.xlmeta import FileInfo
+        d = LocalDrive(str(tmp_path / "ord"))
+        d.make_volume("ob")
+        names = ["x/y", "x!a", "x.txt", "x/z/deep", "w", "x0"]
+        for n in names:
+            d.write_metadata("ob", n, FileInfo(
+                volume="ob", name=n, size=1, mod_time_ns=1,
+                metadata={}, inline_data=b"i"))
+        entries, eof = d.walk_page("ob", limit=100)
+        got = [n for n, _ in entries]
+        assert got == sorted(names), got
+        assert eof
+        # page-by-page with markers covers everything exactly once
+        collected, after = [], ""
+        while True:
+            page, eof = d.walk_page("ob", after=after, limit=2)
+            collected += [n for n, _ in page]
+            if eof:
+                break
+            after = page[-1][0]
+        assert collected == sorted(names), collected
