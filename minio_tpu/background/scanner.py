@@ -20,7 +20,7 @@ import os
 import threading
 import time
 
-from ..storage.errors import ErrFileCorrupt, StorageError
+from ..storage.errors import StorageError
 from .usage import DataUsage, DirtyTracker
 
 
@@ -148,8 +148,7 @@ class DataScanner:
                                 if healed:
                                     self.stats.corruption_found += 1
                                     self.stats.heals_triggered += 1
-                            except (StorageError,
-                                    ErrFileCorrupt):
+                            except StorageError:
                                 pass
                         elif self.heal_fn is not None and \
                                 self._object_needs_heal(es, bucket, fi.name):

@@ -23,6 +23,7 @@ class SitePeer:
     def __init__(self, name: str, endpoint: str, access_key: str,
                  secret_key: str):
         self.name = name
+        self.endpoint = endpoint
         self.cli = S3Client(endpoint, access_key, secret_key)
 
     # -- control-plane pushes ------------------------------------------------

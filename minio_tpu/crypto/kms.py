@@ -59,22 +59,65 @@ class StaticKMS(KMS):
             raise KMSError("refusing all-zero KMS master key")
         self._master = master_key
         self.key_id = key_id
+        # Named keys are DERIVED from the root secret (HMAC(master,
+        # key id)) — the KES "create key" admin surface without any
+        # key-material state to replicate (cf. kes key derivation;
+        # internal/kms/kms.go CreateKey). The default key uses the
+        # master directly for backward compatibility with data sealed
+        # before named keys existed.
+        self._created: set[str] = {key_id}
 
+    def _key_for(self, key_id: str) -> bytes:
+        if key_id == self.key_id:
+            return self._master
+        if key_id not in self._created:
+            # Derivation would succeed for ANY id; the created-set is
+            # what makes "unknown key" a real answer (a typo'd id must
+            # not probe as healthy).
+            raise KMSError(f"unknown key id {key_id!r}")
+        import hmac as _hmac
+        import hashlib as _hashlib
+        return _hmac.new(self._master, b"mtpu-kms-key:" + key_id.encode(),
+                         _hashlib.sha256).digest()
 
-    def generate_data_key(self, context: bytes = b""):
+    # -- admin surface (cf. KMSCreateKey/KMSKeyStatus admin handlers) --------
+
+    def create_key(self, key_id: str) -> None:
+        if not key_id or "/" in key_id:
+            raise KMSError(f"invalid key id {key_id!r}")
+        self._created.add(key_id)
+
+    def list_keys(self) -> list[str]:
+        return sorted(self._created)
+
+    def key_status(self, key_id: str) -> dict:
+        """Round-trip health probe: seal + unseal under the key (the
+        reference's KMSKeyStatusHandler does exactly this)."""
+        try:
+            kid, plaintext, sealed = self.generate_data_key(
+                b"status-probe", key_id=key_id)
+            ok = self.decrypt_data_key(kid, sealed,
+                                       b"status-probe") == plaintext
+            return {"keyId": key_id, "encryptionErr": "",
+                    "decryptionErr": "" if ok else "round-trip mismatch"}
+        except KMSError as e:
+            return {"keyId": key_id, "encryptionErr": str(e),
+                    "decryptionErr": ""}
+
+    def generate_data_key(self, context: bytes = b"",
+                          key_id: str | None = None):
+        key_id = key_id or self.key_id
         plaintext = secrets.token_bytes(32)
         nonce = secrets.token_bytes(12)
-        sealed = nonce + AESGCM(self._master).encrypt(nonce, plaintext,
-                                                      context)
-        return self.key_id, plaintext, sealed
+        sealed = nonce + AESGCM(self._key_for(key_id)).encrypt(
+            nonce, plaintext, context)
+        return key_id, plaintext, sealed
 
     def decrypt_data_key(self, key_id: str, sealed: bytes,
                          context: bytes = b"") -> bytes:
-        if key_id != self.key_id:
-            raise KMSError(f"unknown key id {key_id!r}")
         try:
-            return AESGCM(self._master).decrypt(sealed[:12], sealed[12:],
-                                                context)
+            return AESGCM(self._key_for(key_id)).decrypt(
+                sealed[:12], sealed[12:], context)
         except Exception as e:  # noqa: BLE001
             raise KMSError(f"unseal failed: {e}") from None
 

@@ -338,11 +338,16 @@ class Metacache:
                     for fi in pending:
                         if fi.name > marker:
                             out.append(fi)
-                    for fi in stream:
-                        if fi.name > marker:
-                            out.append(fi)
-                        if len(out) > max_keys:
-                            break
+                    try:
+                        for fi in stream:
+                            if fi.name > marker:
+                                out.append(fi)
+                            if len(out) > max_keys:
+                                break
+                    except StorageError:
+                        # remaining drives died mid-drain: the partial
+                        # page is still better than a 500
+                        pass
                     return out[:max_keys]
                 if len(pending) < SEG_ENTRIES:
                     state["done"] = True

@@ -91,6 +91,56 @@ class Histogram:
             out.append(f"{self.name}_count {self._n}")
 
 
+class BandwidthMonitor:
+    """Per-bucket rx/tx rates over a sliding window — the bandwidth
+    monitor the admin API reports (cf. cmd/admin-router.go bandwidth
+    route + internal/bucket/bandwidth/monitor.go, which the reference
+    uses for replication throttling and `mc admin bandwidth`)."""
+
+    WINDOW = 10.0                    # seconds
+
+    def __init__(self):
+        import collections
+        import threading
+        self._mu = threading.Lock()
+        # bucket -> deque[(ts, rx, tx)]
+        self._events: dict[str, object] = {}
+        self._deque = collections.deque
+
+    def record(self, bucket: str, rx: int, tx: int) -> None:
+        import time as _t
+        now = _t.monotonic()
+        with self._mu:
+            dq = self._events.setdefault(bucket, self._deque())
+            dq.append((now, rx, tx))
+            cutoff = now - self.WINDOW
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+
+    def report(self, buckets: list[str] | None = None) -> dict:
+        import time as _t
+        now = _t.monotonic()
+        cutoff = now - self.WINDOW
+        out = {}
+        with self._mu:
+            for bucket, dq in list(self._events.items()):
+                while dq and dq[0][0] < cutoff:
+                    dq.popleft()
+                if not dq:
+                    # evict idle buckets: _events must not grow with
+                    # every bucket name ever requested
+                    del self._events[bucket]
+                    continue
+                if buckets and bucket not in buckets:
+                    continue
+                rx = sum(e[1] for e in dq)
+                tx = sum(e[2] for e in dq)
+                out[bucket] = {
+                    "rx_bytes_per_s": round(rx / self.WINDOW, 1),
+                    "tx_bytes_per_s": round(tx / self.WINDOW, 1)}
+        return out
+
+
 class MetricsRegistry:
     def __init__(self):
         self.api_requests = Counter(
@@ -117,15 +167,18 @@ class MetricsRegistry:
                                   "Online drives")
         self.drive_offline = Gauge("mtpu_cluster_drives_offline",
                                    "Offline drives")
+        self.bandwidth = BandwidthMonitor()
 
     def observe_request(self, api: str, status: int, duration_s: float,
-                        rx: int, tx: int) -> None:
+                        rx: int, tx: int, bucket: str = "") -> None:
         self.api_requests.inc(api=api, status=str(status))
         if status >= 400:
             self.api_errors.inc(code=str(status))
         self.latency.observe(duration_s)
         self.bytes_rx.inc(rx)
         self.bytes_tx.inc(tx)
+        if bucket:
+            self.bandwidth.record(bucket, rx, tx)
 
     def update_cluster(self, pools, scanner=None) -> None:
         online = offline = 0

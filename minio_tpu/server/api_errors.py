@@ -181,6 +181,153 @@ ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("BusyOperation", 409, "A conflicting operation is in progress."),
     _E("ClientDisconnected", 499, "Client disconnected before response was ready."),
     _E("InvalidSessionToken", 403, "The provided session token is invalid."),
+    # -- full-parity batch r4 (cf. cmd/api-errors.go): every wire
+    # code the reference's registry can emit, so error mapping
+    # and client SDK expectations match 1:1 ------------------------
+    _E("AuthorizationParametersError", 400, "Error parsing the Credential/X-Amz-Credential parameter; incorrect service. This endpoint belongs to 's3'."),
+    _E("Busy", 503, "The service is unavailable. Please retry."),
+    _E("CastFailed", 400, "Attempt to convert from one data type to another using CAST failed in the SQL expression."),
+    _E("EmptyRequestBody", 400, "Request body cannot be empty."),
+    _E("ErrEvaluatorBindingDoesNotExist", 400, "A column name or a path provided does not exist in the SQL expression"),
+    _E("EvaluatorInvalidArguments", 400, "Incorrect number of arguments in the function call in the SQL expression."),
+    _E("EvaluatorInvalidTimestampFormatPattern", 400, "Time stamp format pattern requires additional fields in the SQL expression."),
+    _E("EvaluatorInvalidTimestampFormatPatternSymbol", 400, "Time stamp format pattern contains an invalid symbol in the SQL expression."),
+    _E("EvaluatorInvalidTimestampFormatPatternSymbolForParsing", 400, "Time stamp format pattern contains a valid format symbol that cannot be applied to time stamp parsing in th..."),
+    _E("EvaluatorInvalidTimestampFormatPatternToken", 400, "Time stamp format pattern contains an invalid token in the SQL expression."),
+    _E("EvaluatorTimestampFormatPatternDuplicateFields", 400, "Time stamp format pattern contains multiple format specifiers representing the time stamp field in the SQL..."),
+    _E("EvaluatorUnterminatedTimestampFormatPatternToken", 400, "Time stamp format pattern contains unterminated token in the SQL expression."),
+    _E("ExpressionTooLong", 400, "The SQL expression is too long: The maximum byte-length for the SQL expression is 256 KB."),
+    _E("IllegalSqlFunctionArgument", 400, "Illegal argument was used in the SQL function."),
+    _E("IncorrectSqlFunctionArgumentType", 400, "Incorrect type of arguments in function call in the SQL expression."),
+    _E("IntegerOverflow", 400, "Int overflow or underflow in the SQL expression."),
+    _E("InvalidCast", 400, "Attempt to convert from one data type to another using CAST failed in the SQL expression."),
+    _E("InvalidColumnIndex", 400, "The column index is invalid. Please check the service documentation and try again."),
+    _E("InvalidCompressionFormat", 400, "The file is not in a supported compression format. Only GZIP is supported at this time."),
+    _E("InvalidDataSource", 400, "Invalid data source type. Only CSV and JSON are supported at this time."),
+    _E("InvalidDataType", 400, "The SQL expression contains an invalid data type."),
+    _E("InvalidExpressionType", 400, "The ExpressionType is invalid. Only SQL expressions are supported at this time."),
+    _E("InvalidFileHeaderInfo", 400, "The FileHeaderInfo is invalid. Only NONE, USE, and IGNORE are supported."),
+    _E("InvalidJsonType", 400, "The JsonType is invalid. Only DOCUMENT and LINES are supported at this time."),
+    _E("InvalidKeyPath", 400, "Key path in the SQL expression is invalid."),
+    _E("InvalidPartNumber", 416, "The requested partnumber is not satisfiable"),
+    _E("InvalidPrefixMarker", 400, "Invalid marker prefix combination"),
+    _E("InvalidQuoteFields", 400, "The QuoteFields is invalid. Only ALWAYS and ASNEEDED are supported."),
+    _E("InvalidRequestParameter", 400, "The value of a parameter in SelectRequest element is invalid. Check the service API documentation and try a..."),
+    _E("InvalidTableAlias", 400, "The SQL expression contains an invalid table alias."),
+    _E("InvalidTextEncoding", 400, "Invalid encoding type. Only UTF-8 encoding is supported at this time."),
+    _E("InvalidTokenId", 403, "The security token included in the request is invalid"),
+    _E("LexerInvalidChar", 400, "The SQL expression contains an invalid character."),
+    _E("LexerInvalidIONLiteral", 400, "The SQL expression contains an invalid operator."),
+    _E("LexerInvalidLiteral", 400, "The SQL expression contains an invalid operator."),
+    _E("LexerInvalidOperator", 400, "The SQL expression contains an invalid literal."),
+    _E("LikeInvalidInputs", 400, "Invalid argument given to the LIKE clause in the SQL expression."),
+    _E("MissingFields", 400, "Missing fields in request."),
+    _E("MissingHeaders", 400, "Some headers in the query are missing from the file. Check the file and try again."),
+    _E("MissingRequiredParameter", 400, "The SelectRequest entity is missing a required parameter. Check the service documentation and try again."),
+    _E("NoSuchBucketLifecycle", 404, "The bucket lifecycle configuration does not exist"),
+    _E("ObjectLockConfigurationNotFoundError", 404, "Object Lock configuration does not exist for this bucket"),
+    _E("ObjectSerializationConflict", 400, "The SelectRequest entity can only contain one of CSV or JSON. Check the service documentation and try again."),
+    _E("ParseAsteriskIsNotAloneInSelectList", 400, "Other expressions are not allowed in the SELECT list when '*' is used without dot notation in the SQL expre..."),
+    _E("ParseCannotMixSqbAndWildcardInSelectList", 400, "Cannot mix [] and * in the same expression in a SELECT list in SQL expression."),
+    _E("ParseCastArity", 400, "The SQL expression CAST has incorrect arity."),
+    _E("ParseEmptySelect", 400, "The SQL expression contains an empty SELECT."),
+    _E("ParseExpected2TokenTypes", 400, "Did not find the expected token in the SQL expression."),
+    _E("ParseExpectedArgumentDelimiter", 400, "Did not find the expected argument delimiter in the SQL expression."),
+    _E("ParseExpectedDatePart", 400, "Did not find the expected date part in the SQL expression."),
+    _E("ParseExpectedExpression", 400, "Did not find the expected SQL expression."),
+    _E("ParseExpectedIdentForAlias", 400, "Did not find the expected identifier for the alias in the SQL expression."),
+    _E("ParseExpectedIdentForAt", 400, "Did not find the expected identifier for AT name in the SQL expression."),
+    _E("ParseExpectedIdentForGroupName", 400, "GROUP is not supported in the SQL expression."),
+    _E("ParseExpectedKeyword", 400, "Did not find the expected keyword in the SQL expression."),
+    _E("ParseExpectedLeftParenAfterCast", 400, "Did not find expected the left parenthesis in the SQL expression."),
+    _E("ParseExpectedLeftParenBuiltinFunctionCall", 400, "Did not find the expected left parenthesis in the SQL expression."),
+    _E("ParseExpectedLeftParenValueConstructor", 400, "Did not find expected the left parenthesis in the SQL expression."),
+    _E("ParseExpectedMember", 400, "The SQL expression contains an unsupported use of MEMBER."),
+    _E("ParseExpectedNumber", 400, "Did not find the expected number in the SQL expression."),
+    _E("ParseExpectedRightParenBuiltinFunctionCall", 400, "Did not find the expected right parenthesis character in the SQL expression."),
+    _E("ParseExpectedTokenType", 400, "Did not find the expected token in the SQL expression."),
+    _E("ParseExpectedTypeName", 400, "Did not find the expected type name in the SQL expression."),
+    _E("ParseExpectedWhenClause", 400, "Did not find the expected WHEN clause in the SQL expression. CASE is not supported."),
+    _E("ParseInvalidContextForWildcardInSelectList", 400, "Invalid use of * in SELECT list in the SQL expression."),
+    _E("ParseInvalidTypeParam", 400, "The SQL expression contains an invalid parameter value."),
+    _E("ParseMalformedJoin", 400, "JOIN is not supported in the SQL expression."),
+    _E("ParseMissingIdentAfterAt", 400, "Did not find the expected identifier after the @ symbol in the SQL expression."),
+    _E("ParseNonUnaryAgregateFunctionCall", 400, "Only one argument is supported for aggregate functions in the SQL expression."),
+    _E("ParseSelectMissingFrom", 400, "GROUP is not supported in the SQL expression."),
+    _E("ParseUnexpectedKeyword", 400, "The SQL expression contains an unexpected keyword."),
+    _E("ParseUnexpectedOperator", 400, "The SQL expression contains an unexpected operator."),
+    _E("ParseUnexpectedTerm", 400, "The SQL expression contains an unexpected term."),
+    _E("ParseUnexpectedToken", 400, "The SQL expression contains an unexpected token."),
+    _E("ParseUnknownOperator", 400, "The SQL expression contains an invalid operator."),
+    _E("ParseUnsupportedAlias", 400, "The SQL expression contains an unsupported use of ALIAS."),
+    _E("ParseUnsupportedCallWithStar", 400, "Only COUNT with (*) as a parameter is supported in the SQL expression."),
+    _E("ParseUnsupportedCase", 400, "The SQL expression contains an unsupported use of CASE."),
+    _E("ParseUnsupportedCaseClause", 400, "The SQL expression contains an unsupported use of CASE."),
+    _E("ParseUnsupportedLiteralsGroupBy", 400, "The SQL expression contains an unsupported use of GROUP BY."),
+    _E("ParseUnsupportedSelect", 400, "The SQL expression contains an unsupported use of SELECT."),
+    _E("ParseUnsupportedSyntax", 400, "The SQL expression contains unsupported syntax."),
+    _E("ParseUnsupportedToken", 400, "The SQL expression contains an unsupported token."),
+    _E("PostPolicyInvalidKeyName", 403, "Invalid according to Policy: Policy Condition failed"),
+    _E("RemoteDestinationNotFoundError", 404, "The remote destination bucket does not exist"),
+    _E("RemoteTargetNotVersionedError", 400, "The remote target does not have versioning enabled"),
+    _E("ReplicationDestinationMissingLockError", 400, "The replication destination bucket does not have object locking enabled"),
+    _E("ReplicationSourceNotVersionedError", 400, "The replication source does not have versioning enabled"),
+    _E("UnauthorizedAccess", 401, "You are not authorized to perform this operation"),
+    _E("UnsupportedFunction", 400, "Encountered an unsupported SQL function."),
+    _E("UnsupportedRangeHeader", 400, "Range header is not supported for this operation."),
+    _E("UnsupportedSqlOperation", 400, "Encountered an unsupported SQL operation."),
+    _E("UnsupportedSqlStructure", 400, "Encountered an unsupported SQL structure. Check the SQL Reference."),
+    _E("UnsupportedSyntax", 400, "Encountered invalid syntax."),
+    _E("ValueParseFailure", 400, "Time stamp parse failure in the SQL expression."),
+    _E("XMinioAdminBucketQuotaExceeded", 400, "Bucket quota exceeded"),
+    _E("XMinioAdminBucketRemoteAlreadyExists", 400, "The remote target already exists"),
+    _E("XMinioAdminBucketRemoteLabelInUse", 400, "The remote target with this label already exists"),
+    _E("XMinioAdminConfigBadJSON", 400, "JSON configuration provided is of incorrect format"),
+    _E("XMinioAdminConfigDuplicateKeys", 400, "JSON configuration provided has objects with duplicate keys"),
+    _E("XMinioAdminConfigNoQuorum", 503, "Configuration update failed because server quorum was not met"),
+    _E("XMinioAdminCredentialsMismatch", 503, "Credentials in config mismatch with server environment variables"),
+    _E("XMinioAdminGroupNotEmpty", 400, "The specified group is not empty - cannot remove it."),
+    _E("XMinioAdminInvalidAccessKey", 400, "The access key is invalid."),
+    _E("XMinioAdminInvalidArgument", 400, "Invalid arguments specified."),
+    _E("XMinioAdminInvalidSecretKey", 400, "The secret key is invalid."),
+    _E("XMinioAdminNoSuchGroup", 404, "The specified group does not exist."),
+    _E("XMinioAdminNoSuchPolicy", 404, "The canned policy does not exist."),
+    _E("XMinioAdminNoSuchQuotaConfiguration", 404, "The quota configuration does not exist"),
+    _E("XMinioAdminNoSuchUser", 404, "The specified user does not exist."),
+    _E("XMinioAdminNotificationTargetsTestFailed", 400, "Configuration update failed due an unsuccessful attempt to connect to one or more notification servers"),
+    _E("XMinioAdminProfilerNotEnabled", 400, "Unable to perform the requested operation because profiling is not enabled"),
+    _E("XMinioAdminRemoteARNTypeInvalid", 400, "The bucket remote ARN type is not valid"),
+    _E("XMinioAdminRemoteArnInvalid", 400, "The bucket remote ARN does not have correct format"),
+    _E("XMinioAdminRemoteIdenticalToSource", 400, "The remote target cannot be identical to source"),
+    _E("XMinioAdminRemoteRemoveDisallowed", 400, "This ARN is in use by an existing configuration"),
+    _E("XMinioAdminRemoteTargetNotFoundError", 404, "The remote target does not exist"),
+    _E("XMinioAdminReplicationBandwidthLimitError", 400, "Bandwidth limit for remote target must be atleast 100MBps"),
+    _E("XMinioAdminReplicationRemoteConnectionError", 404, "Remote service connection error - please check remote service credentials and target bucket"),
+    _E("XMinioBackendDown", 503, "Object storage backend is unreachable"),
+    _E("XMinioHealAlreadyRunning", 400, "A heal sequence is already running on this path."),
+    _E("XMinioHealInvalidClientToken", 400, "Client token mismatch"),
+    _E("XMinioHealMissingBucket", 400, "A heal start request with a non-empty object-prefix parameter requires a bucket to be specified."),
+    _E("XMinioHealNoSuchProcess", 400, "No such heal process is running on the server"),
+    _E("XMinioHealNotImplemented", 400, "This server does not implement heal functionality."),
+    _E("XMinioHealOverlappingPaths", 400, "A heal sequence on an overlapping path is already running."),
+    _E("XMinioInsecureClientRequest", 400, "Cannot respond to plain-text request from TLS-encrypted server"),
+    _E("XMinioInvalidDecompressedSize", 400, "The data provided is unfit for decompression"),
+    _E("XMinioInvalidIAMCredentials", 403, "User is not allowed to be same as admin access key"),
+    _E("XMinioInvalidObjectName", 400, "Object name contains unsupported characters."),
+    _E("XMinioInvalidResourceName", 400, "Resource name contains bad components such as '..' or '.'."),
+    _E("XMinioMalformedJSON", 400, "The JSON you provided was not well-formed or did not validate against our published format."),
+    _E("XMinioObjectExistsAsDirectory", 409, "Object name already exists as a directory."),
+    _E("XMinioReplicationNoMatchingRule", 400, "No matching replication rule found for this object prefix"),
+    _E("XMinioRequestBodyParse", 400, "The request body failed to parse."),
+    _E("XMinioServerNotInitialized", 503, "Server not initialized, please try again."),
+    _E("XMinioSiteReplicationBackendIssue", 503, "Error when requesting object layer backend"),
+    _E("XMinioSiteReplicationBucketConfigError", 503, "Error while configuring replication on a bucket"),
+    _E("XMinioSiteReplicationBucketMetaError", 503, "Error while replicating bucket metadata"),
+    _E("XMinioSiteReplicationIAMError", 503, "Error while replicating an IAM item"),
+    _E("XMinioSiteReplicationInvalidRequest", 400, "Invalid site-replication request"),
+    _E("XMinioSiteReplicationPeerResp", 503, "Error received when contacting a peer site"),
+    _E("XMinioSiteReplicationServiceAccountError", 503, "Site replication related service account error"),
+    _E("XMinioStorageFull", 507, "Storage backend has reached its minimum free disk threshold. Please delete a few objects to proceed."),
 ]}
 
 

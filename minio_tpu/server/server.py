@@ -38,8 +38,9 @@ class S3Server:
                  replication=None, scanner=None, kms=None,
                  compress_enabled: bool = False, tier_mgr=None,
                  oidc=None, certs: tuple[str, str] | None = None,
-                 rpc_router=None):
+                 rpc_router=None, site_replicator=None):
         self.oidc = oidc                   # iam.oidc.OpenIDConfig | None
+        self.site_replicator = site_replicator   # SiteReplicator | None
         self.pools = pools
         self.creds = creds                 # root credentials (policy bypass)
         self.iam = iam                     # IAMSys | None
@@ -164,10 +165,13 @@ class S3Server:
                 resp_size = (int(resp.headers.get("Content-Length", 0) or 0)
                              if resp.body_iter is not None
                              else len(resp.body or b""))
+                req_bucket = ("" if path.startswith("/minio/")
+                              else path.split("/", 2)[1]
+                              if path.count("/") >= 1 else "")
                 outer.metrics.observe_request(
                     self.command, resp.status, dur,
                     int(self.headers.get("Content-Length", 0) or 0),
-                    resp_size)
+                    resp_size, bucket=req_bucket)
                 outer.tracer.trace(
                     method=self.command, path=path, status=resp.status,
                     duration_ms=dur * 1e3,
@@ -562,6 +566,10 @@ class S3Server:
         "service": "admin:ServiceRestart",
         "tier": "admin:SetTier",
         "inspect": "admin:InspectData",
+        "kms": "admin:KMSKeyStatus",
+        "bandwidth": "admin:BandwidthMonitor",
+        "pools": "admin:ServerInfo",
+        "site-replication": "admin:SiteReplicationInfo",
     }
 
     def _admin_authorize(self, access_key: str, sub: str,
@@ -577,6 +585,10 @@ class S3Server:
         if ident is None:
             raise S3Error("InvalidAccessKeyId")
         base = self._ADMIN_ACTIONS.get(sub.split("/")[0], "admin:*")
+        if base == "admin:KMSKeyStatus" and method == "POST":
+            # Key creation is a WRITE action — a status-only admin
+            # must not mint keys (cf. KMSCreateKeyAdminAction).
+            base = "admin:KMSCreateKey"
         if base == "admin:*User":
             base = {"GET": "admin:ListUsers", "POST": "admin:CreateUser",
                     "DELETE": "admin:DeleteUser"}.get(method,
@@ -860,6 +872,66 @@ class S3Server:
             if not copies:
                 return j({"error": "no xl.meta found"}, 404)
             return j({"volume": bucket, "file": obj, "copies": copies})
+        if sub.startswith("kms"):
+            # KMS admin (cf. KMSCreateKey/KMSKeyStatus handlers,
+            # cmd/admin-router.go:40 + cmd/admin-handlers.go).
+            kms = self.handlers.kms
+            if kms is None:
+                return j({"error": "KMS not configured"}, 501)
+            if sub == "kms/status" and method == "GET":
+                return j({"name": "StaticKMS",
+                          "defaultKeyId": kms.key_id,
+                          "endpoints": {"local": "online"}})
+            if sub == "kms/key/list" and method == "GET":
+                return j({"keys": kms.list_keys()})
+            if sub == "kms/key/create" and method == "POST":
+                key_id = query.get("key-id", [""])[0]
+                if not key_id:
+                    raise S3Error("InvalidArgument", "key-id required")
+                from ..crypto.kms import KMSError
+                try:
+                    kms.create_key(key_id)
+                except KMSError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return j({"created": key_id})
+            if sub == "kms/key/status" and method == "GET":
+                key_id = query.get("key-id", [kms.key_id])[0]
+                return j(kms.key_status(key_id))
+            raise S3Error("MethodNotAllowed")
+        if sub == "bandwidth" and method == "GET":
+            # Per-bucket bandwidth over a sliding window
+            # (cf. BandwidthMonitor admin route, cmd/admin-router.go).
+            want = query.get("buckets", [""])[0]
+            buckets = [b for b in want.split(",") if b] or None
+            return j({"windowS": self.metrics.bandwidth.WINDOW,
+                      "buckets": self.metrics.bandwidth.report(buckets)})
+        if sub == "pools" and method == "GET":
+            # Pool status listing (cf. ListPools,
+            # cmd/admin-handlers-pools.go).
+            out = []
+            for pi, pool in enumerate(self.pools.pools):
+                sets = getattr(pool, "sets", [pool])
+                drives = online = 0
+                for es in sets:
+                    for d in getattr(es, "drives", []):
+                        drives += 1
+                        if d is not None and (not hasattr(d, "is_online")
+                                              or d.is_online()):
+                            online += 1
+                out.append({"pool": pi, "sets": len(sets),
+                            "drivesPerSet": getattr(
+                                sets[0], "n", drives) if sets else 0,
+                            "drivesTotal": drives,
+                            "drivesOnline": online,
+                            "decommissioning": False})
+            return j({"pools": out})
+        if sub == "site-replication" and method == "GET":
+            sr = self.site_replicator
+            if sr is None:
+                return j({"enabled": False, "sites": []})
+            return j({"enabled": True,
+                      "sites": [{"name": p.name, "endpoint": p.endpoint}
+                                for p in sr.peers]})
         if sub == "service" and method == "POST":
             # Real semantics (cf. ServiceHandler, cmd/admin-handlers.go):
             # stop/restart shut the listener down after this response
