@@ -67,13 +67,16 @@ class StaticKMS(KMS):
         # before named keys existed.
         self._created: set[str] = {key_id}
 
-    def _key_for(self, key_id: str) -> bytes:
+    def _key_for(self, key_id: str, for_decrypt: bool = False) -> bytes:
         if key_id == self.key_id:
             return self._master
-        if key_id not in self._created:
-            # Derivation would succeed for ANY id; the created-set is
-            # what makes "unknown key" a real answer (a typo'd id must
-            # not probe as healthy).
+        if key_id not in self._created and not for_decrypt:
+            # ENCRYPT/status paths enforce the created-set (a typo'd
+            # id must not probe as healthy). DECRYPT derives for any
+            # id: data sealed under a key proves the key was created,
+            # and the created-set is in-memory only — a restart must
+            # never strand sealed data whose key material is
+            # deterministically derivable.
             raise KMSError(f"unknown key id {key_id!r}")
         import hmac as _hmac
         import hashlib as _hashlib
@@ -116,7 +119,7 @@ class StaticKMS(KMS):
     def decrypt_data_key(self, key_id: str, sealed: bytes,
                          context: bytes = b"") -> bytes:
         try:
-            return AESGCM(self._key_for(key_id)).decrypt(
+            return AESGCM(self._key_for(key_id, for_decrypt=True)).decrypt(
                 sealed[:12], sealed[12:], context)
         except Exception as e:  # noqa: BLE001
             raise KMSError(f"unseal failed: {e}") from None

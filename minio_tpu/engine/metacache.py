@@ -338,16 +338,15 @@ class Metacache:
                     for fi in pending:
                         if fi.name > marker:
                             out.append(fi)
-                    try:
-                        for fi in stream:
-                            if fi.name > marker:
-                                out.append(fi)
-                            if len(out) > max_keys:
-                                break
-                    except StorageError:
-                        # remaining drives died mid-drain: the partial
-                        # page is still better than a 500
-                        pass
+                    # A mid-drain all-drives failure PROPAGATES: a
+                    # short page reads as "listing complete" to every
+                    # pagination client (IsTruncated=false) — silent
+                    # truncation loses data downstream, a 5xx does not.
+                    for fi in stream:
+                        if fi.name > marker:
+                            out.append(fi)
+                        if len(out) > max_keys:
+                            break
                     return out[:max_keys]
                 if len(pending) < SEG_ENTRIES:
                     state["done"] = True

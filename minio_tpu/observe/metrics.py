@@ -98,6 +98,7 @@ class BandwidthMonitor:
     uses for replication throttling and `mc admin bandwidth`)."""
 
     WINDOW = 10.0                    # seconds
+    MAX_BUCKETS = 1024               # hostile-path cardinality bound
 
     def __init__(self):
         import collections
@@ -110,10 +111,21 @@ class BandwidthMonitor:
     def record(self, bucket: str, rx: int, tx: int) -> None:
         import time as _t
         now = _t.monotonic()
+        cutoff = now - self.WINDOW
         with self._mu:
-            dq = self._events.setdefault(bucket, self._deque())
+            dq = self._events.get(bucket)
+            if dq is None:
+                if len(self._events) >= self.MAX_BUCKETS:
+                    # evict idle buckets before refusing new ones
+                    for name, other in list(self._events.items()):
+                        while other and other[0][0] < cutoff:
+                            other.popleft()
+                        if not other:
+                            del self._events[name]
+                    if len(self._events) >= self.MAX_BUCKETS:
+                        return           # saturated: drop, don't grow
+                dq = self._events[bucket] = self._deque()
             dq.append((now, rx, tx))
-            cutoff = now - self.WINDOW
             while dq and dq[0][0] < cutoff:
                 dq.popleft()
 

@@ -192,9 +192,9 @@ ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("EvaluatorInvalidArguments", 400, "Incorrect number of arguments in the function call in the SQL expression."),
     _E("EvaluatorInvalidTimestampFormatPattern", 400, "Time stamp format pattern requires additional fields in the SQL expression."),
     _E("EvaluatorInvalidTimestampFormatPatternSymbol", 400, "Time stamp format pattern contains an invalid symbol in the SQL expression."),
-    _E("EvaluatorInvalidTimestampFormatPatternSymbolForParsing", 400, "Time stamp format pattern contains a valid format symbol that cannot be applied to time stamp parsing in th..."),
+    _E("EvaluatorInvalidTimestampFormatPatternSymbolForParsing", 400, "Time stamp format pattern contains a valid format symbol that cannot be applied to time stamp parsing in the SQL expression."),
     _E("EvaluatorInvalidTimestampFormatPatternToken", 400, "Time stamp format pattern contains an invalid token in the SQL expression."),
-    _E("EvaluatorTimestampFormatPatternDuplicateFields", 400, "Time stamp format pattern contains multiple format specifiers representing the time stamp field in the SQL..."),
+    _E("EvaluatorTimestampFormatPatternDuplicateFields", 400, "Time stamp format pattern contains multiple format specifiers representing the time stamp field in the SQL expression."),
     _E("EvaluatorUnterminatedTimestampFormatPatternToken", 400, "Time stamp format pattern contains unterminated token in the SQL expression."),
     _E("ExpressionTooLong", 400, "The SQL expression is too long: The maximum byte-length for the SQL expression is 256 KB."),
     _E("IllegalSqlFunctionArgument", 400, "Illegal argument was used in the SQL function."),
@@ -212,7 +212,7 @@ ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("InvalidPartNumber", 416, "The requested partnumber is not satisfiable"),
     _E("InvalidPrefixMarker", 400, "Invalid marker prefix combination"),
     _E("InvalidQuoteFields", 400, "The QuoteFields is invalid. Only ALWAYS and ASNEEDED are supported."),
-    _E("InvalidRequestParameter", 400, "The value of a parameter in SelectRequest element is invalid. Check the service API documentation and try a..."),
+    _E("InvalidRequestParameter", 400, "The value of a parameter in SelectRequest element is invalid. Check the service API documentation and try again."),
     _E("InvalidTableAlias", 400, "The SQL expression contains an invalid table alias."),
     _E("InvalidTextEncoding", 400, "Invalid encoding type. Only UTF-8 encoding is supported at this time."),
     _E("InvalidTokenId", 403, "The security token included in the request is invalid"),
@@ -227,7 +227,7 @@ ERRORS: dict[str, APIError] = {e.code: e for e in [
     _E("NoSuchBucketLifecycle", 404, "The bucket lifecycle configuration does not exist"),
     _E("ObjectLockConfigurationNotFoundError", 404, "Object Lock configuration does not exist for this bucket"),
     _E("ObjectSerializationConflict", 400, "The SelectRequest entity can only contain one of CSV or JSON. Check the service documentation and try again."),
-    _E("ParseAsteriskIsNotAloneInSelectList", 400, "Other expressions are not allowed in the SELECT list when '*' is used without dot notation in the SQL expre..."),
+    _E("ParseAsteriskIsNotAloneInSelectList", 400, "Other expressions are not allowed in the SELECT list when '*' is used without dot notation in the SQL expression."),
     _E("ParseCannotMixSqbAndWildcardInSelectList", 400, "Cannot mix [] and * in the same expression in a SELECT list in SQL expression."),
     _E("ParseCastArity", 400, "The SQL expression CAST has incorrect arity."),
     _E("ParseEmptySelect", 400, "The SQL expression contains an empty SELECT."),

@@ -165,7 +165,11 @@ class S3Server:
                 resp_size = (int(resp.headers.get("Content-Length", 0) or 0)
                              if resp.body_iter is not None
                              else len(resp.body or b""))
+                # Only successful requests feed the bandwidth monitor:
+                # unauthenticated probes of made-up bucket names must
+                # not mint tracking state.
                 req_bucket = ("" if path.startswith("/minio/")
+                              or resp.status >= 400
                               else path.split("/", 2)[1]
                               if path.count("/") >= 1 else "")
                 outer.metrics.observe_request(

@@ -1,0 +1,352 @@
+"""Broker event targets vs in-process fake brokers (VERDICT r3 #7).
+
+Each fake implements the SERVER side of the same wire frames the
+client emits — NATS text, Kafka Produce v0 binary, AMQP 0-9-1 — so
+the encoding is validated end to end over real sockets. The
+store-and-forward tests kill the fake mid-stream and assert every
+event survives the outage through the persisted queue store.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from minio_tpu.bucket.event_targets import (AMQPTarget, KafkaTarget,
+                                            NATSTarget)
+
+
+class _FakeBroker:
+    """Socket-server shell; subclasses implement serve_conn.
+
+    Listens on a UNIX socket: the sandbox transparently proxies
+    loopback TCP, which makes connect()-refused semantics
+    nondeterministic; the wire protocols under test are byte streams
+    either way."""
+
+    def __init__(self, path: str):
+        self.received: list[bytes] = []
+        self.path = path
+        self._srv = socket.socket(socket.AF_UNIX)
+        self._srv.bind(path)
+        self._srv.listen(8)
+        self.port = 0
+        self._dead = False
+        self._conns: list[socket.socket] = []
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(target=self._guarded_serve, args=(conn,),
+                             daemon=True).start()
+
+    def _guarded_serve(self, conn):
+        try:
+            self.serve_conn(conn)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def kill(self):
+        """Mid-stream broker crash: the listener goes away and every
+        live connection is severed — new connects fail, in-flight
+        publishes see EOF."""
+        import os
+        self._dead = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+    stop = kill
+
+    @property
+    def payloads(self) -> list[dict]:
+        return [json.loads(p) for p in self.received]
+
+
+class FakeNATS(_FakeBroker):
+    def serve_conn(self, conn):
+        if self._dead:
+            conn.sendall(b"-ERR 'server shutdown'\r\n")
+            return
+        conn.sendall(b'INFO {"server_id":"fake"}\r\n')
+        buf = b""
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                piece = conn.recv(4096)
+                if not piece:
+                    raise OSError("closed")
+                buf += piece
+            line, buf = buf.split(b"\r\n", 1)
+            return line
+
+        connect = read_line()
+        assert connect.startswith(b"CONNECT "), connect
+        json.loads(connect[8:])                   # must be valid JSON
+        conn.sendall(b"+OK\r\n")
+        while True:
+            line = read_line()
+            if self._dead:
+                conn.sendall(b"-ERR 'server shutdown'\r\n")
+                return
+            if line.startswith(b"PUB "):
+                _, subj, nbytes = line.split(b" ")
+                nbytes = int(nbytes)
+                nonloc = buf
+                while len(nonloc) < nbytes + 2:
+                    piece = conn.recv(4096)
+                    if not piece:
+                        raise OSError("closed")
+                    nonloc += piece
+                payload, buf = nonloc[:nbytes], nonloc[nbytes + 2:]
+                assert subj == b"minio.events"
+                self.received.append(payload)
+                conn.sendall(b"+OK\r\n")
+
+
+class FakeKafka(_FakeBroker):
+    def serve_conn(self, conn):
+        def read_exact(n):
+            out = b""
+            while len(out) < n:
+                piece = conn.recv(n - len(out))
+                if not piece:
+                    raise OSError("closed")
+                out += piece
+            return out
+
+        while True:
+            size = struct.unpack(">i", read_exact(4))[0]
+            req = read_exact(size)
+            api, ver, corr = struct.unpack(">hhi", req[:8])
+            assert api == 0 and ver == 0, (api, ver)
+            if self._dead:
+                # LEADER_NOT_AVAILABLE per-partition error, the broker-
+                # going-down answer
+                topic = "bucket-events"
+                resp = (struct.pack(">ii", corr, 1)
+                        + struct.pack(">h", len(topic)) + topic.encode()
+                        + struct.pack(">i", 1)
+                        + struct.pack(">ihq", 0, 5, -1))
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+                return
+            pos = 8
+            clen = struct.unpack(">h", req[pos:pos + 2])[0]
+            pos += 2 + clen
+            _acks, _timeout, n_topics = struct.unpack(
+                ">hii", req[pos:pos + 10])
+            pos += 10
+            tlen = struct.unpack(">h", req[pos:pos + 2])[0]
+            topic = req[pos + 2:pos + 2 + tlen].decode()
+            assert topic == "bucket-events"
+            pos += 2 + tlen
+            _nparts, _part, mss = struct.unpack(">iii",
+                                                req[pos:pos + 12])
+            pos += 12
+            ms = req[pos:pos + mss]
+            # MessageSet v0: offset(8) size(4) crc(4) magic attrs key val
+            crc = struct.unpack(">I", ms[12:16])[0]
+            import zlib as _z
+            assert crc == (_z.crc32(ms[16:]) & 0xFFFFFFFF), "bad CRC"
+            vlen = struct.unpack(
+                ">i", ms[16 + 2 + 4:16 + 2 + 4 + 4])[0]
+            value = ms[26:26 + vlen]
+            self.received.append(value)
+            # Produce v0 response: corr, topics[(topic,
+            # partitions[(part, err, offset)])]
+            resp = (struct.pack(">ii", corr, 1)
+                    + struct.pack(">h", tlen) + topic.encode()
+                    + struct.pack(">i", 1)
+                    + struct.pack(">ihq", 0, 0, len(self.received)))
+            conn.sendall(struct.pack(">i", len(resp)) + resp)
+
+
+class FakeAMQP(_FakeBroker):
+    FRAME_END = 0xCE
+
+    def serve_conn(self, conn):
+        def read_exact(n):
+            out = b""
+            while len(out) < n:
+                piece = conn.recv(n - len(out))
+                if not piece:
+                    raise OSError("closed")
+                out += piece
+            return out
+
+        def read_frame():
+            ftype, channel, size = struct.unpack(">BHI", read_exact(7))
+            payload = read_exact(size + 1)
+            assert payload[-1] == self.FRAME_END
+            return ftype, channel, payload[:-1]
+
+        def send_method(channel, cid, mid, args=b""):
+            payload = struct.pack(">HH", cid, mid) + args
+            conn.sendall(struct.pack(">BHI", 1, channel, len(payload))
+                         + payload + bytes([self.FRAME_END]))
+
+        assert read_exact(8) == b"AMQP\x00\x00\x09\x01"
+        if self._dead:
+            # Connection.Close (320 connection-forced) instead of Start
+            send_method(0, 10, 50, struct.pack(">H", 320)
+                        + bytes([6]) + b"forced"
+                        + struct.pack(">HH", 0, 0))
+            return
+        send_method(0, 10, 10, struct.pack(">BB", 0, 9)
+                    + struct.pack(">I", 0)
+                    + struct.pack(">I", 5) + b"PLAIN"
+                    + struct.pack(">I", 5) + b"en_US")
+        ftype, _, p = read_frame()                 # StartOk
+        assert (ftype, struct.unpack(">HH", p[:4])) == (1, (10, 11))
+        send_method(0, 10, 30, struct.pack(">HIH", 0, 131072, 0))
+        ftype, _, p = read_frame()                 # TuneOk
+        assert struct.unpack(">HH", p[:4]) == (10, 31)
+        ftype, _, p = read_frame()                 # Connection.Open
+        assert struct.unpack(">HH", p[:4]) == (10, 40)
+        send_method(0, 10, 41, b"\x00")
+        ftype, _, p = read_frame()                 # Channel.Open
+        assert struct.unpack(">HH", p[:4]) == (20, 10)
+        send_method(1, 20, 11, struct.pack(">I", 0))
+        ftype, _, p = read_frame()                 # Confirm.Select
+        assert struct.unpack(">HH", p[:4]) == (85, 10)
+        send_method(1, 85, 11)
+        delivery = 0
+        while True:
+            ftype, ch, p = read_frame()            # Basic.Publish
+            if self._dead:
+                send_method(0, 10, 50, struct.pack(">H", 320)
+                            + bytes([6]) + b"forced"
+                            + struct.pack(">HH", 0, 0))
+                return
+            assert struct.unpack(">HH", p[:4]) == (60, 40)
+            # exchange + routing key ride the method args
+            pos = 6
+            elen = p[pos]
+            exchange = p[pos + 1:pos + 1 + elen].decode()
+            pos += 1 + elen
+            rlen = p[pos]
+            rkey = p[pos + 1:pos + 1 + rlen].decode()
+            assert (exchange, rkey) == ("minio", "bucket.events")
+            ftype, _, hdr = read_frame()           # content header
+            assert ftype == 2
+            body_size = struct.unpack(">Q", hdr[4:12])[0]
+            got = b""
+            while len(got) < body_size:
+                ftype, _, frag = read_frame()
+                assert ftype == 3
+                got += frag
+            self.received.append(got)
+            delivery += 1
+            send_method(1, 60, 80,
+                        struct.pack(">QB", delivery, 0))  # Basic.Ack
+
+
+EVENT = {"eventName": "s3:ObjectCreated:Put", "s3": {
+    "bucket": {"name": "b"}, "object": {"key": "k", "size": 3}}}
+
+
+def _mk(kind, path, tmp_path):
+    store = str(tmp_path / f"{kind}-store")
+    if kind == "nats":
+        return NATSTarget("arn:nats", path, 0, "minio.events",
+                          store_dir=store, timeout=2.0)
+    if kind == "kafka":
+        return KafkaTarget("arn:kafka", path, 0,
+                           "bucket-events", store_dir=store, timeout=2.0)
+    return AMQPTarget("arn:amqp", path, 0, "minio",
+                      "bucket.events", store_dir=store, timeout=2.0)
+
+
+@pytest.mark.parametrize("kind,broker_cls", [
+    ("nats", FakeNATS), ("kafka", FakeKafka), ("amqp", FakeAMQP)])
+class TestBrokerTargets:
+    def test_publish_over_the_wire(self, kind, broker_cls, tmp_path):
+        path = str(tmp_path / f"{kind}.sock")
+        broker = broker_cls(path)
+        tgt = _mk(kind, path, tmp_path)
+        try:
+            for i in range(3):
+                ev = dict(EVENT)
+                ev["i"] = i
+                tgt.send(ev)
+            deadline = time.monotonic() + 5
+            while len(broker.received) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert len(broker.received) == 3
+            recs = [p["Records"][0] for p in broker.payloads]
+            assert [r["i"] for r in recs] == [0, 1, 2]
+            assert recs[0]["eventName"] == "s3:ObjectCreated:Put"
+            assert tgt.backlog.events == []
+        finally:
+            tgt.close()
+            broker.stop()
+
+    def test_store_and_forward_across_broker_death(self, kind,
+                                                   broker_cls, tmp_path):
+        """Kill the broker mid-stream: events park in the persisted
+        queue store, a new broker drains them, nothing is lost."""
+        path = str(tmp_path / f"{kind}.sock")
+        broker = broker_cls(path)
+        tgt = _mk(kind, path, tmp_path)
+        try:
+            tgt.send({**EVENT, "i": 0})
+            assert len(broker.received) == 1
+            broker.stop()
+            time.sleep(0.05)
+            for i in (1, 2):
+                tgt.send({**EVENT, "i": i})       # broker is DOWN
+            assert len(tgt.backlog.events) == 2
+            # the park is persisted: a process-restart analogue
+            from minio_tpu.bucket.notify import QueueTarget
+            reloaded = QueueTarget(tgt.backlog.arn,
+                                   tgt.backlog.store_dir)
+            assert len(reloaded.events) == 2
+
+            # a retry while the broker is still down re-parks, loses
+            # nothing
+            assert tgt.retry_backlog() == 0
+            assert len(tgt.backlog.events) == 2
+
+            # broker restarts on the SAME endpoint
+            broker2 = broker_cls(path)
+            sent = tgt.retry_backlog()
+            assert sent == 2, sent
+            assert tgt.backlog.events == []
+            got = sorted(json.loads(p)["Records"][0]["i"]
+                         for p in broker2.received)
+            assert got == [1, 2], got
+            broker2.stop()
+        finally:
+            tgt.close()
+            broker.stop()
