@@ -38,8 +38,11 @@ class S3Server:
                  replication=None, scanner=None, kms=None,
                  compress_enabled: bool = False, tier_mgr=None,
                  oidc=None, certs: tuple[str, str] | None = None,
-                 rpc_router=None, site_replicator=None):
+                 rpc_router=None, site_replicator=None,
+                 ldap=None, client_ca: str | None = None):
         self.oidc = oidc                   # iam.oidc.OpenIDConfig | None
+        self.ldap = ldap                   # iam.ldap.LDAPConfig | None
+        self.client_ca = client_ca         # CA bundle for mTLS STS
         self.site_replicator = site_replicator   # SiteReplicator | None
         self.pools = pools
         self.creds = creds                 # root credentials (policy bypass)
@@ -242,6 +245,12 @@ class S3Server:
             cert_file, key_file = certs
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(cert_file, key_file)
+            if client_ca:
+                # mTLS for AssumeRoleWithCertificate: clients MAY
+                # present a certificate; those that do are verified
+                # against this CA and their CN names their policy.
+                ctx.load_verify_locations(client_ca)
+                ctx.verify_mode = ssl.CERT_OPTIONAL
             self._httpd.ssl_context = ctx
         self.port = self._httpd.server_port
         self.host = host
@@ -1010,7 +1019,8 @@ class S3Server:
 
         if not bucket:
             if method == "POST":
-                return self._handle_sts(access_key, headers, body)
+                return self._handle_sts(access_key, headers, body,
+                                        req=req)
             if method == "GET":
                 self._authorize(access_key, method, "", "", query,
                                 req.client_address[0])
@@ -1034,7 +1044,7 @@ class S3Server:
     # -- STS (cf. cmd/sts-handlers.go:99 AssumeRole) -------------------------
 
     def _handle_sts(self, access_key: str, headers: dict,
-                    body: bytes) -> Response:
+                    body: bytes, req=None) -> Response:
         import json
         import urllib.parse as up
         import xml.etree.ElementTree as ET
@@ -1050,6 +1060,10 @@ class S3Server:
             return self._handle_sts_web_identity(
                 form, token_field="Token",
                 action_name="AssumeRoleWithClientGrants")
+        if action == "AssumeRoleWithLDAPIdentity":
+            return self._handle_sts_ldap(form)
+        if action == "AssumeRoleWithCertificate":
+            return self._handle_sts_certificate(form, req)
         if action != "AssumeRole":
             raise S3Error("NotImplemented", "unknown STS action")
         if self.iam is None:
@@ -1144,6 +1158,88 @@ class S3Server:
                           "DurationSeconds must be an integer") from None
         ident = self.iam.assume_role(parent, duration)
         return self._sts_credentials_xml(action_name, ident)
+
+    def _handle_sts_ldap(self, form: dict) -> Response:
+        """AssumeRoleWithLDAPIdentity: directory-authenticated STS
+        (cf. cmd/sts-handlers.go LDAP flow + internal/config/identity/
+        ldap). The LDAP client binds as the user — the directory is
+        the credential check — and the user's groups map to IAM
+        policies."""
+        from ..iam.iam import Identity
+        from ..iam.ldap import LDAPError
+        if self.iam is None or self.ldap is None:
+            raise S3Error("NotImplemented", "LDAP is not configured")
+        username = form.get("LDAPUsername", [""])[0]
+        password = form.get("LDAPPassword", [""])[0]
+        if not username or not password:
+            raise S3Error("InvalidArgument",
+                          "LDAPUsername and LDAPPassword required")
+        try:
+            user_dn, policies = self.ldap.authenticate(username, password)
+        except LDAPError as e:
+            raise S3Error("AccessDenied",
+                          f"LDAP authentication failed: {e}") from None
+        except OSError as e:
+            # directory unreachable: an operational condition, not a
+            # handler crash
+            raise S3Error("ServiceUnavailable",
+                          f"LDAP directory unreachable: {e}") from None
+        if not policies:
+            raise S3Error("AccessDenied",
+                          "LDAP identity grants no policies")
+        parent = Identity(access_key=f"ldap:{user_dn}", secret_key="",
+                          kind="user", policies=policies)
+        try:
+            duration = int(form.get("DurationSeconds", ["3600"])[0])
+        except ValueError:
+            raise S3Error("InvalidArgument",
+                          "DurationSeconds must be an integer") from None
+        ident = self.iam.assume_role(parent, duration)
+        return self._sts_credentials_xml("AssumeRoleWithLDAPIdentity",
+                                         ident)
+
+    def _handle_sts_certificate(self, form: dict, req) -> Response:
+        """AssumeRoleWithCertificate: mTLS-authenticated STS
+        (cf. cmd/sts-handlers.go:115 + internal/config/identity/tls).
+        The TLS layer already verified the client certificate against
+        the configured CA (client_ca); per the reference's convention
+        the certificate's CN names the IAM policy the credentials
+        carry."""
+        from ..iam.iam import Identity
+        if self.iam is None:
+            raise S3Error("NotImplemented", "IAM is not enabled")
+        cert = None
+        if req is not None:
+            getpeer = getattr(req.connection, "getpeercert", None)
+            if getpeer is not None:
+                cert = getpeer()
+        if not cert:
+            raise S3Error("AccessDenied",
+                          "a verified TLS client certificate is required")
+        cn = ""
+        for rdn in cert.get("subject", ()):
+            for key, val in rdn:
+                if key == "commonName":
+                    cn = val
+        if not cn:
+            raise S3Error("AccessDenied", "client certificate has no CN")
+        # Fail loudly at STS time when the CN names no policy —
+        # zero-permission credentials would surface as baffling
+        # downstream denials (the LDAP flow enforces the same).
+        if cn not in self.iam.list_policies():
+            raise S3Error("AccessDenied",
+                          f"no IAM policy named {cn!r} for this "
+                          "certificate")
+        parent = Identity(access_key=f"tls:{cn}", secret_key="",
+                          kind="user", policies=[cn])
+        try:
+            duration = int(form.get("DurationSeconds", ["3600"])[0])
+        except ValueError:
+            raise S3Error("InvalidArgument",
+                          "DurationSeconds must be an integer") from None
+        ident = self.iam.assume_role(parent, duration)
+        return self._sts_credentials_xml("AssumeRoleWithCertificate",
+                                         ident)
 
     def _handle_post_upload(self, bucket: str, content_type: str,
                             body: bytes) -> Response:

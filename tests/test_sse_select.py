@@ -552,3 +552,111 @@ class TestParquetSelect:
                          config={"type": "fs", "path": str(tmp_path)})
         tm4 = TierManager(pools, kms=kms)
         assert "REMOTE" in tm4.list_tiers()
+
+
+class TestSelectR4:
+    """VERDICT r3 #9: JSON paths, CAST, scalar + date functions."""
+
+    def _q(self, sql, records):
+        from minio_tpu.s3select.sql import parse, run_query
+        return run_query(parse(sql), records)
+
+    def test_json_path_expressions(self):
+        recs = [{"a": {"b": [{"c": 1}, {"c": 2}, {"c": 3}]},
+                 "name": "row1"},
+                {"a": {"b": [{"c": 9}]}, "name": "row2"}]
+        out = self._q("SELECT s.a.b[1].c AS v FROM S3Object s", recs)
+        assert [r["v"] for r in out] == [2, None]
+        out = self._q(
+            "SELECT s.name FROM S3Object s WHERE s.a.b[0].c = 9", recs)
+        assert [r["name"] for r in out] == ["row2"]
+        # missing path -> NULL, IS NULL works on it
+        out = self._q("SELECT s.name FROM S3Object s "
+                      "WHERE s.a.missing IS NULL", recs)
+        assert len(out) == 2
+
+    def test_cast(self):
+        recs = [{"n": "42", "f": "2.5", "b": "true", "s": 7}]
+        out = self._q(
+            "SELECT CAST(n AS int) AS i, CAST(f AS float) AS x, "
+            "CAST(b AS bool) AS t, CAST(s AS string) AS st "
+            "FROM S3Object", recs)
+        assert out[0] == {"i": 42, "x": 2.5, "t": True, "st": "7"}
+        from minio_tpu.s3select.sql import SQLError
+        import pytest as _p
+        with _p.raises(SQLError):
+            self._q("SELECT CAST(n AS int) FROM S3Object",
+                    [{"n": "not-a-number"}])
+
+    def test_string_functions(self):
+        recs = [{"s": "  Hello World  "}]
+        out = self._q(
+            "SELECT LOWER(s) AS lo, UPPER(s) AS up, TRIM(s) AS t, "
+            "CHAR_LENGTH(TRIM(s)) AS n, "
+            "SUBSTRING(TRIM(s), 1, 5) AS sub, "
+            "SUBSTRING(TRIM(s) FROM 7) AS tail "
+            "FROM S3Object", recs)
+        r = out[0]
+        assert r["lo"].strip() == "hello world"
+        assert r["t"] == "Hello World"
+        assert r["n"] == 11
+        assert r["sub"] == "Hello"
+        assert r["tail"] == "World"
+        out = self._q("SELECT TRIM(LEADING 'x' FROM v) AS t "
+                      "FROM S3Object", [{"v": "xxabcxx"}])
+        assert out[0]["t"] == "abcxx"
+        out = self._q("SELECT COALESCE(a, b, 'dflt') AS c, "
+                      "NULLIF(x, 5) AS nf FROM S3Object",
+                      [{"b": "bee", "x": 5}])
+        assert out[0] == {"c": "bee", "nf": None}
+
+    def test_date_functions(self):
+        recs = [{"ts": "2024-03-15T10:30:00Z"}]
+        out = self._q(
+            "SELECT EXTRACT(year FROM TO_TIMESTAMP(ts)) AS y, "
+            "EXTRACT(month FROM TO_TIMESTAMP(ts)) AS m, "
+            "EXTRACT(day FROM TO_TIMESTAMP(ts)) AS d, "
+            "EXTRACT(hour FROM TO_TIMESTAMP(ts)) AS h "
+            "FROM S3Object", recs)
+        assert out[0] == {"y": 2024, "m": 3, "d": 15, "h": 10}
+        out = self._q(
+            "SELECT DATE_ADD(month, 2, TO_TIMESTAMP(ts)) AS plus "
+            "FROM S3Object", recs)
+        assert out[0]["plus"].month == 5
+        out = self._q(
+            "SELECT DATE_DIFF(day, TO_TIMESTAMP(a), TO_TIMESTAMP(b)) "
+            "AS dd FROM S3Object",
+            [{"a": "2024-01-01T00:00:00Z", "b": "2024-01-31T00:00:00Z"}])
+        assert out[0]["dd"] == 30
+        # WHERE on extracted parts
+        recs = [{"ts": "2023-06-01T00:00:00Z", "v": 1},
+                {"ts": "2024-06-01T00:00:00Z", "v": 2}]
+        out = self._q("SELECT v FROM S3Object WHERE "
+                      "EXTRACT(year FROM TO_TIMESTAMP(ts)) = 2024", recs)
+        assert [r["v"] for r in out] == [2]
+
+    def test_docs_reference_query(self):
+        # the documented query from /root/reference/docs/select/select.py
+        recs = [{"Location": "Seattle, United States"},
+                {"Location": "Paris, France"}]
+        out = self._q("select * from s3object s "
+                      "where s.Location like '%United States%'", recs)
+        assert len(out) == 1 and "United States" in out[0]["Location"]
+
+    def test_end_to_end_json_input(self):
+        from minio_tpu.s3select.engine import execute_select
+        import json as _json
+        data = b"\n".join(
+            _json.dumps({"user": {"name": f"u{i}",
+                                  "tags": ["a", "b", f"t{i}"]},
+                         "n": i}).encode()
+            for i in range(5))
+        opts = {"expression": "SELECT s.user.tags[2] AS tag FROM "
+                              "S3Object s WHERE CAST(s.n AS int) >= 3",
+                "input": "json", "output": "json",
+                "header": False, "delimiter": ",",
+                "out_delimiter": ","}
+        out = execute_select(data, opts)
+        # out is the framed event-stream body; check payload content
+        assert b'"tag": "t3"' in out and b'"tag": "t4"' in out, out
+        assert b'"t2"' not in out
