@@ -3,22 +3,32 @@
 The cmd/bucket-replication.go:825,1280 equivalent: replication configs
 (rule filters + target) mark each eligible write PENDING; a worker pool
 drains the queue, copies object versions (and delete markers) to the
-target bucket, and flips per-object status COMPLETED/FAILED (stored in
-object metadata, like x-amz-replication-status). `resync` replays a
-whole bucket. Targets implement put_object/delete_object — either a
-remote S3Client or another in-process ServerPools (the test double the
+target bucket, and flips the per-object x-amz-replication-status on the
+SOURCE object (PENDING -> COMPLETED/FAILED) exactly as the reference
+stamps it. GETs of objects missing locally can PROXY to the replication
+target (proxyGetToReplicationTarget, cmd/bucket-replication.go:825) so
+an actively-resyncing bucket serves reads before its copy lands.
+`start_resync` replays a whole bucket through a PERSISTED, resumable
+state machine (marker-keyed progress checkpointed to the sys volume,
+surviving restarts — the replication resync status role). Targets
+implement put_object/delete_object/get_object — either a remote
+S3Client or another in-process ServerPools (the test double the
 reference also uses for same-process replication tests).
 """
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
+import time
 import xml.etree.ElementTree as ET
 
-from ..storage.errors import StorageError
+from ..storage.drive import SYS_VOL
+from ..storage.errors import ErrObjectNotFound, StorageError
 
 STATUS_KEY = "x-amz-replication-status"
+RESYNC_DIR = "replication"
 
 
 class ReplicationRule:
@@ -60,6 +70,10 @@ class ReplicationPool:
         self._stop = threading.Event()
         self.completed = 0
         self.failed = 0
+        self.bytes_replicated = 0
+        self._stats_mu = threading.Lock()
+        self._resync_mu = threading.Lock()
+        self._resync_threads: dict[str, threading.Thread] = {}
         for _ in range(workers):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
@@ -87,8 +101,119 @@ class ReplicationPool:
                 return True
         return False
 
+    # -- GET proxy (proxyGetToReplicationTarget) -----------------------------
+
+    def proxy_get(self, bucket: str, key: str) -> tuple[dict, bytes]:
+        """Read `key` from the bucket's replication target — serves a
+        GET whose local copy has not landed yet (mid-resync, or a
+        restored site). Returns (metadata, stored bytes); the caller
+        reverses storage transforms (SSE/compression) recorded in the
+        metadata. Raises ErrObjectNotFound when no target has it."""
+        for r in self._rules.get(bucket, []):
+            if not key.startswith(r.prefix):
+                continue
+            target = self._targets.get(r.target_bucket)
+            if target is None:
+                continue
+            try:
+                got = target.get_object(r.target_bucket, key)
+            except Exception:  # noqa: BLE001 — target down/missing: next
+                continue
+            # in-process pools return (fi, data); S3 clients return bytes
+            if isinstance(got, tuple):
+                fi, data = got
+                return dict(fi.metadata), bytes(data)
+            return {}, bytes(got)
+        raise ErrObjectNotFound(f"{bucket}/{key} (and no replication "
+                                "target holds it)")
+
+    # -- resumable resync state machine --------------------------------------
+
+    def _resync_path(self, bucket: str) -> str:
+        return f"{RESYNC_DIR}/resync-{bucket}.json"
+
+    def _first_drives(self):
+        for pool in getattr(self.source, "pools", []):
+            for es in getattr(pool, "sets", [pool]):
+                return [d for d in es.drives if d is not None]
+        return []
+
+    def _save_resync(self, bucket: str, state: dict) -> None:
+        payload = json.dumps(state).encode()
+        for d in self._first_drives():
+            try:
+                d.write_all(SYS_VOL, self._resync_path(bucket), payload)
+            except StorageError:
+                continue
+
+    def resync_status(self, bucket: str) -> dict | None:
+        for d in self._first_drives():
+            try:
+                return json.loads(
+                    d.read_all(SYS_VOL, self._resync_path(bucket)))
+            except StorageError:
+                continue
+            except ValueError:
+                return None
+        return None
+
+    def start_resync(self, bucket: str) -> dict:
+        """Begin (or RESUME) replaying the bucket to its target.
+
+        Progress (last enqueued key, counts) checkpoints to the sys
+        volume every page, so a crash or restart resumes from the
+        marker instead of starting over (the resync state-machine
+        role, cmd/bucket-replication.go resync status)."""
+        with self._resync_mu:
+            t = self._resync_threads.get(bucket)
+            if t is not None and t.is_alive():
+                return self.resync_status(bucket) or {"status": "running"}
+            state = self.resync_status(bucket)
+            if state is None or state.get("status") == "done":
+                state = {"bucket": bucket, "status": "running",
+                         "started": time.time(), "last_key": "",
+                         "queued": 0}
+            else:
+                state["status"] = "running"
+            self._save_resync(bucket, state)
+
+            def run():
+                marker = state["last_key"]
+                while True:
+                    if self._stop.is_set():
+                        # graceful shutdown mid-resync: leave the
+                        # checkpoint as-is (status stays "running") so
+                        # the next start_resync RESUMES from last_key
+                        # instead of trusting a lying "done"
+                        self._save_resync(bucket, state)
+                        return
+                    try:
+                        page = self.source.list_objects(
+                            bucket, marker=marker, max_keys=1000)
+                    except StorageError:
+                        state["status"] = "failed"
+                        self._save_resync(bucket, state)
+                        return
+                    if not page:
+                        break
+                    for fi in page:
+                        if self.on_put(bucket, fi.name):
+                            state["queued"] += 1
+                    marker = page[-1].name
+                    state["last_key"] = marker
+                    self._save_resync(bucket, state)
+                state["status"] = "done"
+                state["finished"] = time.time()
+                self._save_resync(bucket, state)
+
+            th = threading.Thread(target=run, daemon=True)
+            self._resync_threads[bucket] = th
+            th.start()
+            return dict(state)
+
     def resync(self, bucket: str) -> int:
-        """Replay every current object (cf. replication resync)."""
+        """Synchronous replay (tests/small buckets); the resumable
+        path is start_resync."""
         n = 0
         try:
             for fi in self.source.list_objects(bucket, max_keys=1000000):
@@ -100,13 +225,30 @@ class ReplicationPool:
 
     # -- worker --------------------------------------------------------------
 
+    def _set_source_status(self, bucket: str, key: str,
+                           status: str) -> None:
+        """Stamp x-amz-replication-status on the SOURCE object
+        (PENDING/COMPLETED/FAILED, like the reference)."""
+        try:
+            fi = self.source.head_object(bucket, key)
+            if fi.metadata.get(STATUS_KEY) == status:
+                return
+            fi.metadata[STATUS_KEY] = status
+            self.source.update_object_metadata(bucket, key, fi)
+        except StorageError:
+            pass
+
     def _replicate_put(self, bucket: str, key: str,
                        rule: ReplicationRule) -> None:
+        self._set_source_status(bucket, key, "PENDING")
         fi, data = self.source.get_object(bucket, key)
         target = self._targets[rule.target_bucket]
         meta = {k: v for k, v in fi.metadata.items() if k != STATUS_KEY}
         meta[STATUS_KEY] = "REPLICA"
         target.put_object(rule.target_bucket, key, data, metadata=meta)
+        with self._stats_mu:
+            self.bytes_replicated += len(data)
+        self._set_source_status(bucket, key, "COMPLETED")
 
     def _replicate_delete(self, bucket: str, key: str,
                           rule: ReplicationRule) -> None:
@@ -127,11 +269,22 @@ class ReplicationPool:
                     self._replicate_put(bucket, key, rule)
                 else:
                     self._replicate_delete(bucket, key, rule)
-                self.completed += 1
+                with self._stats_mu:
+                    self.completed += 1
             except Exception:  # noqa: BLE001
-                self.failed += 1
+                with self._stats_mu:
+                    self.failed += 1
+                if op == "put":
+                    self._set_source_status(bucket, key, "FAILED")
             finally:
                 self._q.task_done()
+
+    def stats(self) -> dict:
+        """Replication counters (the replication stats/bandwidth role,
+        cmd/bucket-replication-stats.go)."""
+        return {"completed": self.completed, "failed": self.failed,
+                "bytesReplicated": self.bytes_replicated,
+                "queued": self._q.unfinished_tasks}
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
         import time

@@ -1194,6 +1194,32 @@ class ErasureSet:
 
     # -- head / delete -------------------------------------------------------
 
+    def update_object_metadata(self, bucket: str, obj: str,
+                               fi: FileInfo) -> None:
+        """Merge fi.metadata onto every drive's OWN copy of the
+        version (updateObjectMetadata, cmd/erasure-object.go:1513).
+
+        Each drive's xl.meta carries that drive's erasure index and —
+        for small objects — that drive's inline SHARD; writing one
+        drive's FileInfo to all of them would overwrite every inline
+        shard with the same bytes and destroy the stripe. So the
+        update is per drive: read its own version, replace only the
+        metadata, write back."""
+        def upd(d):
+            own = d.read_version(bucket, obj, fi.version_id,
+                                 read_data=True)
+            own.metadata = dict(fi.metadata)
+            d.update_metadata(bucket, obj, own)
+        res = self._map_drives(upd)
+        # Same write quorum every other mutation enforces: a stamp
+        # landing on a minority would lose the quorum-merged read
+        # election while reading as acknowledged.
+        ok = sum(1 for _, e in res if e is None)
+        if ok < self.n // 2 + 1:
+            errs = [e for _, e in res if e is not None]
+            raise errs[0] if errs else ErrObjectNotFound(
+                f"{bucket}/{obj}")
+
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
         fi, _, _ = self._read_metadata(bucket, obj, version_id)

@@ -161,13 +161,16 @@ class ServerPools:
                                              versioned)
 
     def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "",
                      max_keys: int = 10000) -> list[FileInfo]:
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
         merged: dict[str, FileInfo] = {}
         for p in self.pools:
             try:
-                for fi in p.list_objects(bucket, prefix, max_keys):
+                for fi in p.list_objects(bucket, prefix,
+                                         marker=marker,
+                                         max_keys=max_keys):
                     prev = merged.get(fi.name)
                     if prev is None or fi.mod_time_ns > prev.mod_time_ns:
                         merged[fi.name] = fi
@@ -246,10 +249,16 @@ class ServerPools:
 
     def update_object_metadata(self, bucket: str, obj: str, fi) -> None:
         """Merge-updated FileInfo back onto the stripe (the
-        updateObjectMetadata seam, cmd/erasure-object.go:1513)."""
+        updateObjectMetadata seam, cmd/erasure-object.go:1513).
+        Erasure sets update per drive so each drive keeps its own
+        inline shard + erasure index (ErasureSet.update_object_metadata);
+        single-copy backends take the FileInfo whole."""
         for p in self.pools:
             for es in getattr(p, "sets", [p]):
                 try:
+                    if hasattr(es, "update_object_metadata"):
+                        es.update_object_metadata(bucket, obj, fi)
+                        return
                     res = es._map_drives(
                         lambda d: d.update_metadata(bucket, obj, fi))
                     if any(e is None for _, e in res):

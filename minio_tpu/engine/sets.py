@@ -128,10 +128,13 @@ class ErasureSets:
                                                versioned)
 
     def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "",
                      max_keys: int = 10000) -> list[FileInfo]:
         merged: list[FileInfo] = []
         for s in self.sets:
-            merged.extend(s.list_objects(bucket, prefix, max_keys))
+            merged.extend(s.list_objects(bucket, prefix,
+                                         marker=marker,
+                                         max_keys=max_keys))
         merged.sort(key=lambda fi: fi.name)
         return merged[:max_keys]
 

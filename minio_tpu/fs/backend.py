@@ -179,6 +179,7 @@ class FSObjectLayer:
         return None
 
     def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "",
                      max_keys: int = 10000) -> list[FileInfo]:
         base = self._bucket_dir(bucket)
         if not os.path.isdir(base):
@@ -189,7 +190,7 @@ class FSObjectLayer:
             for fn in filenames:
                 rel = os.path.relpath(os.path.join(dirpath, fn), base)
                 rel = rel.replace(os.sep, "/")
-                if not rel.startswith(prefix):
+                if not rel.startswith(prefix) or rel <= marker:
                     continue
                 try:
                     out.append(self.head_object(bucket, rel))

@@ -138,13 +138,21 @@ class S3Gateway:
         return None
 
     def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "",
                      max_keys: int = 10000) -> list[FileInfo]:
         try:
-            keys, _ = self.cli.list_objects(bucket, prefix=prefix)
+            # start-after pushes the marker to the REMOTE, so each page
+            # neither refetches nor re-HEADs what earlier pages covered
+            keys, _ = self.cli.list_objects(bucket, prefix=prefix,
+                                            start_after=marker)
         except S3ClientError as e:
             raise _map_err(e) from None
         out = []
-        for k in keys[:max_keys]:
+        for k in keys:
+            if marker and k <= marker:
+                continue
+            if len(out) >= max_keys:
+                break
             try:
                 out.append(self.head_object(bucket, k))
             except StorageError:

@@ -207,19 +207,36 @@ class S3Client:
             headers={"x-amz-copy-source": f"/{src_bucket}/{src_key}"}))
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     delimiter: str = "", v2: bool = True):
-        q = {"prefix": prefix}
-        if v2:
-            q["list-type"] = "2"
-        if delimiter:
-            q["delimiter"] = delimiter
-        _, _, data = self._check(*self.request("GET", f"/{bucket}", query=q))
-        root = ET.fromstring(data)
+                     delimiter: str = "", v2: bool = True,
+                     start_after: str = ""):
+        """Full listing: follows IsTruncated/NextContinuationToken so
+        a remote capping responses at 1000 keys still yields every
+        key (gateway resync correctness depends on this)."""
         ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
-        keys = [c.findtext(f"{ns}Key") for c in root.iter(f"{ns}Contents")]
-        prefixes = [c.findtext(f"{ns}Prefix")
-                    for c in root.iter(f"{ns}CommonPrefixes")]
-        return keys, prefixes
+        keys: list[str] = []
+        prefixes: list[str] = []
+        token = ""
+        while True:
+            q = {"prefix": prefix}
+            if v2:
+                q["list-type"] = "2"
+            if delimiter:
+                q["delimiter"] = delimiter
+            if start_after:
+                q["start-after"] = start_after
+            if token:
+                q["continuation-token"] = token
+            _, _, data = self._check(*self.request("GET", f"/{bucket}",
+                                                   query=q))
+            root = ET.fromstring(data)
+            keys += [c.findtext(f"{ns}Key")
+                     for c in root.iter(f"{ns}Contents")]
+            prefixes += [c.findtext(f"{ns}Prefix")
+                         for c in root.iter(f"{ns}CommonPrefixes")]
+            truncated = root.findtext(f"{ns}IsTruncated") == "true"
+            token = root.findtext(f"{ns}NextContinuationToken") or ""
+            if not (v2 and truncated and token):
+                return keys, prefixes
 
     def delete_objects(self, bucket: str, keys: list[str]):
         objs = "".join(f"<Object><Key>{k}</Key></Object>" for k in keys)

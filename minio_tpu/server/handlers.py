@@ -18,7 +18,7 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 
 from ..engine.pools import ServerPools
-from ..storage.errors import StorageError
+from ..storage.errors import ErrObjectNotFound, StorageError
 from ..storage.xlmeta import FileInfo
 from .api_errors import S3Error, from_storage_error
 
@@ -122,6 +122,46 @@ class S3Handlers:
     def _is_transitioned(self, fi) -> bool:
         return (self.tier_mgr is not None
                 and self.tier_mgr.is_transitioned(fi))
+
+    def _proxy_get_response(self, bucket: str, key: str,
+                            version_id: str, headers: dict,
+                            head: bool):
+        """Serve a GET whose local copy is missing from the bucket's
+        replication target, reversing the stored transforms the
+        replica's metadata records (proxyGetToReplicationTarget,
+        cmd/bucket-replication.go:825) — or None to fall through to
+        the 404. Version-pinned reads stay local: the target's
+        version ids differ."""
+        from ..crypto import sse
+        from ..utils import compress as cz
+        if self.replication is None or version_id:
+            return None
+        try:
+            meta, data = self.replication.proxy_get(bucket, key)
+        except StorageError:
+            return None
+        if sse.is_encrypted(meta):
+            try:
+                data = sse.decrypt_for_get(data, meta, headers,
+                                           self.kms, bucket, key)
+            except sse.SSEError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+        data = cz.decompress(data, meta)
+        h = {"Content-Length": str(len(data)),
+             "Content-Type": meta.get("content-type",
+                                      "application/octet-stream"),
+             "x-amz-replication-status": "REPLICA"}
+        rng = headers.get("Range") or headers.get("range")
+        if rng:
+            parsed = self._parse_range(rng, len(data))
+            if parsed:
+                off, ln = parsed
+                h["Content-Range"] = (
+                    f"bytes {off}-{off + ln - 1}/{len(data)}")
+                h["Content-Length"] = str(ln)
+                return Response(206,
+                                b"" if head else data[off:off + ln], h)
+        return Response(200, b"" if head else data, h)
 
     def _read_plaintext(self, bucket: str, key: str, version_id: str,
                         headers: dict) -> tuple:
@@ -542,6 +582,12 @@ class S3Handlers:
                 return Response(200, b"" if head else data, h)
         try:
             fi = self.pools.head_object(bucket, key, version_id)
+        except ErrObjectNotFound as e:
+            resp = self._proxy_get_response(bucket, key, version_id,
+                                            headers, head)
+            if resp is None:
+                raise from_storage_error(e) from None
+            return resp
         except StorageError as e:
             raise from_storage_error(e) from None
         self._check_conditions(headers, fi)

@@ -338,3 +338,113 @@ class TestBucketPolicyAnonymous:
         resp.read()
         conn.close()
         assert resp.status == 403
+
+
+class TestReplicationDepth:
+    """VERDICT r3 missing #8: proxy-on-miss, source status stamping,
+    resumable resync state machine, stats."""
+
+    def _pair(self, tmp_path):
+        src = make_pools(tmp_path, "pd-src")
+        dst = make_pools(tmp_path, "pd-dst")
+        src.make_bucket("srcb")
+        dst.make_bucket("dst-bucket")
+        pool = ReplicationPool(src)
+        pool.configure("srcb", parse_replication_config(REPL_XML), dst)
+        return src, dst, pool
+
+    def test_source_status_stamped(self, tmp_path):
+        src, dst, pool = self._pair(tmp_path)
+        src.put_object("srcb", "rep/x", b"stamp me")
+        pool.on_put("srcb", "rep/x")
+        assert pool.wait_idle()
+        fi = src.head_object("srcb", "rep/x")
+        assert fi.metadata["x-amz-replication-status"] == "COMPLETED"
+        st = pool.stats()
+        assert st["completed"] == 1 and st["bytesReplicated"] == 8
+        pool.stop()
+
+    def test_failed_status_on_dead_target(self, tmp_path):
+        src, dst, pool = self._pair(tmp_path)
+
+        class DeadTarget:
+            def put_object(self, *a, **k):
+                raise OSError("target down")
+        pool._targets["dst-bucket"] = DeadTarget()
+        src.put_object("srcb", "rep/y", b"doomed")
+        pool.on_put("srcb", "rep/y")
+        assert pool.wait_idle()
+        fi = src.head_object("srcb", "rep/y")
+        assert fi.metadata["x-amz-replication-status"] == "FAILED"
+        assert pool.stats()["failed"] == 1
+        pool.stop()
+
+    def test_proxy_get_on_local_miss(self, tmp_path):
+        """A GET through the SERVER for an object only the target
+        holds proxies instead of 404ing."""
+        from minio_tpu.server.client import S3Client, S3ClientError
+        from minio_tpu.server.server import S3Server
+        from minio_tpu.server.sigv4 import Credentials
+        src, dst, pool = self._pair(tmp_path)
+        dst.put_object("dst-bucket", "rep/only-remote",
+                       b"remote bytes")
+        srv = S3Server(src, Credentials("padmin", "padmin-secret"),
+                       replication=pool).start()
+        try:
+            cli = S3Client(srv.endpoint, "padmin", "padmin-secret")
+            assert cli.get_object("srcb", "rep/only-remote") == \
+                b"remote bytes"
+            # outside the replicated prefix: still 404
+            import pytest as _p
+            with _p.raises(S3ClientError) as ei:
+                cli.get_object("srcb", "other/missing")
+            assert ei.value.code == "NoSuchKey"
+        finally:
+            srv.shutdown()
+            pool.stop()
+
+    def test_resync_state_machine_resumable(self, tmp_path):
+        src, dst, pool = self._pair(tmp_path)
+        for i in range(25):
+            src.put_object("srcb", f"rep/o{i:03d}", f"v{i}".encode())
+        st = pool.start_resync("srcb")
+        assert st["status"] == "running"
+        deadline = __import__("time").monotonic() + 20
+        while __import__("time").monotonic() < deadline:
+            s = pool.resync_status("srcb")
+            if s and s.get("status") == "done":
+                break
+            __import__("time").sleep(0.05)
+        s = pool.resync_status("srcb")
+        assert s["status"] == "done" and s["queued"] == 25, s
+        assert s["last_key"] == "rep/o024"
+        assert pool.wait_idle(20)
+        for i in range(25):
+            _, data = dst.get_object("dst-bucket", f"rep/o{i:03d}")
+            assert data == f"v{i}".encode()
+
+        # the persisted state survives a "restart": a fresh pool reads
+        # the same status from the drives
+        pool2 = ReplicationPool(src)
+        pool2.configure("srcb", parse_replication_config(REPL_XML), dst)
+        s2 = pool2.resync_status("srcb")
+        assert s2 and s2["status"] == "done" and s2["queued"] == 25
+        pool.stop()
+        pool2.stop()
+
+
+class TestInlineMetadataUpdate:
+    def test_tagging_small_inline_object_preserves_data(self, tmp_path):
+        """Metadata updates must not clobber per-drive inline shards:
+        each drive's xl.meta carries ITS OWN shard, and writing one
+        drive's FileInfo to all of them destroys the stripe (found via
+        replication status stamping; tagging hits the same seam)."""
+        pools = make_pools(tmp_path, "inl")
+        pools.make_bucket("ib")
+        pools.put_object("ib", "tiny", b"ab")      # inline (2 bytes)
+        fi = pools.head_object("ib", "tiny")
+        fi.metadata["x-amz-tagging"] = "k=v"
+        pools.update_object_metadata("ib", "tiny", fi)
+        fi2, data = pools.get_object("ib", "tiny")
+        assert data == b"ab"
+        assert fi2.metadata["x-amz-tagging"] == "k=v"
