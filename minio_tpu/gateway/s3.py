@@ -141,10 +141,12 @@ class S3Gateway:
                      marker: str = "",
                      max_keys: int = 10000) -> list[FileInfo]:
         try:
-            # start-after pushes the marker to the REMOTE, so each page
-            # neither refetches nor re-HEADs what earlier pages covered
+            # start-after + max-keys push the window to the REMOTE, so
+            # each page neither refetches nor re-HEADs what earlier
+            # pages covered
             keys, _ = self.cli.list_objects(bucket, prefix=prefix,
-                                            start_after=marker)
+                                            start_after=marker,
+                                            max_keys=max_keys)
         except S3ClientError as e:
             raise _map_err(e) from None
         out = []

@@ -208,34 +208,47 @@ class S3Client:
 
     def list_objects(self, bucket: str, prefix: str = "",
                      delimiter: str = "", v2: bool = True,
-                     start_after: str = ""):
-        """Full listing: follows IsTruncated/NextContinuationToken so
-        a remote capping responses at 1000 keys still yields every
-        key (gateway resync correctness depends on this)."""
+                     start_after: str = "", max_keys: int = 0):
+        """Listing that follows truncation markers (v2 continuation
+        tokens, v1 NextMarker/last-key) so a remote capping responses
+        at 1000 keys still yields every key. max_keys > 0 bounds the
+        result AND is pushed to the remote, stopping the pagination
+        loop as soon as enough keys arrived (paged gateway walks must
+        not refetch the whole remainder per page)."""
         ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
         keys: list[str] = []
         prefixes: list[str] = []
         token = ""
+        marker = ""
         while True:
             q = {"prefix": prefix}
             if v2:
                 q["list-type"] = "2"
             if delimiter:
                 q["delimiter"] = delimiter
-            if start_after:
+            if max_keys > 0:
+                q["max-keys"] = str(max_keys - len(keys))
+            if v2 and start_after:
                 q["start-after"] = start_after
+            if not v2 and (marker or start_after):
+                q["marker"] = marker or start_after
             if token:
                 q["continuation-token"] = token
             _, _, data = self._check(*self.request("GET", f"/{bucket}",
                                                    query=q))
             root = ET.fromstring(data)
-            keys += [c.findtext(f"{ns}Key")
-                     for c in root.iter(f"{ns}Contents")]
+            page = [c.findtext(f"{ns}Key")
+                    for c in root.iter(f"{ns}Contents")]
+            keys += page
             prefixes += [c.findtext(f"{ns}Prefix")
                          for c in root.iter(f"{ns}CommonPrefixes")]
             truncated = root.findtext(f"{ns}IsTruncated") == "true"
             token = root.findtext(f"{ns}NextContinuationToken") or ""
-            if not (v2 and truncated and token):
+            marker = (root.findtext(f"{ns}NextMarker")
+                      or (page[-1] if page else ""))
+            if max_keys > 0 and len(keys) >= max_keys:
+                return keys[:max_keys], prefixes
+            if not truncated or not (token if v2 else marker):
                 return keys, prefixes
 
     def delete_objects(self, bucket: str, keys: list[str]):

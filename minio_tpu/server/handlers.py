@@ -89,7 +89,8 @@ class S3Handlers:
 
     def __init__(self, pools: ServerPools, *, notify=None,
                  replication=None, scanner=None, kms=None,
-                 compress_enabled: bool = False, tier_mgr=None):
+                 compress_enabled: bool = False, tier_mgr=None,
+                 bucket_dns=None):
         from ..bucket.metadata import BucketMetadataSys
         from ..crypto.kms import kms_from_env
         self.pools = pools
@@ -106,6 +107,7 @@ class S3Handlers:
         self.kms = kms if kms is not None else kms_from_env()
         self.compress_enabled = compress_enabled
         self.tier_mgr = tier_mgr          # bucket.tier.TierManager
+        self.bucket_dns = bucket_dns      # cluster.federation.BucketDNS
 
     # Client-visible size of a transformed (compressed/encrypted) object.
     CLIENT_SIZE_KEY = "x-mtpu-internal-client-size"
@@ -136,6 +138,14 @@ class S3Handlers:
         from ..utils import compress as cz
         if self.replication is None or version_id:
             return None
+        # Only while THIS bucket is actively resyncing: outside a
+        # resync, a local miss means the object does not exist (or was
+        # deleted) — proxying then would serve deleted objects from a
+        # stale replica forever (the reference gates the proxy on the
+        # resync window the same way).
+        st = self.replication.resync_status(bucket)
+        if not st or st.get("status") != "running":
+            return None
         try:
             meta, data = self.replication.proxy_get(bucket, key)
         except StorageError:
@@ -147,10 +157,17 @@ class S3Handlers:
             except sse.SSEError as e:
                 raise S3Error("AccessDenied", str(e)) from None
         data = cz.decompress(data, meta)
+        # Conditional semantics survive the proxy: the replica carries
+        # the source etag in its metadata.
+        cond_fi = FileInfo(volume=bucket, name=key, size=len(data),
+                           metadata=dict(meta))
+        self._check_conditions(headers, cond_fi)
         h = {"Content-Length": str(len(data)),
              "Content-Type": meta.get("content-type",
                                       "application/octet-stream"),
              "x-amz-replication-status": "REPLICA"}
+        if meta.get("etag"):
+            h["ETag"] = f'"{meta["etag"]}"'
         rng = headers.get("Range") or headers.get("range")
         if rng:
             parsed = self._parse_range(rng, len(data))
@@ -235,7 +252,38 @@ class S3Handlers:
     def make_bucket(self, bucket: str) -> Response:
         if not _valid_bucket_name(bucket):
             raise S3Error("InvalidBucketName")
+        if self.bucket_dns is not None:
+            # Federation: bucket names are GLOBAL across the domain —
+            # refuse names another cluster already published
+            # (cf. the globalDNSConfig checks in cmd/bucket-handlers.go).
+            try:
+                if self.bucket_dns.owner_endpoint(bucket) is not None:
+                    raise S3Error(
+                        "BucketAlreadyExists",
+                        "bucket owned by another federated cluster")
+            except S3Error:
+                raise
+            except Exception as e:  # noqa: BLE001 — etcd down
+                raise S3Error("ServiceUnavailable",
+                              f"federation store unreachable: {e}") \
+                    from None
         self.pools.make_bucket(bucket)
+        if self.bucket_dns is not None:
+            try:
+                self.bucket_dns.put(bucket)
+            except Exception as e:  # noqa: BLE001
+                # Unpublished-but-existing would let another cluster
+                # claim the same global name (split-brain) — roll the
+                # local create back and fail loudly (the reference
+                # deletes the bucket when the DNS publish fails,
+                # cmd/bucket-handlers.go PutBucket).
+                try:
+                    self.pools.delete_bucket(bucket)
+                except StorageError:
+                    pass
+                raise S3Error(
+                    "ServiceUnavailable",
+                    f"federation publish failed: {e}") from None
         return Response(200, headers={"Location": f"/{bucket}"})
 
     def head_bucket(self, bucket: str) -> Response:
@@ -248,6 +296,11 @@ class S3Handlers:
             raise S3Error("BucketNotEmpty")
         self.pools.delete_bucket(bucket)
         self.meta.drop_bucket(bucket)
+        if self.bucket_dns is not None:
+            try:
+                self.bucket_dns.delete(bucket)
+            except Exception:  # noqa: BLE001
+                pass
         return Response(204)
 
     def get_bucket_location(self, bucket: str) -> Response:

@@ -39,7 +39,8 @@ class S3Server:
                  compress_enabled: bool = False, tier_mgr=None,
                  oidc=None, certs: tuple[str, str] | None = None,
                  rpc_router=None, site_replicator=None,
-                 ldap=None, client_ca: str | None = None):
+                 ldap=None, client_ca: str | None = None,
+                 bucket_dns=None):
         self.oidc = oidc                   # iam.oidc.OpenIDConfig | None
         self.ldap = ldap                   # iam.ldap.LDAPConfig | None
         self.client_ca = client_ca         # CA bundle for mTLS STS
@@ -58,7 +59,9 @@ class S3Server:
         self._handler_opts = dict(notify=notify, replication=replication,
                                   scanner=scanner, kms=kms,
                                   compress_enabled=compress_enabled,
-                                  tier_mgr=tier_mgr)
+                                  tier_mgr=tier_mgr,
+                                  bucket_dns=bucket_dns)
+        self.bucket_dns = bucket_dns
         self.handlers = (S3Handlers(pools, **self._handler_opts)
                          if pools is not None else None)
         self.trace_sink = trace_sink
@@ -1012,6 +1015,31 @@ class S3Server:
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0] if parts[0] else ""
         key = parts[1] if len(parts) > 1 else ""
+
+        # Federation: a request for a bucket another cluster owns
+        # redirects there (the bucket-DNS role, cmd/etcd.go +
+        # internal/config/dns — clients normally resolve
+        # bucket.domain straight to the owner; the redirect covers
+        # clients that hit the wrong cluster). Bucket CREATION is
+        # handled in make_bucket (global-uniqueness check).
+        if (bucket and self.bucket_dns is not None
+                and not (method == "PUT" and not key)
+                and self.pools is not None
+                and not self.pools.bucket_exists(bucket)):
+            try:
+                owner = self.bucket_dns.owner_endpoint(bucket)
+            except Exception:  # noqa: BLE001 — etcd down: serve local
+                owner = None
+            if owner:
+                # Preserve the FULL request target: dropping the query
+                # would turn a versioned delete or multipart call into
+                # a different operation on the owner.
+                qs = urllib.parse.urlencode(
+                    [(k, v) for k, vs in query.items() for v in vs])
+                loc = f"{owner}{urllib.parse.quote(path)}" + \
+                    (f"?{qs}" if qs else "")
+                return Response(307, b"",
+                                {"Location": loc, "Content-Length": "0"})
 
         if self.trace_sink is not None:
             self.trace_sink({"method": method, "path": path,

@@ -380,11 +380,14 @@ class TestReplicationDepth:
         pool.stop()
 
     def test_proxy_get_on_local_miss(self, tmp_path):
-        """A GET through the SERVER for an object only the target
-        holds proxies instead of 404ing."""
+        """While a bucket is actively RESYNCING, a GET for an object
+        only the target holds proxies instead of 404ing; outside the
+        resync window a local miss is a real 404 (a stale replica must
+        not resurrect deleted objects)."""
         from minio_tpu.server.client import S3Client, S3ClientError
         from minio_tpu.server.server import S3Server
         from minio_tpu.server.sigv4 import Credentials
+        import pytest as _p
         src, dst, pool = self._pair(tmp_path)
         dst.put_object("dst-bucket", "rep/only-remote",
                        b"remote bytes")
@@ -392,10 +395,17 @@ class TestReplicationDepth:
                        replication=pool).start()
         try:
             cli = S3Client(srv.endpoint, "padmin", "padmin-secret")
+            # no resync running: local miss is a 404
+            with _p.raises(S3ClientError) as ei:
+                cli.get_object("srcb", "rep/only-remote")
+            assert ei.value.code == "NoSuchKey"
+            # mid-resync: the proxy window opens
+            pool._save_resync("srcb", {
+                "bucket": "srcb", "status": "running", "started": 0,
+                "last_key": "", "queued": 0})
             assert cli.get_object("srcb", "rep/only-remote") == \
                 b"remote bytes"
             # outside the replicated prefix: still 404
-            import pytest as _p
             with _p.raises(S3ClientError) as ei:
                 cli.get_object("srcb", "other/missing")
             assert ei.value.code == "NoSuchKey"

@@ -1,0 +1,193 @@
+"""Bucket DNS federation over an etcd-shaped store (SURVEY §2.11's
+last absent row): two clusters share a fake etcd v3 JSON gateway;
+bucket names are globally unique and requests for a remote-owned
+bucket redirect to the owner."""
+
+import base64
+import json
+import threading
+
+import pytest
+
+from minio_tpu.cluster.federation import BucketDNS, EtcdClient
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.server.client import S3Client, S3ClientError
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ROOT, SECRET = "fedadmin", "fedadmin-secret"
+
+
+class FakeEtcd:
+    """etcd v3 gRPC-gateway JSON surface: kv/put, kv/range,
+    kv/deleterange with base64 keys — backed by a sorted dict."""
+
+    def __init__(self):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        self.kv: dict[bytes, bytes] = {}
+        self._mu = threading.Lock()
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length", 0) or 0)
+                req = json.loads(self.rfile.read(ln) or b"{}")
+                key = base64.b64decode(req.get("key", ""))
+                end = base64.b64decode(req.get("range_end", "")) \
+                    if req.get("range_end") else None
+                out: dict = {}
+                with outer._mu:
+                    if self.path == "/v3/kv/put":
+                        outer.kv[key] = base64.b64decode(
+                            req.get("value", ""))
+                    elif self.path == "/v3/kv/range":
+                        kvs = []
+                        for k in sorted(outer.kv):
+                            if end is None:
+                                if k != key:
+                                    continue
+                            elif not (key <= k < end):
+                                continue
+                            kvs.append({
+                                "key": base64.b64encode(k).decode(),
+                                "value": base64.b64encode(
+                                    outer.kv[k]).decode()})
+                        out["kvs"] = kvs
+                        out["count"] = str(len(kvs))
+                    elif self.path == "/v3/kv/deleterange":
+                        doomed = [k for k in outer.kv
+                                  if (k == key if end is None
+                                      else key <= k < end)]
+                        for k in doomed:
+                            del outer.kv[k]
+                        out["deleted"] = str(len(doomed))
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                body = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self._httpd.server_port
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _cluster(tmp_path, name, etcd_port, domain="fed.example.com"):
+    drives = [LocalDrive(str(tmp_path / name / f"d{i}"))
+              for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    # BucketDNS needs the final port; bind the server first with a
+    # placeholder, then swap in a DNS bound to the real port.
+    srv = S3Server(pools, Credentials(ROOT, SECRET)).start()
+    dns = BucketDNS(EtcdClient("127.0.0.1", etcd_port), domain,
+                    "127.0.0.1", srv.port)
+    srv.bucket_dns = dns
+    srv.handlers.bucket_dns = dns
+    return srv, pools, dns
+
+
+class TestEtcdKV:
+    def test_put_range_delete(self):
+        fake = FakeEtcd()
+        try:
+            cli = EtcdClient("127.0.0.1", fake.port)
+            cli.put("/a/x", b"1")
+            cli.put("/a/y", b"2")
+            cli.put("/b/z", b"3")
+            assert cli.range("/a/") == [("/a/x", b"1"), ("/a/y", b"2")]
+            assert cli.delete("/a/", prefix=True) == 2
+            assert cli.range("/a/") == []
+            assert cli.range("/b/") == [("/b/z", b"3")]
+        finally:
+            fake.stop()
+
+
+class TestFederation:
+    def test_global_buckets_and_redirect(self, tmp_path):
+        fake = FakeEtcd()
+        srv_a, pools_a, dns_a = _cluster(tmp_path, "ca", fake.port)
+        srv_b, pools_b, dns_b = _cluster(tmp_path, "cb", fake.port)
+        try:
+            cli_a = S3Client(srv_a.endpoint, ROOT, SECRET)
+            cli_b = S3Client(srv_b.endpoint, ROOT, SECRET)
+
+            cli_a.make_bucket("fed-bucket")
+            cli_a.put_object("fed-bucket", "obj", b"owned by A")
+            # the record landed in the shared store
+            recs = dns_b.get("fed-bucket")
+            assert recs and int(recs[0]["port"]) == srv_a.port
+
+            # cluster B cannot take the name (global uniqueness)
+            with pytest.raises(S3ClientError) as ei:
+                cli_b.make_bucket("fed-bucket")
+            assert ei.value.code == "BucketAlreadyExists"
+
+            # a request to B for A's bucket redirects to A
+            st, hdrs, _ = cli_b.request("GET", "/fed-bucket/obj")
+            assert st == 307, st
+            assert hdrs["Location"] == \
+                f"{srv_a.endpoint}/fed-bucket/obj"
+            # ...and following it serves the object
+            import urllib.parse as up
+            u = up.urlsplit(hdrs["Location"])
+            cli_follow = S3Client(f"http://{u.hostname}:{u.port}",
+                                  ROOT, SECRET)
+            assert cli_follow.get_object("fed-bucket", "obj") == \
+                b"owned by A"
+
+            # deleting on A withdraws the record; B can then create it
+            cli_a.delete_object("fed-bucket", "obj")
+            cli_a.request("DELETE", "/fed-bucket")
+            assert dns_b.get("fed-bucket") == []
+            cli_b.make_bucket("fed-bucket")
+            recs = dns_a.get("fed-bucket")
+            assert recs and int(recs[0]["port"]) == srv_b.port
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+            fake.stop()
+
+    def test_etcd_down_fails_create_loudly_serves_local(self, tmp_path):
+        fake = FakeEtcd()
+        srv, pools, dns = _cluster(tmp_path, "cd", fake.port)
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("local-b")
+            cli.put_object("local-b", "k", b"v")
+            fake.stop()
+            # store down: creation refuses (uniqueness unknowable)...
+            with pytest.raises(S3ClientError) as ei:
+                cli.make_bucket("new-b")
+            assert ei.value.code == "ServiceUnavailable"
+            # ...but LOCAL buckets keep serving
+            assert cli.get_object("local-b", "k") == b"v"
+        finally:
+            srv.shutdown()
+
+    def test_domain_listing(self, tmp_path):
+        fake = FakeEtcd()
+        srv, pools, dns = _cluster(tmp_path, "cl", fake.port)
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("list-one")
+            cli.make_bucket("list-two")
+            allb = dns.list()
+            assert set(allb) >= {"list-one", "list-two"}
+        finally:
+            srv.shutdown()
+            fake.stop()
