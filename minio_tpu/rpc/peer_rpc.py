@@ -29,6 +29,31 @@ class PeerRegistry:
         self._reload_hooks: dict[str, callable] = {}
         self.trace_buffer: list[dict] = []
         self.started = time.time()
+        self._profiler = None
+
+    # -- profiling (the per-node side of cluster-wide profiling,
+    # cf. StartProfilingHandler fan-out, cmd/admin-handlers.go:491) ----------
+
+    def profile_start(self) -> bool:
+        import cProfile
+        if self._profiler is not None:
+            return False
+        self._profiler = cProfile.Profile()
+        self._profiler.enable()
+        return True
+
+    def profile_dump(self) -> str:
+        """Stop and render this node's profile ('' when none ran)."""
+        import io
+        import pstats
+        prof, self._profiler = self._profiler, None
+        if prof is None:
+            return ""
+        prof.disable()
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats(
+            "cumulative").print_stats(50)
+        return buf.getvalue()
 
     def on_reload(self, subsystem: str, fn) -> None:
         self._reload_hooks[subsystem] = fn
@@ -52,6 +77,10 @@ def register_peer_rpc(server, registry: PeerRegistry) -> None:
     server.register("peer.server_info", lambda p: registry.server_info())
     server.register("peer.trace_tail",
                     lambda p: registry.trace_buffer[-int(p.get("n", 100)):])
+    server.register("peer.profile_start",
+                    lambda p: registry.profile_start())
+    server.register("peer.profile_dump",
+                    lambda p: {"text": registry.profile_dump()})
 
 
 class NotificationSys:

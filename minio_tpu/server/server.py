@@ -808,15 +808,28 @@ class S3Server:
             return j(self.config.help(query.get("subsys", [""])[0]))
         if sub == "profile":
             # cf. StartProfilingHandler/DownloadProfilingHandler,
-            # cmd/admin-handlers.go:491,599 — cProfile in place of pprof.
+            # cmd/admin-handlers.go:491,599 — cProfile in place of
+            # pprof. In a cluster the start FANS OUT to every peer and
+            # the download collects all nodes' profiles into one zip,
+            # like the reference's profiling archive.
             import cProfile
             import io as _io
             import pstats
+            peers = getattr(self, "peer_notification", None)
             if method == "POST":
+                started = 0
                 if getattr(self, "_profiler", None) is None:
                     self._profiler = cProfile.Profile()
                     self._profiler.enable()
-                    return j({"profiling": "started"})
+                    started = 1
+                peer_started = 0
+                if peers is not None:
+                    res = peers._fan_out("peer.profile_start", {})
+                    peer_started = sum(1 for r, e in res
+                                       if e is None and r)
+                if started or peer_started:
+                    return j({"profiling": "started",
+                              "nodes": started + peer_started})
                 return j({"profiling": "already running"}, 409)
             if method == "GET":
                 prof = getattr(self, "_profiler", None)
@@ -827,8 +840,30 @@ class S3Server:
                 buf = _io.StringIO()
                 pstats.Stats(prof, stream=buf).sort_stats(
                     "cumulative").print_stats(50)
-                return Response(200, buf.getvalue().encode(),
-                                {"Content-Type": "text/plain"})
+                local_text = buf.getvalue()
+                want_zip = (query.get("format", [""])[0] == "zip"
+                            or peers is not None)
+                if not want_zip:
+                    return Response(200, local_text.encode(),
+                                    {"Content-Type": "text/plain"})
+                import zipfile
+                blob = _io.BytesIO()
+                with zipfile.ZipFile(blob, "w",
+                                     zipfile.ZIP_DEFLATED) as z:
+                    z.writestr("profile-local.txt", local_text)
+                    if peers is not None:
+                        for cli, (r, e) in zip(
+                                peers.peers,
+                                peers._fan_out("peer.profile_dump",
+                                               {})):
+                            name = (f"profile-{cli.host}-"
+                                    f"{cli.port}.txt")
+                            if e is not None:
+                                z.writestr(name + ".error", str(e))
+                            elif r and r.get("text"):
+                                z.writestr(name, r["text"])
+                return Response(200, blob.getvalue(),
+                                {"Content-Type": "application/zip"})
         if sub == "tier":
             # Tier admin (cf. AddTierHandler/ListTierHandler,
             # cmd/admin-handlers-pools.go + tier config).
