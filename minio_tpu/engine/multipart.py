@@ -158,12 +158,9 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
                     return
                 d.append_file(SYS_VOL, stage, per_drive[pos])
 
-            futures = [es.pool.submit(write_one, pos)
-                       for pos in range(es.n)]
-            for pos, fut in enumerate(futures):
-                try:
-                    fut.result()
-                except Exception:  # noqa: BLE001
+            for pos, (_, e) in enumerate(
+                    es._map_drives_positions(write_one)):
+                if e is not None:
                     failed[pos] = True
             if sum(1 for f in failed if not f) < write_quorum:
                 raise ErrErasureWriteQuorum(

@@ -312,13 +312,30 @@ def unframe_shard(data: bytes, shard_size: int, verify: bool = True,
     pieces = []
     if n_full:
         frames = buf[:n_full * frame].reshape(n_full, frame)
-        hashes = frames[:, :hs]
-        blocks = frames[:, hs:]
-        if verify:
-            got = _hash_batch(np.ascontiguousarray(blocks), algo)
-            if not np.array_equal(got, hashes):
-                raise ErrFileCorrupt("bitrot hash mismatch")
-        pieces.append(blocks.reshape(-1))
+        if verify and algo == "mxh256" and n_full * shard_size >= (1 << 18):
+            # Fused native pass (heal/scanner hot path): hash-verify and
+            # gather the frames in one sweep instead of
+            # contiguous-copy -> hash -> concatenate-copy.
+            try:
+                from native import ecio_native
+                y, _, nbad = ecio_native.get_verify(
+                    [frames], [0], n_full, shard_size, 1, 1, [])
+                if nbad:
+                    raise ErrFileCorrupt("bitrot hash mismatch")
+                pieces.append(y.reshape(-1))
+                frames = None
+            except ErrFileCorrupt:
+                raise
+            except Exception:  # noqa: BLE001 — no toolchain: numpy path
+                pass
+        if frames is not None:
+            hashes = frames[:, :hs]
+            blocks = frames[:, hs:]
+            if verify:
+                got = _hash_batch(np.ascontiguousarray(blocks), algo)
+                if not np.array_equal(got, hashes):
+                    raise ErrFileCorrupt("bitrot hash mismatch")
+            pieces.append(blocks.reshape(-1))
     if rest:
         tail = buf[n_full * frame:]
         if tail.size <= hs:

@@ -106,6 +106,37 @@ def _direct_read(path: str, offset: int, length: int) -> bytes | None:
         os.close(fd)
 
 
+def read_range_view(path: str, offset: int, length: int) -> memoryview:
+    """Zero-copy read: mmap the byte range and return a memoryview over
+    the page cache (the map stays alive through the view).  The host
+    fast path hands these straight to the fused native verify kernel —
+    shard bytes then cross the kernel boundary zero times.
+
+    Shard files are immutable once published (append-only staging, then
+    rename), so the SIGBUS-on-truncate hazard of reading mmaps doesn't
+    arise on this path; the range is clamped against the inode size at
+    map time, so a short file yields a short view exactly like a short
+    read() — callers verify the expected framed length themselves.
+    """
+    if length == 0:
+        return memoryview(b"")
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        size = os.fstat(fd).st_size
+        if length < 0 or offset + length > size:
+            # read() semantics: a range past EOF returns what exists
+            # (callers size-check the framed layout themselves).
+            length = max(size - offset, 0)
+        if length == 0:
+            return memoryview(b"")
+        a_off = offset & ~(ALIGN - 1)
+        mm = mmap.mmap(fd, length + (offset - a_off), mmap.MAP_PRIVATE,
+                       mmap.PROT_READ, offset=a_off)
+        return memoryview(mm)[offset - a_off:offset - a_off + length]
+    finally:
+        os.close(fd)
+
+
 def write_done(fd: int, nbytes: int) -> bool:
     """Post-write cache policy for bulk shard writes (the write side of
     the O_DIRECT role: staged shard bytes should not linger in cache).

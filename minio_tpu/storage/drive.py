@@ -79,6 +79,11 @@ class LocalDrive:
         self.disk_id: str = ""
         self.endpoint = root
         self._osc = oscounters.Counters()   # per-drive syscall stats
+        # Positive volume-existence cache: every data-path call
+        # re-stats the volume dir otherwise (~8 stats per PUT across a
+        # stripe). Same-process deletes invalidate; a cross-process
+        # delete surfaces as ENOENT on the file op itself.
+        self._vols: set[str] = set()
 
     # -- path helpers --------------------------------------------------------
 
@@ -99,8 +104,11 @@ class LocalDrive:
 
     def _check_vol(self, vol: str) -> str:
         p = self._vol_path(vol)
+        if vol in self._vols:
+            return p
         if not os.path.isdir(p):
             raise ErrVolumeNotFound(vol)
+        self._vols.add(vol)
         return p
 
     # -- volume ops ----------------------------------------------------------
@@ -136,6 +144,7 @@ class LocalDrive:
 
     def delete_volume(self, vol: str, force: bool = False) -> None:
         p = self._check_vol(vol)
+        self._vols.discard(vol)
         if force:
             self._move_to_trash(p)
             return
@@ -227,12 +236,31 @@ class LocalDrive:
         with self._osc.timed('write'):
             return self._append_file_impl(vol, path, data)
 
-    def _append_file_impl(self, vol: str, path: str, data: bytes) -> None:
+    def _ensure_parent_in_vol(self, vol: str, p: str) -> None:
+        """_ensure_parent that cannot resurrect a deleted volume: when
+        the parent chain is missing, re-validate the volume with the
+        cache bypassed so a cross-process bucket delete surfaces as
+        ErrVolumeNotFound instead of silently recreating the dir."""
+        d = os.path.dirname(p)
+        try:
+            os.mkdir(d)
+        except FileExistsError:
+            pass
+        except FileNotFoundError:
+            self._vols.discard(vol)
+            self._check_vol(vol)
+            os.makedirs(d, exist_ok=True)
+
+    def _append_file_impl(self, vol: str, path: str, data) -> None:
         """Append to a staged shard file (streaming writes land batch by
-        batch; rename_data fsyncs staged files before publishing)."""
+        batch; rename_data fsyncs staged files before publishing).
+
+        `data` is any contiguous buffer (bytes or a uint8 ndarray view
+        of the fused-encode arena); a whole-buffer write bypasses the
+        BufferedWriter copy path."""
         self._check_vol(vol)
         p = self._file_path(vol, path)
-        _ensure_parent(p)
+        self._ensure_parent_in_vol(vol, p)
         with open(p, "ab") as f:
             f.write(data)
             f.flush()
@@ -251,6 +279,21 @@ class LocalDrive:
         p = self._file_path(vol, path)
         try:
             return diskio.read_range(p, offset, length)
+        except FileNotFoundError:
+            raise ErrFileNotFound(f"{vol}/{path}") from None
+        except IsADirectoryError:
+            raise ErrIsNotRegular(f"{vol}/{path}") from None
+
+    def read_file_view(self, vol: str, path: str, offset: int = 0,
+                       length: int = -1) -> memoryview:
+        """Zero-copy bulk read (mmap over the page cache) for the host
+        fused verify path; same error surface as read_file — including
+        short views for ranges past EOF (callers size-check the framed
+        layout, exactly as they do for short read()s)."""
+        p = self._file_path(vol, path)
+        try:
+            with self._osc.timed('read'):
+                return diskio.read_range_view(p, offset, length)
         except FileNotFoundError:
             raise ErrFileNotFound(f"{vol}/{path}") from None
         except IsADirectoryError:
