@@ -182,6 +182,17 @@ def e2e_bench(n_put: int = 64, n_parts: int = 4,
             for k, v in out.items()}
 
 
+def _best_of(f, n=5):
+    """Best-of-n ms timing for the stage-attribution probes."""
+    f()
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f()
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1e3
+
+
 def _get_stages(es12) -> dict:
     """Per-stage attribution of the degraded GET (2 data shards offline)
     over one 16-block segment of the 8+4 object: mmap'd shard reads,
@@ -192,15 +203,7 @@ def _get_stages(es12) -> dict:
         from native import ecio_native
         from minio_tpu.engine import quorum as Q
 
-        def best(f, n=5):
-            f()
-            times = []
-            for _ in range(n):
-                t0 = time.perf_counter()
-                f()
-                times.append(time.perf_counter() - t0)
-            return min(times) * 1e3
-
+        best = _best_of
         fi, _, _ = es12._read_metadata("bench", "mp")
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         ss = fi.erasure.shard_size
@@ -249,15 +252,7 @@ def _put_stages(es4, obj_bytes: bytes) -> dict:
     import hashlib
     import numpy as np
 
-    def best(f, n=5):
-        f()
-        times = []
-        for _ in range(n):
-            t0 = time.perf_counter()
-            f()
-            times.append(time.perf_counter() - t0)
-        return min(times) * 1e3
-
+    best = _best_of
     stages = {}
     stages["put_stage_md5_ms"] = best(
         lambda: hashlib.md5(obj_bytes).hexdigest())

@@ -287,16 +287,18 @@ class ErasureSet:
         if err is not None:
             raise err
 
-    def bucket_exists(self, bucket: str) -> bool:
-        # Positive results are cached briefly (the bucket-metadata-cache
-        # role, cf. BucketMetadataSys): every PUT/GET probes existence,
-        # and a stat fan-out per call is pure overhead. Deletion races
-        # stay safe — writes into a removed volume fail per-drive and
-        # the quorum layer surfaces ErrVolumeNotFound regardless.
-        hit = self._bucket_cache.get(bucket)
+    def bucket_exists(self, bucket: str, cached: bool = False) -> bool:
+        # cached=True serves the WRITE hot path's pre-check (put_object
+        # probes existence on every call): a stale positive there is
+        # backstopped by the per-drive ErrVolumeNotFound the write
+        # itself surfaces. Reads and explicit existence queries
+        # (HeadBucket, error classification) always stat — a cluster
+        # peer's delete must be visible immediately, not after a TTL.
         now = time.monotonic()
-        if hit is not None and now - hit < 2.0:
-            return True
+        if cached:
+            hit = self._bucket_cache.get(bucket)
+            if hit is not None and now - hit < 2.0:
+                return True
         res = self._map_drives(lambda d: d.stat_volume(bucket))
         ok = sum(1 for _, e in res if e is None)
         exists = ok >= self._live_quorum()
@@ -346,7 +348,7 @@ class ErasureSet:
 
         cf. erasureObjects.putObject, /root/reference/cmd/erasure-object.go:748.
         """
-        if not self.bucket_exists(bucket):
+        if not self.bucket_exists(bucket, cached=True):
             raise ErrBucketNotFound(bucket)
         with self.nslock.write_locked(bucket, obj):
             fi = self._put_object_locked(bucket, obj, data,
@@ -653,9 +655,11 @@ class ErasureSet:
         shard_size = -(-BLOCK_SIZE // k)
         # Host fast path: ONE native pass per batch does parity + bitrot
         # digests + frame layout (native/ecio.cc) — no device, so there
-        # is no dispatch to pipeline behind.
+        # is no dispatch to pipeline behind. Width-gated: the C kernels
+        # hold at most 64 row pointers on the stack.
         fused_host = None
-        if not self._use_device and algo == "mxh256" and not _mesh_mode():
+        if (not self._use_device and algo == "mxh256"
+                and not _mesh_mode() and k + m <= 64):
             fused_host = _ecio_mod()
 
         def frame(blocks, parity, digests):
@@ -993,9 +997,10 @@ class ErasureSet:
         # Host fast path: shard files mmap'd straight into the fused
         # native verify+gather+reconstruct kernel — object bytes are
         # never copied by Python and never cross read() (north-star
-        # config #5, host edition).
+        # config #5, host edition). Width-gated like the PUT side.
         fused_host = None
-        if not self._use_device and algo == "mxh256" and not _mesh_mode():
+        if (not self._use_device and algo == "mxh256"
+                and not _mesh_mode() and k + m <= 64):
             fused_host = _ecio_mod()
 
         def read_shard(pos: int):

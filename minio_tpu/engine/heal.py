@@ -263,6 +263,19 @@ def _purge_version(es: ErasureSet, bucket: str, obj: str, version_id: str,
     es._map_drives(rm)
 
 
+def _ensure_bucket_on(drive, bucket: str) -> None:
+    """Heal explicitly recreates a missing bucket volume on its target
+    drive — the data path itself refuses to resurrect volumes (a PUT
+    racing a bucket delete must fail, drive._ensure_parent_in_vol), so
+    only heal gets to bring the directory back (cf. healBucket before
+    object heal, /root/reference/cmd/erasure-healing.go:281)."""
+    from ..storage.errors import ErrVolumeExists
+    try:
+        drive.make_volume(bucket)
+    except ErrVolumeExists:
+        pass
+
+
 def _heal_metadata_only(es, bucket, obj, fi: FileInfo, metas, states,
                         targets: list[int]) -> None:
     """Delete markers and inline objects: rewrite xl.meta on targets.
@@ -271,6 +284,7 @@ def _heal_metadata_only(es, bucket, obj, fi: FileInfo, metas, states,
     owns; reconstruct it from intact copies when the source lacks it."""
     if fi.deleted:
         for pos in targets:
+            _ensure_bucket_on(es.drives[pos], bucket)
             es.drives[pos].write_metadata(bucket, obj, fi)
         return
     ec = fi.erasure
@@ -310,6 +324,7 @@ def _heal_metadata_only(es, bucket, obj, fi: FileInfo, metas, states,
         framed = bitrot_io.frame_shard(rows[s], ec.shard_size,
                                        ec.bitrot_algo())
         fi_pos = _fi_for_drive(fi, pos, inline=framed)
+        _ensure_bucket_on(es.drives[pos], bucket)
         es.drives[pos].write_metadata(bucket, obj, fi_pos)
 
 
@@ -423,6 +438,7 @@ def _heal_data(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
                     framed)
         for pos in targets:
             fi_pos = _fi_for_drive(fi, pos)
+            _ensure_bucket_on(es.drives[pos], bucket)
             es.drives[pos].rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}",
                                        fi_pos, bucket, obj)
     finally:

@@ -26,8 +26,10 @@ def expand_ellipses(pattern: str) -> list[str]:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="minio_tpu.server")
-    ap.add_argument("--drives", required=True,
-                    help="drive paths, ellipses ok: /tmp/d{1...4}")
+    ap.add_argument("--drives", required=True, action="append",
+                    help="drive paths, ellipses ok: /tmp/d{1...4}; "
+                         "repeat the flag to add a POOL (capacity "
+                         "expansion) — each --drives is one pool")
     ap.add_argument("--port", type=int, default=9000)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--set-drive-count", type=int, default=None)
@@ -46,7 +48,11 @@ def main(argv: list[str] | None = None) -> int:
 
     creds = Credentials(os.environ.get("MTPU_ROOT_USER", "minioadmin"),
                         os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin"))
-    endpoint_args = args.drives.split()
+    # Each --drives flag is one endpoint group; within a group, args
+    # are space-separated (a node list in cluster mode, or ellipsis
+    # pool groups standalone).
+    drive_groups = [g.split() for g in args.drives]
+    endpoint_args = [a for g in drive_groups for a in g]
     cluster_mode = any("://" in a for a in endpoint_args)
 
     certs = None
@@ -97,7 +103,9 @@ def main(argv: list[str] | None = None) -> int:
         while True:
             try:
                 node, srv0, pools = boot_cluster_node(
-                    endpoint_args, args.host, args.port, creds,
+                    drive_groups if len(drive_groups) > 1
+                    else endpoint_args,
+                    args.host, args.port, creds,
                     set_drive_count=args.set_drive_count,
                     server_factory=factory, certs_dir=args.certs_dir,
                     timeout=float(os.environ.get("MTPU_BOOT_TIMEOUT",
@@ -135,12 +143,31 @@ def main(argv: list[str] | None = None) -> int:
     from ..engine.pools import ServerPools
     from ..engine.sets import ErasureSets
     from ..storage.drive import LocalDrive
+    from ..topology.endpoints import has_ellipses
 
-    paths = expand_ellipses(args.drives)
-    drives = [LocalDrive(p) for p in paths]
-    sets = ErasureSets(drives,
-                       set_drive_count=args.set_drive_count or len(drives))
-    pools = ServerPools([sets])
+    # Pools: each --drives flag is one pool, and within a flag each
+    # space-separated ellipsis group is ALSO one pool — `--drives
+    # '/data{1...4} /newdata{1...4}'` is a two-pool deployment exactly
+    # like the reference's `minio server /data{1...4} /newdata{1...4}`
+    # capacity-expansion syntax (cmd/endpoint-ellipses.go:341: one
+    # zone/pool per arg). Plain paths with no ellipses keep the legacy
+    # meaning: one pool over all of them.
+    pool_paths: list[list[str]] = []
+    for group in drive_groups:
+        if len(group) > 1 and any(has_ellipses(a) for a in group):
+            pool_paths.extend(expand_ellipses(a) for a in group)
+        else:
+            pool_paths.append(
+                [p for a in group for p in expand_ellipses(a)])
+    pool_sets: list[ErasureSets] = []
+    for paths in pool_paths:
+        drives = [LocalDrive(p) for p in paths]
+        pool_sets.append(ErasureSets(
+            drives,
+            set_drive_count=args.set_drive_count or len(drives),
+            deployment_id=(pool_sets[0].deployment_id
+                           if pool_sets else None)))
+    pools = ServerPools(pool_sets)
 
     # Full subsystem stack, the newAllSubsystems role
     # (cmd/server-main.go:441): IAM, scanner, notifications.
@@ -163,8 +190,13 @@ def main(argv: list[str] | None = None) -> int:
                        iam=iam, scanner=scanner, notify=notify,
                        certs=certs).start()
         port = srv.port                  # keep the port across restarts
-        print(f"minio_tpu server on {srv.endpoint} "
-              f"({len(paths)} drives, set={sets.set_drive_count})",
+        n_drives = sum(len(p) for p in pool_paths)
+        desc = ", ".join(f"pool{i}: {len(p)} drives "
+                         f"set={pool_sets[i].set_drive_count}"
+                         for i, p in enumerate(pool_paths)) \
+            if len(pool_paths) > 1 else \
+            f"{n_drives} drives, set={pool_sets[0].set_drive_count}"
+        print(f"minio_tpu server on {srv.endpoint} ({desc})",
               flush=True)
         try:
             # Event.wait is race-free against a signal arriving between

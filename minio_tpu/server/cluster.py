@@ -42,7 +42,7 @@ from ..rpc.storage_rpc import RemoteDrive, register_storage_rpc
 from ..storage.drive import LocalDrive
 from ..storage.errors import StorageError
 from ..storage.format import load_format
-from ..topology.endpoints import Endpoint, parse_cluster_endpoints
+from ..topology.endpoints import Endpoint, parse_cluster_pools
 
 
 class ClusterBootError(RuntimeError):
@@ -55,14 +55,17 @@ def internode_token(secret_key: str) -> str:
                     hashlib.sha256).hexdigest()
 
 
-def layout_digest(endpoints: list[Endpoint], set_drive_count: int) -> str:
-    """Every node must agree on the global drive order — a node booted
-    with a reordered endpoint list would place shards wrong."""
+def layout_digest(pools: list[tuple[list[Endpoint], int]]) -> str:
+    """Every node must agree on the global pool/drive order — a node
+    booted with a reordered endpoint list (or different pool grouping)
+    would place shards wrong."""
     h = hashlib.sha256()
-    for ep in endpoints:
-        h.update(repr(ep).encode())
-        h.update(b"\x00")
-    h.update(str(set_drive_count).encode())
+    for eps, size in pools:
+        for ep in eps:
+            h.update(repr(ep).encode())
+            h.update(b"\x00")
+        h.update(str(size).encode())
+        h.update(b"\x01")
     return h.hexdigest()
 
 
@@ -74,8 +77,19 @@ class ClusterNode:
                  certs_dir: str = ""):
         self.creds = creds
         self.token = internode_token(creds.secret_key)
-        eps, size, nodes = parse_cluster_endpoints(endpoint_args,
-                                                   set_drive_count)
+        # endpoint_args: either a flat arg list (ONE pool spanning its
+        # nodes — the legacy cluster syntax, where each arg is one
+        # node's drive pattern) or a list of GROUPS, each group one
+        # POOL (capacity-expansion: the CLI maps one --drives flag per
+        # pool). The flat endpoint list keeps storage-plane drive order.
+        if endpoint_args and isinstance(endpoint_args[0], str):
+            pool_groups = [list(endpoint_args)]
+        else:
+            pool_groups = [list(g) for g in endpoint_args]
+        pools, nodes = parse_cluster_pools(pool_groups, set_drive_count)
+        self.pools = pools
+        eps = [ep for pool_eps, _ in pools for ep in pool_eps]
+        size = pools[0][1]
         # https endpoints: peers are dialed over TLS, trusting the
         # deployment cert (shared certs dir — the reference trusts
         # certs/CAs the same way).
@@ -128,7 +142,7 @@ class ClusterNode:
         register_lock_rpc(self.router, self.locker)
         self.peer_registry = PeerRegistry()
         register_peer_rpc(self.router, self.peer_registry)
-        self.layout_sha = layout_digest(eps, size)
+        self.layout_sha = layout_digest(pools)
         # Mutated in place after wait_format adds the deployment id —
         # the verify handler only enforces keys it already knows, so a
         # peer that has not formatted yet is lenient about the id and
@@ -163,13 +177,40 @@ class ClusterNode:
 
     # -- format phase --------------------------------------------------------
 
-    def _rows(self, drives: list) -> list[list]:
-        k = self.set_drive_count
-        return [drives[i:i + k] for i in range(0, len(drives), k)]
+    def _pool_slices(self, drives: list) -> list[list]:
+        """Slice the flat drive list back into per-pool lists."""
+        out, off = [], 0
+        for eps, _ in self.pools:
+            out.append(drives[off:off + len(eps)])
+            off += len(eps)
+        return out
+
+    def _pool_rows(self, drives: list) -> list[list[list]]:
+        """Per-pool set rows: pool p chunked by ITS set size."""
+        rows = []
+        for (eps, k), pool_drives in zip(self.pools,
+                                         self._pool_slices(drives)):
+            rows.append([pool_drives[i:i + k]
+                         for i in range(0, len(pool_drives), k)])
+        return rows
+
+    def _format_all_pools(self, drives: list) -> list[dict]:
+        """Format/adopt every pool; pool 0 mints the deployment id, the
+        rest share it (the reference's multi-pool format path keeps one
+        deployment id across zones)."""
+        from ..storage.format import init_format_sets
+        fmts = []
+        dep_id = None
+        for rows in self._pool_rows(drives):
+            fmt = init_format_sets(rows, deployment_id=dep_id)
+            dep_id = fmt["id"]
+            fmts.append(fmt)
+        return fmts
 
     def wait_format(self, drives: list, timeout: float = 60.0,
-                    poll: float = 0.3) -> dict:
-        """Format-quorum wait -> the deployment's reference format.
+                    poll: float = 0.3) -> list[dict]:
+        """Format-quorum wait -> per-pool reference formats (one per
+        pool, shared deployment id).
 
         First node: formats the whole deployment once every drive
         answers (fresh format needs ALL drives — the reference prints
@@ -180,13 +221,12 @@ class ClusterNode:
         one surviving formatted local drive is needed, the rest heal
         into their recorded slots (errNotFirstDisk retry,
         cmd/prepare-storage.go:298)."""
-        from ..storage.format import init_format_sets
         deadline = time.monotonic() + timeout
         last_err: Exception | None = None
         while time.monotonic() < deadline:
             if self.is_first:
                 try:
-                    return init_format_sets(self._rows(drives))
+                    return self._format_all_pools(drives)
                 except StorageError as e:
                     last_err = e          # peers not all up yet: retry
             else:
@@ -202,7 +242,7 @@ class ClusterNode:
                     # Adopt + verify my position; heals my unformatted
                     # drives into their recorded slots.
                     try:
-                        return init_format_sets(self._rows(drives))
+                        return self._format_all_pools(drives)
                     except StorageError as e:
                         last_err = e
             time.sleep(poll)
@@ -249,22 +289,30 @@ class ClusterNode:
     # -- object layer --------------------------------------------------------
 
     def build_object_layer(self, drives: list, default_parity=None,
-                           fmt: dict | None = None):
+                           fmt: list[dict] | None = None):
         """Mixed-drive sets with a cluster-wide namespace lock: dsync
         over one locker per NODE (mine direct, peers via the lock
         plane), the reference's granularity
-        (cmd/namespace-lock.go:224). `fmt` is the format wait_format
-        already loaded — skips a second full-deployment scan."""
+        (cmd/namespace-lock.go:224). `fmt` is the per-pool format list
+        wait_format already loaded — skips a second full-deployment
+        scan. One ErasureSets per pool -> ServerPools."""
         from ..engine.pools import ServerPools
         from ..engine.sets import ErasureSets
         lockers = [self.locker] + [RemoteLocker(cli)
                                    for cli in self.peer_clients.values()]
         nslock = NSLockMap(lockers=lockers if self.peer_clients else None)
-        sets = ErasureSets(drives, set_drive_count=self.set_drive_count,
-                           default_parity=default_parity, nslock=nslock,
-                           preloaded_format=fmt)
+        fmts = fmt if fmt is not None else [None] * len(self.pools)
+        pool_sets = []
+        for (eps, size), pool_drives, pf in zip(
+                self.pools, self._pool_slices(drives), fmts):
+            pool_sets.append(ErasureSets(
+                pool_drives, set_drive_count=size,
+                default_parity=default_parity, nslock=nslock,
+                preloaded_format=pf,
+                deployment_id=(pool_sets[0].deployment_id
+                               if pool_sets else None)))
         self.nslock = nslock
-        return ServerPools([sets])
+        return ServerPools(pool_sets)
 
 
 def boot_cluster_node(endpoint_args: list[str], my_host: str,
@@ -282,7 +330,7 @@ def boot_cluster_node(endpoint_args: list[str], my_host: str,
     try:
         drives = node.build_drives()
         fmt = node.wait_format(drives, timeout=timeout)
-        node.wait_peers_verified(fmt["id"], timeout=timeout)
+        node.wait_peers_verified(fmt[0]["id"], timeout=timeout)
         pools = node.build_object_layer(drives, fmt=fmt)
         from ..background.scanner import DataScanner
         from ..iam.iam import IAMSys

@@ -165,7 +165,7 @@ class LocalDrive:
 
     def _write_all(self, vol: str, path: str, data: bytes) -> None:
         p = self._file_path(vol, path)
-        _ensure_parent(p)
+        self._ensure_parent_in_vol(vol, p)
         tmp = os.path.join(self.root, SYS_VOL, TMP_DIR,
                            f"wa-{uuid.uuid4().hex}")
         with open(tmp, "wb") as f:
@@ -223,7 +223,7 @@ class LocalDrive:
         """
         self._check_vol(vol)
         p = self._file_path(vol, path)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
+        self._ensure_parent_in_vol(vol, p)
         with open(p, "wb") as f:
             f.write(data)
             f.flush()
@@ -306,7 +306,7 @@ class LocalDrive:
         dst = self._file_path(dst_vol, dst_path)
         if not os.path.isfile(src):
             raise ErrFileNotFound(f"{src_vol}/{src_path}")
-        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        self._ensure_parent_in_vol(dst_vol, dst)
         os.replace(src, dst)
 
     def list_raw(self, vol: str, path: str = "") -> list[str]:
@@ -357,7 +357,7 @@ class LocalDrive:
             # the xl.meta integrity checksum and reads as missing,
             # which quorum + heal already handle.
             p = self._file_path(vol, os.path.join(obj, XL_META_FILE))
-            _ensure_parent(p)
+            self._ensure_parent_in_vol(vol, p)
             with self._osc.timed("write"), open(p, "wb") as f:
                 f.write(meta.to_bytes())
             return
@@ -465,7 +465,7 @@ class LocalDrive:
                         os.close(dfd)
                 dst = self._file_path(dst_vol,
                                       os.path.join(dst_obj, fi.data_dir))
-                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                self._ensure_parent_in_vol(dst_vol, dst)
                 if os.path.isdir(dst):
                     self._move_to_trash(dst)
                 os.replace(src, dst)

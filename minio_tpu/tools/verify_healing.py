@@ -121,9 +121,97 @@ def _cluster_main() -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _pools_main() -> int:
+    """Two-pool variant: wipe one drive in EACH pool, heal through the
+    admin plane, byte-verify both pools (the reference's capacity-
+    expansion deployment shape, cmd/erasure-server-pool.go)."""
+    import json as _json
+
+    import numpy as np
+
+    from ..engine.pools import ServerPools
+    from ..engine.sets import ErasureSets
+    from ..server.client import S3Client
+    from ..server.server import S3Server
+    from ..server.sigv4 import Credentials
+    from ..storage.drive import LocalDrive
+
+    tmp = tempfile.mkdtemp(prefix="mtpu-verify-heal-pools-")
+    try:
+        p0 = ErasureSets([LocalDrive(os.path.join(tmp, f"p0-{i}"))
+                          for i in range(4)], set_drive_count=4)
+        p1 = ErasureSets([LocalDrive(os.path.join(tmp, f"p1-{i}"))
+                          for i in range(4)], set_drive_count=4,
+                         deployment_id=p0.deployment_id)
+        pools = ServerPools([p0, p1])
+        srv = S3Server(pools, Credentials("healadmin",
+                                          "healadmin-secret")).start()
+        cli = S3Client(srv.endpoint, "healadmin", "healadmin-secret")
+        cli.make_bucket("victim")
+        blobs = {}
+        for i in range(6):
+            # alternate placement by pinning per-pool free space
+            for p, free in zip(pools.pools,
+                               ([1, 2] if i % 2 else [2, 1])):
+                p.disk_usage = (lambda f: lambda: {
+                    "total": 1 << 40, "free": f << 30})(free)
+            data = np.random.default_rng(100 + i).integers(
+                0, 256, 260000 + i * 777, dtype=np.uint8).tobytes()
+            cli.put_object("victim", f"obj{i}", data)
+            blobs[f"obj{i}"] = data
+        on_p0 = sum(1 for n in blobs
+                    if _has(p0, "victim", n))
+        on_p1 = len(blobs) - on_p0
+        assert on_p0 and on_p1, "placement never used one of the pools"
+        print(f"wrote {len(blobs)} objects: {on_p0} on pool0, "
+              f"{on_p1} on pool1")
+
+        for tag in ("p0-1", "p1-2"):
+            shutil.rmtree(os.path.join(tmp, tag, "victim"))
+        print("wiped one drive per pool")
+
+        status, _, body = cli.request("POST", "/minio/admin/v1/heal",
+                                      query={"bucket": "victim"})
+        assert status == 200, body
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            _, _, body = cli.request("GET", "/minio/admin/v1/heal")
+            seqs = _json.loads(body)["sequences"]
+            if seqs and seqs[0]["state"] in ("done", "failed"):
+                break
+            time.sleep(0.2)
+        st = seqs[0]
+        print(f"heal sequence: {st['state']} scanned={st['scanned']} "
+              f"healed={st['healed']}")
+        assert st["state"] == "done" and st["healed"] == len(blobs), st
+        for name, data in blobs.items():
+            assert cli.get_object("victim", name) == data, \
+                f"{name} corrupt after heal"
+        for tag in ("p0-1", "p1-2"):
+            assert os.path.isdir(os.path.join(tmp, tag, "victim")), \
+                f"{tag} not healed"
+        print("verify-healing --pools: OK — both pools healed, "
+              "byte-identical")
+        srv.shutdown()
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _has(pool, bucket, obj) -> bool:
+    from ..storage.errors import StorageError
+    try:
+        pool.head_object(bucket, obj)
+        return True
+    except StorageError:
+        return False
+
+
 def main() -> int:
     if "--cluster" in sys.argv[1:]:
         return _cluster_main()
+    if "--pools" in sys.argv[1:]:
+        return _pools_main()
     from ..engine.pools import ServerPools
     from ..engine.sets import ErasureSets
     from ..server.client import S3Client

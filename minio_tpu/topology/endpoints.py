@@ -190,6 +190,32 @@ class Endpoint:
         return hash(repr(self))
 
 
+def parse_cluster_pools(groups: list[list[str]],
+                        custom_set_count: int | None = None):
+    """Expand CLI endpoint-arg GROUPS into POOLS: each group is one
+    pool (the reference's zone-per-arg rule, cmd/endpoint-ellipses.go:
+    341 — here a group is one --drives flag, so multi-node pools whose
+    nodes listen on different ports remain expressible).
+
+    -> (pools, nodes) where pools is a list of (endpoints,
+    set_drive_count) per group and `nodes` the union (host, port) list
+    in first-appearance order — node 0 (owner of pool 0's first
+    endpoint) is the format leader for the whole deployment."""
+    pools = []
+    nodes: list[tuple[str, int]] = []
+    for group in groups:
+        eps, size, arg_nodes = parse_cluster_endpoints(group,
+                                                       custom_set_count)
+        pools.append((eps, size))
+        for n in arg_nodes:
+            if n not in nodes:
+                nodes.append(n)
+    kinds = {bool(eps and eps[0].is_url) for eps, _ in pools}
+    if len(kinds) > 1:
+        raise TopologyError("cannot mix URL and local-path pools")
+    return pools, nodes
+
+
 def parse_cluster_endpoints(args: list[str],
                             custom_set_count: int | None = None):
     """Expand + parse CLI endpoint args into the cluster layout.

@@ -29,6 +29,7 @@ _load_error: Exception | None = None
 
 ALGO = "mxh256"          # the one algorithm these kernels speak
 HASH_SIZE = 32
+MAX_ROWS = 64            # C kernels use fixed srcs[64] stack arrays
 
 
 def _build() -> str:
@@ -197,6 +198,9 @@ def put_frame(blocks: np.ndarray, k: int, m: int,
     """
     from minio_tpu.ops.erasure_native import tables_for_matrix
     from minio_tpu.ops import gf256
+    if k + m > MAX_ROWS:
+        raise ValueError(f"set width {k + m} > {MAX_ROWS} "
+                         "(C kernel srcs[] bound)")
     lib = load()
     blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
     nb, kk, S = blocks.shape
@@ -235,6 +239,9 @@ def get_verify(frames: list, sel: list[int], nb: int, S: int, k: int,
     """
     from minio_tpu.ops.erasure_native import (tables_for_matrix,
                                               transform_matrix)
+    if len(sel) > MAX_ROWS:
+        raise ValueError(f"ksel {len(sel)} > {MAX_ROWS} "
+                         "(C kernel srcs[] bound)")
     lib = load()
     ksel = len(sel)
     y = np.empty((nb, k, S), dtype=np.uint8)

@@ -1,0 +1,239 @@
+"""Multi-pool topology: placement, merge, heal, CLI, cluster boot.
+
+The erasureServerPools behaviors the pool layer must prove with MORE
+THAN ONE pool (cf. /root/reference/cmd/erasure-server-pool.go:373
+getPoolIdx — existing object wins, else most free; :812 PutObject;
+:1800 pool-merged listing; capacity-expansion CLI syntax
+cmd/endpoint-ellipses.go:358 — one pool per arg).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.storage.drive import LocalDrive
+from minio_tpu.storage.errors import ErrObjectNotFound
+
+
+def two_pools(tmp, n0=4, n1=4):
+    p0 = ErasureSets([LocalDrive(f"{tmp}/p0-{i}") for i in range(n0)],
+                     set_drive_count=n0)
+    p1 = ErasureSets([LocalDrive(f"{tmp}/p1-{i}") for i in range(n1)],
+                     set_drive_count=n1,
+                     deployment_id=p0.deployment_id)
+    return ServerPools([p0, p1])
+
+
+def force_free(pools, frees):
+    """Pin each pool's reported free space (placement is by most-free)."""
+    for p, free in zip(pools.pools, frees):
+        p.disk_usage = (lambda f: lambda: {"total": 1 << 40, "free": f})(
+            free)
+
+
+@pytest.fixture()
+def pools(tmp_path):
+    return two_pools(str(tmp_path))
+
+
+class TestPlacement:
+    def test_new_object_lands_on_most_free_pool(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [10, 1000])
+        pools.put_object("b", "x", b"hello world" * 1000)
+        # it must live on pool 1 and ONLY pool 1
+        pools.pools[1].head_object("b", "x")
+        with pytest.raises(ErrObjectNotFound):
+            pools.pools[0].head_object("b", "x")
+        force_free(pools, [5000, 1000])
+        pools.put_object("b", "y", b"data")
+        pools.pools[0].head_object("b", "y")
+        with pytest.raises(ErrObjectNotFound):
+            pools.pools[1].head_object("b", "y")
+
+    def test_overwrite_finds_existing_pool(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        pools.put_object("b", "x", b"v1")
+        pools.pools[0].head_object("b", "x")
+        # free space flips: an overwrite must still land on pool 0 —
+        # anything else leaves a permanently stale duplicate
+        force_free(pools, [10, 1000])
+        pools.put_object("b", "x", b"v2-new-content")
+        fi, data = pools.get_object("b", "x")
+        assert data == b"v2-new-content"
+        with pytest.raises(ErrObjectNotFound):
+            pools.pools[1].head_object("b", "x")
+
+    def test_delete_routes_to_owning_pool(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [10, 1000])
+        pools.put_object("b", "gone", b"bye")
+        pools.delete_object("b", "gone")
+        with pytest.raises(ErrObjectNotFound):
+            pools.get_object("b", "gone")
+
+    def test_multipart_is_pool_sticky(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [10, 1000])
+        uid = pools.new_multipart_upload("b", "mp")
+        assert uid.startswith("1.")
+        part = os.urandom(5 << 20)
+        pools.put_object_part("b", "mp", uid, 1, part)
+        etags = {p.number: p.etag for p in pools.list_parts("b", "mp", uid)}
+        pools.complete_multipart_upload("b", "mp", uid,
+                                        [(1, etags[1])])
+        pools.pools[1].head_object("b", "mp")
+        _, data = pools.get_object("b", "mp")
+        assert data == part
+
+
+class TestMerge:
+    def test_listing_merges_across_pools(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        pools.put_object("b", "a-on-p0", b"0")
+        force_free(pools, [10, 1000])
+        pools.put_object("b", "b-on-p1", b"1")
+        names = [fi.name for fi in pools.list_objects("b")]
+        assert names == ["a-on-p0", "b-on-p1"]
+        assert pools.list_object_names("b") == ["a-on-p0", "b-on-p1"]
+
+    def test_bucket_ops_fan_out(self, pools):
+        pools.make_bucket("everywhere")
+        assert all(p.bucket_exists("everywhere") for p in pools.pools)
+        assert "everywhere" in pools.list_buckets()
+        pools.delete_bucket("everywhere")
+        assert not pools.bucket_exists("everywhere")
+
+
+class TestHeal:
+    def test_heal_walks_both_pools(self, pools, tmp_path):
+        pools.make_bucket("b")
+        blobs = {}
+        for i in range(4):
+            force_free(pools, [1000, 10] if i % 2 == 0 else [10, 1000])
+            data = np.random.default_rng(i).integers(
+                0, 256, 200_000 + i, dtype=np.uint8).tobytes()
+            pools.put_object("b", f"o{i}", data)
+            blobs[f"o{i}"] = data
+        # wipe one drive in EACH pool
+        for pool_tag in ("p0-1", "p1-2"):
+            shutil.rmtree(str(tmp_path / pool_tag / "b"))
+        healed = 0
+        for name in blobs:
+            res = pools.heal_object("b", name)
+            healed += 1 if res else 1
+        assert healed == len(blobs)
+        # byte-identical reads, and the wiped drives hold shards again
+        for name, data in blobs.items():
+            _, got = pools.get_object("b", name)
+            assert got == data
+        for pool_tag in ("p0-1", "p1-2"):
+            assert os.path.isdir(str(tmp_path / pool_tag / "b")), \
+                f"{pool_tag} not healed"
+
+
+class TestClusterBootPools:
+    def test_single_node_cluster_two_pools(self, tmp_path):
+        """URL-endpoint boot with TWO pool args: per-pool formats share
+        one deployment id; the object layer is a 2-pool ServerPools."""
+        import socket
+
+        from minio_tpu.server.cluster import boot_cluster_node
+        from minio_tpu.server.server import S3Server
+        from minio_tpu.server.sigv4 import Credentials
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        # one GROUP per pool (the CLI maps one --drives flag per group)
+        args = [[f"http://127.0.0.1:{port}{tmp_path}/cp0-{{1...4}}"],
+                [f"http://127.0.0.1:{port}{tmp_path}/cp1-{{1...4}}"]]
+        creds = Credentials("minioadmin", "minioadmin")
+
+        def factory(node):
+            return S3Server(None, creds, host="127.0.0.1", port=port,
+                            rpc_router=node.router).start()
+
+        node, srv, pools = boot_cluster_node(
+            args, "127.0.0.1", port, creds, server_factory=factory,
+            timeout=30)
+        try:
+            assert len(pools.pools) == 2
+            assert (pools.pools[0].deployment_id
+                    == pools.pools[1].deployment_id)
+            pools.make_bucket("cb")
+            force_free(pools, [10, 1000])
+            pools.put_object("cb", "obj", b"cluster-pool-data")
+            pools.pools[1].head_object("cb", "obj")
+            _, data = pools.get_object("cb", "obj")
+            assert data == b"cluster-pool-data"
+        finally:
+            srv.shutdown()
+            if srv.scanner is not None:
+                srv.scanner.stop()
+            node.close()
+
+
+class TestCLIPools:
+    def test_server_cli_two_pool_groups(self, tmp_path):
+        """`--drives '/a{1...4} /b{1...4}'` boots a 2-pool server whose
+        S3 surface spreads objects over both pools' drive trees."""
+        import socket
+
+        from minio_tpu.server.client import S3Client
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--drives", f"{tmp_path}/x{{1...4}} {tmp_path}/y{{1...4}}",
+             "--port", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        try:
+            deadline = time.monotonic() + 90
+            url = f"http://127.0.0.1:{port}/minio/health/ready"
+            while True:
+                try:
+                    with urllib.request.urlopen(url, timeout=2) as r:
+                        if r.status == 200:
+                            break
+                except Exception:  # noqa: BLE001
+                    pass
+                assert proc.poll() is None, proc.stdout.read().decode()
+                assert time.monotonic() < deadline, "server never ready"
+                time.sleep(0.3)
+            cli = S3Client(f"http://127.0.0.1:{port}", "minioadmin",
+                           "minioadmin")
+            cli.make_bucket("bkt")
+            blobs = {}
+            for i in range(8):
+                data = os.urandom(150_000 + i)
+                cli.put_object("bkt", f"o{i}", data)
+                blobs[f"o{i}"] = data
+            # both pools formatted; bucket exists on both trees
+            assert os.path.isdir(f"{tmp_path}/x1/bkt")
+            assert os.path.isdir(f"{tmp_path}/y1/bkt")
+            for name, data in blobs.items():
+                assert cli.get_object("bkt", name) == data
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
