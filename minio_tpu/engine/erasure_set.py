@@ -358,9 +358,18 @@ class ErasureSet:
         self._mark_dirty(bucket)
         return fi
 
+    def clamp_parity(self, parity: int | None) -> int:
+        """Request-supplied parity (storage-class plumbing) clamped to
+        the stripe's sane range — EC:N beyond n/2 would starve data
+        shards (the reference validates SC parity the same way,
+        internal/config/storageclass/storage-class.go)."""
+        if parity is None:
+            return self.default_parity
+        return max(0, min(int(parity), self.n // 2))
+
     def _put_object_locked(self, bucket, obj, data, *, metadata,
                            versioned, parity) -> FileInfo:
-        parity = self.default_parity if parity is None else parity
+        parity = self.clamp_parity(parity)
         # Parity upgrade: offline drives become parity so the write keeps
         # full reconstruction capability (cf. erasure-object.go:766-800).
         offline = sum(1 for d in self.drives if d is None)

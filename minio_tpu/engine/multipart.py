@@ -69,7 +69,7 @@ def new_multipart_upload(es: ErasureSet, bucket: str, obj: str, *,
     from ..storage.errors import ErrBucketNotFound
     if not es.bucket_exists(bucket):
         raise ErrBucketNotFound(bucket)
-    parity = es.default_parity if parity is None else parity
+    parity = es.clamp_parity(parity)
     offline = sum(1 for d in es.drives if d is None)
     if offline and parity < es.n // 2:
         parity = min(parity + offline, es.n // 2)

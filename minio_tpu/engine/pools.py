@@ -69,8 +69,11 @@ class ServerPools:
         if real:
             raise real[0]
 
-    def bucket_exists(self, bucket: str) -> bool:
-        return any(p.bucket_exists(bucket) for p in self.pools)
+    def bucket_exists(self, bucket: str, cached: bool = False) -> bool:
+        # cached=True is the write hot path's pre-check (see
+        # ErasureSet.bucket_exists); explicit queries always stat.
+        return any(p.bucket_exists(bucket, cached=cached)
+                   for p in self.pools)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         errs = []
@@ -97,7 +100,7 @@ class ServerPools:
 
     def put_object(self, bucket: str, obj: str, data: bytes,
                    **kw) -> FileInfo:
-        if not self.bucket_exists(bucket):
+        if not self.bucket_exists(bucket, cached=True):
             raise ErrBucketNotFound(bucket)
         return self.pools[self.get_pool_idx(bucket, obj)].put_object(
             bucket, obj, data, **kw)

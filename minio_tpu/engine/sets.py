@@ -78,8 +78,9 @@ class ErasureSets:
         if real:
             raise real[0]
 
-    def bucket_exists(self, bucket: str) -> bool:
-        return any(s.bucket_exists(bucket) for s in self.sets)
+    def bucket_exists(self, bucket: str, cached: bool = False) -> bool:
+        return any(s.bucket_exists(bucket, cached=cached)
+                   for s in self.sets)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         errs = []

@@ -155,6 +155,14 @@ def main(argv: list[str] | None = None) -> int:
     pool_paths: list[list[str]] = []
     for group in drive_groups:
         if len(group) > 1 and any(has_ellipses(a) for a in group):
+            if not all(has_ellipses(a) for a in group):
+                # The reference rejects mixed args too — a plain path
+                # next to ellipsis pools would become a nonsensical
+                # 1-drive pool.
+                print("--drives: cannot mix ellipsis pool patterns "
+                      f"with plain paths in one group: {group}",
+                      file=sys.stderr)
+                return 2
             pool_paths.extend(expand_ellipses(a) for a in group)
         else:
             pool_paths.append(

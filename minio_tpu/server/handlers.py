@@ -108,9 +108,33 @@ class S3Handlers:
         self.compress_enabled = compress_enabled
         self.tier_mgr = tier_mgr          # bucket.tier.TierManager
         self.bucket_dns = bucket_dns      # cluster.federation.BucketDNS
+        # Built eagerly: a lazy property would race under the threaded
+        # server and split the admin config plane (server.py shares
+        # this instance) from the data path.
+        from ..config.config import ConfigSys
+        self.config_sys = ConfigSys(pools)
 
     # Client-visible size of a transformed (compressed/encrypted) object.
     CLIENT_SIZE_KEY = "x-mtpu-internal-client-size"
+
+    # x-amz-storage-class -> storage_class config key (parity source,
+    # cf. GetParityForSC at cmd/erasure-object.go:761 and
+    # internal/config/storageclass/storage-class.go).
+    SC_HEADER = "x-amz-storage-class"
+    STORAGE_CLASSES = {"STANDARD": "standard", "REDUCED_REDUNDANCY": "rrs"}
+
+    def _parity_for_request(self, h: dict, metadata: dict) -> int | None:
+        """Parse x-amz-storage-class: validate, map through the
+        storage_class config to a parity count, and record the class on
+        the object (non-STANDARD only, like AWS listings)."""
+        sc = h.get(self.SC_HEADER, "").upper()
+        if not sc:
+            return None
+        if sc not in self.STORAGE_CLASSES:
+            raise S3Error("InvalidStorageClass")
+        if sc != "STANDARD":
+            metadata[self.SC_HEADER] = sc
+        return self.config_sys.parity_for_class(self.STORAGE_CLASSES[sc])
 
     def _logical_size(self, fi) -> int:
         from ..bucket.tier import TIER_SIZE_KEY
@@ -456,7 +480,8 @@ class S3Handlers:
                 _el(c, "LastModified", _iso(fi.mod_time_ns))
                 _el(c, "ETag", f'"{fi.metadata.get("etag", "")}"')
                 _el(c, "Size", self._logical_size(fi))
-                _el(c, "StorageClass", "STANDARD")
+                _el(c, "StorageClass",
+                    fi.metadata.get(self.SC_HEADER, "STANDARD"))
         return Response(200, _xml(root), {"Content-Type": "application/xml"})
 
     def list_object_versions(self, bucket: str, query: dict) -> Response:
@@ -555,6 +580,8 @@ class S3Handlers:
         }
         if fi.version_id:
             h["x-amz-version-id"] = fi.version_id
+        if S3Handlers.SC_HEADER in fi.metadata:
+            h[S3Handlers.SC_HEADER] = fi.metadata[S3Handlers.SC_HEADER]
         for k, v in fi.metadata.items():
             if k.startswith(AMZ_META_PREFIX):
                 h[k] = v
@@ -803,6 +830,7 @@ class S3Handlers:
                     if k.startswith(AMZ_META_PREFIX)}
         if "content-type" in h:
             metadata["content-type"] = h["content-type"]
+        parity = self._parity_for_request(h, metadata)
 
         # Quota enforcement (cf. enforceBucketQuotaHard,
         # cmd/bucket-quota.go).
@@ -879,7 +907,8 @@ class S3Handlers:
         try:
             fi = self.pools.put_object(bucket, key, stored,
                                        metadata=metadata,
-                                       versioned=versioned)
+                                       versioned=versioned,
+                                       parity=parity)
         except StorageError as e:
             raise from_storage_error(e) from None
         if replaced_tiered:
@@ -973,9 +1002,22 @@ class S3Handlers:
             elif not su and not compressed:
                 metadata.pop(self.CLIENT_SIZE_KEY, None)
         versioned = self.bucket_versioning_enabled(bucket)
+        # Storage class: an explicit request header re-classes the copy;
+        # otherwise the source's class (already riding in metadata)
+        # keeps its parity (cf. CopyObject storage-class handling,
+        # cmd/object-handlers.go).
+        if self.SC_HEADER in h:
+            metadata.pop(self.SC_HEADER, None)
+            parity = self._parity_for_request(h, metadata)
+        elif self.SC_HEADER in metadata:
+            parity = self.config_sys.parity_for_class(
+                self.STORAGE_CLASSES.get(metadata[self.SC_HEADER],
+                                         "standard"))
+        else:
+            parity = None
         try:
             out = self.pools.put_object(bucket, key, data, metadata=metadata,
-                                        versioned=versioned)
+                                        versioned=versioned, parity=parity)
         except StorageError as e:
             raise from_storage_error(e) from None
         root = ET.Element("CopyObjectResult", xmlns=S3_NS)
@@ -1217,6 +1259,9 @@ class S3Handlers:
                     if k.startswith(AMZ_META_PREFIX)}
         if "content-type" in h:
             metadata["content-type"] = h["content-type"]
+        # Storage class fixes the stripe geometry for EVERY part now
+        # (cf. newMultipartUpload, cmd/erasure-multipart.go:39).
+        parity = self._parity_for_request(h, metadata)
         # Default retention stamps the upload now; the lock/quota gate
         # runs again at complete time when the size is known.
         lock_cfg = self._lock_config(bucket)
@@ -1225,7 +1270,8 @@ class S3Handlers:
             metadata.update(ol.default_retention_metadata(lock_cfg))
         try:
             upload_id = self.pools.new_multipart_upload(bucket, key,
-                                                        metadata=metadata)
+                                                        metadata=metadata,
+                                                        parity=parity)
         except StorageError as e:
             raise from_storage_error(e) from None
         root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)

@@ -786,8 +786,10 @@ class S3Server:
                 return j({"ok": True})
         if sub == "config":
             if not hasattr(self, "config") or self.config is None:
-                from ..config.config import ConfigSys
-                self.config = ConfigSys(self.pools)
+                # Shared with the data path: the PUT handler reads
+                # storage_class parity from the same instance, so an
+                # admin `config set` applies without a restart.
+                self.config = self.handlers.config_sys
             if method == "GET":
                 subsys = query.get("subsys", [""])[0]
                 if subsys:
@@ -803,8 +805,7 @@ class S3Server:
                 return j({"ok": True})
         if sub == "config-help" and method == "GET":
             if not hasattr(self, "config") or self.config is None:
-                from ..config.config import ConfigSys
-                self.config = ConfigSys(self.pools)
+                self.config = self.handlers.config_sys
             return j(self.config.help(query.get("subsys", [""])[0]))
         if sub == "profile":
             # cf. StartProfilingHandler/DownloadProfilingHandler,

@@ -132,7 +132,7 @@ class TestHeal:
         healed = 0
         for name in blobs:
             res = pools.heal_object("b", name)
-            healed += 1 if res else 1
+            healed += 1 if res else 0
         assert healed == len(blobs)
         # byte-identical reads, and the wiped drives hold shards again
         for name, data in blobs.items():

@@ -1,0 +1,165 @@
+"""x-amz-storage-class -> per-object parity plumbing.
+
+cf. GetParityForSC (/root/reference/cmd/erasure-object.go:761),
+internal/config/storageclass/storage-class.go (STANDARD/RRS EC:N),
+and the per-request header parse in cmd/object-handlers.go.
+"""
+
+import glob
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.server.client import S3Client
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+
+@pytest.fixture()
+def srv(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(6)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=6)])
+    server = S3Server(pools, Credentials("scadmin", "scadmin-secret"))
+    server.start()
+    cli = S3Client(server.endpoint, "scadmin", "scadmin-secret")
+    cli.make_bucket("scb")
+    yield server, cli, pools, tmp_path
+    server.shutdown()
+
+
+def parity_of(pools, bucket, obj):
+    fi = pools.head_object(bucket, obj)
+    return fi.erasure.parity_blocks
+
+
+DATA = np.random.default_rng(5).integers(0, 256, 600_000,
+                                         dtype=np.uint8).tobytes()
+
+
+class TestStorageClass:
+    def test_two_classes_one_bucket_different_parities(self, srv):
+        server, cli, pools, tmp = srv
+        cli.put_object("scb", "std", DATA,
+                       headers={"x-amz-storage-class": "STANDARD"})
+        cli.put_object("scb", "rrs", DATA,
+                       headers={"x-amz-storage-class":
+                                "REDUCED_REDUNDANCY"})
+        cli.put_object("scb", "default", DATA)
+        # config defaults: standard EC:2, rrs EC:1; engine default n//2=3
+        assert parity_of(pools, "scb", "std") == 2
+        assert parity_of(pools, "scb", "rrs") == 1
+        assert parity_of(pools, "scb", "default") == 3
+
+    def test_on_disk_shard_layout_matches_class(self, srv):
+        """All n drives hold a shard either way, but the DATA/PARITY
+        split (and therefore loss tolerance) follows the class."""
+        server, cli, pools, tmp = srv
+        cli.put_object("scb", "rrs", DATA,
+                       headers={"x-amz-storage-class":
+                                "REDUCED_REDUNDANCY"})
+        fi = pools.head_object("scb", "rrs")
+        assert fi.erasure.data_blocks == 5
+        shards = glob.glob(f"{tmp}/d*/scb/rrs/*/part.1")
+        assert len(shards) == 6
+
+    def test_degraded_read_respects_class_parity(self, srv):
+        server, cli, pools, tmp = srv
+        cli.put_object("scb", "std", DATA,
+                       headers={"x-amz-storage-class": "STANDARD"})
+        cli.put_object("scb", "rrs", DATA,
+                       headers={"x-amz-storage-class":
+                                "REDUCED_REDUNDANCY"})
+        es = pools.pools[0].sets[0]
+        saved = es.drives[0], es.drives[1]
+        # one drive down: both classes still readable
+        es.drives[0] = None
+        assert cli.get_object("scb", "std") == DATA
+        assert cli.get_object("scb", "rrs") == DATA
+        # two drives down: EC:2 still reads, EC:1 must fail
+        es.drives[1] = None
+        assert cli.get_object("scb", "std") == DATA
+        from minio_tpu.server.client import S3ClientError
+        with pytest.raises(S3ClientError):
+            cli.get_object("scb", "rrs")
+        es.drives[0], es.drives[1] = saved
+
+    def test_head_and_listing_surface_class(self, srv):
+        server, cli, pools, tmp = srv
+        cli.put_object("scb", "rrs", DATA,
+                       headers={"x-amz-storage-class":
+                                "REDUCED_REDUNDANCY"})
+        cli.put_object("scb", "std", DATA)
+        h = cli.head_object("scb", "rrs")
+        assert h.get("x-amz-storage-class") == "REDUCED_REDUNDANCY"
+        h2 = cli.head_object("scb", "std")
+        assert "x-amz-storage-class" not in h2
+        _, _, body = cli.request("GET", "/scb", query={"list-type": "2"})
+        assert b"<StorageClass>REDUCED_REDUNDANCY</StorageClass>" in body
+        assert b"<StorageClass>STANDARD</StorageClass>" in body
+
+    def test_invalid_class_rejected(self, srv):
+        server, cli, pools, tmp = srv
+        from minio_tpu.server.client import S3ClientError
+        with pytest.raises(S3ClientError) as ei:
+            cli.put_object("scb", "bad", b"tiny",
+                           headers={"x-amz-storage-class": "GLACIER"})
+        assert ei.value.code == "InvalidStorageClass"
+
+    def test_multipart_honors_class(self, srv):
+        server, cli, pools, tmp = srv
+        _, _, body = cli.request(
+            "POST", "/scb/mpsc", query={"uploads": ""},
+            headers={"x-amz-storage-class": "REDUCED_REDUNDANCY"})
+        import xml.etree.ElementTree as ET
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        uid = ET.fromstring(body).findtext(f"{ns}UploadId")
+        part = DATA * 12                           # > inline threshold
+        _, h, _ = cli.request("PUT", "/scb/mpsc",
+                              query={"uploadId": uid, "partNumber": "1"},
+                              body=part)
+        etag = h["ETag"].strip('"')
+        root = ET.Element("CompleteMultipartUpload")
+        p = ET.SubElement(root, "Part")
+        ET.SubElement(p, "PartNumber").text = "1"
+        ET.SubElement(p, "ETag").text = etag
+        cli.request("POST", "/scb/mpsc", query={"uploadId": uid},
+                    body=ET.tostring(root))
+        assert parity_of(pools, "scb", "mpsc") == 1
+        assert cli.get_object("scb", "mpsc") == part
+
+    def test_config_set_changes_class_parity(self, srv):
+        """`admin config set storage_class rrs EC:2` applies to the
+        data path without a restart (shared ConfigSys)."""
+        server, cli, pools, tmp = srv
+        import json
+        st, _, _ = cli.request(
+            "POST", "/minio/admin/v1/config",
+            body=json.dumps({"subsys": "storage_class", "key": "rrs",
+                             "value": "EC:2"}).encode())
+        assert st == 200
+        cli.put_object("scb", "rrs2", DATA,
+                       headers={"x-amz-storage-class":
+                                "REDUCED_REDUNDANCY"})
+        assert parity_of(pools, "scb", "rrs2") == 2
+
+    def test_copy_preserves_and_overrides_class(self, srv):
+        server, cli, pools, tmp = srv
+        cli.put_object("scb", "src", DATA,
+                       headers={"x-amz-storage-class":
+                                "REDUCED_REDUNDANCY"})
+        # plain copy keeps the class + parity
+        cli.request("PUT", "/scb/copied",
+                    headers={"x-amz-copy-source": "/scb/src"})
+        assert parity_of(pools, "scb", "copied") == 1
+        h = cli.head_object("scb", "copied")
+        assert h.get("x-amz-storage-class") == "REDUCED_REDUNDANCY"
+        # re-class on copy
+        cli.request("PUT", "/scb/upclassed",
+                    headers={"x-amz-copy-source": "/scb/src",
+                             "x-amz-storage-class": "STANDARD"})
+        assert parity_of(pools, "scb", "upclassed") == 2
+        h = cli.head_object("scb", "upclassed")
+        assert "x-amz-storage-class" not in h
