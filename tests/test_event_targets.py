@@ -350,3 +350,315 @@ class TestBrokerTargets:
         finally:
             tgt.close()
             broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# round-5 targets: MQTT / Redis / PostgreSQL / MySQL / Elasticsearch / NSQ
+# ---------------------------------------------------------------------------
+
+from minio_tpu.bucket.event_targets import (ElasticsearchTarget,  # noqa: E402
+                                            MQTTTarget, MySQLTarget,
+                                            NSQTarget, PostgresTarget,
+                                            RedisTarget)
+
+
+def _read_exact(conn, n):
+    out = bytearray()
+    while len(out) < n:
+        piece = conn.recv(n - len(out))
+        if not piece:
+            raise OSError("closed")
+        out += piece
+    return bytes(out)
+
+
+class FakeMQTT(_FakeBroker):
+    def _varint(self, conn):
+        mult, val = 1, 0
+        while True:
+            b = _read_exact(conn, 1)[0]
+            val += (b & 0x7F) * mult
+            if not b & 0x80:
+                return val
+            mult *= 128
+
+    def serve_conn(self, conn):
+        head = _read_exact(conn, 1)
+        assert head[0] == 0x10, head             # CONNECT
+        _read_exact(conn, self._varint(conn))
+        conn.sendall(bytes([0x20, 2, 0, 0]))     # CONNACK accepted
+        while True:
+            h = _read_exact(conn, 1)[0]
+            size = self._varint(conn)
+            body = _read_exact(conn, size)
+            if h & 0xF0 == 0x30:                 # PUBLISH (QoS 1)
+                tlen = struct.unpack(">H", body[:2])[0]
+                pid = struct.unpack(">H", body[2 + tlen:4 + tlen])[0]
+                self.received.append(body[4 + tlen:])
+                conn.sendall(bytes([0x40, 2]) + struct.pack(">H", pid))
+
+
+class FakeRedis(_FakeBroker):
+    def serve_conn(self, conn):
+        buf = bytearray()
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                piece = conn.recv(4096)
+                if not piece:
+                    raise OSError("closed")
+                buf += piece
+            i = buf.index(b"\r\n")
+            line = bytes(buf[:i])
+            del buf[:i + 2]
+            return line
+
+        def read_nbytes(n):
+            nonlocal buf
+            while len(buf) < n:
+                piece = conn.recv(4096)
+                if not piece:
+                    raise OSError("closed")
+                buf += piece
+            out = bytes(buf[:n])
+            del buf[:n]
+            return out
+
+        while True:
+            hdr = read_line()
+            assert hdr[:1] == b"*", hdr
+            parts = []
+            for _ in range(int(hdr[1:])):
+                ln = read_line()
+                assert ln[:1] == b"$"
+                parts.append(read_nbytes(int(ln[1:]) + 2)[:-2])
+            cmd = parts[0].upper()
+            if cmd == b"PING":
+                conn.sendall(b"+PONG\r\n")
+            elif cmd == b"RPUSH":
+                self.received.append(parts[2])
+                conn.sendall(b":1\r\n")
+            elif cmd == b"HSET":
+                self.received.append(parts[3])
+                conn.sendall(b":1\r\n")
+            elif cmd == b"HDEL":
+                self.received.append(b'{"deleted": "'
+                                     + parts[2] + b'"}')
+                conn.sendall(b":1\r\n")
+            else:
+                conn.sendall(b"-ERR unknown\r\n")
+
+
+def _sql_event(sql: str, esc: str) -> bytes:
+    """Pull the event JSON literal out of an INSERT statement."""
+    import re
+    m = re.search(r"VALUES \('[^']*', '(.*)'\)", sql, re.S)
+    assert m, sql
+    raw = m.group(1)
+    if esc == "pg":
+        return raw.replace("''", "'").encode()
+    return raw.replace("\\'", "'").replace("\\\\", "\\").encode()
+
+
+class FakePostgres(_FakeBroker):
+    def serve_conn(self, conn):
+        size = struct.unpack(">I", _read_exact(conn, 4))[0]
+        _read_exact(conn, size - 4)              # startup params
+        conn.sendall(b"R" + struct.pack(">II", 8, 0))        # AuthOk
+        conn.sendall(b"Z" + struct.pack(">I", 5) + b"I")     # Ready
+        while True:
+            tag = _read_exact(conn, 1)
+            assert tag == b"Q", tag
+            size = struct.unpack(">I", _read_exact(conn, 4))[0]
+            sql = _read_exact(conn, size - 4)[:-1].decode()
+            if "INSERT" in sql:
+                self.received.append(_sql_event(sql, "pg"))
+            done = b"INSERT 0 1\x00"
+            conn.sendall(b"C" + struct.pack(">I", 4 + len(done)) + done)
+            conn.sendall(b"Z" + struct.pack(">I", 5) + b"I")
+
+
+class FakeMySQL(_FakeBroker):
+    def _send_pkt(self, conn, seq, payload):
+        n = len(payload)
+        conn.sendall(bytes([n & 0xFF, (n >> 8) & 0xFF,
+                            (n >> 16) & 0xFF, seq]) + payload)
+
+    def _read_pkt(self, conn):
+        head = _read_exact(conn, 4)
+        size = head[0] | head[1] << 8 | head[2] << 16
+        return head[3], _read_exact(conn, size)
+
+    def serve_conn(self, conn):
+        greet = (bytes([10]) + b"8.0-fake\x00"
+                 + struct.pack("<I", 1) + b"12345678\x00"
+                 + struct.pack("<H", 0xFFFF) + bytes([33])
+                 + struct.pack("<H", 2) + struct.pack("<H", 0xFFFF)
+                 + bytes([21]) + b"\x00" * 10 + b"123456789012\x00"
+                 + b"mysql_native_password\x00")
+        self._send_pkt(conn, 0, greet)
+        self._read_pkt(conn)                     # HandshakeResponse41
+        self._send_pkt(conn, 2, b"\x00\x00\x00\x02\x00\x00\x00")  # OK
+        while True:
+            _, payload = self._read_pkt(conn)
+            assert payload[:1] == b"\x03", payload[:1]
+            sql = payload[1:].decode()
+            if "INSERT" in sql:
+                self.received.append(_sql_event(sql, "mysql"))
+            self._send_pkt(conn, 1, b"\x00\x01\x00\x02\x00\x00\x00")
+
+
+class FakeES(_FakeBroker):
+    def serve_conn(self, conn):
+        buf = bytearray()
+        while True:
+            while b"\r\n\r\n" not in buf:
+                piece = conn.recv(4096)
+                if not piece:
+                    raise OSError("closed")
+                buf += piece
+            i = buf.index(b"\r\n\r\n")
+            head = bytes(buf[:i]).decode()
+            del buf[:i + 4]
+            clen = 0
+            for ln in head.split("\r\n")[1:]:
+                if ln.lower().startswith("content-length:"):
+                    clen = int(ln.split(":", 1)[1])
+            while len(buf) < clen:
+                piece = conn.recv(4096)
+                if not piece:
+                    raise OSError("closed")
+                buf += piece
+            body = bytes(buf[:clen])
+            del buf[:clen]
+            if body:
+                self.received.append(body)
+            resp = b'{"result":"created"}'
+            conn.sendall(b"HTTP/1.1 201 Created\r\nContent-Type: "
+                         b"application/json\r\nContent-Length: "
+                         + str(len(resp)).encode() + b"\r\n\r\n" + resp)
+
+
+class FakeNSQ(_FakeBroker):
+    def serve_conn(self, conn):
+        magic = _read_exact(conn, 4)
+        assert magic == b"  V2", magic
+        buf = bytearray()
+        while True:
+            while b"\n" not in buf:
+                piece = conn.recv(4096)
+                if not piece:
+                    raise OSError("closed")
+                buf += piece
+            i = buf.index(b"\n")
+            line = bytes(buf[:i])
+            del buf[:i + 1]
+            if line == b"NOP":
+                continue
+            assert line.startswith(b"PUB "), line
+            while len(buf) < 4:
+                buf += conn.recv(4096)
+            size = struct.unpack(">I", buf[:4])[0]
+            del buf[:4]
+            while len(buf) < size:
+                buf += conn.recv(4096)
+            self.received.append(bytes(buf[:size]))
+            del buf[:size]
+            conn.sendall(struct.pack(">Ii", 6, 0) + b"OK")
+
+
+def _mk5(kind, path, tmp_path):
+    store = str(tmp_path / f"{kind}-store")
+    if kind == "mqtt":
+        return MQTTTarget("arn:mqtt", path, 0, "minio/events",
+                          store_dir=store, timeout=2.0)
+    if kind == "redis":
+        return RedisTarget("arn:redis", path, 0, "minio-events",
+                           store_dir=store, timeout=2.0)
+    if kind == "postgres":
+        return PostgresTarget("arn:pg", path, 0, "bucket_events",
+                              store_dir=store, timeout=2.0)
+    if kind == "mysql":
+        return MySQLTarget("arn:mysql", path, 0, "bucket_events",
+                           store_dir=store, timeout=2.0)
+    if kind == "es":
+        return ElasticsearchTarget("arn:es", path, 0, "bucket-events",
+                                   store_dir=store, timeout=2.0)
+    return NSQTarget("arn:nsq", path, 0, "bucket-events",
+                     store_dir=store, timeout=2.0)
+
+
+R5_KINDS = [("mqtt", FakeMQTT), ("redis", FakeRedis),
+            ("postgres", FakePostgres), ("mysql", FakeMySQL),
+            ("es", FakeES), ("nsq", FakeNSQ)]
+
+
+@pytest.mark.parametrize("kind,broker_cls", R5_KINDS)
+class TestRound5Targets:
+    def test_publish_over_the_wire(self, kind, broker_cls, tmp_path):
+        path = str(tmp_path / f"{kind}.sock")
+        broker = broker_cls(path)
+        tgt = _mk5(kind, path, tmp_path)
+        try:
+            for i in range(3):
+                tgt.send({**EVENT, "i": i})
+            deadline = time.monotonic() + 5
+            while len(broker.received) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert len(broker.received) == 3
+            recs = [p["Records"][0] for p in broker.payloads]
+            assert [r["i"] for r in recs] == [0, 1, 2]
+            assert tgt.backlog.events == []
+        finally:
+            tgt.close()
+            broker.stop()
+
+    def test_store_and_forward_across_service_death(self, kind,
+                                                    broker_cls,
+                                                    tmp_path):
+        path = str(tmp_path / f"{kind}.sock")
+        broker = broker_cls(path)
+        tgt = _mk5(kind, path, tmp_path)
+        try:
+            tgt.send({**EVENT, "i": 0})
+            assert len(broker.received) == 1
+            broker.stop()
+            time.sleep(0.05)
+            for i in (1, 2):
+                tgt.send({**EVENT, "i": i})
+            assert len(tgt.backlog.events) == 2
+            from minio_tpu.bucket.notify import QueueTarget
+            reloaded = QueueTarget(tgt.backlog.arn, tgt.backlog.store_dir)
+            assert len(reloaded.events) == 2
+            assert tgt.retry_backlog() == 0
+            assert len(tgt.backlog.events) == 2
+            broker2 = broker_cls(path)
+            assert tgt.retry_backlog() == 2
+            assert tgt.backlog.events == []
+            got = sorted(json.loads(p)["Records"][0]["i"]
+                         for p in broker2.received)
+            assert got == [1, 2], got
+            broker2.stop()
+        finally:
+            tgt.close()
+            broker.stop()
+
+
+class TestRedisNamespace:
+    def test_hset_and_hdel_mirror_bucket(self, tmp_path):
+        path = str(tmp_path / "rns.sock")
+        broker = FakeRedis(path)
+        tgt = RedisTarget("arn:rns", path, 0, "ns-key", fmt="namespace",
+                          store_dir=str(tmp_path / "rns-store"),
+                          timeout=2.0)
+        try:
+            tgt.send(EVENT)                           # HSET k
+            tgt.send({"eventName": "s3:ObjectRemoved:Delete",
+                      "s3": {"object": {"key": "k"}}})  # HDEL k
+            assert len(broker.received) == 2
+            assert json.loads(broker.received[0])["Records"]
+            assert json.loads(broker.received[1]) == {"deleted": "k"}
+        finally:
+            tgt.close()
+            broker.stop()
