@@ -1,11 +1,32 @@
-"""Disk cache: read-through ObjectLayer wrapper with LRU eviction.
+"""Disk cache: read-through ObjectLayer wrapper with range caching and
+watermark GC.
 
-The cmd/disk-cache*.go equivalent: GETs populate an on-disk cache
-(fast local SSD in the reference's deployment shape); hits serve from
-cache after validating the backend ETag; writes/deletes invalidate.
-Eviction trims least-recently-used entries once the configured size
-budget is exceeded. Everything else proxies to the wrapped layer, so
-the wrapper composes with any backend (erasure pools or FS).
+The cmd/disk-cache.go + cmd/disk-cache-backend.go equivalent: GETs
+populate an on-disk cache (fast local SSD in the reference's deployment
+shape); hits serve from cache after validating the backend ETag; writes
+and multipart commits invalidate. Depth matching the reference:
+
+- WHOLE-OBJECT caching on full-object fills, plus RANGE caching —
+  a ranged miss fetches and caches exactly the requested range as its
+  own cache file (cacheRange, disk-cache-backend.go), and later ranged
+  GETs within any cached range (or the whole object) are hits;
+- WATERMARK GC (disk-cache.go low/high watermark): when usage crosses
+  high_watermark x max_bytes, LRU entries are evicted until usage
+  falls to low_watermark x max_bytes — not merely bounded at write;
+- get_object_iter interception so the S3 front door's streaming GET
+  path actually consults the cache; the cacheability gate compares the
+  EFFECTIVE requested length, so small ranges of huge objects cache
+  while whole huge objects stream through uncached;
+- backend-outage reads: when the backend errors (not "missing"), a
+  validated-any-time cache entry still serves (the gateway-caching
+  behavior of the reference);
+- hit/miss/eviction/usage metrics surfaced through the Prometheus
+  registry (cache_metrics()).
+
+Layout: one directory per object key (sha256), holding `data` (whole
+object), `meta.json`, and `r<lo>-<hi>` range files — lookups and
+invalidation touch only that object's directory, and GC can tell when
+a meta file has no surviving data to describe.
 """
 
 from __future__ import annotations
@@ -13,116 +34,301 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import shutil
 import threading
 import time
+
+from ..storage.errors import (ErrBucketNotFound, ErrObjectNotFound,
+                              ErrVersionNotFound, StorageError)
+
+_MISSING = (ErrObjectNotFound, ErrVersionNotFound, ErrBucketNotFound)
 
 
 class DiskCache:
     def __init__(self, backend, cache_dir: str,
-                 max_bytes: int = 1 << 30):
+                 max_bytes: int = 1 << 30,
+                 high_watermark: float = 0.8,
+                 low_watermark: float = 0.7,
+                 max_object_bytes: int | None = None):
         self.backend = backend
         self.dir = os.path.abspath(cache_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.max_bytes = max_bytes
+        self.high = high_watermark
+        self.low = low_watermark
+        # requests larger than this stream through uncached (a quarter
+        # of the budget by default, like the reference's per-object cap)
+        self.max_object_bytes = (max_object_bytes
+                                 if max_object_bytes is not None
+                                 else max_bytes // 4)
         self._mu = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+        self._usage = self._scan_usage()
 
     def __getattr__(self, name):
         # Everything not overridden proxies to the backend.
         return getattr(self.backend, name)
 
-    # -- cache mechanics -----------------------------------------------------
+    # -- cache layout --------------------------------------------------------
 
-    def _key(self, bucket: str, obj: str) -> str:
-        return hashlib.sha256(f"{bucket}\x00{obj}".encode()).hexdigest()
+    def _obj_dir(self, bucket: str, obj: str) -> str:
+        k = hashlib.sha256(f"{bucket}\x00{obj}".encode()).hexdigest()
+        return os.path.join(self.dir, k)
 
-    def _paths(self, bucket: str, obj: str) -> tuple[str, str]:
-        k = self._key(bucket, obj)
-        return (os.path.join(self.dir, k + ".data"),
-                os.path.join(self.dir, k + ".json"))
+    def _scan_usage(self) -> int:
+        total = 0
+        for root, _, files in os.walk(self.dir):
+            for fn in files:
+                if fn != "meta.json":
+                    try:
+                        total += os.stat(os.path.join(root, fn)).st_size
+                    except OSError:
+                        pass
+        return total
 
-    def _store(self, bucket: str, obj: str, fi, data: bytes) -> None:
-        dp, mp = self._paths(bucket, obj)
-        with open(dp + ".tmp", "wb") as f:
+    def usage_bytes(self) -> int:
+        return self._usage
+
+    def cache_metrics(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "usage_bytes": self._usage,
+                "max_bytes": self.max_bytes}
+
+    def _write_file(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        prev = 0
+        try:
+            prev = os.stat(path).st_size      # overwrite: don't double-count
+        except OSError:
+            pass
+        with open(path + ".tmp", "wb") as f:
             f.write(data)
-        os.replace(dp + ".tmp", dp)
-        with open(mp, "w") as f:
+        os.replace(path + ".tmp", path)
+        with self._mu:
+            self._usage += len(data) - prev
+        self._gc_if_needed()
+
+    def _write_meta(self, bucket: str, obj: str, fi) -> None:
+        d = self._obj_dir(bucket, obj)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "meta.json"), "w") as f:
             json.dump({"etag": fi.metadata.get("etag", ""),
                        "size": fi.size, "mt": fi.mod_time_ns,
                        "meta": fi.metadata}, f)
-        self._evict()
 
-    def _load(self, bucket: str, obj: str):
-        dp, mp = self._paths(bucket, obj)
+    def _store(self, bucket: str, obj: str, fi, data: bytes) -> None:
+        self._write_file(os.path.join(self._obj_dir(bucket, obj),
+                                      "data"), data)
+        self._write_meta(bucket, obj, fi)
+
+    def _store_range(self, bucket: str, obj: str, fi, lo: int,
+                     data: bytes) -> None:
+        self._write_file(
+            os.path.join(self._obj_dir(bucket, obj),
+                         f"r{lo}-{lo + len(data)}"), data)
+        # Always refresh meta: a stale etag would turn every later
+        # ranged GET of this object into a permanent miss.
+        self._write_meta(bucket, obj, fi)
+
+    def _meta(self, bucket: str, obj: str) -> dict | None:
         try:
-            with open(mp) as f:
-                meta = json.load(f)
-            with open(dp, "rb") as f:
-                data = f.read()
+            with open(os.path.join(self._obj_dir(bucket, obj),
+                                   "meta.json")) as f:
+                return json.load(f)
         except (OSError, ValueError):
             return None
+
+    def _load_whole(self, bucket: str, obj: str) -> bytes | None:
+        p = os.path.join(self._obj_dir(bucket, obj), "data")
+        try:
+            with open(p, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
         now = time.time()
-        os.utime(dp, (now, now))               # LRU touch
-        return meta, data
+        os.utime(p, (now, now))                # LRU touch
+        return data
+
+    def _load_range(self, bucket: str, obj: str, offset: int,
+                    length: int) -> bytes | None:
+        """Serve [offset, offset+length) from any cached range file
+        that covers it (only this object's directory is scanned)."""
+        d = self._obj_dir(bucket, obj)
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return None
+        for fn in names:
+            if not fn.startswith("r"):
+                continue
+            try:
+                lo, hi = map(int, fn[1:].split("-"))
+            except ValueError:
+                continue
+            if lo <= offset and offset + length <= hi:
+                p = os.path.join(d, fn)
+                try:
+                    with open(p, "rb") as f:
+                        f.seek(offset - lo)
+                        data = f.read(length)
+                except OSError:
+                    return None
+                now = time.time()
+                os.utime(p, (now, now))
+                return data
+        return None
 
     def invalidate(self, bucket: str, obj: str) -> None:
-        for p in self._paths(bucket, obj):
-            try:
-                os.unlink(p)
-            except OSError:
-                pass
-
-    def _evict(self) -> None:
+        d = self._obj_dir(bucket, obj)
         with self._mu:
-            entries = []
-            total = 0
-            for fn in os.listdir(self.dir):
-                if not fn.endswith(".data"):
-                    continue
-                p = os.path.join(self.dir, fn)
-                try:
-                    st = os.stat(p)
-                except OSError:
-                    continue
-                entries.append((st.st_atime, st.st_size, p))
-                total += st.st_size
-            if total <= self.max_bytes:
+            freed = 0
+            try:
+                for fn in os.listdir(d):
+                    if fn != "meta.json":
+                        try:
+                            freed += os.stat(os.path.join(d, fn)).st_size
+                        except OSError:
+                            pass
+            except OSError:
                 return
+            shutil.rmtree(d, ignore_errors=True)
+            self._usage -= freed
+
+    def _gc_if_needed(self) -> None:
+        """Watermark GC: crossing high*max evicts LRU down to low*max
+        (cf. diskCache.gc, cmd/disk-cache.go)."""
+        if self._usage < self.high * self.max_bytes:
+            return
+        with self._mu:
+            target = self.low * self.max_bytes
+            entries = []
+            for root, _, files in os.walk(self.dir):
+                for fn in files:
+                    if fn == "meta.json" or fn.endswith(".tmp"):
+                        continue
+                    p = os.path.join(root, fn)
+                    try:
+                        st = os.stat(p)
+                    except OSError:
+                        continue
+                    entries.append((st.st_atime, st.st_size, p))
             entries.sort()                      # oldest atime first
+            touched: set[str] = set()
             for _, size, p in entries:
+                if self._usage <= target:
+                    break
                 try:
                     os.unlink(p)
-                    os.unlink(p[:-5] + ".json")
+                    self._usage -= size
+                    self.evictions += 1
+                    touched.add(os.path.dirname(p))
+                except OSError:
+                    continue
+            # meta files describing nothing (all data evicted) go too,
+            # along with their empty object dirs
+            for d in touched:
+                try:
+                    left = [f for f in os.listdir(d) if f != "meta.json"]
+                    if not left:
+                        shutil.rmtree(d, ignore_errors=True)
                 except OSError:
                     pass
-                total -= size
-                if total <= self.max_bytes:
-                    break
 
     # -- intercepted ObjectLayer methods -------------------------------------
+
+    def _validate(self, bucket: str, obj: str):
+        """(fi_or_None, cached_meta_or_None, backend_down). A cached
+        entry is valid when its etag matches the live backend; when the
+        backend ERRORS (as opposed to reporting the object missing),
+        the cache still serves — that is the point of a gateway cache.
+        """
+        meta = self._meta(bucket, obj)
+        try:
+            fi = self.backend.head_object(bucket, obj)
+            return fi, meta, False
+        except _MISSING:
+            raise
+        except StorageError:
+            return None, meta, True
 
     def get_object(self, bucket: str, obj: str, offset: int = 0,
                    length: int = -1, version_id: str = ""):
         if version_id:
             return self.backend.get_object(bucket, obj, offset, length,
                                            version_id)
-        # validate against backend metadata (cheap) before serving a hit
-        fi = self.backend.head_object(bucket, obj)
-        cached = self._load(bucket, obj)
-        if cached is not None and \
-                cached[0].get("etag") == fi.metadata.get("etag", ""):
-            self.hits += 1
-            data = cached[1]
-            if length < 0:
-                return fi, data[offset:]
-            return fi, data[offset:offset + length]
+        fi, meta, down = self._validate(bucket, obj)
+        return self._serve(bucket, obj, fi, meta, down, offset, length)
+
+    def _serve(self, bucket, obj, fi, meta, down, offset, length):
+        """Cache-or-backend for one validated request."""
+        etag = fi.metadata.get("etag", "") if fi is not None else None
+        fresh = meta is not None and (down or meta.get("etag") == etag)
+        if fresh:
+            size = meta["size"]
+            eff_len = size - offset if length < 0 else length
+            whole = self._load_whole(bucket, obj)
+            if whole is not None:
+                self.hits += 1
+                return self._fi_from_meta(bucket, obj, meta), \
+                    whole[offset:offset + eff_len]
+            part = self._load_range(bucket, obj, offset, eff_len)
+            if part is not None:
+                self.hits += 1
+                return self._fi_from_meta(bucket, obj, meta), part
+        if down:
+            raise StorageError(f"{bucket}/{obj}: backend unreachable "
+                               "and not cached")
         self.misses += 1
-        fi, full = self.backend.get_object(bucket, obj)
-        self._store(bucket, obj, fi, full)
-        if length < 0:
-            return fi, full[offset:]
-        return fi, full[offset:offset + length]
+        if offset == 0 and length < 0:
+            fi, full = self.backend.get_object(bucket, obj)
+            if len(full) <= self.max_object_bytes:
+                self._store(bucket, obj, fi, full)
+            return fi, full
+        # ranged miss: fetch + cache exactly the requested range
+        fi2, part = self.backend.get_object(bucket, obj, offset, length)
+        if len(part) <= self.max_object_bytes:
+            self._store_range(bucket, obj, fi2, offset, part)
+        return fi2, part
+
+    @staticmethod
+    def _fi_from_meta(bucket: str, obj: str, meta: dict):
+        from ..storage.xlmeta import FileInfo, ObjectPartInfo
+        size = meta["size"]
+        return FileInfo(volume=bucket, name=obj, version_id="",
+                        data_dir="", mod_time_ns=meta.get("mt", 0),
+                        size=size, metadata=dict(meta.get("meta", {})),
+                        parts=[ObjectPartInfo(1, size, size)])
+
+    def get_object_iter(self, bucket: str, obj: str, offset: int = 0,
+                        length: int = -1, version_id: str = ""):
+        """The front door streams through this — it must consult the
+        cache or the server never hits it. One validation round-trip;
+        requests whose EFFECTIVE length exceeds max_object_bytes
+        stream straight through uncached (a small range of a huge
+        object still caches)."""
+        if version_id:
+            return self._backend_iter(bucket, obj, offset, length,
+                                      version_id)
+        fi, meta, down = self._validate(bucket, obj)
+        size = fi.size if fi is not None else (
+            meta["size"] if meta else 0)
+        eff_len = size - offset if length < 0 else length
+        if eff_len > self.max_object_bytes and not down:
+            return self._backend_iter(bucket, obj, offset, length,
+                                      version_id)
+        fi, data = self._serve(bucket, obj, fi, meta, down, offset,
+                               length)
+        return fi, iter((data,))
+
+    def _backend_iter(self, bucket, obj, offset, length, version_id):
+        if hasattr(self.backend, "get_object_iter"):
+            return self.backend.get_object_iter(bucket, obj, offset,
+                                                length, version_id)
+        fi, data = self.backend.get_object(bucket, obj, offset, length,
+                                           version_id)
+        return fi, iter((data,))
 
     def put_object(self, bucket: str, obj: str, data: bytes, **kw):
         self.invalidate(bucket, obj)
@@ -133,3 +339,15 @@ class DiskCache:
         self.invalidate(bucket, obj)
         return self.backend.delete_object(bucket, obj, version_id,
                                           versioned)
+
+    def complete_multipart_upload(self, bucket: str, obj: str,
+                                  upload_id: str, parts, **kw):
+        # A committed multipart upload replaces the object: stale cache
+        # entries must go (the reference invalidates on commit too).
+        self.invalidate(bucket, obj)
+        return self.backend.complete_multipart_upload(
+            bucket, obj, upload_id, parts, **kw)
+
+    def update_object_metadata(self, bucket: str, obj: str, fi) -> None:
+        self.invalidate(bucket, obj)
+        return self.backend.update_object_metadata(bucket, obj, fi)

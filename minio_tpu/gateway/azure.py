@@ -278,8 +278,13 @@ class AzureGateway:
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
         status, h, _ = self.cli.request("HEAD", f"/{bucket}/{obj}")
-        if status != 200:
+        if status == 404:
             raise ErrObjectNotFound(f"{bucket}/{obj}")
+        if status != 200:
+            # auth failures / 5xx throttling are NOT "missing" — a
+            # NoSuchKey here would misreport existing objects (and
+            # defeat DiskCache's backend-outage serving).
+            raise AzureError(status, "", f"HEAD {bucket}/{obj}")
         hl = {k.lower(): v for k, v in h.items()}
         metadata = _meta_from_headers(h)
         metadata.setdefault("content-type",
@@ -296,8 +301,10 @@ class AzureGateway:
             headers["x-ms-range"] = f"bytes={offset}-{end}"
         status, h, data = self.cli.request("GET", f"/{bucket}/{obj}",
                                            headers=headers)
-        if status not in (200, 206):
+        if status == 404:
             raise ErrObjectNotFound(f"{bucket}/{obj}")
+        if status not in (200, 206):
+            raise AzureError(status, "", f"GET {bucket}/{obj}")
         # The GET response already carries the x-ms-meta-* headers —
         # no second HEAD round-trip on the data hot path.
         hl = {k.lower(): v for k, v in h.items()}

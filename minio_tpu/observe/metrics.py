@@ -179,6 +179,17 @@ class MetricsRegistry:
                                   "Online drives")
         self.drive_offline = Gauge("mtpu_cluster_drives_offline",
                                    "Offline drives")
+        # Disk-cache gauges (cf. getCacheMetrics, cmd/metrics-v2.go)
+        self.cache_hits = Gauge("mtpu_cache_hits_total",
+                                "Disk cache hits")
+        self.cache_misses = Gauge("mtpu_cache_misses_total",
+                                  "Disk cache misses")
+        self.cache_evictions = Gauge("mtpu_cache_evicted_total",
+                                     "Disk cache LRU evictions")
+        self.cache_usage = Gauge("mtpu_cache_usage_bytes",
+                                 "Disk cache bytes in use")
+        self.cache_max = Gauge("mtpu_cache_total_bytes",
+                               "Disk cache size budget")
         self.bandwidth = BandwidthMonitor()
 
     def observe_request(self, api: str, status: int, duration_s: float,
@@ -193,6 +204,14 @@ class MetricsRegistry:
             self.bandwidth.record(bucket, rx, tx)
 
     def update_cluster(self, pools, scanner=None) -> None:
+        cm = getattr(pools, "cache_metrics", None)
+        if callable(cm):
+            c = cm()
+            self.cache_hits.set(c["hits"])
+            self.cache_misses.set(c["misses"])
+            self.cache_evictions.set(c["evictions"])
+            self.cache_usage.set(c["usage_bytes"])
+            self.cache_max.set(c["max_bytes"])
         online = offline = 0
         for pool in pools.pools:
             for es in getattr(pool, "sets", [pool]):
@@ -217,6 +236,9 @@ class MetricsRegistry:
         for m in (self.api_requests, self.api_errors, self.inflight,
                   self.latency, self.bytes_rx, self.bytes_tx,
                   self.bucket_usage, self.bucket_objects,
-                  self.heal_total, self.drive_online, self.drive_offline):
+                  self.heal_total, self.drive_online, self.drive_offline,
+                  self.cache_hits, self.cache_misses,
+                  self.cache_evictions, self.cache_usage,
+                  self.cache_max):
             m.render(out)
         return "\n".join(out) + "\n"

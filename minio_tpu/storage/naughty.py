@@ -1,0 +1,109 @@
+"""Programmable fault-injection drive — the deterministic subtle-bug net.
+
+The naughtyDisk equivalent (cf. /root/reference/cmd/naughty-disk_test.go:31):
+a LocalDrive whose methods can be programmed to fail on their Nth call,
+on every call, or permanently from a given call onward. Quorum-edge
+tests sweep write/read failures across EC geometries with it and assert
+the EXACT error the API surfaces — the class of bug (off-by-one quorum
+math, misclassified errors, partial-write leaks) that only
+deterministic injection catches.
+
+It subclasses LocalDrive so engine fast paths gated on
+isinstance(d, LocalDrive) (serial fan-out, mmap reads) stay active —
+the faults hit the same code a real flaky disk would.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .drive import LocalDrive
+from .errors import ErrDiskNotFound
+
+#: Methods the engine calls on the per-drive contract.
+INTERCEPTED = (
+    "make_volume", "stat_volume", "delete_volume", "list_volumes",
+    "write_all", "read_all", "delete", "append_file", "create_file",
+    "read_file", "read_file_view", "rename_file", "rename_data",
+    "read_version", "write_metadata", "update_metadata",
+    "delete_version", "file_size",
+    "list_dir", "list_raw", "verify_file", "disk_info",
+)
+
+
+class NaughtyDrive(LocalDrive):
+    """LocalDrive with a per-method fault program.
+
+    program entries (set via the helpers):
+      fail(method, on_call=N, exc=...)   fail that method's Nth call
+      fail_from(method, call=N, exc=...) fail from the Nth call onward
+      fail_always(method, exc=...)       every call
+      offline(exc=...)                   EVERY intercepted method fails
+    Counters in .calls[method] record invocations (including failed).
+    """
+
+    def __init__(self, root: str, create: bool = True):
+        super().__init__(root, create=create)
+        self._mu_naughty = threading.Lock()
+        self.calls: dict[str, int] = {}
+        self._on_call: dict[tuple[str, int], Exception] = {}
+        self._from_call: dict[str, tuple[int, Exception]] = {}
+        self._always: dict[str, Exception] = {}
+        self._offline_exc: Exception | None = None
+        for name in INTERCEPTED:
+            real = getattr(self, name, None)
+            if real is None:
+                continue
+            # instance attribute shadows the class method
+            setattr(self, name, self._wrap(name, real))
+
+    def _wrap(self, name, real):
+        def naughty(*a, **kw):
+            with self._mu_naughty:
+                n = self.calls.get(name, 0) + 1
+                self.calls[name] = n
+                exc = self._on_call.pop((name, n), None)
+                if exc is None and self._offline_exc is not None:
+                    exc = self._offline_exc
+                if exc is None and name in self._always:
+                    exc = self._always[name]
+                if exc is None and name in self._from_call:
+                    start, e = self._from_call[name]
+                    if n >= start:
+                        exc = e
+            if exc is not None:
+                raise exc
+            return real(*a, **kw)
+        return naughty
+
+    # -- programming ---------------------------------------------------------
+
+    def fail(self, method: str, on_call: int = 1,
+             exc: Exception | None = None) -> "NaughtyDrive":
+        self._on_call[(method, self.calls.get(method, 0) + on_call)] = \
+            exc or ErrDiskNotFound("injected")
+        return self
+
+    def fail_from(self, method: str, call: int = 1,
+                  exc: Exception | None = None) -> "NaughtyDrive":
+        self._from_call[method] = (self.calls.get(method, 0) + call,
+                                   exc or ErrDiskNotFound("injected"))
+        return self
+
+    def fail_always(self, method: str,
+                    exc: Exception | None = None) -> "NaughtyDrive":
+        self._always[method] = exc or ErrDiskNotFound("injected")
+        return self
+
+    def offline(self, exc: Exception | None = None) -> "NaughtyDrive":
+        self._offline_exc = exc or ErrDiskNotFound("injected offline")
+        return self
+
+    def heal_thyself(self) -> "NaughtyDrive":
+        """Clear the whole fault program (the drive 'recovers')."""
+        with self._mu_naughty:
+            self._on_call.clear()
+            self._from_call.clear()
+            self._always.clear()
+            self._offline_exc = None
+        return self

@@ -129,3 +129,165 @@ class TestDiskCache:
         # evicted objects still readable (read-through repopulates)
         _, got = cache.get_object("bkt", "o0")
         assert got == payload(10000, 0)
+
+
+class TestDiskCacheDepth:
+    """r5 depth: range caching, watermark GC, streaming interception,
+    multipart invalidation, backend-outage serving, metrics."""
+
+    def test_ranged_miss_caches_the_range(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"),
+                          max_bytes=1 << 20,
+                          max_object_bytes=30_000)  # whole obj too big
+        cache.make_bucket("bkt")
+        data = payload(100_000, 7)
+        cache.put_object("bkt", "big", data)
+        # whole-object GET streams through uncached (too large)...
+        _, got = cache.get_object("bkt", "big")
+        assert got == data and cache.usage_bytes() == 0
+        # ...but a ranged miss caches exactly that range
+        _, r1 = cache.get_object("bkt", "big", offset=1000, length=5000)
+        assert r1 == data[1000:6000] and cache.misses == 2
+        assert cache.usage_bytes() == 5000
+        # a sub-range of the cached range is a HIT
+        _, r2 = cache.get_object("bkt", "big", offset=2000, length=1000)
+        assert r2 == data[2000:3000] and cache.hits == 1
+        # outside the cached range: miss, new range file
+        _, r3 = cache.get_object("bkt", "big", offset=50_000,
+                                 length=2000)
+        assert r3 == data[50_000:52_000] and cache.misses == 3
+        assert cache.usage_bytes() == 7000
+
+    def test_ranged_hits_after_whole_object_fill(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        cache.make_bucket("bkt")
+        data = payload(20_000, 8)
+        cache.put_object("bkt", "obj", data)
+        cache.get_object("bkt", "obj")               # whole-object fill
+        for off, ln in ((0, 100), (5000, 5000), (19_000, 1000)):
+            _, got = cache.get_object("bkt", "obj", offset=off,
+                                      length=ln)
+            assert got == data[off:off + ln]
+        assert cache.hits == 3 and cache.misses == 1
+
+    def test_watermark_gc(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"),
+                          max_bytes=100_000, high_watermark=0.8,
+                          low_watermark=0.5, max_object_bytes=50_000)
+        cache.make_bucket("bkt")
+        import time as _t
+        for i in range(7):                    # 7 x 12k; high mark at 80k
+            cache.put_object("bkt", f"o{i}", payload(12_000, i))
+            cache.get_object("bkt", f"o{i}")
+            _t.sleep(0.01)                    # distinct atimes for LRU
+        # crossing 80k triggered GC down to <= 50k
+        assert cache.usage_bytes() <= 50_000
+        assert cache.evictions > 0
+        # newest entries survive (LRU evicts oldest)
+        hits_before = cache.hits
+        cache.get_object("bkt", "o6")
+        assert cache.hits == hits_before + 1
+
+    def test_get_object_iter_consults_cache(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        cache.make_bucket("bkt")
+        data = payload(15_000, 9)
+        cache.put_object("bkt", "obj", data)
+        fi, it = cache.get_object_iter("bkt", "obj")
+        assert b"".join(it) == data and cache.misses == 1
+        fi, it = cache.get_object_iter("bkt", "obj", offset=10,
+                                       length=100)
+        assert b"".join(it) == data[10:110] and cache.hits == 1
+
+    def test_multipart_commit_invalidates(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        cache.make_bucket("bkt")
+        cache.put_object("bkt", "obj", b"v1-original")
+        assert cache.get_object("bkt", "obj")[1] == b"v1-original"
+        uid = cache.new_multipart_upload("bkt", "obj")
+        part = payload(6000, 11)
+        info = cache.put_object_part("bkt", "obj", uid, 1, part)
+        cache.complete_multipart_upload("bkt", "obj", uid,
+                                        [(1, info.etag)])
+        assert cache.get_object("bkt", "obj")[1] == part
+
+    def test_backend_down_serves_cache(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        cache.make_bucket("bkt")
+        data = payload(9000, 12)
+        cache.put_object("bkt", "obj", data)
+        cache.get_object("bkt", "obj")               # fill
+        def boom(*a, **kw):
+            raise StorageError("backend unreachable")
+        cache.backend.head_object = boom
+        _, got = cache.get_object("bkt", "obj")
+        assert got == data
+        _, rng = cache.get_object("bkt", "obj", offset=5, length=10)
+        assert rng == data[5:15]
+
+    def test_metrics_surface_through_prometheus(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        srv = S3Server(cache, Credentials(ROOT, SECRET)).start()
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("mbk")
+            cli.put_object("mbk", "obj", payload(4000, 13))
+            assert cli.get_object("mbk", "obj")      # miss+fill
+            assert cli.get_object("mbk", "obj")      # hit
+            st, _, body = cli.request(
+                "GET", "/minio/v2/metrics/cluster")
+            assert st == 200
+            text = body.decode()
+            assert "mtpu_cache_hits_total 1" in text, text[-500:]
+            assert "mtpu_cache_misses_total 1" in text
+            assert "mtpu_cache_usage_bytes 4000" in text
+        finally:
+            srv.shutdown()
+
+    def test_small_range_of_huge_object_caches_via_iter(self, tmp_path):
+        """The front-door streaming path caches small ranges even when
+        the whole object exceeds the cacheable size."""
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"),
+                          max_object_bytes=10_000)
+        cache.make_bucket("bkt")
+        data = payload(80_000, 14)            # whole object uncacheable
+        cache.put_object("bkt", "huge", data)
+        fi, it = cache.get_object_iter("bkt", "huge")   # streams through
+        assert b"".join(it) == data and cache.usage_bytes() == 0
+        fi, it = cache.get_object_iter("bkt", "huge", offset=500,
+                                       length=2000)     # range miss+fill
+        assert b"".join(it) == data[500:2500]
+        assert cache.usage_bytes() == 2000
+        fi, it = cache.get_object_iter("bkt", "huge", offset=900,
+                                       length=1000)     # range HIT
+        assert b"".join(it) == data[900:1900]
+        assert cache.hits == 1
+
+    def test_range_refill_refreshes_meta_and_usage(self, tmp_path):
+        """Out-of-band object change: ranged reads recover (meta is
+        refreshed on range fill) and usage never double-counts an
+        overwritten range file."""
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"),
+                          max_object_bytes=10_000)
+        cache.make_bucket("bkt")
+        cache.put_object("bkt", "obj", payload(50_000, 15))
+        cache.get_object("bkt", "obj", offset=0, length=1000)  # fill
+        assert cache.usage_bytes() == 1000
+        # replaced BEHIND the cache
+        fs.put_object("bkt", "obj", payload(50_000, 16))
+        _, got = cache.get_object("bkt", "obj", offset=0, length=1000)
+        assert got == payload(50_000, 16)[:1000]
+        assert cache.usage_bytes() == 1000    # overwrite, not +1000
+        # and the NEXT ranged read is a HIT again (meta refreshed)
+        hits = cache.hits
+        cache.get_object("bkt", "obj", offset=0, length=1000)
+        assert cache.hits == hits + 1
