@@ -293,6 +293,51 @@ def _put_stages(es4, obj_bytes: bytes) -> dict:
             for k, v in stages.items()}
 
 
+def _select_bench(n_records: int = 300_000) -> dict:
+    """S3 Select NDJSON scan: the simdjson-role native fast path vs the
+    stdlib reader on the same query (VERDICT r4 #9)."""
+    import json as _json
+
+    from minio_tpu.s3select.engine import read_json_lines
+    from minio_tpu.s3select.fastjson import (load, read_json_lines_fast,
+                                             referenced_fields)
+    from minio_tpu.s3select.sql import parse
+
+    load()                                  # build outside the timing
+    lines = []
+    for i in range(n_records):
+        lines.append(_json.dumps({
+            "id": i, "name": f"user-{i}", "score": (i % 997) / 7.0,
+            "active": bool(i % 3), "tags": ["a", "b"],
+            "nested": {"x": i}, "payload": "x" * 64, "note": "plain"}))
+    data = ("\n".join(lines)).encode()
+
+    def best_of(expr, n=2):
+        fields = referenced_fields(parse(expr))
+        b_std = b_fast = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            read_json_lines(data)
+            b_std = min(b_std, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            read_json_lines_fast(data, fields)
+            b_fast = min(b_fast, time.perf_counter() - t0)
+        return b_std, b_fast
+
+    # the classic scan shape: aggregate over a filtered pass
+    std, fast = best_of("SELECT count(*) FROM s3object s "
+                        "WHERE s.score > 100")
+    # multi-field projection: bounded by Python dict assembly
+    std_p, fast_p = best_of("SELECT s.note FROM s3object s "
+                            "WHERE s.active = true AND s.id < 100")
+    return {
+        "select_ndjson_fast_gbps": round(len(data) / fast / 1e9, 3),
+        "select_ndjson_stdlib_gbps": round(len(data) / std / 1e9, 3),
+        "select_ndjson_speedup": round(std / fast, 1),
+        "select_ndjson_project_speedup": round(std_p / fast_p, 1),
+    }
+
+
 def _tunnel_probe() -> dict:
     """Measure the axon tunnel's dispatch RT and transfer bandwidth so
     the e2e numbers can be read against the environment's ceiling."""
@@ -528,6 +573,10 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — codec numbers must still print
         results["e2e_error"] = f"{type(e).__name__}: {e}"
     try:
+        results.update(_select_bench())
+    except Exception as e:  # noqa: BLE001 — extras are best-effort
+        results["select_bench_error"] = f"{type(e).__name__}: {e}"
+    try:
         tpu_e2e = e2e_bench(n_put=8, n_parts=1, part_mib=32)
         results["put_e2e_8p4_mp_tpu_tunnel_gbps"] = \
             tpu_e2e["put_e2e_8p4_mp_gbps"]
@@ -565,8 +614,8 @@ def main() -> None:
     }
     # e2e object-layer configs + tunnel context measured above
     for k, v in results.items():
-        if (k.endswith(("_gbps", "_error", "_mbps", "_ms"))
-                or k.startswith("tunnel_")):
+        if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup"))
+                or k.startswith("tunnel_") or k == "host_cores"):
             extras.setdefault(k, v)
     print(json.dumps({
         "metric": "ec_8p4_encode_throughput",

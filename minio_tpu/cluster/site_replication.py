@@ -32,16 +32,81 @@ class SitePeer:
                   policies: list[str]) -> bool:
         body = json.dumps({"accessKey": access_key,
                            "secretKey": secret_key,
-                           "policies": policies}).encode()
+                           "policies": policies,
+                           "srInternal": True}).encode()
         status, _, _ = self.cli.request("POST", "/minio/admin/v1/users",
                                         body=body)
         return status == 200
 
     def push_policy(self, name: str, doc: dict) -> bool:
-        body = json.dumps({"name": name, "policy": doc}).encode()
+        body = json.dumps({"name": name, "policy": doc,
+                           "srInternal": True}).encode()
         status, _, _ = self.cli.request("POST",
                                         "/minio/admin/v1/policies",
                                         body=body)
+        return status == 200
+
+    def push_service_account(self, parent: str, access_key: str,
+                             secret_key: str,
+                             policies: list[str]) -> bool:
+        body = json.dumps({"parent": parent, "accessKey": access_key,
+                           "secretKey": secret_key,
+                           "policies": list(policies),
+                           "srInternal": True}).encode()
+        status, _, _ = self.cli.request(
+            "POST", "/minio/admin/v1/service-accounts", body=body)
+        return status == 200
+
+    def push_group(self, name: str, members: list[str],
+                   policies: list[str]) -> bool:
+        body = json.dumps({"name": name, "members": list(members),
+                           "policies": list(policies),
+                           "setPolicies": list(policies),
+                           "srInternal": True}).encode()
+        status, _, _ = self.cli.request("POST",
+                                        "/minio/admin/v1/groups",
+                                        body=body)
+        return status == 200
+
+    def remote_iam_listing(self) -> dict | None:
+        """The peer's IAM inventory, for deletion reconciliation."""
+        try:
+            _, _, u = self.cli.request("GET", "/minio/admin/v1/users")
+            _, _, p = self.cli.request("GET",
+                                       "/minio/admin/v1/policies")
+            _, _, g = self.cli.request("GET", "/minio/admin/v1/groups")
+            _, _, a = self.cli.request(
+                "GET", "/minio/admin/v1/service-accounts")
+            return {"users": json.loads(u).get("users", []),
+                    "policies": json.loads(p).get("policies", []),
+                    "groups": json.loads(g).get("groups", []),
+                    "svc": [x["accessKey"] for x in
+                            json.loads(a).get("accounts", [])]}
+        except Exception:  # noqa: BLE001 — peer down
+            return None
+
+    def delete_user(self, access_key: str) -> bool:
+        status, _, _ = self.cli.request(
+            "DELETE", "/minio/admin/v1/users",
+            query={"accessKey": access_key, "srInternal": "1"})
+        return status == 200
+
+    def delete_policy(self, name: str) -> bool:
+        status, _, _ = self.cli.request(
+            "DELETE", "/minio/admin/v1/policies",
+            query={"name": name, "srInternal": "1"})
+        return status in (200, 404)
+
+    def delete_group(self, name: str) -> bool:
+        status, _, _ = self.cli.request(
+            "DELETE", "/minio/admin/v1/groups",
+            query={"name": name, "srInternal": "1"})
+        return status in (200, 404)
+
+    def push_leave(self) -> bool:
+        status, _, _ = self.cli.request(
+            "POST", "/minio/admin/v1/site-replication",
+            body=json.dumps({"action": "leave"}).encode())
         return status == 200
 
     def push_bucket(self, bucket: str, configs: dict[str, bytes]) -> bool:
@@ -134,3 +199,312 @@ class SiteReplicator:
             if self.on_bucket_config(bucket):
                 stats["buckets"] += 1
         return stats
+
+
+# ---------------------------------------------------------------------------
+# round-5: membership protocol, IAM-complete sync, drift reconciliation
+# ---------------------------------------------------------------------------
+
+import hashlib as _hashlib
+import threading as _threading
+import time as _time
+
+_STATE_KEY = "config/site-replication/state.json"
+
+
+class SiteReplicationSys:
+    """The SiteReplicationSys role (cmd/site-replication.go:173): a
+    persistent site-group membership with a join handshake, change
+    fan-out, and drift detection + reconciliation.
+
+    - add_peers (AddPeerClusters :257): validate every site (reachable,
+      distinct deployment ids), then push the agreed state to every
+      member over its admin plane (InternalJoinReq :469);
+    - local_digest / status: per-category content digests (buckets'
+      replicated configs, users, service accounts, groups, policies)
+      compared across members -> a drift report naming the categories
+      out of sync per site;
+    - reconcile (syncLocalToPeers :1285): push the full local truth —
+      users incl. policy mappings, SERVICE ACCOUNTS with their
+      credentials, groups, policies, buckets + configs — to every
+      drifted peer, then re-run status.
+    """
+
+    def __init__(self, pools, iam, meta, my_name: str = "",
+                 my_endpoint: str = "", creds=None):
+        self.pools = pools
+        self.iam = iam
+        self.meta = meta
+        self.my_name = my_name
+        self.my_endpoint = my_endpoint
+        self.creds = creds
+        self._mu = _threading.Lock()
+        self.state: dict = self._load() or {}
+
+    # -- persistence ---------------------------------------------------------
+
+    def _load(self) -> dict | None:
+        try:
+            _, data = self.pools.get_object(".mtpu.sys", _STATE_KEY)
+            return json.loads(data)
+        except (StorageError, ValueError):
+            return None
+
+    def _save(self) -> None:
+        self.pools.put_object(".mtpu.sys", _STATE_KEY,
+                              json.dumps(self.state).encode())
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.state.get("sites"))
+
+    @property
+    def deployment_id(self) -> str:
+        return getattr(self.pools, "deployment_id", "")
+
+    def _peers(self) -> list[SitePeer]:
+        """Clients for every member EXCEPT this site."""
+        out = []
+        for site in self.state.get("sites", []):
+            if site["deploymentId"] == self.deployment_id:
+                continue
+            out.append(SitePeer(site["name"], site["endpoint"],
+                                site["accessKey"], site["secretKey"]))
+        return out
+
+    # -- join handshake ------------------------------------------------------
+
+    def add_peers(self, sites: list[dict]) -> dict:
+        """Coordinator side of `mc admin replicate add`: validate every
+        site, assemble the group state, push it to every member, then
+        run one full reconcile so the group starts converged."""
+        seen: dict[str, str] = {}
+        enriched = []
+        for site in sites:
+            cli = S3Client(site["endpoint"], site["accessKey"],
+                           site["secretKey"])
+            status, _, body = cli.request(
+                "GET", "/minio/admin/v1/site-replication",
+                query={"internal": "deployment"})
+            if status != 200:
+                raise StorageError(
+                    f"site {site['name']}: unreachable or unauthorized "
+                    f"({status})")
+            dep = json.loads(body).get("deploymentId", "")
+            if not dep:
+                raise StorageError(f"site {site['name']}: no deployment id")
+            if dep in seen:
+                raise StorageError(
+                    f"sites {seen[dep]!r} and {site['name']!r} are the "
+                    f"same deployment ({dep}) — a site cannot join a "
+                    "group twice")
+            seen[dep] = site["name"]
+            enriched.append({**site, "deploymentId": dep})
+        state = {"group_id": _hashlib.sha256(
+                     "".join(sorted(seen)).encode()).hexdigest()[:16],
+                 "sites": enriched,
+                 "updated": _time.time()}
+        # push the agreed state to EVERY member (including this one)
+        results = {}
+        for site in enriched:
+            cli = S3Client(site["endpoint"], site["accessKey"],
+                           site["secretKey"])
+            status, _, body = cli.request(
+                "POST", "/minio/admin/v1/site-replication",
+                body=json.dumps({"action": "join",
+                                 "state": state}).encode())
+            results[site["name"]] = (status == 200)
+        with self._mu:
+            self.state = state
+            self._save()
+        sync = self.reconcile()
+        return {"joined": results, "initial_sync": sync}
+
+    def accept_join(self, state: dict) -> None:
+        """Member side (InternalJoinReq): the group must include us."""
+        ids = [s["deploymentId"] for s in state.get("sites", [])]
+        if self.deployment_id not in ids:
+            raise StorageError(
+                f"join state does not include this deployment "
+                f"({self.deployment_id})")
+        with self._mu:
+            self.state = state
+            self._save()
+
+    def accept_leave(self) -> None:
+        """This site was removed from the group: forget the membership
+        so hooks stop firing and reconcile stops pushing."""
+        with self._mu:
+            self.state = {}
+            self._save()
+
+    def remove_site(self, name: str) -> dict:
+        """Drop a member and push the shrunk state to the remainder."""
+        with self._mu:
+            removed = [s for s in self.state.get("sites", [])
+                       if s["name"] == name]
+            sites = [s for s in self.state.get("sites", [])
+                     if s["name"] != name]
+            if not removed:
+                raise StorageError(f"no site named {name!r} in group")
+            self.state["sites"] = sites
+            self.state["updated"] = _time.time()
+            state = dict(self.state)
+            self._save()
+        results = {}
+        for site in sites:
+            if site["deploymentId"] == self.deployment_id:
+                continue
+            cli = S3Client(site["endpoint"], site["accessKey"],
+                           site["secretKey"])
+            status, _, _ = cli.request(
+                "POST", "/minio/admin/v1/site-replication",
+                body=json.dumps({"action": "join",
+                                 "state": state}).encode())
+            results[site["name"]] = (status == 200)
+        # the ejected member must STOP acting as a group member — tell
+        # it to clear its persisted state (an unreachable ejectee can
+        # no longer be trusted anyway; best effort)
+        for site in removed:
+            try:
+                SitePeer(site["name"], site["endpoint"],
+                         site["accessKey"],
+                         site["secretKey"]).push_leave()
+            except Exception:  # noqa: BLE001
+                pass
+        return {"removed": name, "pushed": results}
+
+    # -- digests + drift -----------------------------------------------------
+
+    def local_digest(self) -> dict:
+        """Content digests per replicated category — equal digests on
+        two sites mean that category is in sync."""
+        def h(obj) -> str:
+            return _hashlib.sha256(
+                json.dumps(obj, sort_keys=True).encode()).hexdigest()[:16]
+
+        users = {}
+        svc = {}
+        with self.iam._mu:
+            for ak, u in sorted(self.iam._users.items()):
+                if u.kind == "user":
+                    users[ak] = [u.secret_key, sorted(u.policies),
+                                 u.status]
+                elif u.kind == "service":
+                    svc[ak] = [u.secret_key, u.parent,
+                               sorted(u.policies)]
+            groups = {n: [sorted(g.get("members", [])),
+                          sorted(g.get("policies", []))]
+                      for n, g in sorted(self.iam._groups.items())}
+            policies = {n: p.doc for n, p in
+                        sorted(self.iam._policies.items())
+                        if n not in ("readwrite", "readonly",
+                                     "writeonly")}
+        buckets = {}
+        for b in self.pools.list_buckets():
+            if b.startswith(".mtpu"):
+                continue
+            cfgs = {}
+            for sub in _REPLICATED_CONFIGS:
+                kind = sub.replace("-", "_")
+                try:
+                    data = self.meta.get(b, kind)
+                except StorageError:
+                    data = None
+                if data is not None:
+                    cfgs[sub] = _hashlib.sha256(data).hexdigest()[:16]
+            buckets[b] = cfgs
+        return {"users": h(users), "svc_accounts": h(svc),
+                "groups": h(groups), "policies": h(policies),
+                "buckets": h(buckets)}
+
+    def status(self) -> dict:
+        """Drift report (SiteReplicationStatus): every member's digest
+        vs ours, with the drifted categories named."""
+        mine = self.local_digest()
+        sites_out = []
+        for site in self.state.get("sites", []):
+            if site["deploymentId"] == self.deployment_id:
+                sites_out.append({"name": site["name"], "self": True,
+                                  "inSync": True, "drift": []})
+                continue
+            cli = S3Client(site["endpoint"], site["accessKey"],
+                           site["secretKey"])
+            try:
+                status, _, body = cli.request(
+                    "GET", "/minio/admin/v1/site-replication",
+                    query={"internal": "digest"})
+                theirs = json.loads(body) if status == 200 else None
+            except Exception:  # noqa: BLE001 — peer down
+                theirs = None
+            if theirs is None:
+                sites_out.append({"name": site["name"], "self": False,
+                                  "inSync": False,
+                                  "drift": ["unreachable"]})
+                continue
+            drift = sorted(k for k in mine if theirs.get(k) != mine[k])
+            sites_out.append({"name": site["name"], "self": False,
+                              "inSync": not drift, "drift": drift})
+        return {"groupId": self.state.get("group_id", ""),
+                "sites": sites_out}
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self) -> dict:
+        """Push the local truth to every drifted member, then report
+        the post-state (the periodic resync of syncLocalToPeers)."""
+        before = self.status()
+        drifted = [s["name"] for s in before["sites"]
+                   if not s["self"] and not s["inSync"]]
+        pushed = {}
+        if drifted:
+            peers = [p for p in self._peers() if p.name in drifted]
+            rep = SiteReplicator(self.iam, self.meta, peers)
+            buckets = [b for b in self.pools.list_buckets()
+                       if not b.startswith(".mtpu")]
+            pushed = rep.sync_all(buckets)
+            # IAM-complete extras: service accounts, groups, policy
+            # mappings ride on top of sync_all's users/policies/buckets
+            with self.iam._mu:
+                svcs = [u for u in self.iam._users.values()
+                        if u.kind == "service"]
+                groups = {n: dict(g)
+                          for n, g in self.iam._groups.items()}
+            with self.iam._mu:
+                local_users = {ak for ak, u in self.iam._users.items()
+                               if u.kind == "user"}
+                local_svc = {ak for ak, u in self.iam._users.items()
+                             if u.kind == "service"}
+                local_groups = set(self.iam._groups)
+                local_policies = {n for n in self.iam._policies
+                                  if n not in ("readwrite", "readonly",
+                                               "writeonly")}
+            for peer in peers:
+                for u in svcs:
+                    peer.push_service_account(u.parent, u.access_key,
+                                              u.secret_key, u.policies)
+                for name, g in groups.items():
+                    peer.push_group(name, g.get("members", []),
+                                    g.get("policies", []))
+                # deletions: anything the peer has that we don't is a
+                # remnant this site's truth says must go (the full-
+                # mirror half of syncLocalToPeers — without it a
+                # delete leaves permanent drift)
+                listing = peer.remote_iam_listing()
+                if listing is None:
+                    continue
+                for ak in set(listing["users"]) - local_users:
+                    peer.delete_user(ak)
+                for ak in set(listing["svc"]) - local_svc:
+                    peer.delete_user(ak)
+                for n in (set(listing["policies"]) - local_policies
+                          - {"readwrite", "readonly", "writeonly"}):
+                    peer.delete_policy(n)
+                for n in set(listing["groups"]) - local_groups:
+                    peer.delete_group(n)
+        after = self.status()
+        return {"drift_before": [s for s in before["sites"]
+                                 if not s["inSync"]],
+                "pushed": pushed,
+                "drift_after": [s for s in after["sites"]
+                                if not s["inSync"]]}

@@ -253,6 +253,22 @@ class DiskCache:
         except StorageError:
             return None, meta, True
 
+    def head_object(self, bucket: str, obj: str, version_id: str = ""):
+        """Backend-outage HEADs serve from cached metadata — the front
+        door stats before reading, so without this interception the
+        advertised outage serving would never be reachable over S3."""
+        if version_id:
+            return self.backend.head_object(bucket, obj, version_id)
+        try:
+            return self.backend.head_object(bucket, obj)
+        except _MISSING:
+            raise
+        except StorageError:
+            meta = self._meta(bucket, obj)
+            if meta is not None:
+                return self._fi_from_meta(bucket, obj, meta)
+            raise
+
     def get_object(self, bucket: str, obj: str, offset: int = 0,
                    length: int = -1, version_id: str = ""):
         if version_id:
@@ -281,6 +297,12 @@ class DiskCache:
             raise StorageError(f"{bucket}/{obj}: backend unreachable "
                                "and not cached")
         self.misses += 1
+        if meta is not None and not fresh:
+            # The object changed behind the cache: every stale file for
+            # it must go BEFORE storing anything new, or a later hit on
+            # a surviving old-version file would serve corrupt bytes
+            # under the refreshed etag.
+            self.invalidate(bucket, obj)
         if offset == 0 and length < 0:
             fi, full = self.backend.get_object(bucket, obj)
             if len(full) <= self.max_object_bytes:

@@ -166,13 +166,19 @@ class IAMSys:
         self._broadcast_reload()
 
     def add_service_account(self, parent: str,
-                            policies: list[str] | None = None) -> Identity:
+                            policies: list[str] | None = None,
+                            access_key: str = "",
+                            secret_key: str = "") -> Identity:
+        """Create a service account under `parent`. Explicit credentials
+        are the site-replication import path (a mirrored svc account
+        must keep its keys, cf. PeerSvcAccChangeHandler,
+        cmd/site-replication.go:991); omitted -> minted fresh."""
         with self._mu:
             if parent not in self._users:
                 raise KeyError(f"no such user {parent}")
         ident = Identity(
-            access_key=f"svc-{secrets.token_hex(8)}",
-            secret_key=secrets.token_urlsafe(24),
+            access_key=access_key or f"svc-{secrets.token_hex(8)}",
+            secret_key=secret_key or secrets.token_urlsafe(24),
             kind="service", parent=parent, policies=list(policies or []))
         with self._mu:
             self._users[ident.access_key] = ident
@@ -288,6 +294,18 @@ class IAMSys:
             ident.policies = sorted(set(ident.policies) | set(names))
         self._put(f"users/{access_key}.json", ident.__dict__)
         self._broadcast_reload()
+
+    def list_service_accounts(self, parent: str = "") -> list[dict]:
+        """Service accounts (optionally for one parent) with their
+        policies — the site-replication IAM digest/sync source."""
+        with self._mu:
+            return sorted(
+                ({"accessKey": u.access_key, "secretKey": u.secret_key,
+                  "parent": u.parent, "policies": list(u.policies)}
+                 for u in self._users.values()
+                 if u.kind == "service"
+                 and (not parent or u.parent == parent)),
+                key=lambda d: d["accessKey"])
 
     def list_users(self) -> list[str]:
         with self._mu:

@@ -187,7 +187,20 @@ def execute_select(data: bytes, opts: dict) -> bytes:
     if opts["input"] == "parquet":
         records = read_parquet(data)
     elif opts["input"] == "json":
-        records = read_json_lines(data)
+        # simdjson-role fast path: when the query provably touches only
+        # top-level fields, the native scanner extracts just those
+        # slices instead of json.loads-ing whole records
+        # (s3select/fastjson.py; falls back on any ineligibility).
+        records = None
+        try:
+            from .fastjson import read_json_lines_fast, referenced_fields
+            fields = referenced_fields(query)
+            if fields is not None:
+                records = read_json_lines_fast(data, fields)
+        except Exception:  # noqa: BLE001 — no toolchain/odd AST: stdlib
+            records = None
+        if records is None:
+            records = read_json_lines(data)
     else:
         records = read_csv(data, header=opts["header"],
                            delimiter=opts["delimiter"])

@@ -574,6 +574,7 @@ class S3Server:
         "trace": "admin:ServerTrace",
         "console": "admin:ConsoleLog",
         "users": "admin:*User",          # method-refined below
+        "service-accounts": "admin:*ServiceAccount",
         "groups": "admin:*Group",
         "policies": "admin:*Policy",
         "config": "admin:ConfigUpdate",
@@ -618,8 +619,61 @@ class S3Server:
             base = {"GET": "admin:GetPolicy", "POST": "admin:CreatePolicy",
                     "DELETE": "admin:DeletePolicy"}.get(
                 method, "admin:CreatePolicy")
+        elif base == "admin:*ServiceAccount":
+            base = {"GET": "admin:ListServiceAccounts",
+                    "POST": "admin:CreateServiceAccount",
+                    "DELETE": "admin:RemoveServiceAccount"}.get(
+                method, "admin:CreateServiceAccount")
+        elif base == "admin:SiteReplicationInfo" and method != "GET":
+            # membership mutations are WRITE actions (cf.
+            # SiteReplicationAddAction / SiteReplicationRemoveAction)
+            base = "admin:SiteReplicationOperation"
         if not self.iam.is_allowed(ident, base, "*"):
             raise S3Error("AccessDenied", f"{base} denied")
+
+    def _site_sys(self):
+        """Lazy SiteReplicationSys bound to this server's stack."""
+        if getattr(self, "_site_sys_obj", None) is None:
+            from ..cluster.site_replication import SiteReplicationSys
+            self._site_sys_obj = SiteReplicationSys(
+                self.pools, self.iam, self.handlers.meta,
+                creds=self.creds)
+        return self._site_sys_obj
+
+    def _site_hook(self, what: str) -> None:
+        """After a local IAM/bucket-config mutation: if this server is
+        in a site group, fan the change out ASYNCHRONOUSLY, single-
+        flight — a mutation must not block on (or cascade through) the
+        whole group; peers' pushes carry srInternal and never re-enter
+        this hook. Best-effort: reconcile repairs anything missed."""
+        try:
+            sys_ = self._site_sys()    # loads persisted state: a hook
+        except Exception:  # noqa: BLE001    # must fire after restarts
+            return
+        if not sys_.enabled:
+            return
+        if getattr(self, "_site_hook_busy", False):
+            self._site_hook_again = True
+            return
+        self._site_hook_busy = True
+        self._site_hook_again = False
+
+        def run():
+            import threading as _t
+            try:
+                while True:
+                    self._site_hook_again = False
+                    try:
+                        sys_.reconcile()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    if not self._site_hook_again:
+                        return
+            finally:
+                self._site_hook_busy = False
+        import threading
+        threading.Thread(target=run, daemon=True,
+                         name="site-repl-hook").start()
 
     def _dispatch_admin(self, access_key: str, method: str, path: str,
                         query: dict, body: bytes) -> Response:
@@ -709,14 +763,52 @@ class S3Server:
             if method == "POST":
                 req_obj = _json.loads(body or b"{}")
                 try:
-                    self.iam.add_user(req_obj["accessKey"],
-                                      req_obj["secretKey"],
-                                      req_obj.get("policies", []))
+                    if req_obj.get("attachPolicies") is not None:
+                        # policy-mapping update for an EXISTING identity
+                        # (cf. SetPolicyForUserOrGroup)
+                        self.iam.attach_policy(
+                            req_obj["accessKey"],
+                            req_obj["attachPolicies"])
+                    else:
+                        self.iam.add_user(req_obj["accessKey"],
+                                          req_obj["secretKey"],
+                                          req_obj.get("policies", []))
                 except (KeyError, ValueError) as e:
                     raise S3Error("InvalidArgument", str(e)) from None
+                if not req_obj.get("srInternal"):
+                    self._site_hook("iam")
                 return j({"ok": True})
             if method == "DELETE":
                 self.iam.remove_user(query.get("accessKey", [""])[0])
+                if not query.get("srInternal"):
+                    self._site_hook("iam")
+                return j({"ok": True})
+        if sub == "service-accounts":
+            # cf. AddServiceAccount / ListServiceAccounts,
+            # cmd/admin-handlers-users.go; explicit credentials are the
+            # site-replication import path.
+            if self.iam is None:
+                return j({"error": "IAM not enabled"}, 501)
+            if method == "GET":
+                return j({"accounts": self.iam.list_service_accounts(
+                    query.get("parent", [""])[0])})
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                try:
+                    ident = self.iam.add_service_account(
+                        req_obj["parent"],
+                        req_obj.get("policies", []),
+                        access_key=req_obj.get("accessKey", ""),
+                        secret_key=req_obj.get("secretKey", ""))
+                except KeyError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                if not req_obj.get("srInternal"):
+                    self._site_hook("iam")
+                return j({"accessKey": ident.access_key,
+                          "secretKey": ident.secret_key})
+            if method == "DELETE":
+                self.iam.remove_user(query.get("accessKey", [""])[0])
+                self._site_hook("iam")
                 return j({"ok": True})
         if sub == "policies":
             if self.iam is None:
@@ -737,6 +829,8 @@ class S3Server:
                                         req_obj["policy"])
                 except (KeyError, ValueError) as e:
                     raise S3Error("InvalidArgument", str(e)) from None
+                if not req_obj.get("srInternal"):
+                    self._site_hook("iam")
                 return j({"ok": True})
             if method == "DELETE":
                 try:
@@ -745,6 +839,8 @@ class S3Server:
                     return j({"error": f"no policy {e}"}, 404)
                 except ValueError as e:     # built-in policy
                     return j({"error": str(e)}, 409)
+                if not query.get("srInternal"):
+                    self._site_hook("iam")
                 return j({"ok": True})
         if sub == "groups":
             # Group CRUD + policy attach (cf. cmd/admin-handlers-users.go
@@ -775,6 +871,8 @@ class S3Server:
                                                   req_obj["setPolicies"])
                 except KeyError as e:
                     raise S3Error("InvalidArgument", str(e)) from None
+                if not req_obj.get("srInternal"):
+                    self._site_hook("iam")
                 return j({"ok": True})
             if method == "DELETE":
                 try:
@@ -783,6 +881,8 @@ class S3Server:
                     return j({"error": f"no group {e}"}, 404)
                 except ValueError as e:
                     return j({"error": str(e)}, 409)
+                if not query.get("srInternal"):
+                    self._site_hook("iam")
                 return j({"ok": True})
         if sub == "config":
             if not hasattr(self, "config") or self.config is None:
@@ -977,13 +1077,55 @@ class S3Server:
                             "drivesOnline": online,
                             "decommissioning": False})
             return j({"pools": out})
-        if sub == "site-replication" and method == "GET":
-            sr = self.site_replicator
-            if sr is None:
-                return j({"enabled": False, "sites": []})
-            return j({"enabled": True,
-                      "sites": [{"name": p.name, "endpoint": p.endpoint}
-                                for p in sr.peers]})
+        if sub == "site-replication":
+            sys_ = self._site_sys()
+            if method == "GET":
+                internal = query.get("internal", [""])[0]
+                if internal == "deployment":
+                    # join-handshake probe (validates reachability +
+                    # credentials + deployment identity)
+                    return j({"deploymentId": sys_.deployment_id,
+                              "enabled": sys_.enabled})
+                if internal == "digest":
+                    return j(sys_.local_digest())
+                legacy = self.site_replicator
+                if not sys_.enabled and legacy is not None:
+                    return j({"enabled": True,
+                              "sites": [{"name": p.name,
+                                         "endpoint": p.endpoint}
+                                        for p in legacy.peers]})
+                info = {"enabled": sys_.enabled,
+                        "groupId": sys_.state.get("group_id", ""),
+                        "sites": [{"name": s["name"],
+                                   "endpoint": s["endpoint"],
+                                   "deploymentId": s["deploymentId"]}
+                                  for s in sys_.state.get("sites", [])]}
+                return j(info)
+            if method == "POST":
+                from ..storage.errors import StorageError as _SE
+                req_obj = _json.loads(body or b"{}")
+                action = req_obj.get("action", "")
+                try:
+                    if action == "add":
+                        return j(sys_.add_peers(req_obj["sites"]))
+                    if action == "join":
+                        sys_.accept_join(req_obj["state"])
+                        return j({"ok": True})
+                    if action == "status":
+                        return j(sys_.status())
+                    if action == "reconcile":
+                        return j(sys_.reconcile())
+                    if action == "remove":
+                        return j(sys_.remove_site(req_obj["site"]))
+                    if action == "leave":
+                        sys_.accept_leave()
+                        return j({"ok": True})
+                except _SE as e:
+                    return j({"error": str(e)}, 409)
+                except KeyError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                raise S3Error("InvalidArgument",
+                              f"unknown action {action!r}")
         if sub == "service" and method == "POST":
             # Real semantics (cf. ServiceHandler, cmd/admin-handlers.go):
             # stop/restart shut the listener down after this response

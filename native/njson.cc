@@ -1,0 +1,200 @@
+// NDJSON top-level field extractor — the simdjson-role fast path for
+// S3 Select (cf. the reference's internal/s3select/json reader built on
+// minio/simdjson-go).
+//
+// One pass per record: a string-aware, depth-aware scan that records
+// the byte extents of the requested TOP-LEVEL fields without building
+// any DOM. The Select engine (s3select/fastjson.py) then materializes
+// only the handful of fields the query touches — the hot loop never
+// json.loads whole records.
+//
+// Output layout per record: (nf + 1) pairs of int64 —
+//   slot 0:        [line_start, line_end)
+//   slot 1..nf:    [value_start, value_end) of field i, or (-1,-1) if
+//                  absent; value extent INCLUDES quotes/braces so the
+//                  caller can json-parse the slice for exact semantics.
+// A record slot 0 start of -2 means "this line confused the scanner —
+// fall back to a full parse" (never silently wrong).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static inline long skip_ws(const uint8_t* b, long i, long n) {
+  while (i < n && (b[i] == ' ' || b[i] == '\t' || b[i] == '\r')) ++i;
+  return i;
+}
+
+// Scan a JSON string starting at the opening quote; returns index just
+// past the closing quote, or -1 on truncation.
+static inline long skip_string(const uint8_t* b, long i, long n) {
+  ++i;                                   // opening quote
+  while (i < n) {
+    uint8_t c = b[i];
+    if (c == '\\') { i += 2; continue; }
+    if (c == '"') return i + 1;
+    ++i;
+  }
+  return -1;
+}
+
+// Scan a balanced {...} or [...] value; returns index just past it.
+static inline long skip_container(const uint8_t* b, long i, long n) {
+  int depth = 0;
+  while (i < n) {
+    uint8_t c = b[i];
+    if (c == '"') {
+      i = skip_string(b, i, n);
+      if (i < 0) return -1;
+      continue;
+    }
+    if (c == '{' || c == '[') ++depth;
+    else if (c == '}' || c == ']') {
+      if (--depth == 0) return i + 1;
+    }
+    ++i;
+  }
+  return -1;
+}
+
+// buf[n] NDJSON; nf field names (fnames + per-field offset/len);
+// out: max_records * (nf+1) * 2 int64. Returns record count, or -1 if
+// max_records would overflow.
+long ndjson_extract(const uint8_t* buf, long n, const uint8_t* fnames,
+                    const long* foff, const long* flen, int nf,
+                    int64_t* out, long max_records) {
+  long rec = 0;
+  long i = 0;
+  while (i < n) {
+    long line_start = i;
+    long line_end = i;
+    while (line_end < n && buf[line_end] != '\n') ++line_end;
+    long next = line_end + 1;
+    long s = skip_ws(buf, line_start, line_end);
+    if (s == line_end) { i = next; continue; }      // blank line
+    if (rec >= max_records) return -1;
+    int64_t* slots = out + rec * (nf + 1) * 2;
+    slots[0] = line_start; slots[1] = line_end;
+    for (int f = 0; f < nf; ++f) { slots[2 + 2*f] = -1;
+                                   slots[3 + 2*f] = -1; }
+    bool bad = false;
+    if (buf[s] != '{') bad = true;
+    long p = s + 1;
+    while (!bad) {
+      p = skip_ws(buf, p, line_end);
+      if (p < line_end && buf[p] == '}') break;     // empty / done
+      if (p >= line_end || buf[p] != '"') { bad = true; break; }
+      long kstart = p + 1;
+      long kend_q = skip_string(buf, p, line_end);
+      if (kend_q < 0 || kend_q > line_end) { bad = true; break; }
+      long kend = kend_q - 1;
+      p = skip_ws(buf, kend_q, line_end);
+      if (p >= line_end || buf[p] != ':') { bad = true; break; }
+      p = skip_ws(buf, p + 1, line_end);
+      if (p >= line_end) { bad = true; break; }
+      long vstart = p;
+      uint8_t c = buf[p];
+      long vend;
+      if (c == '"') vend = skip_string(buf, p, line_end);
+      else if (c == '{' || c == '[') vend = skip_container(buf, p,
+                                                           line_end);
+      else {                                        // number/bool/null
+        vend = p;
+        while (vend < line_end && buf[vend] != ',' && buf[vend] != '}'
+               && buf[vend] != ' ' && buf[vend] != '\t'
+               && buf[vend] != '\r') ++vend;
+      }
+      if (vend < 0 || vend > line_end) { bad = true; break; }
+      // key match (exact bytes; escaped keys simply never match and
+      // the query falls back per-record only if the field is missing,
+      // which is correct behavior for keys the query didn't name)
+      long klen = kend - kstart;
+      for (int f = 0; f < nf; ++f) {
+        if (flen[f] == klen
+            && std::memcmp(fnames + foff[f], buf + kstart, klen) == 0
+            && slots[2 + 2*f] < 0) {
+          slots[2 + 2*f] = vstart;
+          slots[3 + 2*f] = vend;
+        }
+      }
+      p = skip_ws(buf, vend, line_end);
+      if (p < line_end && buf[p] == ',') { ++p; continue; }
+      if (p < line_end && buf[p] == '}') break;
+      bad = true;
+    }
+    if (bad) slots[0] = -2;                         // full-parse me
+    ++rec;
+    i = next;
+  }
+  return rec;
+}
+
+}  // extern "C"
+
+// Value classifier for the extracted extents: one call per FIELD
+// column. types: 0 absent, 1 int64, 2 double, 3 plain string (extent
+// tightened to exclude quotes), 4 python-parse-me, 5 true, 6 false,
+// 7 null. Numbers parse here (strtoll/strtod); strings flag escapes /
+// non-ASCII so Python can slice a single latin-1 decode of the buffer.
+#include <cstdlib>
+#include <cerrno>
+
+extern "C" {
+
+void njson_classify(const uint8_t* buf, const int64_t* extents, long n,
+                    int8_t* types, int64_t* ivals, double* dvals,
+                    int64_t* sextents) {
+  for (long r = 0; r < n; ++r) {
+    int64_t s = extents[2 * r], e = extents[2 * r + 1];
+    sextents[2 * r] = sextents[2 * r + 1] = 0;
+    if (s < 0) { types[r] = 0; continue; }
+    uint8_t c = buf[s];
+    if (c == '"') {
+      bool plain = true;
+      for (int64_t i = s + 1; i < e - 1; ++i) {
+        if (buf[i] == '\\' || buf[i] >= 0x80) { plain = false; break; }
+      }
+      if (plain) {
+        types[r] = 3;
+        sextents[2 * r] = s + 1;
+        sextents[2 * r + 1] = e - 1;
+      } else {
+        types[r] = 4;
+      }
+      continue;
+    }
+    if (c == 't' && e - s == 4) { types[r] = 5; continue; }
+    if (c == 'f' && e - s == 5) { types[r] = 6; continue; }
+    if (c == 'n' && e - s == 4) { types[r] = 7; continue; }
+    if (c == '-' || (c >= '0' && c <= '9')) {
+      bool is_int = true;
+      for (int64_t i = s; i < e; ++i) {
+        uint8_t d = buf[i];
+        if (d == '.' || d == 'e' || d == 'E') { is_int = false; break; }
+      }
+      char tmp[48];
+      long len = e - s;
+      if (len < (long)sizeof(tmp)) {
+        std::memcpy(tmp, buf + s, len);
+        tmp[len] = 0;
+        char* endp = nullptr;
+        if (is_int) {
+          errno = 0;
+          long long v = strtoll(tmp, &endp, 10);
+          if (endp == tmp + len && errno != ERANGE) {
+            types[r] = 1; ivals[r] = v; continue;
+          }
+          if (endp == tmp + len) { types[r] = 4; continue; }  // bigint
+        }
+        double dv = strtod(tmp, &endp);
+        if (endp == tmp + len) { types[r] = 2; dvals[r] = dv; continue; }
+      }
+      types[r] = 4;
+      continue;
+    }
+    types[r] = 4;                        // object/array/unknown
+  }
+}
+
+}  // extern "C"

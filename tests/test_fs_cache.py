@@ -291,3 +291,42 @@ class TestDiskCacheDepth:
         hits = cache.hits
         cache.get_object("bkt", "obj", offset=0, length=1000)
         assert cache.hits == hits + 1
+
+    def test_stale_version_files_purged_on_etag_change(self, tmp_path):
+        """Out-of-band change must purge ALL old-version cache files —
+        a surviving old range/whole file under the refreshed etag would
+        serve corrupt bytes."""
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"),
+                          max_object_bytes=10_000)
+        cache.make_bucket("bkt")
+        v1 = payload(50_000, 21)
+        cache.put_object("bkt", "obj", v1)
+        cache.get_object("bkt", "obj", offset=0, length=1000)
+        cache.get_object("bkt", "obj", offset=2000, length=1000)
+        # replaced behind the cache
+        v2 = payload(50_000, 22)
+        fs.put_object("bkt", "obj", v2)
+        # a DIFFERENT range misses, refreshes meta ... and must purge
+        _, got = cache.get_object("bkt", "obj", offset=4000,
+                                  length=1000)
+        assert got == v2[4000:5000]
+        # the previously cached v1 ranges must NOT serve under v2's etag
+        _, got = cache.get_object("bkt", "obj", offset=0, length=1000)
+        assert got == v2[:1000]
+        _, got = cache.get_object("bkt", "obj", offset=2000,
+                                  length=1000)
+        assert got == v2[2000:3000]
+
+    def test_head_served_from_cache_when_backend_down(self, tmp_path):
+        fs = FSObjectLayer(str(tmp_path / "fs"))
+        cache = DiskCache(fs, str(tmp_path / "cache"))
+        cache.make_bucket("bkt")
+        data = payload(8000, 23)
+        cache.put_object("bkt", "obj", data)
+        cache.get_object("bkt", "obj")
+        def boom(*a, **kw):
+            raise StorageError("unreachable")
+        cache.backend.head_object = boom
+        fi = cache.head_object("bkt", "obj")
+        assert fi.size == len(data)
