@@ -57,6 +57,13 @@ class DataScanner:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._last_usage: DataUsage | None = None
+        # Lifecycle/tier wiring (attach_config): with these attached,
+        # every scan cycle also runs ILM expiry + transitions per
+        # bucket — the runDataScanner + initBackgroundExpiry/-Transition
+        # coupling of the reference (cmd/data-scanner.go:96,
+        # cmd/bucket-lifecycle.go:213).
+        self.meta = None                # BucketMetadataSys
+        self.tier_mgr = None            # TierManager
         # Restart path: union persisted dirt back in so buckets marked
         # before a crash/restart still get their full rescan
         # (cf. dataUpdateTracker load, cmd/data-update-tracker.go:59).
@@ -102,6 +109,40 @@ class DataScanner:
         live = sum(1 for d in es.drives if d is not None)
         return 0 < missing < live
 
+    def attach_config(self, meta, tier_mgr=None) -> "DataScanner":
+        """Bind the bucket-config store (and tier manager) so cycles
+        apply lifecycle expiry/transitions; the server calls this when
+        it binds the object layer."""
+        self.meta = meta
+        self.tier_mgr = tier_mgr
+        return self
+
+    def _apply_lifecycle(self, bucket: str) -> None:
+        if self.meta is None:
+            return
+        try:
+            raw = self.meta.get(bucket, "lifecycle")
+        except Exception:  # noqa: BLE001 — config store hiccup
+            return
+        if raw is None:
+            return
+        from ..bucket.lifecycle import Lifecycle, apply_lifecycle
+        try:
+            lc = Lifecycle.parse(raw)
+            # gate each pass on rules that can fire — every pass costs
+            # a full bucket listing on top of the scanner's own walk
+            if any(r.expire_days or r.expire_date or r.noncurrent_days
+                   for r in lc.rules):
+                apply_lifecycle(self.pools, bucket, lc,
+                                tier_mgr=self.tier_mgr)
+            if self.tier_mgr is not None and any(
+                    r.transition_tier and r.transition_days
+                    for r in lc.rules):
+                from ..bucket.tier import run_transitions
+                run_transitions(self.pools, bucket, lc, self.tier_mgr)
+        except Exception:  # noqa: BLE001 — ILM must not kill the scan
+            pass
+
     def scan_cycle(self, deep: bool = False) -> DataUsage:
         t0 = time.time()
         self.stats.cycles += 1
@@ -113,6 +154,7 @@ class DataScanner:
         usage.cycle = cycle
 
         for bucket in self.pools.list_buckets():
+            self._apply_lifecycle(bucket)
             full = (bucket in dirty or deep
                     or cycle % self.full_scan_every == 1)
             if not full and self._last_usage is not None \

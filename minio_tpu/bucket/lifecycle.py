@@ -122,7 +122,7 @@ def _object_tags(fi) -> dict[str, str]:
 
 
 def apply_lifecycle(pools, bucket: str, lc: Lifecycle,
-                    now: float | None = None) -> dict:
+                    now: float | None = None, tier_mgr=None) -> dict:
     """One expiry pass over a bucket (the transition worker analogue,
     cmd/bucket-lifecycle.go:213 — expiry actions only here; transitions
     are handed to the tier module by the caller).
@@ -130,6 +130,12 @@ def apply_lifecycle(pools, bucket: str, lc: Lifecycle,
     WORM-protected versions are skipped (the reference's lifecycle path
     also runs retention enforcement before expiry) and noncurrent-expiry
     rules walk the version list.
+
+    `tier_mgr`: expiring a TRANSITIONED version must also free its
+    remote tier object (the free-version role,
+    cmd/xl-storage-free-version.go — without it lifecycle expiry leaks
+    cold storage forever); the tier journal retries until the remote
+    delete succeeds.
     """
     from . import object_lock as ol
     stats = {"expired": 0, "expired_noncurrent": 0, "transitioned": 0,
@@ -149,6 +155,8 @@ def apply_lifecycle(pools, bucket: str, lc: Lifecycle,
                 try:
                     pools.delete_object(bucket, fi.name)
                     stats["expired"] += 1
+                    if tier_mgr is not None:
+                        tier_mgr.on_version_deleted(fi)
                 except StorageError:
                     pass
         elif action.startswith("transition:"):
@@ -171,6 +179,8 @@ def apply_lifecycle(pools, bucket: str, lc: Lifecycle,
             try:
                 pools.delete_object(bucket, fi.name, v.version_id)
                 stats["expired_noncurrent"] += 1
+                if tier_mgr is not None:
+                    tier_mgr.on_version_deleted(v)
             except StorageError:
                 pass
     return stats

@@ -29,10 +29,11 @@ class SitePeer:
     # -- control-plane pushes ------------------------------------------------
 
     def push_user(self, access_key: str, secret_key: str,
-                  policies: list[str]) -> bool:
+                  policies: list[str],
+                  status: str = "enabled") -> bool:
         body = json.dumps({"accessKey": access_key,
                            "secretKey": secret_key,
-                           "policies": policies,
+                           "policies": policies, "status": status,
                            "srInternal": True}).encode()
         status, _, _ = self.cli.request("POST", "/minio/admin/v1/users",
                                         body=body)
@@ -109,19 +110,25 @@ class SitePeer:
             body=json.dumps({"action": "leave"}).encode())
         return status == 200
 
+    SR_HDR = {"x-mtpu-sr-internal": "1"}
+
     def push_bucket(self, bucket: str, configs: dict[str, bytes]) -> bool:
-        try:
-            self.cli.make_bucket(bucket)
-        except S3ClientError as e:
-            if e.code not in ("BucketAlreadyOwnedByYou",
-                              "BucketAlreadyExists"):
-                return False
+        status, _, _ = self.cli.request("PUT", f"/{bucket}",
+                                        headers=dict(self.SR_HDR))
+        if status not in (200, 409):
+            return False
         ok = True
         for sub, data in configs.items():
             status, _, _ = self.cli.request("PUT", f"/{bucket}",
-                                            query={sub: ""}, body=data)
+                                            query={sub: ""}, body=data,
+                                            headers=dict(self.SR_HDR))
             ok = ok and status == 200
         return ok
+
+    def delete_bucket(self, bucket: str) -> bool:
+        status, _, _ = self.cli.request("DELETE", f"/{bucket}",
+                                        headers=dict(self.SR_HDR))
+        return status in (200, 204, 404)
 
 
 class SiteReplicator:
@@ -151,9 +158,10 @@ class SiteReplicator:
     # -- hooks (call after local mutations) ----------------------------------
 
     def on_user_added(self, access_key: str, secret_key: str,
-                      policies: list[str]) -> int:
+                      policies: list[str],
+                      status: str = "enabled") -> int:
         return self._fan(lambda p: p.push_user(access_key, secret_key,
-                                               policies))
+                                               policies, status))
 
     def on_policy_set(self, name: str, doc: dict) -> int:
         return self._fan(lambda p: p.push_policy(name, doc))
@@ -193,7 +201,7 @@ class SiteReplicator:
                     stats["policies"] += 1
             for u in users:
                 if self.on_user_added(u.access_key, u.secret_key,
-                                      u.policies):
+                                      u.policies, u.status):
                     stats["users"] += 1
         for bucket in buckets:
             if self.on_bucket_config(bucket):

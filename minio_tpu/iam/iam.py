@@ -133,11 +133,12 @@ class IAMSys:
     # -- user management (cf. cmd/admin-handlers-users.go) ------------------
 
     def add_user(self, access_key: str, secret_key: str,
-                 policies: list[str] | None = None) -> Identity:
+                 policies: list[str] | None = None,
+                 status: str = "enabled") -> Identity:
         if len(access_key) < 3 or len(secret_key) < 8:
             raise ValueError("access key >= 3 chars, secret >= 8 chars")
         ident = Identity(access_key=access_key, secret_key=secret_key,
-                         policies=list(policies or []))
+                         policies=list(policies or []), status=status)
         with self._mu:
             self._users[access_key] = ident
         self._put(f"users/{access_key}.json", ident.__dict__)
@@ -295,17 +296,27 @@ class IAMSys:
         self._put(f"users/{access_key}.json", ident.__dict__)
         self._broadcast_reload()
 
-    def list_service_accounts(self, parent: str = "") -> list[dict]:
+    def list_service_accounts(self, parent: str = "",
+                              include_secrets: bool = False
+                              ) -> list[dict]:
         """Service accounts (optionally for one parent) with their
-        policies — the site-replication IAM digest/sync source."""
+        policies. Secrets stay OUT of the listing unless the caller is
+        an in-process replicator — the admin API must never hand a
+        list-level grant every credential in the cluster (the
+        reference's ListServiceAccounts omits secrets too)."""
         with self._mu:
-            return sorted(
-                ({"accessKey": u.access_key, "secretKey": u.secret_key,
-                  "parent": u.parent, "policies": list(u.policies)}
-                 for u in self._users.values()
-                 if u.kind == "service"
-                 and (not parent or u.parent == parent)),
-                key=lambda d: d["accessKey"])
+            out = []
+            for u in sorted(self._users.values(),
+                            key=lambda x: x.access_key):
+                if u.kind != "service" or (parent
+                                           and u.parent != parent):
+                    continue
+                d = {"accessKey": u.access_key, "parent": u.parent,
+                     "policies": list(u.policies)}
+                if include_secrets:
+                    d["secretKey"] = u.secret_key
+                out.append(d)
+            return out
 
     def list_users(self) -> list[str]:
         with self._mu:

@@ -64,6 +64,12 @@ class S3Server:
         self.bucket_dns = bucket_dns
         self.handlers = (S3Handlers(pools, **self._handler_opts)
                          if pools is not None else None)
+        if scanner is not None and self.handlers is not None \
+                and hasattr(scanner, "attach_config"):
+            # scan cycles run ILM expiry/transitions against the live
+            # bucket-config store (free-version semantics included)
+            scanner.attach_config(self.handlers.meta,
+                                  self.handlers.tier_mgr)
         self.trace_sink = trace_sink
         from ..observe.logger import Logger, RingTarget
         from ..observe.metrics import MetricsRegistry
@@ -166,6 +172,24 @@ class S3Server:
                     self.close_connection = True
                 finally:
                     outer.metrics.inflight.inc(-1)
+                # Site replication: successful BUCKET-level mutations
+                # (create/delete/config) fan out like IAM ones —
+                # internal pushes carry x-mtpu-sr-internal and don't
+                # re-enter.
+                if (self.command in ("PUT", "DELETE")
+                        and resp.status < 300
+                        and not path.startswith("/minio/")
+                        and "/" not in path.strip("/")
+                        and path.strip("/")
+                        and not self.headers.get("x-mtpu-sr-internal")):
+                    kind = ("bucket-delete"
+                            if self.command == "DELETE" and not query
+                            else "bucket")
+                    try:
+                        outer._site_hook(kind,
+                                         bucket=path.strip("/"))
+                    except Exception:  # noqa: BLE001
+                        pass
                 dur = (_time.perf_counter() - t0)
                 api = f"{self.command} {path.split('/')[1] if '/' in path else ''}"
                 resp_size = (int(resp.headers.get("Content-Length", 0) or 0)
@@ -273,6 +297,10 @@ class S3Server:
             self.scanner = scanner
             self._handler_opts["scanner"] = scanner
         self.handlers = S3Handlers(pools, **self._handler_opts)
+        if self.scanner is not None \
+                and hasattr(self.scanner, "attach_config"):
+            self.scanner.attach_config(self.handlers.meta,
+                                       self.handlers.tier_mgr)
 
     def start(self) -> "S3Server":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -640,38 +668,57 @@ class S3Server:
                 creds=self.creds)
         return self._site_sys_obj
 
-    def _site_hook(self, what: str) -> None:
-        """After a local IAM/bucket-config mutation: if this server is
-        in a site group, fan the change out ASYNCHRONOUSLY, single-
-        flight — a mutation must not block on (or cascade through) the
-        whole group; peers' pushes carry srInternal and never re-enter
-        this hook. Best-effort: reconcile repairs anything missed."""
+    def _site_hook(self, what: str, bucket: str = "") -> None:
+        """After a local IAM/bucket mutation: if this server is in a
+        site group, fan the change out ASYNCHRONOUSLY, single-flight —
+        a mutation must not block on (or cascade through) the whole
+        group; peers' pushes carry srInternal and never re-enter this
+        hook. Bucket DELETES additionally push explicit DeleteBucket
+        to every peer (reconcile is deliberately additive — a sweep
+        that deleted "extra" remote buckets could destroy data a peer
+        created while we were partitioned). Best-effort: reconcile
+        repairs anything missed."""
         try:
             sys_ = self._site_sys()    # loads persisted state: a hook
         except Exception:  # noqa: BLE001    # must fire after restarts
             return
         if not sys_.enabled:
             return
-        if getattr(self, "_site_hook_busy", False):
-            self._site_hook_again = True
-            return
-        self._site_hook_busy = True
-        self._site_hook_again = False
+        if what == "bucket-delete" and bucket:
+            import threading as _thr
 
-        def run():
-            import threading as _t
-            try:
-                while True:
-                    self._site_hook_again = False
+            def drop():
+                for peer in sys_._peers():
                     try:
-                        sys_.reconcile()
+                        peer.delete_bucket(bucket)
                     except Exception:  # noqa: BLE001
                         pass
-                    if not self._site_hook_again:
-                        return
-            finally:
-                self._site_hook_busy = False
+            _thr.Thread(target=drop, daemon=True,
+                        name="site-repl-bucket-del").start()
         import threading
+        if getattr(self, "_site_hook_mu", None) is None:
+            self._site_hook_mu = threading.Lock()
+        with self._site_hook_mu:
+            if getattr(self, "_site_hook_busy", False):
+                self._site_hook_again = True
+                return
+            self._site_hook_busy = True
+            self._site_hook_again = False
+
+        def run():
+            while True:
+                try:
+                    sys_.reconcile()
+                except Exception:  # noqa: BLE001
+                    pass
+                # exit-decision and busy-clear are ATOMIC: a mutation
+                # landing after the check would otherwise set again=True
+                # on a worker that already chose to exit (lost wakeup)
+                with self._site_hook_mu:
+                    if not self._site_hook_again:
+                        self._site_hook_busy = False
+                        return
+                    self._site_hook_again = False
         threading.Thread(target=run, daemon=True,
                          name="site-repl-hook").start()
 
@@ -772,7 +819,9 @@ class S3Server:
                     else:
                         self.iam.add_user(req_obj["accessKey"],
                                           req_obj["secretKey"],
-                                          req_obj.get("policies", []))
+                                          req_obj.get("policies", []),
+                                          status=req_obj.get(
+                                              "status", "enabled"))
                 except (KeyError, ValueError) as e:
                     raise S3Error("InvalidArgument", str(e)) from None
                 if not req_obj.get("srInternal"):

@@ -106,7 +106,9 @@ class LocalDrive:
         p = self._vol_path(vol)
         if vol in self._vols:
             return p
-        if not os.path.isdir(p):
+        with self._osc.timed("stat"):
+            ok = os.path.isdir(p)
+        if not ok:
             raise ErrVolumeNotFound(vol)
         self._vols.add(vol)
         return p
@@ -124,13 +126,18 @@ class LocalDrive:
 
     def make_volume(self, vol: str) -> None:
         p = self._vol_path(vol)
-        if os.path.isdir(p):
+        with self._osc.timed("stat"):
+            exists = os.path.isdir(p)
+        if exists:
             raise ErrVolumeExists(vol)
-        os.makedirs(p)
+        with self._osc.timed("mkdir"):
+            os.makedirs(p)
 
     def list_volumes(self) -> list[str]:
         out = []
-        for name in sorted(os.listdir(self.root)):
+        with self._osc.timed("listdir"):
+            names = sorted(os.listdir(self.root))
+        for name in names:
             if name == SYS_VOL or name.startswith("."):
                 continue
             if os.path.isdir(os.path.join(self.root, name)):
@@ -139,7 +146,8 @@ class LocalDrive:
 
     def stat_volume(self, vol: str) -> dict:
         p = self._check_vol(vol)
-        st = os.stat(p)
+        with self._osc.timed("stat"):
+            st = os.stat(p)
         return {"name": vol, "created_ns": int(st.st_mtime_ns)}
 
     def delete_volume(self, vol: str, force: bool = False) -> None:
@@ -172,7 +180,8 @@ class LocalDrive:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, p)
+        with self._osc.timed("rename"):
+            os.replace(tmp, p)
 
     def read_all(self, vol: str, path: str) -> bytes:
         with self._osc.timed('read'):
@@ -243,13 +252,15 @@ class LocalDrive:
         ErrVolumeNotFound instead of silently recreating the dir."""
         d = os.path.dirname(p)
         try:
-            os.mkdir(d)
+            with self._osc.timed("mkdir"):
+                os.mkdir(d)
         except FileExistsError:
             pass
         except FileNotFoundError:
             self._vols.discard(vol)
             self._check_vol(vol)
-            os.makedirs(d, exist_ok=True)
+            with self._osc.timed("mkdir"):
+                os.makedirs(d, exist_ok=True)
 
     def _append_file_impl(self, vol: str, path: str, data) -> None:
         """Append to a staged shard file (streaming writes land batch by
@@ -307,7 +318,8 @@ class LocalDrive:
         if not os.path.isfile(src):
             raise ErrFileNotFound(f"{src_vol}/{src_path}")
         self._ensure_parent_in_vol(dst_vol, dst)
-        os.replace(src, dst)
+        with self._osc.timed("rename"):
+            os.replace(src, dst)
 
     def list_raw(self, vol: str, path: str = "") -> list[str]:
         """All directory entries (files and dirs) under a path, unfiltered —
@@ -315,7 +327,8 @@ class LocalDrive:
         self._check_vol(vol)
         p = self._file_path(vol, path) if path else self._vol_path(vol)
         try:
-            return sorted(os.listdir(p))
+            with self._osc.timed("listdir"):
+                return sorted(os.listdir(p))
         except FileNotFoundError:
             raise ErrPathNotFound(f"{vol}/{path}") from None
         except NotADirectoryError:
@@ -324,7 +337,8 @@ class LocalDrive:
     def file_size(self, vol: str, path: str) -> int:
         p = self._file_path(vol, path)
         try:
-            st = os.stat(p)
+            with self._osc.timed("stat"):
+                st = os.stat(p)
         except FileNotFoundError:
             raise ErrFileNotFound(f"{vol}/{path}") from None
         if not os.path.isfile(p):
@@ -468,7 +482,8 @@ class LocalDrive:
                 self._ensure_parent_in_vol(dst_vol, dst)
                 if os.path.isdir(dst):
                     self._move_to_trash(dst)
-                os.replace(src, dst)
+                with self._osc.timed("rename"):
+                    os.replace(src, dst)
             meta.add_version(fi)
             self._write_xlmeta(dst_vol, dst_obj, meta, new=fresh)
             if old_dd:
@@ -521,7 +536,8 @@ class LocalDrive:
         self._check_vol(vol)
         p = self._file_path(vol, path) if path else self._vol_path(vol)
         try:
-            names = sorted(os.listdir(p))
+            with self._osc.timed("listdir"):
+                names = sorted(os.listdir(p))
         except FileNotFoundError:
             raise ErrPathNotFound(f"{vol}/{path}") from None
         except NotADirectoryError:
@@ -674,7 +690,8 @@ class LocalDrive:
         trash = os.path.join(self.root, SYS_VOL, TMP_DIR,
                              f"trash-{uuid.uuid4().hex}")
         try:
-            os.replace(path, trash)
+            with self._osc.timed("rename"):
+                os.replace(path, trash)
         except FileNotFoundError:
             return
         shutil.rmtree(trash, ignore_errors=True)

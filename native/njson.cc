@@ -112,8 +112,9 @@ long ndjson_extract(const uint8_t* buf, long n, const uint8_t* fnames,
       long klen = kend - kstart;
       for (int f = 0; f < nf; ++f) {
         if (flen[f] == klen
-            && std::memcmp(fnames + foff[f], buf + kstart, klen) == 0
-            && slots[2 + 2*f] < 0) {
+            && std::memcmp(fnames + foff[f], buf + kstart, klen) == 0) {
+          // duplicate keys: LAST wins, like json.loads — the fast
+          // path must agree with the stdlib reader byte for byte
           slots[2 + 2*f] = vstart;
           slots[3 + 2*f] = vend;
         }
@@ -122,6 +123,13 @@ long ndjson_extract(const uint8_t* buf, long n, const uint8_t* fnames,
       if (p < line_end && buf[p] == ',') { ++p; continue; }
       if (p < line_end && buf[p] == '}') break;
       bad = true;
+    }
+    if (!bad) {
+      // the line must END at the object: trailing garbage is malformed
+      // NDJSON the stdlib reader would raise on — never silently drop
+      long q = (buf[s] == '{' && p < line_end && buf[p] == '}')
+                   ? skip_ws(buf, p + 1, line_end) : p;
+      if (q != line_end) bad = true;
     }
     if (bad) slots[0] = -2;                         // full-parse me
     ++rec;

@@ -92,14 +92,14 @@ class TestConvergence:
         _, c0, _ = sites[0]
         _, c1, _ = sites[1]
         _, c2, _ = sites[2]
-        # divergent edits on DIFFERENT sites, made directly against
-        # each site's IAM/bucket plane
-        c1.request("POST", "/minio/admin/v1/policies", body=json.dumps({
-            "name": "drifted-pol", "policy": {
-                "Version": "2012-10-17",
-                "Statement": [{"Effect": "Allow", "Action": ["s3:Get*"],
-                               "Resource": ["arn:aws:s3:::*"]}]}}).encode())
-        c2.make_bucket("only-on-site2")
+        # divergent edits on DIFFERENT sites, made OUT OF BAND
+        # (directly against IAM/pools, not over the admin API — the
+        # async change hooks would self-heal API edits immediately)
+        sites[1][0].iam.set_policy("drifted-pol", {
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Action": ["s3:Get*"],
+                           "Resource": ["arn:aws:s3:::*"]}]})
+        sites[2][2].make_bucket("only-on-site2")
         # drift visible from site 0
         st, rep = admin(c0, "POST", "status")
         assert st == 200
@@ -148,8 +148,9 @@ class TestConvergence:
                 "GET", "/minio/admin/v1/service-accounts")[2])["accounts"]
             svc = {a["accessKey"]: a for a in accs}
             assert "svc-alice-1" in svc
-            assert svc["svc-alice-1"]["secretKey"] == "svc-alice-secret-1"
             assert svc["svc-alice-1"]["parent"] == "alice"
+            # the admin listing must NOT leak secrets
+            assert "secretKey" not in svc["svc-alice-1"]
             # the mirrored svc account can actually SIGN requests
             svc_cli = S3Client(srv.endpoint, "svc-alice-1",
                                "svc-alice-secret-1")
