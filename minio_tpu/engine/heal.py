@@ -360,6 +360,17 @@ def _reconstruct_rows(es: ErasureSet, fi: FileInfo,
     shard_size = ec.shard_size
     logical = rows[avail[0]].size
     use = avail[:k]
+    # Host fast path: RS is positional, so whole LOGICAL rows (full
+    # blocks AND tail in one go) transform with per-row pointers — no
+    # batch stacking, no per-block loop (native ec_gf_rows, GFNI when
+    # the CPU has it).
+    if not es._use_device and k + m <= 64:
+        try:
+            from native import ecio_native
+            return ecio_native.gf_transform_rows(
+                [rows[s] for s in use], list(use), k, m, list(need))
+        except Exception:  # noqa: BLE001 — no toolchain: batch path
+            pass
     # Split logical shard into full-block matrix + tail.
     n_full = logical // shard_size
     tail_len = logical - n_full * shard_size

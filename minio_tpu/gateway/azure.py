@@ -26,11 +26,12 @@ from __future__ import annotations
 import base64
 import hashlib
 import hmac
-import http.client
 import time
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
+
+from .common import KeepAliveHTTPClient
 
 from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
                               ErrInvalidPart, ErrObjectNotFound,
@@ -74,39 +75,17 @@ def sign_shared_key(account: str, key_b64: str, method: str, path: str,
     return f"SharedKey {account}:{base64.b64encode(sig).decode()}"
 
 
-class AzureBlobClient:
-    """Minimal Blob REST client over http.client with SharedKey auth.
-
-    One persistent keep-alive connection per client (rebuilt on any
-    transport error) — the data hot path must not pay a TCP/TLS
-    handshake per call."""
+class AzureBlobClient(KeepAliveHTTPClient):
+    """Blob REST client with SharedKey auth over the shared keep-alive
+    transport (gateway/common.py)."""
 
     def __init__(self, endpoint: str, account: str, key_b64: str,
                  timeout: float = 10.0):
         u = urllib.parse.urlsplit(endpoint)
-        self.host = u.hostname
-        self.port = u.port or (443 if u.scheme == "https" else 80)
-        self.tls = u.scheme == "https"
+        super().__init__(u.hostname,
+                         u.port or (443 if u.scheme == "https" else 80),
+                         u.scheme == "https", timeout)
         self.account, self.key = account, key_b64
-        self.timeout = timeout
-        self._conn: http.client.HTTPConnection | None = None
-        import threading
-        self._mu = threading.Lock()
-
-    def _connection(self) -> http.client.HTTPConnection:
-        if self._conn is None:
-            self._conn = (http.client.HTTPSConnection if self.tls
-                          else http.client.HTTPConnection)(
-                              self.host, self.port, timeout=self.timeout)
-        return self._conn
-
-    def _drop(self) -> None:
-        if self._conn is not None:
-            try:
-                self._conn.close()
-            except OSError:
-                pass
-            self._conn = None
 
     def request(self, method: str, path: str,
                 query: dict[str, str] | None = None,
@@ -123,19 +102,7 @@ class AzureBlobClient:
             self.account, self.key, method, path, query, headers)
         qs = urllib.parse.urlencode(sorted(query.items()))
         url = urllib.parse.quote(path) + ("?" + qs if qs else "")
-        with self._mu:
-            for attempt in (0, 1):
-                conn = self._connection()
-                try:
-                    conn.request(method, url, body=body, headers=headers)
-                    resp = conn.getresponse()
-                    data = resp.read()
-                    return resp.status, dict(resp.getheaders()), data
-                except (OSError, http.client.HTTPException):
-                    # stale keep-alive: rebuild once, then surface
-                    self._drop()
-                    if attempt:
-                        raise
+        return self.roundtrip(method, url, body, headers)
 
     def check(self, method: str, path: str, query=None, headers=None,
               body: bytes = b"", ok=(200, 201, 202, 204, 206)):
@@ -270,10 +237,8 @@ class AzureGateway:
 
     @staticmethod
     def _fi(bucket: str, obj: str, size: int, metadata: dict) -> FileInfo:
-        return FileInfo(volume=bucket, name=obj, version_id="",
-                        data_dir="", mod_time_ns=time.time_ns(),
-                        size=size, metadata=metadata,
-                        parts=[ObjectPartInfo(1, size, size)])
+        from .common import make_fi
+        return make_fi(bucket, obj, size, metadata)
 
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
@@ -382,6 +347,8 @@ class AzureGateway:
 
     def put_object_part(self, bucket: str, obj: str, upload_id: str,
                         part_number: int, data: bytes):
+        from ..utils.streams import ensure_bytes
+        data = ensure_bytes(data)
         etag = hashlib.md5(data).hexdigest()
         try:
             self.cli.check("PUT", f"/{bucket}/{obj}",

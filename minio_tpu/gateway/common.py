@@ -1,0 +1,64 @@
+"""Shared gateway plumbing: keep-alive HTTP transport + FileInfo
+synthesis.
+
+One implementation of the connection lifecycle (persistent conn,
+rebuild-once on transport error, serialized under a lock) serves every
+cloud gateway; subclasses only contribute auth headers.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+
+
+class KeepAliveHTTPClient:
+    """One persistent connection, rebuilt once on a stale keep-alive."""
+
+    def __init__(self, host: str, port: int, tls: bool,
+                 timeout: float = 10.0):
+        self.host, self.port, self.tls = host, port, tls
+        self.timeout = timeout
+        self._conn: http.client.HTTPConnection | None = None
+        self._mu = threading.Lock()
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = (http.client.HTTPSConnection if self.tls
+                          else http.client.HTTPConnection)(
+                              self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    def _drop(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def roundtrip(self, method: str, url: str, body: bytes,
+                  headers: dict[str, str]) -> tuple[int, dict, bytes]:
+        with self._mu:
+            for attempt in (0, 1):
+                conn = self._connection()
+                try:
+                    conn.request(method, url, body=body, headers=headers)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    return resp.status, dict(resp.getheaders()), data
+                except (OSError, http.client.HTTPException):
+                    # stale keep-alive: rebuild once, then surface
+                    self._drop()
+                    if attempt:
+                        raise
+
+
+def make_fi(bucket: str, obj: str, size: int, metadata: dict):
+    """Single-part FileInfo for gateway objects."""
+    from ..storage.xlmeta import FileInfo, ObjectPartInfo
+    return FileInfo(volume=bucket, name=obj, version_id="",
+                    data_dir="", mod_time_ns=time.time_ns(),
+                    size=size, metadata=dict(metadata),
+                    parts=[ObjectPartInfo(1, size, size)])

@@ -210,6 +210,18 @@ int ec_get_verify(const uint8_t* const* frames, const int32_t* sel,
   return nbad;
 }
 
+// Whole-row GF transform with per-row pointers: dsts[t] = sum_c
+// M[t][c] * srcs[c] over len bytes — the heal path reconstructs full
+// logical shard rows without ever stacking them into a batch matrix.
+void ec_gf_rows(const uint8_t* tables, const uint64_t* mats,
+                const uint8_t* const* srcs, int nsrc,
+                uint8_t* const* dsts, int ntgt, size_t len) {
+  for (int t = 0; t < ntgt; ++t) {
+    rs_row_ptrs(tables + (size_t)t * nsrc * 32,
+                mats + (size_t)t * nsrc, srcs, nsrc, dsts[t], len);
+  }
+}
+
 // GFNI<->field self-check material: y = c * x in GF(2^8)/0x11D for the
 // loader to validate the affine-matrix layout at import time.
 int ec_selftest_mul(const uint64_t* mat, int x) {

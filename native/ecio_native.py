@@ -76,6 +76,12 @@ def _load_inner():
         ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p]
+    lib.ec_gf_rows.restype = None
+    lib.ec_gf_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.c_size_t]
     lib.ec_selftest_mul.restype = ctypes.c_int
     lib.ec_selftest_mul.argtypes = [ctypes.c_void_p, ctypes.c_int]
     if b"gfni" in lib.ec_isa():
@@ -267,3 +273,30 @@ def get_verify(frames: list, sel: list[int], nb: int, S: int, k: int,
         tag.ctypes.data, y.ctypes.data, ok.ctypes.data,
         scratch.ctypes.data)
     return y, ok, nbad
+
+
+def gf_transform_rows(srcs: list, sel: list[int], k: int, m: int,
+                      targets: list[int]) -> list[np.ndarray]:
+    """Reconstruct whole logical shard rows: targets from the selected
+    rows, one GF pass per target with per-row POINTERS — no batch
+    stacking, no per-block loop (the heal hot path; RS is positional,
+    so one call covers full blocks AND the tail fragment)."""
+    from minio_tpu.ops.erasure_native import (tables_for_matrix,
+                                              transform_matrix)
+    if len(sel) > MAX_ROWS:
+        raise ValueError(f"ksel {len(sel)} > {MAX_ROWS}")
+    lib = load()
+    mat = transform_matrix(k, m, tuple(sel), tuple(targets))
+    tabs = tables_for_matrix(mat)
+    mats = affine_qwords(mat)
+    L = int(srcs[0].size)
+    keep: list = []
+    sptr = (ctypes.c_void_p * len(sel))(
+        *[_raddr(np.ascontiguousarray(r, dtype=np.uint8), keep)
+          for r in srcs])
+    outs = [np.empty(L, dtype=np.uint8) for _ in targets]
+    dptr = (ctypes.c_void_p * len(targets))(
+        *[o.ctypes.data for o in outs])
+    lib.ec_gf_rows(tabs.ctypes.data, mats.ctypes.data, sptr, len(sel),
+                   dptr, len(targets), L)
+    return outs
