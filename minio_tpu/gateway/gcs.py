@@ -364,9 +364,18 @@ class GCSGateway:
         for item in self._list_raw(bucket,
                                    f"{self.MP_PREFIX}{upload_id}/"):
             self.cli.request("DELETE", _obj_path(bucket, item["name"]))
+        # persist the multipart etag on the composed object — compose
+        # leaves GCS metadata empty, and a HEAD serving ETag "" forever
+        # is exactly what put_object's PATCH check guards against
+        etag = f"{total_etag.hexdigest()}-{len(sources)}"
+        st, _, resp = self.cli.request(
+            "PATCH", _obj_path(bucket, obj), None,
+            json.dumps({"metadata": {"etag": etag}}).encode())
+        if st != 200:
+            raise GCSError(st, "metadata patch failed: "
+                           + resp[:80].decode("utf-8", "replace"))
         fi = self.head_object(bucket, obj)
-        fi.metadata["etag"] = (f"{total_etag.hexdigest()}-"
-                               f"{len(list(parts))}")
+        fi.metadata["etag"] = etag
         return fi
 
     def _compose(self, bucket: str, sources: list[dict],

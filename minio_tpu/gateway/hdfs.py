@@ -28,7 +28,6 @@ from __future__ import annotations
 import hashlib
 import http.client
 import json
-import threading
 import time
 import urllib.parse
 import uuid
@@ -50,7 +49,8 @@ class WebHDFSClient:
                  timeout: float = 10.0):
         u = urllib.parse.urlsplit(endpoint)
         self.host = u.hostname
-        self.port = u.port or 9870
+        self.tls = u.scheme == "https"
+        self.port = u.port or (9871 if self.tls else 9870)
         self.user = user
         self.timeout = timeout
 
@@ -63,7 +63,9 @@ class WebHDFSClient:
         at it both doubles the bytes on the wire and risks the
         namenode closing the socket mid-send."""
         u = urllib.parse.urlsplit(url)
-        conn = http.client.HTTPConnection(
+        tls = (u.scheme == "https") if u.scheme else self.tls
+        conn = (http.client.HTTPSConnection if tls
+                else http.client.HTTPConnection)(
             u.hostname or self.host, u.port or self.port,
             timeout=self.timeout)
         first_leg_body = b"" if (follow and body) else body
@@ -88,7 +90,8 @@ class WebHDFSClient:
         # no lock: every call opens its own connection (the redirect
         # targets vary), so there is no shared state to serialize
         q = {"op": op, "user.name": self.user, **params}
-        url = (f"http://{self.host}:{self.port}/webhdfs/v1"
+        scheme = "https" if self.tls else "http"
+        url = (f"{scheme}://{self.host}:{self.port}/webhdfs/v1"
                + urllib.parse.quote(path)
                + "?" + urllib.parse.urlencode(q))
         return self._req(method, url, body)
@@ -228,16 +231,26 @@ class HDFSGateway:
         out: list[FileInfo] = []
 
         def walk(rel: str) -> None:
+            if len(out) >= max_keys:
+                return                       # bounded: stop listing
             st, data = self.cli.op("GET", self._p(bucket, rel),
                                    "LISTSTATUS")
             if st != 200:
                 return
             for s in json.loads(data)["FileStatuses"]["FileStatus"]:
+                if len(out) >= max_keys:
+                    return
                 name = (f"{rel}/{s['pathSuffix']}" if rel
                         else s["pathSuffix"])
                 if name.startswith("."):
                     continue
                 if s["type"] == "DIRECTORY":
+                    # prune: descend only into dirs that can still
+                    # hold prefix matches
+                    d = name + "/"
+                    if prefix and not (d.startswith(prefix)
+                                       or prefix.startswith(d)):
+                        continue
                     walk(name)
                 else:
                     if name.startswith(prefix) and \
@@ -326,10 +339,22 @@ class HDFSGateway:
             if st not in (200, 201):
                 raise HDFSError(st)
         dest = self._p(bucket, obj)
+        if "/" in obj:
+            self.cli.op("PUT", dest.rsplit("/", 1)[0], "MKDIRS")
         self.cli.op("DELETE", dest, "DELETE")
-        st, _ = self.cli.op("PUT", staged, "RENAME", destination=dest)
-        if st != 200:
-            raise HDFSError(st)
+        st, resp = self.cli.op("PUT", staged, "RENAME",
+                               destination=dest)
+        ok = False
+        if st == 200:
+            try:
+                ok = bool(json.loads(resp).get("boolean"))
+            except ValueError:
+                ok = False
+        if not ok:
+            # WebHDFS reports rename failure as 200 {"boolean": false}
+            # — treating that as success would delete the staged data
+            raise HDFSError(st, f"rename to {dest} failed: "
+                            + resp[:80].decode("utf-8", "replace"))
         self.cli.op("DELETE", f"{self.root}/{self.TMP}/{upload_id}",
                     "DELETE", recursive="true")
         fi = self.head_object(bucket, obj)

@@ -100,6 +100,12 @@ class S3Server:
                 if "Content-Length" not in resp.headers:
                     self.send_header("Content-Length", str(len(body)))
                 self.send_header("x-amz-request-id", self.request_id)
+                # security headers on every response (the
+                # addSecurityHeaders middleware, cmd/generic-handlers.go)
+                self.send_header("X-Content-Type-Options", "nosniff")
+                self.send_header("X-XSS-Protection", "1; mode=block")
+                self.send_header("Content-Security-Policy",
+                                 "block-all-mixed-content")
                 self.end_headers()
                 if self.command == "HEAD":
                     return
@@ -121,6 +127,17 @@ class S3Server:
                 path = urllib.parse.unquote(parsed.path)
                 query = urllib.parse.parse_qs(parsed.query,
                                               keep_blank_values=True)
+                if path == "/crossdomain.xml":
+                    # setCrossDomainPolicy (cmd/crossdomain-xml-handler.go)
+                    body = (b'<?xml version="1.0"?><!DOCTYPE cross-domain-'
+                            b'policy SYSTEM "http://www.adobe.com/xml/dtds'
+                            b'/cross-domain-policy.dtd"><cross-domain-'
+                            b'policy><allow-access-from domain="*" '
+                            b'secure="false" /></cross-domain-policy>')
+                    self._respond(Response(200, body,
+                                           {"Content-Type":
+                                            "application/xml"}))
+                    return
                 if path.startswith("/minio/rpc/") and \
                         outer.rpc_router is not None:
                     # Inter-node plane: bearer-token auth + msgpack,

@@ -247,6 +247,10 @@ class TestGCSGateway:
             etags.append((i, info.etag))
         fi = gw.complete_multipart_upload("mp", "big", uid, etags)
         assert fi.metadata["etag"].endswith("-40")
+        # the multipart etag must SURVIVE to later HEADs (persisted on
+        # the composed object, not just on the returned FileInfo)
+        assert gw.head_object("mp", "big").metadata["etag"] == \
+            fi.metadata["etag"]
         _, got = gw.get_object("mp", "big")
         assert got == b"".join(chunks)
         # every temporary part/intermediate swept

@@ -78,10 +78,12 @@ class FakeWebHDFS:
                     return self._reply(201)
                 if op == "RENAME":
                     dst = q["destination"]
-                    if path in fake.files:
+                    parent = dst.rsplit("/", 1)[0] or "/"
+                    if path in fake.files and parent in fake.dirs:
                         fake.files[dst] = fake.files.pop(path)
                         return self._reply(200, b'{"boolean": true}')
-                    return self._reply(404, b"{}")
+                    # real WebHDFS: failure is 200 + boolean false
+                    return self._reply(200, b'{"boolean": false}')
                 return self._reply(400, b"{}")
 
             def do_POST(self):
@@ -251,3 +253,31 @@ class TestHDFSGateway:
             assert "/minio/front/obj" not in fake.files
         finally:
             srv.shutdown()
+
+
+    def test_multipart_to_nested_key(self, hdfs):
+        """Complete to a nested key: the dest parent dirs must exist or
+        WebHDFS RENAME fails with 200/boolean:false — which must NOT be
+        treated as success (it would delete the staged data)."""
+        fake, gw = hdfs
+        gw.make_bucket("mpn")
+        uid = gw.new_multipart_upload("mpn", "deep/path/obj")
+        import os
+        chunks = [os.urandom(3000), os.urandom(4000)]
+        etags = [(i, gw.put_object_part("mpn", "deep/path/obj", uid, i,
+                                        c).etag)
+                 for i, c in enumerate(chunks, 1)]
+        fi = gw.complete_multipart_upload("mpn", "deep/path/obj", uid,
+                                          etags)
+        _, got = gw.get_object("mpn", "deep/path/obj")
+        assert got == b"".join(chunks)
+
+    def test_prefix_walk_is_pruned(self, hdfs):
+        fake, gw = hdfs
+        gw.make_bucket("pfx")
+        for d in ("logs", "data", "misc"):
+            for i in range(3):
+                gw.put_object("pfx", f"{d}/f{i}", b"x")
+        assert gw.list_object_names("pfx", prefix="logs/") == \
+            ["logs/f0", "logs/f1", "logs/f2"]
+        assert len(gw.list_objects("pfx", max_keys=1)) == 1
