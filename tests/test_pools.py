@@ -206,7 +206,7 @@ class TestCLIPools:
              "--port", str(port)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
         try:
-            deadline = time.monotonic() + 90
+            deadline = time.monotonic() + 240
             url = f"http://127.0.0.1:{port}/minio/health/ready"
             while True:
                 try:
