@@ -614,7 +614,8 @@ def main() -> None:
     }
     # e2e object-layer configs + tunnel context measured above
     for k, v in results.items():
-        if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup"))
+        if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup",
+                        "_ms_tmpfs"))
                 or k.startswith("tunnel_") or k == "host_cores"):
             extras.setdefault(k, v)
     print(json.dumps({

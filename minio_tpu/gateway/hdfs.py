@@ -230,28 +230,36 @@ class HDFSGateway:
             raise ErrBucketNotFound(bucket)
         out: list[FileInfo] = []
 
-        # NOTE: no max_keys early-exit — the walk is in TRAVERSAL
-        # order, not key order ('b/x' walks before 'b.txt' but sorts
-        # after it), so truncating before the sort would make marker
-        # pagination skip keys forever. Pruning by prefix is safe.
-        def walk(rel: str) -> None:
+        # GLOBAL-KEY-ORDER walk: entries sort with files as `name` and
+        # dirs as `name + "/"` ('b.txt' < 'b/'), so recursing in that
+        # order emits keys exactly sorted — which makes the max_keys
+        # early exit SAFE for marker pagination (no later-sorting key
+        # can still appear). Prefix pruning bounds the subtree.
+        def walk(rel: str) -> bool:
             st, data = self.cli.op("GET", self._p(bucket, rel),
                                    "LISTSTATUS")
             if st != 200:
-                return
+                return False
+            entries = []
             for s in json.loads(data)["FileStatuses"]["FileStatus"]:
                 name = (f"{rel}/{s['pathSuffix']}" if rel
                         else s["pathSuffix"])
                 if name.startswith("."):
                     continue
-                if s["type"] == "DIRECTORY":
-                    # prune: descend only into dirs that can still
-                    # hold prefix matches
+                is_dir = s["type"] == "DIRECTORY"
+                entries.append((name + "/" if is_dir else name,
+                                is_dir, name, s))
+            for _, is_dir, name, s in sorted(entries):
+                if is_dir:
                     d = name + "/"
                     if prefix and not (d.startswith(prefix)
                                        or prefix.startswith(d)):
                         continue
-                    walk(name)
+                    if marker and not (marker.startswith(d)
+                                       or marker < d):
+                        continue        # whole subtree <= marker
+                    if walk(name):
+                        return True
                 else:
                     if name.startswith(prefix) and \
                             (not marker or name > marker):
@@ -260,9 +268,12 @@ class HDFSGateway:
                             {"etag": hashlib.md5(
                                 f"{bucket}/{name}".encode()
                             ).hexdigest()}))
+                        if len(out) >= max_keys:
+                            return True
+            return False
 
         walk("")
-        return sorted(out, key=lambda f: f.name)[:max_keys]
+        return out[:max_keys]
 
     def list_object_names(self, bucket: str, prefix: str = "") -> list[str]:
         return [fi.name for fi in self.list_objects(bucket, prefix)]
@@ -361,8 +372,30 @@ class HDFSGateway:
         # file stays put (no sweep), so nothing is ever lost silently.
         ok, st, resp = try_rename()
         if not ok:
-            self.cli.op("DELETE", dest, "DELETE")
-            ok, st, resp = try_rename()
+            # Overwrite case (HDFS refuses rename onto an existing
+            # file): SWAP, never plain-delete — park the old object
+            # under the staging dir, rename the new one in, and if
+            # THAT still fails restore the old one. No failure shape
+            # loses the published version.
+            st_dest, _ = self.cli.op("GET", dest, "GETFILESTATUS")
+            st_staged, _ = self.cli.op("GET", staged, "GETFILESTATUS")
+            if st_dest == 200 and st_staged == 200:
+                backup = f"{self.root}/{self.TMP}/{upload_id}/.old"
+                st_b, resp_b = self.cli.op("PUT", dest, "RENAME",
+                                           destination=backup)
+                moved = False
+                if st_b == 200:
+                    try:
+                        moved = bool(json.loads(resp_b).get("boolean"))
+                    except ValueError:
+                        moved = False
+                if moved:
+                    ok, st, resp = try_rename()
+                    if ok:
+                        self.cli.op("DELETE", backup, "DELETE")
+                    else:
+                        self.cli.op("PUT", backup, "RENAME",
+                                    destination=dest)   # restore
         if not ok:
             raise HDFSError(st, f"rename to {dest} failed: "
                             + resp[:80].decode("utf-8", "replace"))

@@ -307,3 +307,33 @@ class TestHDFSGateway:
         # happy path: overwrite succeeds via delete+retry
         gw.complete_multipart_upload("ow", "obj", uid, e)
         assert gw.get_object("ow", "obj")[1] == b"new-version"
+
+    def test_failed_overwrite_restores_old_object(self, hdfs):
+        """Swap publish: if the final rename keeps failing, the OLD
+        published object is restored — no failure shape loses data."""
+        import json as _json
+        fake, gw = hdfs
+        gw.make_bucket("swap")
+        gw.put_object("swap", "obj", b"OLD")
+        uid = gw.new_multipart_upload("swap", "obj")
+        e = [(1, gw.put_object_part("swap", "obj", uid, 1,
+                                    b"NEW").etag)]
+        orig_op = gw.cli.op
+        calls = {"n": 0}
+
+        def flaky(method, path, op, body=b"", **p):
+            if op == "RENAME" and p.get("destination",
+                                        "").endswith("/obj"):
+                calls["n"] += 1
+                if calls["n"] <= 2:
+                    return 200, b'{"boolean": false}'
+            return orig_op(method, path, op, body=body, **p)
+        gw.cli.op = flaky
+        try:
+            with pytest.raises(Exception, match="rename"):
+                gw.complete_multipart_upload("swap", "obj", uid, e)
+            assert gw.get_object("swap", "obj")[1] == b"OLD"
+        finally:
+            gw.cli.op = orig_op
+        gw.complete_multipart_upload("swap", "obj", uid, e)
+        assert gw.get_object("swap", "obj")[1] == b"NEW"
