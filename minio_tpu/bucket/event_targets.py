@@ -826,3 +826,113 @@ class NSQTarget(_BrokerTargetBase):
         resp = self._read_frame()
         if resp != b"OK":
             raise BrokerError(f"nsq: PUB answered {resp[:40]!r}")
+
+
+# ---------------------------------------------------------------------------
+# config-driven construction (internal/config/notify role)
+# ---------------------------------------------------------------------------
+
+def _hostport(addr: str, default_port: int) -> tuple[str, int]:
+    """First address of a possibly comma-separated list, with scheme
+    prefixes (amqp://, nats://, tcp://...) stripped — the formats the
+    reference documents for brokers/url keys. Unix-socket paths pass
+    through (transport-orthogonal wire)."""
+    addr = addr.split(",")[0].strip()
+    if "://" in addr:
+        addr = addr.split("://", 1)[1]
+    addr = addr.rstrip("/")
+    if addr.startswith("/"):
+        return addr, 0
+    host, _, port = addr.rpartition(":")
+    if not host:
+        return addr, default_port
+    try:
+        return host, int(port)
+    except ValueError:
+        return addr, default_port
+
+
+def targets_from_config(config_sys, store_dir: str | None = None,
+                        target_id: str = "1") -> list:
+    """Build every ENABLED notify_* subsystem's target with the
+    reference's ARN convention (arn:minio:sqs::<id>:<kind>) — called at
+    server boot; `admin config set notify_kafka ...` + service restart
+    brings a target up, exactly the reference's flow
+    (cf. GetNotificationTargets, internal/config/notify/config.go)."""
+    from .notify import WebhookTarget
+
+    def on(subsys: str) -> bool:
+        return config_sys.get(subsys, "enable").lower() in ("on", "true",
+                                                            "1")
+
+    def arn(kind: str) -> str:
+        return f"arn:minio:sqs::{target_id}:{kind}"
+
+    out: list = []
+    if on("notify_webhook") and config_sys.get("notify_webhook",
+                                               "endpoint"):
+        out.append(WebhookTarget(
+            arn("webhook"), config_sys.get("notify_webhook", "endpoint"),
+            store_dir=store_dir))
+    if on("notify_kafka") and config_sys.get("notify_kafka", "brokers"):
+        h, p = _hostport(config_sys.get("notify_kafka", "brokers"), 9092)
+        out.append(KafkaTarget(arn("kafka"), h, p,
+                               config_sys.get("notify_kafka", "topic"),
+                               store_dir=store_dir))
+    if on("notify_amqp") and config_sys.get("notify_amqp", "url"):
+        h, p = _hostport(config_sys.get("notify_amqp", "url"), 5672)
+        out.append(AMQPTarget(arn("amqp"), h, p,
+                              config_sys.get("notify_amqp", "exchange"),
+                              config_sys.get("notify_amqp",
+                                             "routing_key"),
+                              store_dir=store_dir))
+    if on("notify_nats") and config_sys.get("notify_nats", "address"):
+        h, p = _hostport(config_sys.get("notify_nats", "address"), 4222)
+        out.append(NATSTarget(arn("nats"), h, p,
+                              config_sys.get("notify_nats", "subject"),
+                              store_dir=store_dir))
+    if on("notify_mqtt") and config_sys.get("notify_mqtt", "broker"):
+        h, p = _hostport(config_sys.get("notify_mqtt", "broker"), 1883)
+        out.append(MQTTTarget(arn("mqtt"), h, p,
+                              config_sys.get("notify_mqtt", "topic"),
+                              store_dir=store_dir))
+    if on("notify_redis") and config_sys.get("notify_redis", "address"):
+        h, p = _hostport(config_sys.get("notify_redis", "address"), 6379)
+        out.append(RedisTarget(arn("redis"), h, p,
+                               config_sys.get("notify_redis", "key"),
+                               fmt=config_sys.get("notify_redis",
+                                                  "format"),
+                               store_dir=store_dir))
+    if on("notify_postgres") and config_sys.get("notify_postgres", "address"):
+        h, p = _hostport(config_sys.get("notify_postgres", "address"),
+                         5432)
+        out.append(PostgresTarget(
+            arn("postgresql"), h, p,
+            config_sys.get("notify_postgres", "table"),
+            fmt=config_sys.get("notify_postgres", "format"),
+            user=config_sys.get("notify_postgres", "user"),
+            database=config_sys.get("notify_postgres", "database"),
+            store_dir=store_dir))
+    if on("notify_mysql") and config_sys.get("notify_mysql", "address"):
+        h, p = _hostport(config_sys.get("notify_mysql", "address"), 3306)
+        out.append(MySQLTarget(
+            arn("mysql"), h, p, config_sys.get("notify_mysql", "table"),
+            fmt=config_sys.get("notify_mysql", "format"),
+            user=config_sys.get("notify_mysql", "user"),
+            database=config_sys.get("notify_mysql", "database"),
+            store_dir=store_dir))
+    if on("notify_elasticsearch") and config_sys.get("notify_elasticsearch", "address"):
+        h, p = _hostport(config_sys.get("notify_elasticsearch",
+                                        "address"), 9200)
+        out.append(ElasticsearchTarget(
+            arn("elasticsearch"), h, p,
+            config_sys.get("notify_elasticsearch", "index"),
+            fmt=config_sys.get("notify_elasticsearch", "format"),
+            store_dir=store_dir))
+    if on("notify_nsq") and config_sys.get("notify_nsq", "nsqd_address"):
+        h, p = _hostport(config_sys.get("notify_nsq", "nsqd_address"),
+                         4150)
+        out.append(NSQTarget(arn("nsq"), h, p,
+                             config_sys.get("notify_nsq", "topic"),
+                             store_dir=store_dir))
+    return out

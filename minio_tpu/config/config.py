@@ -70,7 +70,36 @@ class ConfigSys:
             [HelpKV("bitrotscan", "deep bitrot verify during heal")])
         self.register("logger_webhook", {"enable": "off", "endpoint": ""})
         self.register("audit_webhook", {"enable": "off", "endpoint": ""})
+        # Event-target subsystems (cf. internal/config/notify): one per
+        # wire target; enable=on + connection keys -> a live target with
+        # ARN arn:minio:sqs::<id>:<kind> at server boot.
         self.register("notify_webhook", {"enable": "off", "endpoint": ""})
+        self.register("notify_kafka", {"enable": "off", "brokers": "",
+                                       "topic": ""})
+        self.register("notify_amqp", {"enable": "off", "url": "",
+                                      "exchange": "",
+                                      "routing_key": ""})
+        self.register("notify_nats", {"enable": "off", "address": "",
+                                      "subject": ""})
+        self.register("notify_mqtt", {"enable": "off", "broker": "",
+                                      "topic": ""})
+        self.register("notify_redis", {"enable": "off", "address": "",
+                                       "key": "", "format": "access"})
+        self.register("notify_postgres", {"enable": "off", "address": "",
+                                          "table": "",
+                                          "format": "access",
+                                          "user": "minio",
+                                          "database": "minio"})
+        self.register("notify_mysql", {"enable": "off", "address": "",
+                                       "table": "", "format": "access",
+                                       "user": "minio",
+                                       "database": "minio"})
+        self.register("notify_elasticsearch", {"enable": "off",
+                                               "address": "",
+                                               "index": "",
+                                               "format": "access"})
+        self.register("notify_nsq", {"enable": "off",
+                                     "nsqd_address": "", "topic": ""})
         self.register("identity_openid", {"enable": "off",
                                           "config_url": ""})
         self.register("kms", {"enable": "off", "key_id": ""})

@@ -70,6 +70,7 @@ class S3Server:
             # bucket-config store (free-version semantics included)
             scanner.attach_config(self.handlers.meta,
                                   self.handlers.tier_mgr)
+
         self.trace_sink = trace_sink
         from ..observe.logger import Logger, RingTarget
         from ..observe.metrics import MetricsRegistry
@@ -79,6 +80,10 @@ class S3Server:
         self.log = Logger()
         self.log_ring = RingTarget()
         self.log.add_target(self.log_ring)
+        if notify is not None and self.handlers is not None:
+            # after the logger exists: a bad notify config is logged,
+            # never boot-fatal
+            self._register_config_targets(notify)
         self.audit_targets: list = []
         self.scanner = scanner
         self.config = None                 # lazy ConfigSys (admin API)
@@ -675,6 +680,21 @@ class S3Server:
             base = "admin:SiteReplicationOperation"
         if not self.iam.is_allowed(ident, base, "*"):
             raise S3Error("AccessDenied", f"{base} denied")
+
+    def _register_config_targets(self, notify) -> None:
+        """Build + register every enabled notify_* config target
+        (internal/config/notify role); applied at boot — `admin config
+        set notify_<kind> ...` + service restart brings a target up."""
+        try:
+            from ..bucket.event_targets import targets_from_config
+            import os as _os
+            store = _os.environ.get("MTPU_NOTIFY_STORE_DIR") or None
+            for t in targets_from_config(self.handlers.config_sys,
+                                         store_dir=store):
+                notify.register_target(t)
+        except Exception as e:  # noqa: BLE001 — notification targets
+            self.log.error(f"notify config targets: {e}")   # are not
+                                                            # boot-fatal
 
     def _site_sys(self):
         """Lazy SiteReplicationSys bound to this server's stack."""

@@ -662,3 +662,87 @@ class TestRedisNamespace:
         finally:
             tgt.close()
             broker.stop()
+
+
+class TestConfigDrivenTargets:
+    """internal/config/notify role: enabled notify_* subsystems become
+    live targets at boot with reference ARNs, end to end through the
+    server's notification dispatch."""
+
+    def test_factory_builds_enabled_targets(self, tmp_path):
+        from minio_tpu.bucket.event_targets import targets_from_config
+        from minio_tpu.config.config import ConfigSys
+        cfg = ConfigSys(None, env={})
+        cfg.set("notify_mqtt", "enable", "on")
+        cfg.set("notify_mqtt", "broker", str(tmp_path / "m.sock"))
+        cfg.set("notify_mqtt", "topic", "minio/events")
+        cfg.set("notify_redis", "enable", "on")
+        cfg.set("notify_redis", "address", "10.0.0.5:6380")
+        cfg.set("notify_redis", "key", "evkey")
+        tgts = targets_from_config(cfg)
+        arns = {t.arn for t in tgts}
+        assert arns == {"arn:minio:sqs::1:mqtt",
+                        "arn:minio:sqs::1:redis"}, arns
+        redis = next(t for t in tgts if "redis" in t.arn)
+        assert (redis.host, redis.port) == ("10.0.0.5", 6380)
+
+    def test_config_target_fires_through_live_server(self, tmp_path):
+        from minio_tpu.bucket.notify import NotificationSystem
+        from minio_tpu.engine.pools import ServerPools
+        from minio_tpu.engine.sets import ErasureSets
+        from minio_tpu.server.client import S3Client
+        from minio_tpu.server.server import S3Server
+        from minio_tpu.server.sigv4 import Credentials
+        from minio_tpu.storage.drive import LocalDrive
+
+        path = str(tmp_path / "nsq.sock")
+        broker = FakeNSQ(path)
+        drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        # pre-store the notify config so boot picks it up
+        from minio_tpu.config.config import ConfigSys
+        seed = ConfigSys(pools)
+        seed.set("notify_nsq", "enable", "on")
+        seed.set("notify_nsq", "nsqd_address", path)
+        seed.set("notify_nsq", "topic", "bucket-events")
+        notify = NotificationSystem()
+        srv = S3Server(pools, Credentials("cfgadmin", "cfgadmin-sec1"),
+                       notify=notify).start()
+        try:
+            assert "arn:minio:sqs::1:nsq" in notify.targets
+            cli = S3Client(srv.endpoint, "cfgadmin", "cfgadmin-sec1")
+            cli.make_bucket("evb")
+            cfg = ("<NotificationConfiguration><QueueConfiguration>"
+                   "<Id>q</Id><Queue>arn:minio:sqs::1:nsq</Queue>"
+                   "<Event>s3:ObjectCreated:*</Event>"
+                   "</QueueConfiguration></NotificationConfiguration>")
+            st, _, _ = cli.request("PUT", "/evb",
+                                   query={"notification": ""},
+                                   body=cfg.encode())
+            assert st == 200
+            cli.put_object("evb", "hello", b"x")
+            deadline = time.monotonic() + 5
+            while not broker.received and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert broker.received, "config-driven NSQ target never fired"
+            rec = json.loads(broker.received[0])["Records"][0]
+            assert rec["s3"]["object"]["key"] == "hello"
+        finally:
+            srv.shutdown()
+            broker.stop()
+
+
+    def test_hostport_reference_formats(self):
+        from minio_tpu.bucket.event_targets import _hostport
+        assert _hostport("b1:9092,b2:9092", 9092) == ("b1", 9092)
+        assert _hostport("amqp://rabbit:5672", 5672) == ("rabbit", 5672)
+        assert _hostport("nats://n1", 4222) == ("n1", 4222)
+        assert _hostport("/tmp/x.sock", 0) == ("/tmp/x.sock", 0)
+        assert _hostport("plainhost", 6379) == ("plainhost", 6379)
+
+    def test_enabled_but_unconfigured_target_not_registered(self):
+        from minio_tpu.bucket.event_targets import targets_from_config
+        from minio_tpu.config.config import ConfigSys
+        cfg = ConfigSys(None, env={})
+        cfg.set("notify_kafka", "enable", "on")     # no brokers
+        assert targets_from_config(cfg) == []
