@@ -618,6 +618,13 @@ def main() -> None:
                         "_ms_tmpfs"))
                 or k.startswith("tunnel_") or k == "host_cores"):
             extras.setdefault(k, v)
+    if "put_stage_md5_ms_tmpfs" in extras:
+        extras["put_attribution_note"] = (
+            "1-core host: the serial S3 MD5 ETag "
+            f"({extras['put_stage_md5_ms_tmpfs']} ms/MiB) is the PUT "
+            "wall; put_e2e_2p2_noetag_tmpfs_gbps shows the framework "
+            "with a client-supplied ETag (multi-core hosts overlap the "
+            "digest in the etag thread)")
     print(json.dumps({
         "metric": "ec_8p4_encode_throughput",
         "value": round(gbps, 2),
