@@ -38,7 +38,7 @@ def _free_ports(n):
     return ports
 
 
-def _wait_ready(port, timeout=90.0):
+def _wait_ready(port, timeout=240.0):
     deadline = time.monotonic() + timeout
     url = f"http://127.0.0.1:{port}/minio/health/ready"
     while time.monotonic() < deadline:
@@ -60,7 +60,7 @@ def cluster(tmp_path):
             for i, p in enumerate(ports, 1)]
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    env["MTPU_BOOT_TIMEOUT"] = "90"
+    env["MTPU_BOOT_TIMEOUT"] = "240"
     procs = []
     logs = []
     try:

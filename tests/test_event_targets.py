@@ -738,6 +738,10 @@ class TestConfigDrivenTargets:
         assert _hostport("amqp://rabbit:5672", 5672) == ("rabbit", 5672)
         assert _hostport("nats://n1", 4222) == ("n1", 4222)
         assert _hostport("/tmp/x.sock", 0) == ("/tmp/x.sock", 0)
+        assert _hostport("/tmp/foo@bar.sock", 0) == \
+            ("/tmp/foo@bar.sock", 0)
+        assert _hostport("amqp://u:p@rabbit:5672/myvhost", 5672) == \
+            ("rabbit", 5672)
         assert _hostport("plainhost", 6379) == ("plainhost", 6379)
 
     def test_enabled_but_unconfigured_target_not_registered(self):
