@@ -86,9 +86,12 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
 
+        from ..bucket.notify import NotificationSystem
+
         def factory(node):
             srv = S3Server(None, creds, host=args.host, port=args.port,
-                           rpc_router=node.router, certs=certs).start()
+                           rpc_router=node.router, certs=certs,
+                           notify=NotificationSystem()).start()
             print(f"minio_tpu cluster node on {srv.endpoint} "
                   f"(first={node.is_first}, "
                   f"{len(node.local_drives)} local / "

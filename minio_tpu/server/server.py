@@ -323,6 +323,10 @@ class S3Server:
                 and hasattr(self.scanner, "attach_config"):
             self.scanner.attach_config(self.handlers.meta,
                                        self.handlers.tier_mgr)
+        if self._handler_opts.get("notify") is not None:
+            # cluster boot reaches here with the object layer freshly
+            # bound: config-driven notification targets come up now
+            self._register_config_targets(self._handler_opts["notify"])
 
     def start(self) -> "S3Server":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -682,9 +686,12 @@ class S3Server:
             raise S3Error("AccessDenied", f"{base} denied")
 
     def _register_config_targets(self, notify) -> None:
-        """Build + register every enabled notify_* config target
-        (internal/config/notify role); applied at boot — `admin config
-        set notify_<kind> ...` + service restart brings a target up."""
+        """Boot-time notification wiring: (1) build + register every
+        enabled notify_* config target (internal/config/notify role);
+        (2) RELOAD persisted bucket notification rules — they live in
+        each bucket's metadata, and a fresh NotificationSystem that
+        never loads them would silently drop events after every
+        restart until each bucket's config is re-PUT."""
         try:
             from ..bucket.event_targets import targets_from_config
             import os as _os
@@ -695,6 +702,17 @@ class S3Server:
         except Exception as e:  # noqa: BLE001 — notification targets
             self.log.error(f"notify config targets: {e}")   # are not
                                                             # boot-fatal
+        try:
+            from ..bucket.notify import parse_notification_config
+            for bucket in self.pools.list_buckets():
+                if bucket.startswith(".mtpu"):
+                    continue
+                raw = self.handlers.meta.get(bucket, "notification")
+                if raw:
+                    notify.set_bucket_rules(
+                        bucket, parse_notification_config(raw))
+        except Exception as e:  # noqa: BLE001
+            self.log.error(f"notify rule reload: {e}")
 
     def _site_sys(self):
         """Lazy SiteReplicationSys bound to this server's stack."""

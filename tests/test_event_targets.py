@@ -740,6 +740,8 @@ class TestConfigDrivenTargets:
         assert _hostport("/tmp/x.sock", 0) == ("/tmp/x.sock", 0)
         assert _hostport("/tmp/foo@bar.sock", 0) == \
             ("/tmp/foo@bar.sock", 0)
+        assert _hostport("unix:///tmp/x.sock", 6379) == \
+            ("/tmp/x.sock", 0)
         assert _hostport("amqp://u:p@rabbit:5672/myvhost", 5672) == \
             ("rabbit", 5672)
         assert _hostport("plainhost", 6379) == ("plainhost", 6379)
@@ -772,3 +774,53 @@ class TestConfigDrivenTargets:
         tgts = targets_from_config(cfg, store_dir=str(tmp_path / "q"))
         dirs = {t.backlog.store_dir for t in tgts}
         assert len(dirs) == 2, dirs      # one subdir per target kind
+
+
+    def test_bucket_rules_survive_server_restart(self, tmp_path):
+        """Persisted notification.xml reloads at boot: a restart must
+        not silently drop bucket event routing."""
+        import numpy as _np  # noqa: F401 - parity with module imports
+        from minio_tpu.bucket.notify import NotificationSystem
+        from minio_tpu.config.config import ConfigSys
+        from minio_tpu.engine.pools import ServerPools
+        from minio_tpu.engine.sets import ErasureSets
+        from minio_tpu.server.client import S3Client
+        from minio_tpu.server.server import S3Server
+        from minio_tpu.server.sigv4 import Credentials
+        from minio_tpu.storage.drive import LocalDrive
+
+        path = str(tmp_path / "r.sock")
+        broker = FakeRedis(path)
+        drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        seed = ConfigSys(pools)
+        seed.set("notify_redis", "enable", "on")
+        seed.set("notify_redis", "address", path)
+        seed.set("notify_redis", "key", "k")
+        srv = S3Server(pools, Credentials("rsadmin", "rsadmin-sec1"),
+                       notify=NotificationSystem()).start()
+        cli = S3Client(srv.endpoint, "rsadmin", "rsadmin-sec1")
+        cli.make_bucket("rrbkt")
+        cfg = ("<NotificationConfiguration><QueueConfiguration>"
+               "<Id>q</Id><Queue>arn:minio:sqs::1:redis</Queue>"
+               "<Event>s3:ObjectCreated:*</Event>"
+               "</QueueConfiguration></NotificationConfiguration>")
+        st, _, _ = cli.request("PUT", "/rrbkt", query={"notification": ""},
+                               body=cfg.encode())
+        assert st == 200
+        srv.shutdown()
+        # RESTART: fresh server + fresh NotificationSystem
+        srv2 = S3Server(pools, Credentials("rsadmin", "rsadmin-sec1"),
+                        notify=NotificationSystem()).start()
+        try:
+            cli2 = S3Client(srv2.endpoint, "rsadmin", "rsadmin-sec1")
+            cli2.put_object("rrbkt", "after-restart", b"x")
+            deadline = time.monotonic() + 5
+            while not broker.received and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert broker.received, "rules lost across restart"
+            rec = json.loads(broker.received[0])["Records"][0]
+            assert rec["s3"]["object"]["key"] == "after-restart"
+        finally:
+            srv2.shutdown()
+            broker.stop()
