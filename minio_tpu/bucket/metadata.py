@@ -22,6 +22,8 @@ CONFIG_FILES = {
     "object_lock": "object-lock.xml",
     "tagging": "tagging.xml",
     "encryption": "encryption.xml",
+    # remote replication targets (cmd/bucket-targets.go role)
+    "replication_targets": "bucket-targets.json",
 }
 
 

@@ -83,7 +83,23 @@ class ReplicationPool:
                   target) -> None:
         self._rules[bucket] = rules
         for r in rules:
-            self._targets[r.target_bucket] = target
+            # keyed by (SOURCE, target) — two source buckets pointing
+            # at same-named target buckets on different endpoints must
+            # not clobber each other's clients/credentials
+            self._targets[(bucket, r.target_bucket)] = target
+
+    def configure_rules(self, bucket: str, pairs) -> None:
+        """Multi-target form: pairs of (rule, target-client)."""
+        self._rules[bucket] = [r for r, _ in pairs]
+        for r, t in pairs:
+            self._targets[(bucket, r.target_bucket)] = t
+
+    def unconfigure(self, bucket: str) -> None:
+        """Drop a bucket's live wiring (target deregistered / config
+        removed) — replication must stop NOW, not at next restart."""
+        rules = self._rules.pop(bucket, [])
+        for r in rules:
+            self._targets.pop((bucket, r.target_bucket), None)
 
     # -- enqueue hooks (called after successful PUT/DELETE) ------------------
 
@@ -112,7 +128,7 @@ class ReplicationPool:
         for r in self._rules.get(bucket, []):
             if not key.startswith(r.prefix):
                 continue
-            target = self._targets.get(r.target_bucket)
+            target = self._targets.get((bucket, r.target_bucket))
             if target is None:
                 continue
             try:
@@ -242,7 +258,7 @@ class ReplicationPool:
                        rule: ReplicationRule) -> None:
         self._set_source_status(bucket, key, "PENDING")
         fi, data = self.source.get_object(bucket, key)
-        target = self._targets[rule.target_bucket]
+        target = self._targets[(bucket, rule.target_bucket)]
         meta = {k: v for k, v in fi.metadata.items() if k != STATUS_KEY}
         meta[STATUS_KEY] = "REPLICA"
         target.put_object(rule.target_bucket, key, data, metadata=meta)
@@ -252,7 +268,7 @@ class ReplicationPool:
 
     def _replicate_delete(self, bucket: str, key: str,
                           rule: ReplicationRule) -> None:
-        target = self._targets[rule.target_bucket]
+        target = self._targets[(bucket, rule.target_bucket)]
         try:
             target.delete_object(rule.target_bucket, key)
         except StorageError:
@@ -297,3 +313,101 @@ class ReplicationPool:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# remote-target registry + production wiring (cmd/bucket-targets.go role)
+# ---------------------------------------------------------------------------
+
+def parse_targets(raw: bytes | None) -> list[dict]:
+    """bucket-targets.json -> [{arn, endpoint, accessKey, secretKey,
+    targetBucket}]."""
+    import json as _json
+    if not raw:
+        return []
+    try:
+        out = _json.loads(raw)
+        return out if isinstance(out, list) else []
+    except ValueError:
+        return []
+
+
+def target_client(entry: dict):
+    """S3 client for one registered remote target (the TargetClient of
+    cmd/bucket-targets.go:388) — replication rides the same signed S3
+    wire the reference uses."""
+    from ..server.client import S3Client
+
+    class _RemoteTarget:
+        """Adapter: ReplicationPool calls pools-style methods."""
+
+        def __init__(self, cli, bucket):
+            self.cli = cli
+            self.bucket = bucket
+
+        def put_object(self, bucket, key, data, *, metadata=None, **kw):
+            headers = {}
+            for k, v in (metadata or {}).items():
+                if (k.startswith("x-amz-meta-") or k == "content-type"
+                        or k == "x-amz-replication-status"):
+                    # the status header marks the replica as REPLICA on
+                    # the remote: GET/HEAD report it, and the remote's
+                    # own replication hooks suppress on it (loop guard)
+                    headers[k] = v
+            self.cli.put_object(bucket, key, bytes(data),
+                                headers=headers or None)
+
+        def get_object(self, bucket, key, *a, **kw):
+            return self.cli.get_object(bucket, key)
+
+        def delete_object(self, bucket, key, *a, **kw):
+            self.cli.delete_object(bucket, key)
+
+        def head_object(self, bucket, key, *a, **kw):
+            return self.cli.head_object(bucket, key)
+
+        def list_object_names(self, bucket, prefix=""):
+            try:
+                _, _, body = self.cli.request(
+                    "GET", f"/{bucket}", query={"list-type": "2",
+                                                "prefix": prefix})
+                import re as _re
+                return _re.findall(r"<Key>([^<]+)</Key>",
+                                   body.decode("utf-8", "replace"))
+            except Exception:  # noqa: BLE001
+                return []
+
+    cli = S3Client(entry["endpoint"], entry["accessKey"],
+                   entry["secretKey"])
+    return _RemoteTarget(cli, entry.get("targetBucket", ""))
+
+
+def wire_bucket(pool: "ReplicationPool", meta, bucket: str) -> bool:
+    """(Re)wire one bucket's replication from its PERSISTED config +
+    registered remote targets — called when the config lands and at
+    every boot, so rules survive restarts (unlike a fresh pool that
+    would silently drop them)."""
+    raw_cfg = meta.get(bucket, "replication")
+    if not raw_cfg:
+        return False
+    targets = parse_targets(meta.get(bucket, "replication_targets"))
+    if not targets:
+        return False
+    rules = parse_replication_config(raw_cfg)
+    # the reference matches rule ARNs to registered targets; with one
+    # registered target per bucket (the common shape) it serves all
+    # rules, else match by target bucket name
+    by_bucket = {t.get("targetBucket", ""): t for t in targets}
+    default = targets[0]
+    clients = {}
+
+    def client_for(entry: dict):
+        key = entry.get("arn") or entry.get("targetBucket", "")
+        if key not in clients:
+            clients[key] = target_client(entry)
+        return clients[key]
+
+    pairs = [(r, client_for(by_bucket.get(r.target_bucket, default)))
+             for r in rules]
+    pool.configure_rules(bucket, pairs)
+    return True

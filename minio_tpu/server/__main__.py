@@ -184,8 +184,10 @@ def main(argv: list[str] | None = None) -> int:
     # (cmd/server-main.go:441): IAM, scanner, notifications.
     from ..background.scanner import DataScanner
     from ..bucket.notify import NotificationSystem
+    from ..bucket.replication import ReplicationPool
     from ..iam.iam import IAMSys
     iam = IAMSys(pools)
+    replication = ReplicationPool(pools)
     # Perpetual scanner lifecycle: an idle server crawls, accounts
     # usage, heals missing metadata, and bitrot-verifies every
     # deep_every-th cycle (cf. initDataScanner, cmd/server-main.go:441).
@@ -199,7 +201,7 @@ def main(argv: list[str] | None = None) -> int:
     while True:
         srv = S3Server(pools, creds, host=args.host, port=port,
                        iam=iam, scanner=scanner, notify=notify,
-                       certs=certs).start()
+                       replication=replication, certs=certs).start()
         port = srv.port                  # keep the port across restarts
         n_drives = sum(len(p) for p in pool_paths)
         desc = ", ".join(f"pool{i}: {len(p)} drives "

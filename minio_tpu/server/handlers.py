@@ -365,6 +365,7 @@ class S3Handlers:
                           body: bytes) -> Response:
         self.head_bucket(bucket)
         kind, _ = self._CONFIG_KINDS[sub]
+        wire_replication_after = False
         # Validate before storing (cf. per-config parse in
         # cmd/bucket-handlers.go).
         try:
@@ -379,6 +380,8 @@ class S3Handlers:
             elif kind == "replication":
                 from ..bucket.replication import parse_replication_config
                 parse_replication_config(body)
+                # live wiring happens below once the config persists
+                wire_replication_after = True
             elif kind == "object_lock":
                 from ..bucket.object_lock import parse_lock_config
                 parse_lock_config(body)
@@ -393,6 +396,16 @@ class S3Handlers:
         except Exception:  # noqa: BLE001 — any parse failure
             raise S3Error("MalformedXML") from None
         self.meta.put(bucket, kind, body)
+        if wire_replication_after and self.replication is not None:
+            from ..bucket.replication import wire_bucket
+            try:
+                wire_bucket(self.replication, self.meta, bucket)
+            except Exception as e:  # noqa: BLE001 — wire_bucket returns
+                # False when targets are simply absent; an EXCEPTION
+                # means corrupt registration data — a 200 with silently
+                # dead replication would hide it from the operator
+                raise S3Error("InvalidArgument",
+                              f"replication wiring: {e}") from None
         return Response(200)
 
     def get_bucket_config(self, bucket: str, sub: str) -> Response:
@@ -582,6 +595,9 @@ class S3Handlers:
             h["x-amz-version-id"] = fi.version_id
         if S3Handlers.SC_HEADER in fi.metadata:
             h[S3Handlers.SC_HEADER] = fi.metadata[S3Handlers.SC_HEADER]
+        if "x-amz-replication-status" in fi.metadata:
+            h["x-amz-replication-status"] = \
+                fi.metadata["x-amz-replication-status"]
         for k, v in fi.metadata.items():
             if k.startswith(AMZ_META_PREFIX):
                 h[k] = v
@@ -830,6 +846,12 @@ class S3Handlers:
                     if k.startswith(AMZ_META_PREFIX)}
         if "content-type" in h:
             metadata["content-type"] = h["content-type"]
+        # incoming replica writes carry the replication status; storing
+        # it makes GET/HEAD report REPLICA and suppresses re-replication
+        # (active-active loop guard, cf. ReplicateObjectAction)
+        is_replica = h.get("x-amz-replication-status") == "REPLICA"
+        if is_replica:
+            metadata["x-amz-replication-status"] = "REPLICA"
         parity = self._parity_for_request(h, metadata)
 
         # Quota enforcement (cf. enforceBucketQuotaHard,
@@ -917,7 +939,7 @@ class S3Handlers:
         self._publish_event("s3:ObjectCreated:Put", bucket, key,
                             size=self._logical_size(fi), etag=etag,
                             version_id=fi.version_id)
-        if self.replication is not None:
+        if self.replication is not None and not is_replica:
             self.replication.on_put(bucket, key)
         resp_headers = {"ETag": f'"{etag}"'}
         if fi.version_id:

@@ -84,6 +84,7 @@ class S3Server:
             # after the logger exists: a bad notify config is logged,
             # never boot-fatal
             self._register_config_targets(notify)
+        self._reload_replication()
         self.audit_targets: list = []
         self.scanner = scanner
         self.config = None                 # lazy ConfigSys (admin API)
@@ -327,6 +328,7 @@ class S3Server:
             # cluster boot reaches here with the object layer freshly
             # bound: config-driven notification targets come up now
             self._register_config_targets(self._handler_opts["notify"])
+        self._reload_replication()
 
     def start(self) -> "S3Server":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -628,6 +630,7 @@ class S3Server:
         "trace": "admin:ServerTrace",
         "console": "admin:ConsoleLog",
         "users": "admin:*User",          # method-refined below
+        "bucket-remote": "admin:SetBucketTarget",
         "service-accounts": "admin:*ServiceAccount",
         "groups": "admin:*Group",
         "policies": "admin:*Policy",
@@ -713,6 +716,32 @@ class S3Server:
                         bucket, parse_notification_config(raw))
         except Exception as e:  # noqa: BLE001
             self.log.error(f"notify rule reload: {e}")
+
+    def _wire_replication(self, bucket: str) -> None:
+        """(Re)wire one bucket's replication rules + remote targets
+        into the worker pool (no-op until both halves exist)."""
+        pool = self.handlers.replication if self.handlers else None
+        if pool is None:
+            return
+        try:
+            from ..bucket.replication import wire_bucket
+            wire_bucket(pool, self.handlers.meta, bucket)
+        except Exception as e:  # noqa: BLE001 — replication wiring is
+            self.log.error(f"replication wiring {bucket}: {e}")  # async
+
+    def _reload_replication(self) -> None:
+        """Boot: every bucket with a persisted replication config +
+        registered targets starts replicating again (restart must not
+        silently stop replication, same rule as notification rules)."""
+        if self.handlers is None or self.handlers.replication is None \
+                or self.pools is None:
+            return
+        try:
+            for bucket in self.pools.list_buckets():
+                if not bucket.startswith(".mtpu"):
+                    self._wire_replication(bucket)
+        except Exception as e:  # noqa: BLE001
+            self.log.error(f"replication reload: {e}")
 
     def _site_sys(self):
         """Lazy SiteReplicationSys bound to this server's stack."""
@@ -1181,6 +1210,64 @@ class S3Server:
                             "drivesOnline": online,
                             "decommissioning": False})
             return j({"pools": out})
+        if sub == "bucket-remote":
+            # cmd/admin-bucket-targets handlers (SetRemoteTargetHandler
+            # etc.): register the remote cluster/bucket a replication
+            # config's rules flow to; persisted per bucket, reloaded at
+            # boot with the rules.
+            from ..bucket import replication as repl
+            bucket = query.get("bucket", [""])[0]
+            if not bucket:
+                raise S3Error("InvalidArgument", "bucket required")
+            raw = self.handlers.meta.get(bucket, "replication_targets")
+            targets = repl.parse_targets(raw)
+            if method == "GET":
+                return j({"targets": [
+                    {k: v for k, v in t.items() if k != "secretKey"}
+                    for t in targets]})
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                try:
+                    tb = req_obj["targetBucket"]
+                    prev = next((t for t in targets
+                                 if t.get("targetBucket") == tb), None)
+                    kept = [t for t in targets
+                            if t.get("targetBucket") != tb]
+                    entry = {
+                        # re-registering (credential rotation) KEEPS
+                        # the ARN — a stale handle must stay valid
+                        "arn": (prev["arn"] if prev else
+                                f"arn:minio:replication::"
+                                f"{len(kept) + 1}:{tb}"),
+                        "endpoint": req_obj["endpoint"],
+                        "accessKey": req_obj["accessKey"],
+                        "secretKey": req_obj["secretKey"],
+                        "targetBucket": tb,
+                    }
+                except KeyError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                targets = kept + [entry]
+                self.handlers.meta.put(bucket, "replication_targets",
+                                       _json.dumps(targets).encode())
+                self._wire_replication(bucket)
+                return j({"arn": entry["arn"]})
+            if method == "DELETE":
+                arn = query.get("arn", [""])[0]
+                remaining = [t for t in targets if t.get("arn") != arn]
+                if len(remaining) == len(targets):
+                    return j({"error": f"no target with arn {arn!r}"},
+                             404)
+                self.handlers.meta.put(bucket, "replication_targets",
+                                       _json.dumps(remaining).encode())
+                # unwire NOW: replication to a deregistered target must
+                # stop immediately, not at the next restart
+                pool = (self.handlers.replication
+                        if self.handlers else None)
+                if pool is not None:
+                    pool.unconfigure(bucket)
+                    if remaining:
+                        self._wire_replication(bucket)
+                return j({"ok": True})
         if sub == "site-replication":
             sys_ = self._site_sys()
             if method == "GET":

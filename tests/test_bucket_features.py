@@ -370,7 +370,7 @@ class TestReplicationDepth:
         class DeadTarget:
             def put_object(self, *a, **k):
                 raise OSError("target down")
-        pool._targets["dst-bucket"] = DeadTarget()
+        pool._targets[("srcb", "dst-bucket")] = DeadTarget()
         src.put_object("srcb", "rep/y", b"doomed")
         pool.on_put("srcb", "rep/y")
         assert pool.wait_idle()
