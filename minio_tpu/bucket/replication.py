@@ -89,7 +89,10 @@ class ReplicationPool:
             self._targets[(bucket, r.target_bucket)] = target
 
     def configure_rules(self, bucket: str, pairs) -> None:
-        """Multi-target form: pairs of (rule, target-client)."""
+        """Multi-target form: pairs of (rule, target-client).
+        Replaces the bucket's ENTIRE previous wiring — stale clients
+        built from rotated-out credentials must not linger."""
+        self.unconfigure(bucket)
         self._rules[bucket] = [r for r, _ in pairs]
         for r, t in pairs:
             self._targets[(bucket, r.target_bucket)] = t
@@ -398,7 +401,6 @@ def wire_bucket(pool: "ReplicationPool", meta, bucket: str) -> bool:
     # registered target per bucket (the common shape) it serves all
     # rules, else match by target bucket name
     by_bucket = {t.get("targetBucket", ""): t for t in targets}
-    default = targets[0]
     clients = {}
 
     def client_for(entry: dict):
@@ -407,7 +409,17 @@ def wire_bucket(pool: "ReplicationPool", meta, bucket: str) -> bool:
             clients[key] = target_client(entry)
         return clients[key]
 
-    pairs = [(r, client_for(by_bucket.get(r.target_bucket, default)))
+    unmatched = [r.target_bucket for r in rules
+                 if r.target_bucket not in by_bucket]
+    if unmatched:
+        # silently replicating into an UNREGISTERED destination (or
+        # onto the wrong endpoint via a fallback) is data misdirection
+        # — surface it at config time instead
+        raise ValueError(
+            f"replication rules reference unregistered target "
+            f"bucket(s) {unmatched}; register them with "
+            f"admin bucket-remote first")
+    pairs = [(r, client_for(by_bucket[r.target_bucket]))
              for r in rules]
     pool.configure_rules(bucket, pairs)
     return True

@@ -24,6 +24,27 @@ def expand_ellipses(pattern: str) -> list[str]:
     return pattern.split()
 
 
+def bucket_dns_from_env(host: str, port: int):
+    """Federation wiring (the reference's MINIO_ETCD_ENDPOINTS +
+    MINIO_DOMAIN convention): MTPU_ETCD_ENDPOINTS=host:port and
+    MTPU_DOMAIN=cluster.domain enable bucket-DNS federation; absent ->
+    standalone namespace (cf. cmd/etcd.go + internal/config/dns)."""
+    ep = os.environ.get("MTPU_ETCD_ENDPOINTS", "")
+    domain = os.environ.get("MTPU_DOMAIN", "")
+    if not ep or not domain:
+        return None
+    from ..bucket.event_targets import _hostport
+    from ..cluster.federation import BucketDNS, EtcdClient
+    ehost, eport = _hostport(ep, 2379)   # handles http://, bare hosts
+    try:
+        return BucketDNS(EtcdClient(ehost, eport or 2379),
+                         domain, host, port)
+    except Exception as e:  # noqa: BLE001 — misconfig must be loud
+        print(f"minio_tpu: federation config invalid: {e}",
+              file=sys.stderr)
+        raise SystemExit(2) from None
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="minio_tpu.server")
     ap.add_argument("--drives", required=True, action="append",
@@ -91,7 +112,9 @@ def main(argv: list[str] | None = None) -> int:
         def factory(node):
             srv = S3Server(None, creds, host=args.host, port=args.port,
                            rpc_router=node.router, certs=certs,
-                           notify=NotificationSystem()).start()
+                           notify=NotificationSystem(),
+                           bucket_dns=bucket_dns_from_env(
+                               args.host, args.port)).start()
             print(f"minio_tpu cluster node on {srv.endpoint} "
                   f"(first={node.is_first}, "
                   f"{len(node.local_drives)} local / "
@@ -201,8 +224,14 @@ def main(argv: list[str] | None = None) -> int:
     while True:
         srv = S3Server(pools, creds, host=args.host, port=port,
                        iam=iam, scanner=scanner, notify=notify,
-                       replication=replication, certs=certs).start()
+                       replication=replication, certs=certs,
+                       bucket_dns=bucket_dns_from_env(args.host,
+                                                      port)).start()
         port = srv.port                  # keep the port across restarts
+        if srv.bucket_dns is not None:
+            # SRV records must advertise the BOUND port (--port 0
+            # binds an ephemeral one)
+            srv.bucket_dns.my_port = srv.port
         n_drives = sum(len(p) for p in pool_paths)
         desc = ", ".join(f"pool{i}: {len(p)} drives "
                          f"set={pool_sets[i].set_drive_count}"

@@ -424,6 +424,9 @@ class S3Handlers:
         self.meta.delete(bucket, kind)
         if kind == "notification" and self.notify is not None:
             self.notify.set_bucket_rules(bucket, [])
+        if kind == "replication" and self.replication is not None:
+            # replication must stop NOW, not at next restart
+            self.replication.unconfigure(bucket)
         return Response(204)
 
     # ---- listing ----------------------------------------------------------

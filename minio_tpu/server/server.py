@@ -717,6 +717,16 @@ class S3Server:
         except Exception as e:  # noqa: BLE001
             self.log.error(f"notify rule reload: {e}")
 
+    def _may_replicate(self, access_key: str) -> bool:
+        """s3:ReplicateObject gate for the incoming REPLICA marker."""
+        if access_key == self.creds.access_key:
+            return True                      # root (registered targets
+        if self.iam is None or not access_key:   # usually use root)
+            return False
+        ident = self.iam.lookup(access_key)
+        return ident is not None and self.iam.is_allowed(
+            ident, "s3:ReplicateObject", "*")
+
     def _wire_replication(self, bucket: str) -> None:
         """(Re)wire one bucket's replication rules + remote targets
         into the worker pool (no-op until both halves exist)."""
@@ -1375,6 +1385,15 @@ class S3Server:
             body, access_key = self._authenticate(req, path, query)
         h = self.handlers
         method = req.command
+        # Internal replication marker: only principals allowed to
+        # replicate may present it — any other writer could mark its
+        # objects REPLICA and silently exempt them from replication
+        # (the reference strips this internal header the same way,
+        # gated on ReplicateObjectAction). Must happen BEFORE the
+        # header dict below is captured for the handlers.
+        if (req.headers.get("x-amz-replication-status")
+                and not self._may_replicate(access_key)):
+            del req.headers["x-amz-replication-status"]
         headers = {k: v for k, v in req.headers.items()}
 
         if path.startswith("/minio/admin/"):

@@ -191,3 +191,92 @@ class TestFederation:
         finally:
             srv.shutdown()
             fake.stop()
+
+
+class TestProductionWiring:
+    """Federation reaches production boots via the env convention
+    (MTPU_ETCD_ENDPOINTS + MTPU_DOMAIN, the reference's
+    MINIO_ETCD_ENDPOINTS/MINIO_DOMAIN)."""
+
+    def test_env_builds_bucket_dns(self, monkeypatch):
+        from minio_tpu.server.__main__ import bucket_dns_from_env
+        monkeypatch.delenv("MTPU_ETCD_ENDPOINTS", raising=False)
+        monkeypatch.delenv("MTPU_DOMAIN", raising=False)
+        assert bucket_dns_from_env("127.0.0.1", 9000) is None
+        monkeypatch.setenv("MTPU_ETCD_ENDPOINTS", "10.0.0.9:2379")
+        monkeypatch.setenv("MTPU_DOMAIN", "minio.example.com")
+        dns = bucket_dns_from_env("127.0.0.1", 9000)
+        assert dns is not None
+        assert dns.etcd.host == "10.0.0.9" and dns.etcd.port == 2379
+        assert dns.domain == "minio.example.com"
+
+    def test_cli_server_federates_end_to_end(self, tmp_path):
+        """Two CLI-booted servers sharing one (fake) etcd: a bucket
+        created on A redirects from B (307 to the owner)."""
+        import json as _json
+        import os
+        import subprocess
+        import sys
+        import time
+        import urllib.request
+
+        import socket
+        etcd = FakeEtcd()
+        ports = []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        procs = []
+        try:
+            for i, p in enumerate(ports):
+                env = dict(os.environ)
+                env["JAX_PLATFORMS"] = "cpu"
+                env["MTPU_ETCD_ENDPOINTS"] = \
+                    f"127.0.0.1:{etcd.port}"
+                env["MTPU_DOMAIN"] = "fed.example.com"
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "minio_tpu.server",
+                     "--drives", f"{tmp_path}/n{i}-d{{1...4}}",
+                     "--port", str(p)],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.STDOUT, env=env))
+            for p in ports:
+                deadline = time.monotonic() + 240
+                url = f"http://127.0.0.1:{p}/minio/health/ready"
+                while True:
+                    try:
+                        with urllib.request.urlopen(url, timeout=2) as r:
+                            if r.status == 200:
+                                break
+                    except Exception:  # noqa: BLE001
+                        pass
+                    assert time.monotonic() < deadline
+                    time.sleep(0.3)
+            from minio_tpu.server.client import S3Client
+            ca = S3Client(f"http://127.0.0.1:{ports[0]}",
+                          "minioadmin", "minioadmin")
+            cb = S3Client(f"http://127.0.0.1:{ports[1]}",
+                          "minioadmin", "minioadmin")
+            ca.make_bucket("fedbkt")
+            ca.put_object("fedbkt", "obj", b"federated")
+            # B does not own fedbkt: request redirects to A (307)
+            st, h, _ = cb.request("GET", "/fedbkt/obj")
+            assert st in (200, 307), st
+            if st == 307:
+                assert str(ports[0]) in h.get("Location", ""), h
+            # duplicate creation on B is refused (global namespace)
+            from minio_tpu.server.client import S3ClientError
+            import pytest as _p
+            with _p.raises(S3ClientError):
+                cb.make_bucket("fedbkt")
+        finally:
+            for pr in procs:
+                pr.terminate()
+            for pr in procs:
+                try:
+                    pr.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+            etcd.stop()

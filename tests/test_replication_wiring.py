@@ -113,22 +113,52 @@ class TestReplicationWiring:
         finally:
             srv2.shutdown()
 
-    def test_replica_marked_and_no_ping_pong(self, pair):
-        """Active-active: both servers replicate to each other; the
-        REPLICA status must flow on the wire and suppress re-replication
-        (no infinite ping-pong)."""
-        (src_srv, src_cli, _), (dst_srv, dst_cli, dst_pools) = pair
-        self._setup(src_cli, dst_srv)
-        # make dst replicate BACK to src (active-active)
-        from minio_tpu.bucket.replication import ReplicationPool
-        # rebuild dst with a replication pool (fixture booted it bare)
-        data = np.random.default_rng(2).integers(
-            0, 256, 80_000, dtype=np.uint8).tobytes()
-        src_cli.put_object("srcb", "aa-obj", data)
-        assert wait_for(dst_cli, "dstbkt", "aa-obj", data)
-        # the replica carries REPLICA status on the remote
-        h = dst_cli.head_object("dstbkt", "aa-obj")
-        assert h.get("x-amz-replication-status") == "REPLICA", h
+    def test_replica_marked_and_no_ping_pong(self, tmp_path):
+        """TRUE active-active: two servers each registered as the
+        other's remote; one write per side converges with exactly one
+        replication each way — the REPLICA marker rides the wire,
+        is served on HEAD, and suppresses re-replication."""
+        a_srv, a_cli, a_pools = boot(str(tmp_path), "aa", with_repl=True)
+        b_srv, b_cli, b_pools = boot(str(tmp_path), "bb", with_repl=True)
+        try:
+            mirror_xml = REPL_XML.replace("dstbkt", "mirror")
+            for cli, other in ((a_cli, b_srv), (b_cli, a_srv)):
+                cli.make_bucket("mirror")
+            for cli, other in ((a_cli, b_srv), (b_cli, a_srv)):
+                st, _, _ = cli.request(
+                    "POST", "/minio/admin/v1/bucket-remote",
+                    query={"bucket": "mirror"},
+                    body=json.dumps({"endpoint": other.endpoint,
+                                     "accessKey": ROOT,
+                                     "secretKey": SECRET,
+                                     "targetBucket": "mirror"}).encode())
+                assert st == 200
+                st, _, _ = cli.request("PUT", "/mirror",
+                                       query={"replication": ""},
+                                       body=mirror_xml.encode())
+                assert st == 200
+            da = np.random.default_rng(2).integers(
+                0, 256, 60_000, dtype=np.uint8).tobytes()
+            db = np.random.default_rng(3).integers(
+                0, 256, 60_000, dtype=np.uint8).tobytes()
+            a_cli.put_object("mirror", "from-a", da)
+            b_cli.put_object("mirror", "from-b", db)
+            assert wait_for(b_cli, "mirror", "from-a", da)
+            assert wait_for(a_cli, "mirror", "from-b", db)
+            h = b_cli.head_object("mirror", "from-a")
+            assert h.get("x-amz-replication-status") == "REPLICA", h
+            # queues drain and STAY drained: one replication per object
+            time.sleep(1.0)
+            ra = a_srv.handlers.replication
+            rb = b_srv.handlers.replication
+            total = ra.completed + rb.completed
+            time.sleep(1.5)
+            assert ra.completed + rb.completed == total, \
+                "replication still churning (ping-pong)"
+            assert total == 2, total
+        finally:
+            a_srv.shutdown()
+            b_srv.shutdown()
 
     def test_deregister_stops_replication_immediately(self, pair):
         (src_srv, src_cli, _), (dst_srv, dst_cli, _) = pair
@@ -166,3 +196,27 @@ class TestReplicationWiring:
                              "accessKey": ROOT, "secretKey": SECRET,
                              "targetBucket": "dstbkt"}).encode())
         assert json.loads(body)["arn"] == arn1
+
+    def test_forged_replica_marker_stripped(self, pair):
+        """A principal without s3:ReplicateObject cannot mark its own
+        objects REPLICA (which would exempt them from replication)."""
+        (src_srv, src_cli, src_pools), (dst_srv, dst_cli, _) = pair
+        self._setup(src_cli, dst_srv)
+        from minio_tpu.iam.iam import IAMSys
+        iam = IAMSys(src_pools)
+        src_srv.iam = iam
+        iam.set_policy("put-only", {"Version": "2012-10-17",
+                                    "Statement": [{
+                                        "Effect": "Allow",
+                                        "Action": ["s3:PutObject",
+                                                   "s3:GetObject"],
+                                        "Resource":
+                                            ["arn:aws:s3:::*"]}]})
+        iam.add_user("low", "low-secret-123", ["put-only"])
+        low = S3Client(src_srv.endpoint, "low", "low-secret-123")
+        low.put_object("srcb", "forged", b"forged-data",
+                       headers={"x-amz-replication-status": "REPLICA"})
+        # marker stripped -> the object still replicates
+        assert wait_for(dst_cli, "dstbkt", "forged", b"forged-data")
+        h = src_cli.head_object("srcb", "forged")
+        assert h.get("x-amz-replication-status") != "REPLICA"
