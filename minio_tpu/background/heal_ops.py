@@ -36,16 +36,17 @@ class HealSequence:
 
     # -- execution -----------------------------------------------------------
 
-    def _heal_one(self, es, bucket: str, name: str) -> None:
-        from ..engine import heal as H
-        self.items_scanned += 1
-        try:
-            results = H.heal_object(es, bucket, name, deep=self.deep,
-                                    remove_dangling=self.remove_dangling)
-            if any(r.healed_drives for r in results):
-                self.items_healed += 1
-        except StorageError as e:
-            self.failures.append(f"{bucket}/{name}: {e}")
+    def _on_object(self, bucket):
+        mu = threading.Lock()
+
+        def observe(name, results, err):
+            with mu:
+                self.items_scanned += 1
+                if err is not None:
+                    self.failures.append(f"{bucket}/{name}: {err}")
+                elif any(r.healed_drives for r in results):
+                    self.items_healed += 1
+        return observe
 
     def run(self) -> "HealSequence":
         self.state = "running"
@@ -71,16 +72,21 @@ class HealSequence:
                             H.heal_bucket(es, bucket)
                         except StorageError:
                             pass
+                        # Bounded worker pool feeding the reconstruct
+                        # pipeline; per-object outcomes stream back via
+                        # the observer so status() stays live mid-walk.
                         try:
-                            infos = es.list_objects(bucket, self.prefix,
-                                                    max_keys=1000000)
+                            H.heal_bucket_objects(
+                                es, bucket, prefix=self.prefix,
+                                deep=self.deep,
+                                remove_dangling=self.remove_dangling,
+                                stop=self._stop,
+                                on_object=self._on_object(bucket))
                         except StorageError:
                             continue
-                        for fi in infos:
-                            if self._stop.is_set():
-                                self.state = "stopped"
-                                return self
-                            self._heal_one(es, bucket, fi.name)
+                        if self._stop.is_set():
+                            self.state = "stopped"
+                            return self
             self.state = "done"
         except Exception as e:  # noqa: BLE001
             self.state = "failed"

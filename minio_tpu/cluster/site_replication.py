@@ -323,7 +323,12 @@ class SiteReplicationSys:
                                  "state": state}).encode())
             results[site["name"]] = (status == 200)
         with self._mu:
-            self.state = state
+            # Membership state is group-shared; the push ledger is
+            # strictly local — carry it across the replacement.
+            ledger = self.state.get("pushed_iam")
+            self.state = dict(state)
+            if ledger:
+                self.state["pushed_iam"] = ledger
             self._save()
         sync = self.reconcile()
         return {"joined": results, "initial_sync": sync}
@@ -336,7 +341,14 @@ class SiteReplicationSys:
                 f"join state does not include this deployment "
                 f"({self.deployment_id})")
         with self._mu:
-            self.state = state
+            # Keep OUR push ledger (and drop any the coordinator's
+            # payload might carry — it describes the sender's pushes,
+            # not ours).
+            ledger = self.state.get("pushed_iam")
+            self.state = {k: v for k, v in state.items()
+                          if k != "pushed_iam"}
+            if ledger:
+                self.state["pushed_iam"] = ledger
             self._save()
 
     def accept_leave(self) -> None:
@@ -465,6 +477,30 @@ class SiteReplicationSys:
         drifted = [s["name"] for s in before["sites"]
                    if not s["self"] and not s["inSync"]]
         pushed = {}
+        with self.iam._mu:
+            svcs = [u for u in self.iam._users.values()
+                    if u.kind == "service"]
+            groups = {n: dict(g)
+                      for n, g in self.iam._groups.items()}
+            local_users = {ak for ak, u in self.iam._users.items()
+                           if u.kind == "user"}
+            local_svc = {ak for ak, u in self.iam._users.items()
+                         if u.kind == "service"}
+            local_groups = set(self.iam._groups)
+            local_policies = {n for n in self.iam._policies
+                              if n not in ("readwrite", "readonly",
+                                           "writeonly")}
+        # Deletion ledger: only entities THIS site's sync has ever
+        # propagated may be deleted on a peer. A bare "peer has it,
+        # we don't" sweep wipes pre-existing IAM the moment a site
+        # with its own users joins the group (add_peers → reconcile)
+        # — those credentials are the peer's truth to push to US, not
+        # remnants. Entities in the ledger that are gone locally ARE
+        # remnants: deleting them is what makes local deletions
+        # converge instead of ping-ponging back from a stale peer.
+        ledger = self.state.get("pushed_iam", {})
+        known = {cat: set(ledger.get(cat, []))
+                 for cat in ("users", "svc", "policies", "groups")}
         if drifted:
             peers = [p for p in self._peers() if p.name in drifted]
             rep = SiteReplicator(self.iam, self.meta, peers)
@@ -473,20 +509,6 @@ class SiteReplicationSys:
             pushed = rep.sync_all(buckets)
             # IAM-complete extras: service accounts, groups, policy
             # mappings ride on top of sync_all's users/policies/buckets
-            with self.iam._mu:
-                svcs = [u for u in self.iam._users.values()
-                        if u.kind == "service"]
-                groups = {n: dict(g)
-                          for n, g in self.iam._groups.items()}
-            with self.iam._mu:
-                local_users = {ak for ak, u in self.iam._users.items()
-                               if u.kind == "user"}
-                local_svc = {ak for ak, u in self.iam._users.items()
-                             if u.kind == "service"}
-                local_groups = set(self.iam._groups)
-                local_policies = {n for n in self.iam._policies
-                                  if n not in ("readwrite", "readonly",
-                                               "writeonly")}
             for peer in peers:
                 for u in svcs:
                     peer.push_service_account(u.parent, u.access_key,
@@ -494,22 +516,35 @@ class SiteReplicationSys:
                 for name, g in groups.items():
                     peer.push_group(name, g.get("members", []),
                                     g.get("policies", []))
-                # deletions: anything the peer has that we don't is a
-                # remnant this site's truth says must go (the full-
-                # mirror half of syncLocalToPeers — without it a
-                # delete leaves permanent drift)
                 listing = peer.remote_iam_listing()
                 if listing is None:
                     continue
-                for ak in set(listing["users"]) - local_users:
+                for ak in (set(listing["users"]) - local_users) \
+                        & known["users"]:
                     peer.delete_user(ak)
-                for ak in set(listing["svc"]) - local_svc:
+                for ak in (set(listing["svc"]) - local_svc) \
+                        & known["svc"]:
                     peer.delete_user(ak)
-                for n in (set(listing["policies"]) - local_policies
-                          - {"readwrite", "readonly", "writeonly"}):
+                for n in ((set(listing["policies"]) - local_policies
+                           - {"readwrite", "readonly", "writeonly"})
+                          & known["policies"]):
                     peer.delete_policy(n)
-                for n in set(listing["groups"]) - local_groups:
+                for n in (set(listing["groups"]) - local_groups) \
+                        & known["groups"]:
                     peer.delete_group(n)
+        # Fold the local truth into the ledger on EVERY reconcile —
+        # whatever is local while we're a member is (being) pushed.
+        # Grow-only: an entry must outlive its local deletion so the
+        # delete keeps propagating to peers that were unreachable (or
+        # not yet drifted) this round.
+        merged = {"users": sorted(known["users"] | local_users),
+                  "svc": sorted(known["svc"] | local_svc),
+                  "policies": sorted(known["policies"] | local_policies),
+                  "groups": sorted(known["groups"] | local_groups)}
+        if merged != ledger:
+            with self._mu:
+                self.state["pushed_iam"] = merged
+                self._save()
         after = self.status()
         return {"drift_before": [s for s in before["sites"]
                                  if not s["inSync"]],

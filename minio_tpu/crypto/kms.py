@@ -11,11 +11,21 @@ from __future__ import annotations
 import os
 import secrets
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:                       # gated optional dep: the
+    AESGCM = None                         # server must boot without it;
+                                          # SSE/KMS paths error on use
 
 
 class KMSError(Exception):
     pass
+
+
+def _require_aesgcm():
+    if AESGCM is None:
+        raise KMSError("SSE unavailable: the 'cryptography' package "
+                       "is not installed")
 
 
 class KMS:
@@ -110,6 +120,7 @@ class StaticKMS(KMS):
     def generate_data_key(self, context: bytes = b"",
                           key_id: str | None = None):
         key_id = key_id or self.key_id
+        _require_aesgcm()
         plaintext = secrets.token_bytes(32)
         nonce = secrets.token_bytes(12)
         sealed = nonce + AESGCM(self._key_for(key_id)).encrypt(
@@ -118,6 +129,7 @@ class StaticKMS(KMS):
 
     def decrypt_data_key(self, key_id: str, sealed: bytes,
                          context: bytes = b"") -> bytes:
+        _require_aesgcm()
         try:
             return AESGCM(self._key_for(key_id, for_decrypt=True)).decrypt(
                 sealed[:12], sealed[12:], context)

@@ -20,9 +20,12 @@ import hmac
 import secrets
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:                       # gated optional dep (see kms)
+    AESGCM = None
 
-from .kms import KMS, KMSError
+from .kms import KMS, KMSError, _require_aesgcm
 
 PACKET_SIZE = 64 * 1024
 
@@ -54,6 +57,7 @@ def _nonce(base: bytes, seq: int, final: bool) -> bytes:
 
 def seal(data: bytes, key: bytes) -> bytes:
     """Plaintext -> [8B nonce-base][packets: 4B len + ct+tag]..."""
+    _require_aesgcm()
     aes = AESGCM(key)
     base = secrets.token_bytes(8)
     out = bytearray(base)
@@ -66,6 +70,7 @@ def seal(data: bytes, key: bytes) -> bytes:
 
 
 def unseal(blob: bytes, key: bytes) -> bytes:
+    _require_aesgcm()
     aes = AESGCM(key)
     if len(blob) < 8:
         raise SSEError("ciphertext too short")

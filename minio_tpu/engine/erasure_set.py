@@ -27,9 +27,11 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..observe.metrics import DATA_PATH
 from ..ops import fused
 from ..ops.erasure_cpu import ReedSolomonCPU
 from ..ops.erasure_jax import ReedSolomonTPU
+from ..parallel import pipeline as pl
 from ..storage import bitrot_io
 from ..storage.drive import (SMALL_FILE_THRESHOLD, SYS_VOL, TMP_DIR,
                              LocalDrive)
@@ -829,29 +831,26 @@ class ErasureSet:
                 remaining -= in_len
             part_start = part_end
 
-        def gen():
-            # One-segment prefetch: segment i+1's drive reads + fused
-            # verify/decode dispatch run while segment i drains to the
-            # caller — hides device round-trips (large via the axon
-            # tunnel) behind socket writes.  On a 1-core host with local
-            # drives there is nothing to overlap — prefetch is pure
-            # executor overhead, so segments run inline.
-            if self._serial_local():
-                for pn, off, ln in segs:
-                    yield self._read_part(bucket, obj, fi, part_number=pn,
-                                          offset=off, length=ln)
-                return
-            fut = None
-            for pn, off, ln in segs:
-                nxt = self._iter_pool.submit(self._read_part, bucket,
-                                             obj, fi, part_number=pn,
-                                             offset=off, length=ln)
-                if fut is not None:
-                    yield fut.result()
-                fut = nxt
-            if fut is not None:
-                yield fut.result()
-        return fi, gen()
+        # One-segment prefetch: segment i+1's drive reads + fused
+        # verify/decode dispatch run while segment i drains to the
+        # caller — hides device round-trips (large via the axon
+        # tunnel) behind socket writes.  On a 1-core host with local
+        # drives a HEALTHY read has nothing to overlap — prefetch is
+        # pure executor overhead, so segments run inline.  A DEGRADED
+        # read is different even there: reconstruction is native
+        # GIL-releasing kernel work, so segment i+1's shard reads run
+        # under segment i's decode (the reconstruct-pipeline shape
+        # heal uses, parallel/pipeline.py).
+        degraded = (any(d is None for d in self.drives)
+                    or any(m is None for m in metas))
+        pool = (None if self._serial_local() and not degraded
+                else self._iter_pool)
+
+        def read_seg(seg):
+            pn, off, ln = seg
+            return self._read_part(bucket, obj, fi, part_number=pn,
+                                   offset=off, length=ln)
+        return fi, pl.prefetch_map(read_seg, segs, pool, depth=1)
 
     def _read_v1_object(self, bucket, obj, fi) -> bytes:
         """Whole-object read of a legacy (xl.json) object: per-drive
@@ -1050,7 +1049,13 @@ class ErasureSet:
         # parity as spares (cf. preferReaders, cmd/erasure-decode.go:101).
         rows: dict[int, tuple] = {}
         tried: set[int] = set()
-        candidates = list(range(k + m))
+        # Offline drives can never yield a shard — skipping them up
+        # front means a degraded read goes straight to the parity
+        # spares instead of burning a retry round per dead position.
+        candidates = [s for s in range(k + m)
+                      if self.drives[order[s]] is not None]
+        degraded = any(s < k for s in range(k + m) if s not in candidates)
+        t_deg = time.monotonic() if degraded else 0.0
         sel: list[int] = []
         missing: list[int] = []
         out = None
@@ -1061,7 +1066,12 @@ class ErasureSet:
             if len(rows) < k and not active:
                 raise ErrErasureReadQuorum(
                     f"{bucket}/{obj}: only {len(rows)}/{k} shards readable")
-            if self._serial_local():
+            # A degraded read always fans out: the surviving-shard
+            # fetches are mmap/pread + native digest work that release
+            # the GIL, so overlapping them pays even on the 1-core host
+            # (unlike the healthy path, where the K reads are page-cache
+            # hits and pool hops only add latency).
+            if self._serial_local() and not degraded:
                 for s in active:
                     tried.add(s)
                     try:
@@ -1175,20 +1185,26 @@ class ErasureSet:
             pieces.append(tail_block[:geo["tail_len"]])
         lo = offset - b0 * BLOCK_SIZE
         if not pieces:
-            return b""
-        if len(pieces) == 1:
+            res: bytes | memoryview = b""
+        elif len(pieces) == 1:
             view = pieces[0][lo:lo + length]
             # Full aligned segment: hand the caller a view of the
             # gather buffer (freshly allocated per call, never reused)
             # — skipping the final tobytes copy, ~25% of a cached GET.
             if view.size == pieces[0].size:
-                return memoryview(view)
-            return view.tobytes()
-        if lo == 0 and sum(p.size for p in pieces) == length:
-            return b"".join(memoryview(np.ascontiguousarray(p))
-                            for p in pieces)
-        data = np.concatenate(pieces)
-        return data[lo:lo + length].tobytes()
+                res = memoryview(view)
+            else:
+                res = view.tobytes()
+        elif lo == 0 and sum(p.size for p in pieces) == length:
+            res = b"".join(memoryview(np.ascontiguousarray(p))
+                           for p in pieces)
+        else:
+            data = np.concatenate(pieces)
+            res = data[lo:lo + length].tobytes()
+        if degraded:
+            DATA_PATH.record_degraded_read(length,
+                                           time.monotonic() - t_deg)
+        return res
 
     @staticmethod
     def _range_geometry(fi, part_size: int, b0: int, b1: int) -> dict:

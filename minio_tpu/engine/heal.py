@@ -20,12 +20,17 @@ The reference's healing stack rebuilt on the batched device codec:
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..observe.metrics import DATA_PATH
+from ..parallel import pipeline as pl
 from ..storage import bitrot_io
 from ..storage.drive import SYS_VOL, TMP_DIR, LocalDrive
 from ..storage.errors import (ErrErasureReadQuorum, ErrFileCorrupt,
@@ -34,7 +39,7 @@ from ..storage.errors import (ErrErasureReadQuorum, ErrFileCorrupt,
 from ..storage.xlmeta import FileInfo, XLMeta
 from ..utils import msgpackx
 from . import quorum as Q
-from .erasure_set import BLOCK_SIZE, ErasureSet
+from .erasure_set import BATCH_BLOCKS, BLOCK_SIZE, ErasureSet
 
 # Drive states (cf. madmin drive states in the reference heal API).
 DRIVE_OK = "ok"
@@ -392,66 +397,48 @@ def _reconstruct_rows(es: ErasureSet, fi: FileInfo,
     return out_rows
 
 
+#: Blocks per reconstruct batch — one device dispatch / native C pass,
+#: and the memory bound of the heal pipeline (O(batch), never O(part)).
+HEAL_BATCH_BLOCKS = BATCH_BLOCKS
+
+
+def _pipelined() -> bool:
+    """Env escape hatch (MTPU_HEAL_PIPELINE=0): run the one-shot serial
+    reference path. The equivalence test drives both implementations
+    over the same corruption matrix and diffs the repaired bytes."""
+    return os.environ.get("MTPU_HEAL_PIPELINE", "1") != "0"
+
+
 def _heal_data(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
                sources: list[int], targets: list[int]) -> None:
     """Reconstruct every part's shard files onto the target drives and
-    publish atomically via rename_data."""
+    publish atomically via rename_data.
+
+    Pipelined: surviving-shard reads fan out across drives, parts are
+    staged in HEAL_BATCH_BLOCKS-deep batches through a double-buffered
+    read -> verify+decode(+re-encode) -> write pipeline (the Erasure.Heal
+    role, cmd/erasure-lowlevel-heal.go:31, on the PUT path's `pending`
+    scheme), so drive I/O for batch i+1 overlaps the decode of batch i
+    and the repaired-shard appends of batch i-1."""
     ec = fi.erasure
     dist = ec.distribution
-    k = ec.data_blocks
     tmp_id = f"heal-{uuid.uuid4().hex}"
     need = sorted({dist[pos] - 1 for pos in targets})
 
     try:
         for part in fi.parts:
-            path = f"{obj}/{fi.data_dir}/part.{part.number}"
-            logical = ec.shard_file_size(part.size)
-            rows: list[np.ndarray | None] = [None] * (k + ec.parity_blocks)
-            got = 0
-            # Read + verify source shards until K good ones (spares beyond
-            # the first K cover sources that fail at read time).
-            for pos in sources:
-                if got >= k:
-                    break
-                s = dist[pos] - 1
-                try:
-                    d = es.drives[pos]
-                    # mmap on local drives: the fused unframe verifies
-                    # straight off the page cache (no read() copy).
-                    raw = (d.read_file_view(bucket, path)
-                           if isinstance(d, LocalDrive)
-                           else d.read_file(bucket, path))
-                    row = bitrot_io.unframe_shard(
-                        raw, ec.shard_size, verify=True,
-                        algo=ec.bitrot_algo(part.number))
-                    if row.size != logical:
-                        raise ErrFileCorrupt("short shard")
-                    rows[s] = row
-                    got += 1
-                except StorageError:
-                    continue
-            if got < k:
-                raise ErrErasureReadQuorum(
-                    f"heal {bucket}/{obj} part {part.number}: "
-                    f"{got} readable < {k}")
-            avail = [s for s in range(len(rows)) if rows[s] is not None]
-            missing = [s for s in need if rows[s] is None]
-            rebuilt = _reconstruct_rows(es, fi, rows, avail, missing) \
-                if missing else []
-            for s, row in zip(missing, rebuilt):
-                rows[s] = row
-            for pos in targets:
-                s = dist[pos] - 1
-                framed = bitrot_io.frame_shard(
-                    rows[s], ec.shard_size, ec.bitrot_algo(part.number))
-                es.drives[pos].create_file(
-                    SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.{part.number}",
-                    framed)
+            if _pipelined():
+                _heal_part_pipelined(es, bucket, obj, fi, part, sources,
+                                     targets, need, tmp_id)
+            else:
+                _heal_part_serial(es, bucket, obj, fi, part, sources,
+                                  targets, need, tmp_id)
         for pos in targets:
             fi_pos = _fi_for_drive(fi, pos)
             _ensure_bucket_on(es.drives[pos], bucket)
             es.drives[pos].rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}",
                                        fi_pos, bucket, obj)
+        DATA_PATH.record_heal_object()
     finally:
         for pos in targets:
             try:
@@ -459,6 +446,304 @@ def _heal_data(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
                                       recursive=True)
             except StorageError:
                 pass
+
+
+def _heal_part_serial(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
+                      part, sources: list[int], targets: list[int],
+                      need: list[int], tmp_id: str) -> None:
+    """Reference implementation: whole-part staging, serial drive loop
+    (the pre-pipeline path, kept as the equivalence oracle)."""
+    ec = fi.erasure
+    dist = ec.distribution
+    k = ec.data_blocks
+    path = f"{obj}/{fi.data_dir}/part.{part.number}"
+    logical = ec.shard_file_size(part.size)
+    rows: list[np.ndarray | None] = [None] * (k + ec.parity_blocks)
+    got = 0
+    # Read + verify source shards until K good ones (spares beyond
+    # the first K cover sources that fail at read time).
+    for pos in sources:
+        if got >= k:
+            break
+        s = dist[pos] - 1
+        try:
+            d = es.drives[pos]
+            # mmap on local drives: the fused unframe verifies
+            # straight off the page cache (no read() copy).
+            raw = (d.read_file_view(bucket, path)
+                   if isinstance(d, LocalDrive)
+                   else d.read_file(bucket, path))
+            row = bitrot_io.unframe_shard(
+                raw, ec.shard_size, verify=True,
+                algo=ec.bitrot_algo(part.number))
+            if row.size != logical:
+                raise ErrFileCorrupt("short shard")
+            rows[s] = row
+            got += 1
+        except StorageError:
+            continue
+    if got < k:
+        raise ErrErasureReadQuorum(
+            f"heal {bucket}/{obj} part {part.number}: "
+            f"{got} readable < {k}")
+    avail = [s for s in range(len(rows)) if rows[s] is not None]
+    missing = [s for s in need if rows[s] is None]
+    rebuilt = _reconstruct_rows(es, fi, rows, avail, missing) \
+        if missing else []
+    for s, row in zip(missing, rebuilt):
+        rows[s] = row
+    for pos in targets:
+        s = dist[pos] - 1
+        framed = bitrot_io.frame_shard(
+            rows[s], ec.shard_size, ec.bitrot_algo(part.number))
+        es.drives[pos].create_file(
+            SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.{part.number}",
+            framed)
+
+
+def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
+                         fi: FileInfo, part, sources: list[int],
+                         targets: list[int], need: list[int],
+                         tmp_id: str) -> None:
+    """Batched double-buffered reconstruct of one part onto the targets.
+
+    Memory is O(batch): surviving shards are read as ranged frame
+    segments (fanned out across drives), each HEAL_BATCH_BLOCKS batch is
+    verified+decoded in one native/device pass (+re-encoded for parity
+    targets), framed vectorized, and appended to the per-target staging
+    files with one write in flight — so batch i+1's reads overlap batch
+    i's decode and batch i-1's writes. A bitrot hit or read failure
+    drops the source and promotes a spare for that batch onward, exactly
+    like the GET path's spare-read policy."""
+    from ..ops import fused
+    from .erasure_set import _ecio_mod, _mesh_mode
+    ec = fi.erasure
+    dist = ec.distribution
+    k, m = ec.data_blocks, ec.parity_blocks
+    S = ec.shard_size
+    algo = ec.bitrot_algo(part.number)
+    hs = bitrot_io.digest_size(algo)
+    frame = hs + S
+    logical = ec.shard_file_size(part.size)
+    n_full = part.size // BLOCK_SIZE
+    tail_shard = logical - n_full * S
+    want = bitrot_io.bitrot_shard_file_size(logical, S, algo)
+    path = f"{obj}/{fi.data_dir}/part.{part.number}"
+    tmp_path = f"{TMP_DIR}/{tmp_id}/part.{part.number}"
+    need_data = [s for s in need if s < k]
+    need_parity = [s for s in need if s >= k]
+
+    src_pos = {dist[pos] - 1: pos for pos in sources}
+    candidates = sorted(src_pos)
+    serial = es._serial_local()
+
+    def quorum_err(got: int) -> ErrErasureReadQuorum:
+        return ErrErasureReadQuorum(
+            f"heal {bucket}/{obj} part {part.number}: "
+            f"{got} readable < {k}")
+
+    # Source election: a framed-size stat weeds out missing/truncated
+    # shards before any data moves (fan-out: one stat per drive).
+    def usable(s: int) -> bool:
+        d = es.drives[src_pos[s]]
+        try:
+            return d is not None and d.file_size(bucket, path) == want
+        except StorageError:
+            return False
+
+    if serial:
+        good = [s for s in candidates if usable(s)]
+    else:
+        flags = list(es.pool.map(usable, candidates))
+        good = [s for s, f in zip(candidates, flags) if f]
+    if len(good) < k:
+        raise quorum_err(len(good))
+    sel = good[:k]          # kept sorted; mutated on bitrot/read failure
+    spares = good[k:]
+
+    fused_host = None
+    if not es._use_device and algo == "mxh256" and k + m <= 64 \
+            and not _mesh_mode():
+        fused_host = _ecio_mod()
+
+    def read_one(s: int, lo: int, ln: int) -> bytes:
+        raw = es.drives[src_pos[s]].read_file(bucket, path, lo, ln)
+        if len(raw) != ln:
+            raise ErrFileCorrupt(
+                f"short shard segment ({len(raw)} != {ln})")
+        return raw
+
+    def read_batch(batch):
+        """Read stage: fan the selected sources' frame segments out
+        across drives. Failures are left out — the compute stage drops
+        the source and promotes a spare."""
+        b0, nb = batch
+        lo, ln = b0 * frame, nb * frame
+        t0 = time.perf_counter()
+        cur = list(sel)
+        data: dict[int, bytes] = {}
+        if serial:
+            for s in cur:
+                try:
+                    data[s] = read_one(s, lo, ln)
+                except StorageError:
+                    pass
+        else:
+            futs = {s: es.pool.submit(read_one, s, lo, ln) for s in cur}
+            for s, f in futs.items():
+                try:
+                    data[s] = f.result()
+                except StorageError:
+                    pass
+        return batch, data, time.perf_counter() - t0
+
+    def compute(item):
+        """Verify + decode (+ re-encode parity) one batch; on a bad row,
+        swap in a spare and rerun the batch."""
+        (b0, nb), data, read_s = item
+        lo, ln = b0 * frame, nb * frame
+        t0 = time.perf_counter()
+        while True:
+            # Reconcile with the current selection: a source dropped by
+            # an earlier batch leaves a hole in this prefetched read; a
+            # promoted spare has no bytes yet.
+            for s in [s for s in sel if s not in data]:
+                try:
+                    data[s] = read_one(s, lo, ln)
+                except StorageError:
+                    sel.remove(s)
+            while len(sel) < k:
+                if not spares:
+                    raise quorum_err(len(sel))
+                s = spares.pop(0)
+                try:
+                    data[s] = read_one(s, lo, ln)
+                except StorageError:
+                    continue
+                sel.append(s)
+                sel.sort()
+            cur = list(sel)
+            out: dict[int, np.ndarray] = {}
+            if fused_host is not None:
+                # ONE C pass: digest every chosen row, gather the data
+                # matrix, rebuild missing data rows. Parity targets
+                # re-encode from the full matrix right after.
+                dmiss = [s for s in range(k) if s not in cur]
+                dtargets = dmiss if need_parity else \
+                    [s for s in dmiss if s in need_data]
+                y, okf, nbad = fused_host.get_verify(
+                    [data[s] for s in cur], cur, nb, S, k, m, dtargets)
+                if nbad:
+                    for j, s in enumerate(cur):
+                        if not okf[j]:
+                            sel.remove(s)
+                            data.pop(s, None)
+                    continue
+                for s in need_data:
+                    out[s] = y[:, s, :]
+                if need_parity:
+                    prows = np.asarray(es._native(k, m).transform_blocks(
+                        y, tuple(range(k)), tuple(need_parity)))
+                    for j, s in enumerate(need_parity):
+                        out[s] = prows[:, j, :]
+                break
+            # Generic path: gather rows, digest-verify, then ONE
+            # transform straight to every needed row — transform_matrix
+            # maps any K sources to arbitrary targets, parity included.
+            bufs = {s: np.frombuffer(data[s], dtype=np.uint8)
+                    .reshape(nb, frame) for s in cur}
+            x = np.empty((nb, k, S), dtype=np.uint8)
+            for i, s in enumerate(cur):
+                x[:, i, :] = bufs[s][:, hs:]
+            if es._use_device and algo in fused.DEVICE_ALGOS \
+                    and bitrot_io.device_preferred(algo) \
+                    and not _mesh_mode():
+                digests, rebuilt = fused.verify_and_transform(
+                    x, k, m, tuple(cur), tuple(need), algo=algo)
+                digests = np.asarray(digests)
+                rebuilt = np.asarray(rebuilt) if need else None
+            else:
+                digests = bitrot_io._hash_batch(
+                    x.reshape(nb * k, S), algo).reshape(nb, k, hs)
+                rebuilt = np.asarray(es._transform(
+                    k, m, x, tuple(cur), tuple(need))) if need else None
+            bad = [cur[i] for i in range(k)
+                   if not np.array_equal(digests[:, i],
+                                         bufs[cur[i]][:, :hs])]
+            if bad:
+                for s in bad:
+                    sel.remove(s)
+                    data.pop(s, None)
+                continue
+            for j, s in enumerate(need):
+                out[s] = rebuilt[:, j, :]
+            break
+        # Vectorized framing of the rebuilt rows (same frame layout the
+        # serial frame_shard produces, batch-concatenation identical).
+        stack = np.stack([out[s] for s in need])         # (T, nb, S)
+        framed = bitrot_io.frame_shard_views(None, None, None, algo,
+                                             shards=stack)
+        payload = dict(zip(need, framed))
+        return (b0, nb), payload, read_s, time.perf_counter() - t0
+
+    def write_batch(res):
+        """Write stage: append the repaired frames to every target's
+        staging file (fan-out across target drives)."""
+        (b0, nb), payload, read_s, decode_s = res
+        t0 = time.perf_counter()
+
+        def put(pos):
+            es.drives[pos].append_file(SYS_VOL, tmp_path,
+                                       payload[dist[pos] - 1])
+        if serial or len(targets) == 1:
+            for pos in targets:
+                put(pos)
+        else:
+            list(es.pool.map(put, targets))
+        DATA_PATH.record_heal_batch(
+            nb, HEAL_BATCH_BLOCKS, len(sel) * nb * frame,
+            len(targets) * nb * frame, read_s, decode_s,
+            time.perf_counter() - t0)
+
+    batches = [(b0, min(HEAL_BATCH_BLOCKS, n_full - b0))
+               for b0 in range(0, n_full, HEAL_BATCH_BLOCKS)]
+    # The pipeline threads pay off even on the 1-core host: reads,
+    # appends, and the native decode all release the GIL, so disk I/O
+    # for neighboring batches genuinely overlaps the C pass.
+    pl.StagePipeline(es._iter_pool).run(
+        pl.prefetch_map(read_batch, batches, es._iter_pool, depth=1),
+        compute, write_batch)
+
+    if tail_shard:
+        # Tail fragment (one short frame per shard): CPU oracle codec,
+        # same bytes as the serial whole-row path.
+        lo, ln = n_full * frame, hs + tail_shard
+        shards_in: list[np.ndarray | None] = [None] * (k + m)
+        got = 0
+        for s in list(sel) + spares:
+            if got >= k:
+                break
+            try:
+                row = bitrot_io.unframe_shard(
+                    read_one(s, lo, ln), tail_shard, verify=True,
+                    algo=algo)
+                if row.size != tail_shard:
+                    raise ErrFileCorrupt("short tail")
+                shards_in[s] = row
+                got += 1
+            except StorageError:
+                continue
+        if got < k:
+            raise quorum_err(got)
+        if any(shards_in[s] is None for s in need):
+            full = es._cpu(k, m).reconstruct(shards_in)
+            for s in need:
+                if shards_in[s] is None:
+                    shards_in[s] = full[s]
+        for pos in targets:
+            es.drives[pos].append_file(
+                SYS_VOL, tmp_path,
+                bitrot_io.frame_shard(shards_in[dist[pos] - 1], S, algo))
 
 
 def heal_format(es: ErasureSet) -> list[int]:
@@ -573,9 +858,27 @@ def _set_objects(es: ErasureSet, bucket: str, skip_pos: int) -> list[str]:
     return sorted(names)
 
 
-def heal_drive(es: ErasureSet, pos: int,
-               checkpoint_every: int = 64) -> HealingTracker:
-    """Walk the whole set onto one (new/replaced/wiped) drive, resumably.
+def _heal_workers(es: ErasureSet, workers: int | None) -> int:
+    """Bounded default: a couple of concurrent object heals per spare
+    core, 1 on the serial-local host (same policy as the data-path
+    fan-out, ErasureSet._SERIAL_FANOUT)."""
+    if workers is not None:
+        return max(1, int(workers))
+    return 1 if es._serial_local() else min(4, os.cpu_count() or 1)
+
+
+def heal_drive(es: ErasureSet, pos: int, checkpoint_every: int = 64,
+               workers: int | None = None,
+               stop: threading.Event | None = None) -> HealingTracker:
+    """Walk the whole set onto one (new/replaced/wiped) drive, resumably,
+    healing up to `workers` objects concurrently through the reconstruct
+    pipeline (bounded submission window — no unbounded queue growth).
+
+    The HealingTracker checkpoint only ever advances over the CONTIGUOUS
+    completed prefix of the sorted walk: with concurrent workers, object
+    i+1 may finish before object i, and persisting i+1 as the resume
+    point would skip i forever if the heal is interrupted mid-batch.
+    Re-healing a beyond-frontier object on resume is a no-op.
 
     cf. healErasureSet, /root/reference/cmd/global-heal.go:166."""
     drive = es.drives[pos]
@@ -586,29 +889,93 @@ def heal_drive(es: ErasureSet, pos: int,
         tracker = HealingTracker(heal_id=str(uuid.uuid4()),
                                  started_ns=time.time_ns())
         tracker.save(drive)
+    workers = _heal_workers(es, workers)
 
-    buckets = es.list_buckets()
-    since_ckpt = 0
-    for bucket in buckets:
-        if bucket < tracker.resume_bucket:
-            continue
-        heal_bucket(es, bucket)
-        for obj in _set_objects(es, bucket, skip_pos=pos):
-            if (bucket == tracker.resume_bucket
-                    and obj <= tracker.resume_object):
+    def walk():
+        for bucket in es.list_buckets():
+            if bucket < tracker.resume_bucket:
                 continue
-            try:
-                for r in heal_object(es, bucket, obj):
-                    if pos in r.healed_drives:
-                        tracker.objects_healed += 1
-                        tracker.bytes_healed += r.size
-            except StorageError:
-                tracker.objects_failed += 1
-            tracker.resume_bucket, tracker.resume_object = bucket, obj
-            since_ckpt += 1
-            if since_ckpt >= checkpoint_every:
-                tracker.save(drive)
-                since_ckpt = 0
-    tracker.finished = True
+            heal_bucket(es, bucket)
+            for obj in _set_objects(es, bucket, skip_pos=pos):
+                if (bucket == tracker.resume_bucket
+                        and obj <= tracker.resume_object):
+                    continue
+                yield bucket, obj
+
+    def heal_one(item):
+        bucket, obj = item
+        healed = nbytes = 0
+        for r in heal_object(es, bucket, obj):
+            if pos in r.healed_drives:
+                healed += 1
+                nbytes += r.size
+        return healed, nbytes
+
+    mu = threading.Lock()
+    frontier = pl.Frontier()
+    items: dict[int, tuple[str, str]] = {}
+    done_below = 0          # items consumed by the frontier so far
+    since_ckpt = 0
+    pool = ThreadPoolExecutor(max_workers=workers) if workers > 1 else None
+    try:
+        for idx, item, res, err in pl.run_window(
+                heal_one, walk(), pool, window=workers * 2, stop=stop):
+            if err is not None and not isinstance(err, StorageError):
+                raise err
+            with mu:
+                if err is not None:
+                    tracker.objects_failed += 1
+                else:
+                    tracker.objects_healed += res[0]
+                    tracker.bytes_healed += res[1]
+                items[idx] = item
+                front = frontier.mark(idx)
+                while done_below < front:
+                    tracker.resume_bucket, tracker.resume_object = \
+                        items.pop(done_below)
+                    done_below += 1
+                    since_ckpt += 1
+                if since_ckpt >= checkpoint_every:
+                    tracker.save(drive)
+                    since_ckpt = 0
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True)
+    if stop is None or not stop.is_set():
+        tracker.finished = True
     tracker.save(drive)
     return tracker
+
+
+def heal_bucket_objects(es: ErasureSet, bucket: str, prefix: str = "",
+                        deep: bool = False, remove_dangling: bool = True,
+                        workers: int | None = None,
+                        stop: threading.Event | None = None,
+                        on_object=None) -> list[HealResult]:
+    """Heal every object in a bucket through the same bounded worker
+    pool as heal_drive (the per-bucket arm of the background heal
+    sequence). `on_object(name, results, err)` observes each object as
+    it completes; non-storage errors propagate."""
+    workers = _heal_workers(es, workers)
+    names = [n for n in _set_objects(es, bucket, skip_pos=-1)
+             if n.startswith(prefix)]
+
+    def one(name):
+        return heal_object(es, bucket, name, deep=deep,
+                           remove_dangling=remove_dangling)
+
+    results: list[HealResult] = []
+    pool = ThreadPoolExecutor(max_workers=workers) if workers > 1 else None
+    try:
+        for _, name, res, err in pl.run_window(
+                one, names, pool, window=workers * 2, stop=stop):
+            if err is not None and not isinstance(err, StorageError):
+                raise err
+            if on_object is not None:
+                on_object(name, res, err)
+            if err is None and res:
+                results.extend(res)
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True)
+    return results

@@ -153,6 +153,83 @@ class BandwidthMonitor:
         return out
 
 
+class DataPathStats:
+    """Process-global heal / degraded-read data-path accounting.
+
+    The reconstruct pipeline (engine/heal.py, ErasureSet._read_part)
+    runs deep inside the engine where no MetricsRegistry instance is
+    reachable — and must work without a server at all (bench, tests,
+    `heal_drive` from an admin job). So the engine records into this
+    singleton and the registry renders from a snapshot, the same split
+    the reference makes between globalBackgroundHealState and the
+    metrics collector (cmd/metrics-v2.go getHealMetrics)."""
+
+    STAGES = ("read", "decode", "write")
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._mu:
+            self.heal_bytes = 0              # repaired shard bytes written
+            self.heal_source_bytes = 0       # surviving shard bytes read
+            self.heal_stage_s = {s: 0.0 for s in self.STAGES}
+            self.heal_batches = 0
+            self.heal_batch_blocks = 0       # blocks actually carried
+            self.heal_batch_capacity = 0     # blocks the batches could carry
+            self.heal_objects = 0
+            self.degraded_reads = 0
+            self.degraded_bytes = 0
+            self.degraded_s = 0.0
+
+    def record_heal_batch(self, blocks: int, capacity: int,
+                          source_bytes: int, out_bytes: int,
+                          read_s: float, decode_s: float,
+                          write_s: float) -> None:
+        with self._mu:
+            self.heal_batches += 1
+            self.heal_batch_blocks += blocks
+            self.heal_batch_capacity += capacity
+            self.heal_source_bytes += source_bytes
+            self.heal_bytes += out_bytes
+            self.heal_stage_s["read"] += read_s
+            self.heal_stage_s["decode"] += decode_s
+            self.heal_stage_s["write"] += write_s
+
+    def record_heal_object(self) -> None:
+        with self._mu:
+            self.heal_objects += 1
+
+    def record_degraded_read(self, nbytes: int, seconds: float) -> None:
+        with self._mu:
+            self.degraded_reads += 1
+            self.degraded_bytes += nbytes
+            self.degraded_s += seconds
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "heal_bytes": self.heal_bytes,
+                "heal_source_bytes": self.heal_source_bytes,
+                "heal_stage_s": dict(self.heal_stage_s),
+                "heal_batches": self.heal_batches,
+                "heal_batch_blocks": self.heal_batch_blocks,
+                "heal_batch_capacity": self.heal_batch_capacity,
+                "heal_batch_occupancy": (
+                    self.heal_batch_blocks / self.heal_batch_capacity
+                    if self.heal_batch_capacity else 0.0),
+                "heal_objects": self.heal_objects,
+                "degraded_reads": self.degraded_reads,
+                "degraded_bytes": self.degraded_bytes,
+                "degraded_seconds": self.degraded_s,
+            }
+
+
+#: Engine-side singleton (see DataPathStats docstring).
+DATA_PATH = DataPathStats()
+
+
 class MetricsRegistry:
     def __init__(self):
         self.api_requests = Counter(
@@ -175,6 +252,30 @@ class MetricsRegistry:
                                     ("bucket",))
         self.heal_total = Counter("mtpu_heal_objects_healed_total",
                                   "Objects healed")
+        # Reconstruct-pipeline families (rendered from DATA_PATH):
+        # throughput, per-stage latency, and batch occupancy for heal
+        # and the degraded-read path.
+        self.heal_bytes = Gauge("mtpu_heal_repaired_bytes_total",
+                                "Repaired shard bytes written by heal")
+        self.heal_source_bytes = Gauge(
+            "mtpu_heal_source_bytes_total",
+            "Surviving shard bytes read by heal")
+        self.heal_stage_seconds = Gauge(
+            "mtpu_heal_stage_seconds_total",
+            "Heal pipeline time by stage", ("stage",))
+        self.heal_batches = Gauge("mtpu_heal_batches_total",
+                                  "Reconstruct batches dispatched by heal")
+        self.heal_batch_occupancy = Gauge(
+            "mtpu_heal_batch_occupancy_ratio",
+            "Blocks carried / batch capacity (1.0 = full batches)")
+        self.degraded_reads = Gauge("mtpu_degraded_reads_total",
+                                    "GET segments served by reconstruction")
+        self.degraded_bytes = Gauge(
+            "mtpu_degraded_read_bytes_total",
+            "Bytes served through the degraded-read path")
+        self.degraded_seconds = Gauge(
+            "mtpu_degraded_read_seconds_total",
+            "Time spent reconstructing degraded reads")
         self.drive_online = Gauge("mtpu_cluster_drives_online",
                                   "Online drives")
         self.drive_offline = Gauge("mtpu_cluster_drives_offline",
@@ -231,13 +332,30 @@ class MetricsRegistry:
                     self.bucket_usage.set(u.bytes, bucket=bucket)
                     self.bucket_objects.set(u.objects, bucket=bucket)
 
+    def _sync_datapath(self) -> None:
+        snap = DATA_PATH.snapshot()
+        self.heal_bytes.set(snap["heal_bytes"])
+        self.heal_source_bytes.set(snap["heal_source_bytes"])
+        for stage, s in snap["heal_stage_s"].items():
+            self.heal_stage_seconds.set(s, stage=stage)
+        self.heal_batches.set(snap["heal_batches"])
+        self.heal_batch_occupancy.set(snap["heal_batch_occupancy"])
+        self.degraded_reads.set(snap["degraded_reads"])
+        self.degraded_bytes.set(snap["degraded_bytes"])
+        self.degraded_seconds.set(snap["degraded_seconds"])
+
     def render(self) -> str:
+        self._sync_datapath()
         out: list[str] = []
         for m in (self.api_requests, self.api_errors, self.inflight,
                   self.latency, self.bytes_rx, self.bytes_tx,
                   self.bucket_usage, self.bucket_objects,
-                  self.heal_total, self.drive_online, self.drive_offline,
-                  self.cache_hits, self.cache_misses,
+                  self.heal_total, self.heal_bytes,
+                  self.heal_source_bytes, self.heal_stage_seconds,
+                  self.heal_batches, self.heal_batch_occupancy,
+                  self.degraded_reads, self.degraded_bytes,
+                  self.degraded_seconds, self.drive_online,
+                  self.drive_offline, self.cache_hits, self.cache_misses,
                   self.cache_evictions, self.cache_usage,
                   self.cache_max):
             m.render(out)

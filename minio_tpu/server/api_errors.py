@@ -367,6 +367,12 @@ def from_storage_error(e: Exception) -> S3Error:
         return S3Error("NoSuchVersion")
     if isinstance(e, (se.ErrObjectNotFound, se.ErrFileNotFound)):
         return S3Error("NoSuchKey")
+    if isinstance(e, se.ErrVolumeNotFound):
+        # A PUT racing a peer's bucket delete surfaces the missing
+        # volume from deep in the write path — that's a 404 on the
+        # bucket, not a 500 (cf. toAPIErrorCode's VolumeNotFound →
+        # NoSuchBucket, cmd/api-errors.go).
+        return S3Error("NoSuchBucket")
     if isinstance(e, (se.ErrErasureReadQuorum, se.ErrErasureWriteQuorum)):
         return S3Error("SlowDown", str(e))
     if isinstance(e, (se.ErrVolumeNotEmpty, se.ErrBucketNotEmpty)):

@@ -378,8 +378,27 @@ class S3Handlers:
                 if self.notify is not None:
                     self.notify.set_bucket_rules(bucket, rules)
             elif kind == "replication":
-                from ..bucket.replication import parse_replication_config
-                parse_replication_config(body)
+                from ..bucket.replication import (parse_replication_config,
+                                                  parse_targets)
+                rules = parse_replication_config(body)
+                # Target wiring validates BEFORE the config persists:
+                # a 400 here must not leave a half-persisted config
+                # that re-fails its wiring at every boot. (Targets may
+                # legitimately be absent entirely — wiring is then
+                # deferred, matching wire_bucket's False return.)
+                targets = parse_targets(
+                    self.meta.get(bucket, "replication_targets"))
+                if targets:
+                    registered = {t.get("targetBucket", "")
+                                  for t in targets}
+                    unmatched = [r.target_bucket for r in rules
+                                 if r.target_bucket not in registered]
+                    if unmatched:
+                        raise S3Error(
+                            "InvalidArgument",
+                            f"replication rules reference unregistered "
+                            f"target bucket(s) {unmatched}; register "
+                            f"them with admin bucket-remote first")
                 # live wiring happens below once the config persists
                 wire_replication_after = True
             elif kind == "object_lock":
@@ -403,7 +422,15 @@ class S3Handlers:
             except Exception as e:  # noqa: BLE001 — wire_bucket returns
                 # False when targets are simply absent; an EXCEPTION
                 # means corrupt registration data — a 200 with silently
-                # dead replication would hide it from the operator
+                # dead replication would hide it from the operator.
+                # Roll the just-persisted config back (fallback for
+                # anything the pre-persist validation couldn't see,
+                # e.g. a target unregistered in the races-with-us
+                # window) so boot never replays a known-bad config.
+                try:
+                    self.meta.delete(bucket, kind)
+                except Exception:  # noqa: BLE001 — rollback best-effort
+                    pass
                 raise S3Error("InvalidArgument",
                               f"replication wiring: {e}") from None
         return Response(200)

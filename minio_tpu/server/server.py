@@ -89,6 +89,14 @@ class S3Server:
         self.scanner = scanner
         self.config = None                 # lazy ConfigSys (admin API)
         self.service_event = ""            # "" | "restart" | "stop"
+        # Site-hook single-flight state is created EAGERLY: the lazy
+        # `if getattr(...) is None: self._site_hook_mu = Lock()` dance
+        # raced — two first-ever mutations on different handler threads
+        # could each install their own lock and both start a reconcile
+        # worker.
+        self._site_hook_mu = threading.Lock()
+        self._site_hook_busy = False
+        self._site_hook_again = False
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -789,11 +797,8 @@ class S3Server:
                         pass
             _thr.Thread(target=drop, daemon=True,
                         name="site-repl-bucket-del").start()
-        import threading
-        if getattr(self, "_site_hook_mu", None) is None:
-            self._site_hook_mu = threading.Lock()
         with self._site_hook_mu:
-            if getattr(self, "_site_hook_busy", False):
+            if self._site_hook_busy:
                 self._site_hook_again = True
                 return
             self._site_hook_busy = True

@@ -172,9 +172,16 @@ void njson_classify(const uint8_t* buf, const int64_t* extents, long n,
       }
       continue;
     }
-    if (c == 't' && e - s == 4) { types[r] = 5; continue; }
-    if (c == 'f' && e - s == 5) { types[r] = 6; continue; }
-    if (c == 'n' && e - s == 4) { types[r] = 7; continue; }
+    // Literals must match in full: first-char + length alone would
+    // accept `tru1`/`falsy`/`nule` as valid — malformed tokens fall
+    // through to type 4 so the python-parse path raises like the
+    // stdlib reader.
+    if (c == 't' && e - s == 4 &&
+        std::memcmp(buf + s, "true", 4) == 0) { types[r] = 5; continue; }
+    if (c == 'f' && e - s == 5 &&
+        std::memcmp(buf + s, "false", 5) == 0) { types[r] = 6; continue; }
+    if (c == 'n' && e - s == 4 &&
+        std::memcmp(buf + s, "null", 4) == 0) { types[r] = 7; continue; }
     if (c == '-' || (c >= '0' && c <= '9')) {
       bool is_int = true;
       for (int64_t i = s; i < e; ++i) {

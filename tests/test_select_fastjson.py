@@ -73,6 +73,22 @@ class TestFastJSON:
         with pytest.raises(ValueError):
             read_json_lines_fast(bad, ["id"])
 
+    @pytest.mark.parametrize("lit", ["tru1", "falsy", "nule", "trUe",
+                                     "null"[:3] + "1"])
+    def test_malformed_literal_raises_like_stdlib(self, lit):
+        # Same first char + length as a real literal: the classifier
+        # must memcmp the whole token, not pattern-match its shape.
+        bad = DATA + ('\n{"id": 9, "ok": %s}' % lit).encode()
+        with pytest.raises(ValueError):
+            read_json_lines(bad)
+        with pytest.raises(ValueError):
+            read_json_lines_fast(bad, ["id", "ok"])
+
+    def test_wellformed_literals_survive_strict_match(self):
+        line = b'{"a": true, "b": false, "c": null}'
+        recs = read_json_lines_fast(line, ["a", "b", "c"])
+        assert recs == [{"a": True, "b": False, "c": None}]
+
     def test_engine_uses_fast_path_transparently(self):
         from minio_tpu.s3select.engine import execute_select
         opts = {"expression":

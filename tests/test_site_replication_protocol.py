@@ -198,3 +198,49 @@ class TestConvergence:
         # the ejected site's own state is CLEARED (leave pushed)
         st, info = admin(sites[2][1], "GET")
         assert not info["enabled"], info
+
+    def test_join_preserves_preexisting_disjoint_iam(self, sites):
+        """Joining a group must be ADDITIVE for IAM: a site that
+        already holds its own credentials must not have them wiped by
+        the coordinator's first reconcile (the deletion sweep may only
+        remove entities the group's sync itself propagated)."""
+        _, c0, _ = sites[0]
+        _, c1, _ = sites[1]
+        # DISJOINT pre-existing IAM on both sites, created BEFORE join
+        c0.request("POST", "/minio/admin/v1/users", body=json.dumps({
+            "accessKey": "alice", "secretKey": "alice-secret-12",
+            "policies": []}).encode())
+        c1.request("POST", "/minio/admin/v1/users", body=json.dumps({
+            "accessKey": "bob", "secretKey": "bob-secret-1234",
+            "policies": ["readonly"]}).encode())
+        sites[1][0].iam.set_policy("bob-pol", {
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Action": ["s3:Get*"],
+                           "Resource": ["arn:aws:s3:::*"]}]})
+        self._join(sites)
+        admin(c0, "POST", "reconcile")
+        # bob (and his policy) survived site0's reconcile against site1
+        users1 = json.loads(c1.request(
+            "GET", "/minio/admin/v1/users")[2])["users"]
+        assert "bob" in users1, users1
+        assert "bob-pol" in sites[1][0].iam._policies
+        # bob's credentials still WORK on his own site
+        bob_cli = S3Client(sites[1][0].endpoint, "bob",
+                           "bob-secret-1234")
+        st, _, _ = bob_cli.request("GET", "/")
+        assert st == 200
+        # alice was pushed outward, not bob wiped: both sites converge
+        # to the union once the bob-holding site reconciles too
+        admin(c1, "POST", "reconcile")
+        for cli in (c0, c1):
+            users = json.loads(cli.request(
+                "GET", "/minio/admin/v1/users")[2])["users"]
+            assert {"alice", "bob"} <= set(users), users
+        # group-synced deletions still converge (bob is in the
+        # ledger now that site1's reconcile propagated him)
+        c1.request("DELETE", "/minio/admin/v1/users",
+                   query={"accessKey": "bob"})
+        admin(c1, "POST", "reconcile")
+        users0 = json.loads(c0.request(
+            "GET", "/minio/admin/v1/users")[2])["users"]
+        assert "bob" not in users0, users0
