@@ -76,6 +76,22 @@ def _ecio_mod():
 # Process-wide mesh for multi-device codec placement (built lazily).
 _MESH = None
 
+# Per-thread pair of alternating fused-encode output buffers for the
+# double-buffered pipeline (same page-fault economics as ecio_native's
+# single _arena_buf: a fresh 2x ~50 MB allocation per multipart part
+# would cost more in faults than the overlap saves).  One pipelined
+# encode per thread at a time, and StagePipeline joins its in-flight
+# write before returning, so reuse across calls is safe.
+_DB_ARENAS = __import__("threading").local()
+
+
+def _db_arenas(nbytes: int) -> list:
+    pair = getattr(_DB_ARENAS, "pair", None)
+    if pair is None or pair[0].size < nbytes:
+        pair = [np.empty(nbytes, dtype=np.uint8) for _ in range(2)]
+        _DB_ARENAS.pair = pair
+    return pair
+
 
 def _mesh_mode() -> bool:
     """Whether the engine places codec work on a multi-device mesh.
@@ -93,6 +109,18 @@ def _mesh_mode() -> bool:
         return False
     import jax
     return jax.default_backend() == "tpu" and len(jax.devices()) > 1
+
+
+def _get_fastpath() -> bool:
+    """Healthy-read verify-only fast path gate (MTPU_GET_FASTPATH).
+
+    Default on: when all k data shards are present, `_read_part`
+    dispatches a batched verify-only bitrot check and assembles the
+    object from systematic shard slices with zero GF(2^8) work.
+    MTPU_GET_FASTPATH=0 forces the fused verify+decode path — the
+    oracle the equivalence tests diff against (read per call so tests
+    can flip it without re-importing)."""
+    return os.environ.get("MTPU_GET_FASTPATH", "1") != "0"
 
 
 def _etag(data: bytes) -> str:
@@ -140,12 +168,27 @@ class ErasureSet:
         self.mrf = None
         self._dirty_tracker = None
         self._bucket_cache: dict[str, float] = {}
+        # Parsed-quorum FileInfo cache for the GET fan-out: a ranged GET
+        # split into N segment requests must not re-read and re-elect
+        # xl.meta N times.  Entries are (bucket generation, stamp, fi,
+        # metas, errs); any write path bumps the bucket's generation via
+        # _mark_dirty, and a short TTL bounds cross-process staleness
+        # exactly like the bucket-existence cache above.
+        self._fi_cache: dict[tuple, tuple] = {}
+        self._fi_gen: dict[str, int] = {}
         from .metacache import Metacache
         self.metacache = Metacache(self)
+
+    #: FileInfo-cache tuning: TTL matches the bucket-existence cache
+    #: window; the size cap only matters for pathological key churn
+    #: (clearing wholesale is fine — it is a latency cache, not state).
+    _FI_CACHE_TTL = 2.0
+    _FI_CACHE_MAX = 512
 
     def _mark_dirty(self, bucket: str) -> None:
         if self._dirty_tracker is not None:
             self._dirty_tracker.mark(bucket)
+        self._fi_gen[bucket] = self._fi_gen.get(bucket, 0) + 1
         self.metacache.bump(bucket)
 
     # -- codec helpers -------------------------------------------------------
@@ -615,8 +658,14 @@ class ErasureSet:
             isinstance(d, (LocalDrive, type(None)))
             for d in (self.drives if drives is None else drives))
 
-    def _map_drives_positions(self, fn) -> list:
-        if self._serial_local():
+    def _map_drives_positions(self, fn, parallel: bool = False) -> list:
+        """Like _map_drives but fn gets the drive *position*.
+
+        ``parallel=True`` forces the pool fan-out even on the 1-core
+        host — for syscall-heavy per-drive work (multipart complete's
+        publish: per-part stat + meta read + renames) where the GIL is
+        released in the kernel and overlap beats pool overhead."""
+        if not parallel and self._serial_local():
             out = []
             for pos in range(self.n):
                 try:
@@ -640,7 +689,9 @@ class ErasureSet:
         out = [bytearray() for _ in range(k + m)]
         for framed in self._encode_stream(data, k, m, algo):
             for i, b in enumerate(framed):
-                out[i] += b
+                # Frames arrive as ndarray views (fused kernel) or bytes
+                # (CPU tail); bytearray += needs a buffer, not an array.
+                out[i] += memoryview(b) if isinstance(b, np.ndarray) else b
         return [bytes(b) for b in out]
 
     def _encode_stream(self, data: bytes, k: int, m: int,
@@ -652,7 +703,8 @@ class ErasureSet:
         yield from self._encode_chunks(chunks, k, m, algo)
 
     def _encode_chunks(self, chunks, k: int, m: int,
-                       algo: str | None = None):
+                       algo: str | None = None,
+                       double_buffer: bool = False):
         """Encode an iterator of (chunk, is_last) pairs — every chunk a
         multiple of BLOCK_SIZE except the final one — yielding lists of
         n framed shard-chunks.  Memory is O(chunk), never O(object).
@@ -660,6 +712,14 @@ class ErasureSet:
         Full 1 MiB blocks are encoded as one batched device dispatch
         ((B, K, S) uint8); the partial tail block goes through the CPU
         oracle codec (tiny, not worth a dispatch).
+
+        ``double_buffer=True`` makes every yielded batch safe to consume
+        asynchronously while the NEXT batch encodes: the fused host
+        kernel normally writes into one reused per-thread arena (valid
+        only until the next put_frame on that thread), so a pipelined
+        caller that overlaps shard writes of batch *i* with the encode
+        of batch *i+1* must get alternating buffers.  The device/mesh/
+        numpy paths allocate fresh frames per batch and need no copy.
         """
         if algo is None:
             algo = bitrot_io.write_algo()
@@ -690,6 +750,9 @@ class ErasureSet:
         # and the caller's disk writes, the role of the reference's
         # in-flight parallelWriter (cmd/erasure-encode.go:36).
         pending = None
+        arenas = None       # two alternating fused-output buffers
+        flip = 0
+        frame_len = bitrot_io.digest_size("mxh256") + shard_size
         for chunk, is_last in chunks:
             buf = np.frombuffer(chunk, dtype=np.uint8)
             n_full = buf.size // BLOCK_SIZE
@@ -706,7 +769,17 @@ class ErasureSet:
                     blocks[:, :BLOCK_SIZE] = batch.reshape(nb, BLOCK_SIZE)
                     blocks = blocks.reshape(nb, k, shard_size)
                 if fused_host is not None:
-                    yield fused_host.put_frame(blocks, k, m)
+                    if double_buffer:
+                        per = BATCH_BLOCKS * frame_len
+                        if arenas is None:
+                            arenas = _db_arenas((k + m) * per)
+                        a = arenas[flip]
+                        flip ^= 1
+                        outs = [a[i * per:i * per + nb * frame_len]
+                                for i in range(k + m)]
+                        yield fused_host.put_frame(blocks, k, m, outs=outs)
+                    else:
+                        yield fused_host.put_frame(blocks, k, m)
                     continue
                 # Parity AND bitrot digests in ONE device dispatch
                 # (north-star config #5 PUT side, ops/fused.py); framing
@@ -758,20 +831,58 @@ class ErasureSet:
         """Read [offset, offset+length) of an object, verifying bitrot and
         reconstructing up to `parity` missing/corrupt shards.
 
+        Segment reads assemble straight into ONE preallocated bytearray
+        (each `_read_part` gathers into its slice of the final buffer),
+        so the object is never joined through an extra full-size copy;
+        the return is that memoryview-backed bytearray (bytes-compatible
+        for hashing/slicing/IO).
+
         cf. GetObjectNInfo → getObjectWithFileInfo,
         /root/reference/cmd/erasure-object.go:221.
         """
-        fi, it = self.get_object_iter(bucket, obj, offset, length,
-                                      version_id)
-        return fi, b"".join(it)
+        fi, metas, offset, length = self._plan_read(bucket, obj, offset,
+                                                    length, version_id)
+        if length == 0:
+            return fi, b""
+        data = self._read_whole_small(bucket, obj, fi, metas, version_id)
+        if data is not None:
+            # Inline/v1 objects are small — the (rare) ranged slice copy
+            # is cheaper than making every caller memoryview-safe.
+            if offset == 0 and length == len(data):
+                return fi, data
+            return fi, data[offset:offset + length]
 
-    def get_object_iter(self, bucket: str, obj: str, offset: int = 0,
-                        length: int = -1, version_id: str = ""):
-        """Streaming read: returns (fi, iterator of assembled byte
-        chunks), each chunk one device batch (<= BATCH_BLOCKS blocks) of
-        verified+decoded data — memory is O(batch), never O(object)
-        (the GetObjectReader role, cmd/object-api-utils.go:392-528)."""
-        fi, metas, errs = self._read_metadata(bucket, obj, version_id)
+        buf = bytearray(length)
+        mv = memoryview(buf)
+        segs = self._plan_segments(fi, offset, length)
+        offs = []
+        o = 0
+        for _, _, ln in segs:
+            offs.append(o)
+            o += ln
+        degraded = (any(d is None for d in self.drives)
+                    or any(m is None for m in metas))
+
+        def read_seg(i):
+            pn, off, ln = segs[i]
+            self._read_part(bucket, obj, fi, part_number=pn,
+                            offset=off, length=ln,
+                            dst=mv[offs[i]:offs[i] + ln],
+                            healthy=not degraded)
+        if self._serial_local() and not degraded:
+            for i in range(len(segs)):
+                read_seg(i)
+        else:
+            for _ in pl.prefetch_map(read_seg, range(len(segs)),
+                                     self._iter_pool, depth=1):
+                pass
+        return fi, buf
+
+    def _plan_read(self, bucket, obj, offset, length, version_id):
+        """Shared GET front half: cached metadata election + range
+        validation.  Returns (fi, metas, offset, resolved_length)."""
+        fi, metas, errs = self._read_metadata_cached(bucket, obj,
+                                                     version_id)
         if fi.deleted:
             raise ErrObjectNotFound(f"{bucket}/{obj} (delete marker)")
         size = fi.size
@@ -782,31 +893,35 @@ class ErasureSet:
         if offset + length > size:
             raise StorageError(f"range [{offset}, {offset + length}) "
                                f"outside object of size {size}")
-        if length == 0 or size == 0:
-            return fi, iter(())
+        if size == 0:
+            length = 0
+        return fi, metas, offset, length
 
+    def _read_whole_small(self, bucket, obj, fi, metas, version_id):
+        """Inline / legacy-v1 whole-object read, or None for the
+        streaming erasure layout."""
         if fi.inline_data is not None or (fi.parts and not fi.data_dir):
-            data = self._read_inline(bucket, obj, fi, metas, version_id)
-            return fi, iter((data[offset:offset + length],))
-
+            return self._read_inline(bucket, obj, fi, metas, version_id)
         from ..storage import xlmeta_v1
         if xlmeta_v1.is_v1(fi):
             # Legacy format-v1 object: unframed shard files with
             # whole-file bitrot, 10 MiB blocks (migration read path,
             # cmd/xl-storage-format-v1.go + cmd/bitrot-whole.go).
-            data = self._read_v1_object(bucket, obj, fi)
-            return fi, iter((data[offset:offset + length],))
+            return self._read_v1_object(bucket, obj, fi)
+        return None
 
-        # Segment size: one bounded device dispatch per yield on TPU; on
-        # the host path, 16 MiB keeps the gather buffer under glibc's
-        # mmap threshold so successive segments recycle the same pages
-        # (a fresh 32 MiB allocation pays ~0.5 ms/MiB in page faults).
+    def _plan_segments(self, fi, offset: int,
+                       length: int) -> list[tuple[int, int, int]]:
+        """Map an object byte range onto batch-aligned per-part segments.
+
+        Segment size: one bounded device dispatch per segment on TPU; on
+        the host path, 16 MiB keeps the gather buffer under glibc's
+        mmap threshold so successive segments recycle the same pages
+        (a fresh 32 MiB allocation pays ~0.5 ms/MiB in page faults).
+        Each part is an independent EC stream (cf. ObjectToPartOffset,
+        cmd/erasure-metadata.go)."""
         batch_bytes = (BATCH_BLOCKS if self._use_device
                        else BATCH_BLOCKS // 2) * BLOCK_SIZE
-
-        # Map the object byte range onto parts (each part an independent
-        # EC stream; cf. ObjectToPartOffset, cmd/erasure-metadata.go),
-        # then walk each in-part range in batch-aligned segments.
         segs: list[tuple[int, int, int]] = []   # (part_number, off, len)
         part_start = 0
         remaining = length
@@ -830,6 +945,28 @@ class ErasureSet:
                 pos += in_len
                 remaining -= in_len
             part_start = part_end
+        return segs
+
+    def get_object_iter(self, bucket: str, obj: str, offset: int = 0,
+                        length: int = -1, version_id: str = ""):
+        """Streaming read: returns (fi, iterator of assembled byte
+        chunks), each chunk one device batch (<= BATCH_BLOCKS blocks) of
+        verified+decoded data — memory is O(batch), never O(object)
+        (the GetObjectReader role, cmd/object-api-utils.go:392-528)."""
+        fi, metas, offset, length = self._plan_read(bucket, obj, offset,
+                                                    length, version_id)
+        if length == 0:
+            return fi, iter(())
+
+        data = self._read_whole_small(bucket, obj, fi, metas, version_id)
+        if data is not None:
+            if offset == 0 and length == len(data):
+                return fi, iter((data,))
+            # Zero-copy range: the consumer (socket writer) takes any
+            # buffer, so slice through a memoryview instead of copying.
+            return fi, iter((memoryview(data)[offset:offset + length],))
+
+        segs = self._plan_segments(fi, offset, length)
 
         # One-segment prefetch: segment i+1's drive reads + fused
         # verify/decode dispatch run while segment i drains to the
@@ -849,7 +986,8 @@ class ErasureSet:
         def read_seg(seg):
             pn, off, ln = seg
             return self._read_part(bucket, obj, fi, part_number=pn,
-                                   offset=off, length=ln)
+                                   offset=off, length=ln,
+                                   healthy=not degraded)
         return fi, pl.prefetch_map(read_seg, segs, pool, depth=1)
 
     def _read_v1_object(self, bucket, obj, fi) -> bytes:
@@ -964,6 +1102,32 @@ class ErasureSet:
         fi = Q.find_file_info_in_quorum(metas, read_quorum)
         return fi, metas, errs
 
+    def _fi_cache_store(self, bucket, obj, version_id, entry) -> None:
+        if len(self._fi_cache) >= self._FI_CACHE_MAX:
+            self._fi_cache.clear()
+        key = (bucket, obj, normalize_version_id(version_id))
+        self._fi_cache[key] = (self._fi_gen.get(bucket, 0),
+                               time.monotonic(), *entry)
+
+    def _read_metadata_cached(self, bucket, obj, version_id=""):
+        """GET-path metadata election with the parsed-quorum cache: a
+        ranged GET fanned out as N segment requests (or HEAD followed by
+        GET in the same request) elects xl.meta once, not N times.  Any
+        write through this set bumps the bucket generation (_mark_dirty)
+        and invalidates immediately; a short TTL bounds what another
+        process's write can leave stale, same policy as bucket_exists."""
+        key = (bucket, obj, normalize_version_id(version_id))
+        hit = self._fi_cache.get(key)
+        if hit is not None:
+            gen, stamp, fi, metas, errs = hit
+            if (gen == self._fi_gen.get(bucket, 0)
+                    and time.monotonic() - stamp < self._FI_CACHE_TTL):
+                return fi, metas, errs
+            self._fi_cache.pop(key, None)
+        entry = self._read_metadata(bucket, obj, version_id)
+        self._fi_cache_store(bucket, obj, version_id, entry)
+        return entry
+
     def _read_inline(self, bucket, obj, fi, metas, version_id) -> bytes:
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         dist = fi.erasure.distribution
@@ -979,12 +1143,27 @@ class ErasureSet:
                 shard_bytes[dist[pos] - 1] = meta.inline_data
         return self._decode_shard_files(shard_bytes, fi, fi.size)
 
-    def _read_part(self, bucket, obj, fi, part_number, offset, length) -> bytes:
+    def _read_part(self, bucket, obj, fi, part_number, offset, length,
+                   dst=None, healthy=None):
         """Ranged read of one part: fetch only the frames covering the
         block range, then run bitrot verify + reconstruction of missing
         rows as ONE fused device dispatch (north-star config #5; the
         parallelReader analogue of cmd/erasure-decode.go:101 with the
         verifying ReadAt of cmd/bitrot-streaming.go:142 moved on-device).
+
+        HEALTHY reads (all k data shards present, metas agreed) take the
+        verify-only fast path instead: batched bitrot VERDICTS (fused
+        host kernel / device digests / pooled HighwayHash) plus a
+        systematic gather — zero GF(2^8) work, since the data shards of
+        a systematic code already are the plaintext.  Any verify or read
+        failure falls back to the decode path below, which is also the
+        byte-exactness oracle (MTPU_GET_FASTPATH=0).
+
+        `dst`: optional writable memoryview of exactly `length` bytes;
+        when given, the result is assembled straight into it (the
+        get_object zero-copy assembly) and None is returned.  `healthy`:
+        tri-state hint from the caller's metadata election (False =
+        metas disagreed somewhere, skip the fast path).
 
         A digest mismatch is handled exactly like an I/O failure: the
         corrupt row is dropped and a spare shard is fetched.
@@ -1056,6 +1235,124 @@ class ErasureSet:
                       if self.drives[order[s]] is not None]
         degraded = any(s < k for s in range(k + m) if s not in candidates)
         t_deg = time.monotonic() if degraded else 0.0
+        lo = offset - b0 * BLOCK_SIZE
+
+        def fast_path():
+            """Verify-only healthy read.  Returns (res,) on success or
+            None to fall back (bad rows already dropped from `rows` so
+            the decode loop goes straight to the parity spares)."""
+            t0 = time.monotonic()
+            want = [s for s in range(k) if s not in rows]
+            tried.update(want)
+            if self._serial_local():
+                for s in want:
+                    rows[s] = read_shard(order[s])
+            else:
+                futs = {s: self.pool.submit(read_shard, order[s])
+                        for s in want}
+                first_err = None
+                for s, fut in futs.items():
+                    try:
+                        rows[s] = fut.result()
+                    except Exception as e:  # noqa: BLE001
+                        first_err = first_err or e
+                if first_err is not None:
+                    raise first_err
+            full_bytes = nb * k * shard_size       # == nb * BLOCK_SIZE
+            aligned = (dst is not None and lo == 0
+                       and length >= full_bytes)
+            body = dst[:full_bytes] if aligned else None
+            t_read = time.monotonic()
+            asm_s = 0.0
+            y = None
+            if nb and fused_host is not None:
+                # mxh256 host: ONE C pass verifies every frame AND
+                # gathers the systematic rows straight into the final
+                # object buffer — targets=[] means the GF unit is never
+                # entered (verify time below includes that gather).
+                y, okf, nbad = fused_host.get_verify(
+                    [rows[s][3] for s in range(k)], list(range(k)),
+                    nb, shard_size, k, m, [], out=body)
+                if nbad:
+                    for j in range(k):
+                        if not okf[j]:
+                            del rows[j]
+                    return None
+            elif nb:
+                # Gather first (it IS the assembly either way), then
+                # hash-verify: the device kernel returns verdict
+                # digests only — no decoded blocks cross back — and
+                # HighwayHash/host algos digest the mmap'd frames in
+                # place via the strided kernel on the worker pool.
+                tg = time.monotonic()
+                if body is not None:
+                    y = np.frombuffer(body, dtype=np.uint8).reshape(
+                        nb, k, shard_size)
+                else:
+                    y = np.empty((nb, k, shard_size), dtype=np.uint8)
+                for s in range(k):
+                    y[:, s, :] = rows[s][1]
+                asm_s += time.monotonic() - tg
+                if algo in fused.DEVICE_ALGOS and self._use_device \
+                        and bitrot_io.device_preferred(algo) \
+                        and not _mesh_mode():
+                    digests = np.asarray(fused.verify_and_transform(
+                        y, k, m, tuple(range(k)), (), algo=algo)[0])
+                    got = [digests[:, s] for s in range(k)]
+                else:
+                    got = self._hash_shard_frames(
+                        [rows[s][3] for s in range(k)], nb, shard_size,
+                        hs, algo)
+                bad = [s for s in range(k)
+                       if not np.array_equal(got[s], rows[s][0])]
+                if bad:
+                    for s in bad:
+                        del rows[s]
+                    return None
+            t_verify = time.monotonic()
+            ta = t_verify
+            tail_np = None
+            if has_tail:
+                tail_np = np.concatenate(
+                    [rows[s][2] for s in range(k)])[:geo["tail_len"]]
+            if aligned:
+                if tail_np is not None and length > full_bytes:
+                    dst[full_bytes:length] = memoryview(
+                        np.ascontiguousarray(
+                            tail_np[:length - full_bytes]))
+                res = None
+            else:
+                flat = (y.reshape(-1) if nb
+                        else np.zeros(0, dtype=np.uint8))
+                data = (np.concatenate([flat, tail_np])
+                        if tail_np is not None else flat)
+                view = data[lo:lo + length]
+                if dst is not None:
+                    dst[:length] = memoryview(np.ascontiguousarray(view))
+                    res = None
+                elif view.size == data.size:
+                    res = memoryview(view)
+                else:
+                    res = view.tobytes()
+            done = time.monotonic()
+            DATA_PATH.record_healthy_read(
+                length, read_s=t_read - t0, verify_s=t_verify - t_read,
+                assemble_s=asm_s + (done - ta))
+            return (res,)
+
+        # BLOCK_SIZE % k gate: the padded (non-dividing k) layout needs
+        # per-block trimming, which the generic assembly already does.
+        if (_get_fastpath() and healthy is not False and not degraded
+                and BLOCK_SIZE % k == 0
+                and all(s in candidates for s in range(k))):
+            try:
+                got = fast_path()
+            except (StorageError, OSError):
+                got = None
+            if got is not None:
+                return got[0]
+            DATA_PATH.record_fastpath_fallback()
+
         sel: list[int] = []
         missing: list[int] = []
         out = None
@@ -1183,7 +1480,6 @@ class ErasureSet:
         if has_tail:
             tail_block = np.concatenate([tails[s] for s in range(k)])
             pieces.append(tail_block[:geo["tail_len"]])
-        lo = offset - b0 * BLOCK_SIZE
         if not pieces:
             res: bytes | memoryview = b""
         elif len(pieces) == 1:
@@ -1204,7 +1500,41 @@ class ErasureSet:
         if degraded:
             DATA_PATH.record_degraded_read(length,
                                            time.monotonic() - t_deg)
+        if dst is not None:
+            # Fallback/decode result lands in the caller's buffer too —
+            # one copy, same as the join it replaces.
+            dst[:length] = res
+            return None
         return res
+
+    def _hash_shard_frames(self, bufs: list, nb: int, shard_size: int,
+                           hs: int, algo: str) -> list[np.ndarray]:
+        """Per-shard frame digests for the verify-only fast path.
+
+        bufs[s] holds shard s's nb frames of (hs | shard_size).
+        HighwayHash goes through the strided native kernel (digesting
+        the frame data regions in place, no gather copy); other host
+        algorithms hash via the batch hasher.  On multi-core hosts each
+        shard is one worker-pool task — the native hash releases the
+        GIL, so k shards verify concurrently; the 1-core bench host
+        keeps the serial policy every other fan-out uses."""
+        frame = hs + shard_size
+
+        if algo.startswith("highwayhash") and bitrot_io._hh_native():
+            from native.hh_native import hh256_frames_native
+
+            def one(buf):
+                return hh256_frames_native(buf, nb, frame, hs,
+                                           shard_size)
+        else:
+            def one(buf):
+                rows = np.ascontiguousarray(
+                    np.frombuffer(buf, dtype=np.uint8).reshape(
+                        nb, frame)[:, hs:])
+                return bitrot_io._hash_batch(rows, algo)
+        if self._serial_local():
+            return [one(b) for b in bufs]
+        return list(self.pool.map(one, bufs))
 
     @staticmethod
     def _range_geometry(fi, part_size: int, b0: int, b1: int) -> dict:
@@ -1334,7 +1664,12 @@ class ErasureSet:
 
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
-        fi, _, _ = self._read_metadata(bucket, obj, version_id)
+        # HEAD always stats (a peer's write must be visible immediately)
+        # but WRITES THROUGH the FileInfo cache: the common HEAD-then-GET
+        # of one server request elects xl.meta once.
+        entry = self._read_metadata(bucket, obj, version_id)
+        self._fi_cache_store(bucket, obj, version_id, entry)
+        fi = entry[0]
         if fi.deleted and not version_id:
             raise ErrObjectNotFound(f"{bucket}/{obj} (delete marker)")
         return fi

@@ -18,6 +18,8 @@ import hashlib
 import time
 import uuid
 
+from ..observe.metrics import DATA_PATH
+from ..parallel import pipeline as pl
 from ..storage import bitrot_io
 from ..storage.drive import MULTIPART_DIR, SYS_VOL, TMP_DIR
 from ..storage.errors import (ErrErasureWriteQuorum, ErrFileNotFound,
@@ -26,7 +28,7 @@ from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo,
                               XLMeta, new_uuid)
 from ..utils import msgpackx, streams
 from . import quorum as Q
-from .erasure_set import BLOCK_SIZE, ErasureSet
+from .erasure_set import BATCH_BLOCKS, BLOCK_SIZE, ErasureSet
 
 MIN_PART_SIZE = 5 * 1024 * 1024        # S3 minimum for all but the last part
 MAX_PARTS = 10_000                     # docs/minio-limits.md:24-29
@@ -111,12 +113,28 @@ def _read_upload_fi(es: ErasureSet, bucket: str, obj: str,
     return next(m for m in metas if m is not None)
 
 
+def _part_meta_blob(part_number: int, etag: str, total: int,
+                    algo: str) -> bytes:
+    return msgpackx.packb({
+        "n": part_number, "etag": etag, "size": total,
+        "as": total, "mt": time.time_ns(), "algo": algo})
+
+
 def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
                     part_number: int, data) -> ObjectPartInfo:
     """Encode one part as its own EC stream into the upload's staging dir
     (cf. PutObjectPart, erasure-multipart.go:400).  `data` is bytes or a
     reader — a reader streams through encode in O(batch) memory exactly
-    like ErasureSet.put_object."""
+    like ErasureSet.put_object.
+
+    The encode→write loop is a bounded StagePipeline: the shard appends
+    of batch *i* run on the iter pool while batch *i+1* encodes on the
+    caller's thread (the fused kernel and file IO both release the GIL,
+    so the two stages genuinely overlap even on one core).  The encode
+    is double-buffered so the in-flight batch survives the next fused
+    put_frame.  Parts that fit one device batch skip staging-then-rename
+    round trips: one encode, then a single per-drive fan-out that writes
+    shard + rename + part meta together."""
     if not 1 <= part_number <= MAX_PARTS:
         raise ErrInvalidPart(f"part number {part_number}")
     fi = _read_upload_fi(es, bucket, obj, upload_id)
@@ -133,43 +151,92 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
     # re-upload of the same part can't interleave appends.
     stage = f"{path}/stage-{uuid.uuid4().hex}.{part_number}"
     algo = bitrot_io.write_algo()
+
+    if stream is None and 0 < len(data) <= BATCH_BLOCKS * BLOCK_SIZE:
+        # Small-part fast path (covers every trailing part of a large
+        # upload): ONE device/native dispatch encodes the whole part,
+        # then ONE fan-out per drive does shard write + publish rename +
+        # part meta — instead of the streaming path's three rounds
+        # (append, rename, meta) per drive.
+        t0 = time.perf_counter()
+        total = len(data)
+        etag = hashlib.md5(data).hexdigest()
+        per_drive = Q.unshuffle_to_drives(
+            es._encode_full(bytes(data), k, m, algo), ec.distribution)
+        part_meta = _part_meta_blob(part_number, etag, total, algo)
+        t1 = time.perf_counter()
+
+        def put_one(pos):
+            d = es.drives[pos]
+            if d is None:
+                raise ErrFileNotFound("offline")
+            d.create_file(SYS_VOL, stage, per_drive[pos])
+            d.rename_file(SYS_VOL, stage, SYS_VOL,
+                          f"{path}/part.{part_number}")
+            d.write_all(SYS_VOL, f"{path}/part.{part_number}.meta",
+                        part_meta)
+
+        try:
+            res = es._map_drives_positions(put_one)
+            err = Q.reduce_write_quorum_errs([e for _, e in res],
+                                             write_quorum)
+            if err is not None:
+                raise err
+        finally:
+            _cleanup_stage(es, stage)
+        DATA_PATH.record_mp_batch(total, t1 - t0,
+                                  time.perf_counter() - t1)
+        return ObjectPartInfo(number=part_number, size=total,
+                              actual_size=total, etag=etag)
+
     failed = [d is None for d in es.drives]
     md5 = hashlib.md5()
     total = 0
 
     def counted_chunks():
         nonlocal total
-        from ..engine.erasure_set import BATCH_BLOCKS, BLOCK_SIZE
         for chunk, is_last in streams.batched_chunks(
                 data, stream, BATCH_BLOCKS * BLOCK_SIZE):
             md5.update(chunk)
             total += len(chunk)
             yield chunk, is_last
 
+    def shuffle(batch_shards):
+        return Q.unshuffle_to_drives(batch_shards, ec.distribution)
+
+    def write_batch(per_drive):
+        def write_one(pos):
+            d = es.drives[pos]
+            if d is None or failed[pos]:
+                return
+            d.append_file(SYS_VOL, stage, per_drive[pos])
+
+        for pos, (_, e) in enumerate(
+                es._map_drives_positions(write_one)):
+            if e is not None:
+                failed[pos] = True
+        if sum(1 for f in failed if not f) < write_quorum:
+            raise ErrErasureWriteQuorum(
+                f"{sum(1 for f in failed if not f)} < {write_quorum}")
+
+    seen = [0]
+
+    def record(read_s, compute_s, write_s):
+        nbytes, seen[0] = total - seen[0], total
+        DATA_PATH.record_mp_batch(nbytes, read_s + compute_s, write_s)
+
     try:
-        for batch_shards in es._encode_chunks(counted_chunks(), k, m,
-                                              algo):
-            per_drive = Q.unshuffle_to_drives(batch_shards,
-                                              ec.distribution)
-
-            def write_one(pos):
-                d = es.drives[pos]
-                if d is None or failed[pos]:
-                    return
-                d.append_file(SYS_VOL, stage, per_drive[pos])
-
-            for pos, (_, e) in enumerate(
-                    es._map_drives_positions(write_one)):
-                if e is not None:
-                    failed[pos] = True
-            if sum(1 for f in failed if not f) < write_quorum:
-                raise ErrErasureWriteQuorum(
-                    f"{es.n - sum(failed)} < {write_quorum}")
+        # Encode of batch i+1 (the `reads` pull) overlaps the shard
+        # appends of batch i (one write in flight keeps per-drive
+        # append order).  double_buffer: the async batch must survive
+        # the next fused put_frame's arena reuse.
+        pl.StagePipeline(es._iter_pool).run(
+            es._encode_chunks(counted_chunks(), k, m, algo,
+                              double_buffer=True),
+            shuffle, write_batch, on_batch=record)
 
         etag = md5.hexdigest()
-        part_meta = msgpackx.packb({
-            "n": part_number, "etag": etag, "size": total,
-            "as": total, "mt": time.time_ns(), "algo": algo})
+        part_meta = _part_meta_blob(part_number, etag, total, algo)
 
         def publish(pos):
             d = es.drives[pos]
@@ -215,14 +282,13 @@ def _list_parts_with_algos(es: ErasureSet, bucket: str, obj: str,
     """Part list + per-part bitrot algo map from the part metas."""
     _read_upload_fi(es, bucket, obj, upload_id)  # validates upload
     path = _upload_path(bucket, obj, upload_id)
-    votes: dict[tuple, int] = {}
-    for d in es.drives:
-        if d is None:
-            continue
+
+    def scan(d) -> list[tuple]:
+        keys = []
         try:
             names = d.list_raw(SYS_VOL, path)
         except StorageError:
-            continue
+            return keys
         for name in names:
             if not name.endswith(".meta") or not name.startswith("part."):
                 continue
@@ -230,8 +296,15 @@ def _list_parts_with_algos(es: ErasureSet, bucket: str, obj: str,
                 pm = msgpackx.unpackb(d.read_all(SYS_VOL, f"{path}/{name}"))
             except StorageError:
                 continue
-            key = (pm["n"], pm["etag"], pm["size"], pm["as"],
-                   pm.get("algo", "highwayhash256S"))
+            keys.append((pm["n"], pm["etag"], pm["size"], pm["as"],
+                         pm.get("algo", "highwayhash256S")))
+        return keys
+
+    # One listing + meta-read sweep per drive, fanned out on the pool
+    # (each sweep is a burst of small GIL-releasing syscalls).
+    votes: dict[tuple, int] = {}
+    for keys, _ in es._map_drives(scan):
+        for key in keys or ():
             votes[key] = votes.get(key, 0) + 1
     quorum = es._live_quorum()
     best: dict[int, tuple] = {}
@@ -381,9 +454,14 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
     # The publish mutates the object namespace: hold the same write lock
     # as PUT/DELETE so a concurrent overwrite can't interleave per-drive
     # metadata writes (cf. NSLock in CompleteMultipartUpload,
-    # erasure-multipart.go:771).
+    # erasure-multipart.go:771).  Each drive's publish is a chain of
+    # stats + meta reads + renames — force the pool fan-out so the
+    # per-drive chains assemble concurrently instead of serially, even
+    # on the 1-core host (the work is syscalls, not Python).
+    t0 = time.perf_counter()
     with es.nslock.write_locked(bucket, obj, timeout=30.0):
-        res = es._map_drives_positions(publish)
+        res = es._map_drives_positions(publish, parallel=True)
+    DATA_PATH.record_mp_complete(time.perf_counter() - t0)
     errs = [e for _, e in res]
     err = Q.reduce_write_quorum_errs(errs, write_quorum)
     if err is not None:

@@ -182,6 +182,21 @@ class DataPathStats:
             self.degraded_reads = 0
             self.degraded_bytes = 0
             self.degraded_s = 0.0
+            # Healthy-read fast path (verify-only verdicts + systematic
+            # gather; on the fused host route verify_s includes the
+            # gather — it is one C pass).
+            self.healthy_reads = 0
+            self.healthy_bytes = 0
+            self.healthy_stage_s = {"read": 0.0, "verify": 0.0,
+                                    "assemble": 0.0}
+            self.fastpath_fallbacks = 0
+            # Multipart PUT pipeline stages (encode of batch i+1
+            # overlaps the shard writes of batch i, so wall time is
+            # less than the stage sums).
+            self.mp_batches = 0
+            self.mp_bytes = 0
+            self.mp_stage_s = {"encode": 0.0, "write": 0.0,
+                               "complete": 0.0}
 
     def record_heal_batch(self, blocks: int, capacity: int,
                           source_bytes: int, out_bytes: int,
@@ -207,6 +222,31 @@ class DataPathStats:
             self.degraded_bytes += nbytes
             self.degraded_s += seconds
 
+    def record_healthy_read(self, nbytes: int, read_s: float,
+                            verify_s: float, assemble_s: float) -> None:
+        with self._mu:
+            self.healthy_reads += 1
+            self.healthy_bytes += nbytes
+            self.healthy_stage_s["read"] += read_s
+            self.healthy_stage_s["verify"] += verify_s
+            self.healthy_stage_s["assemble"] += assemble_s
+
+    def record_fastpath_fallback(self) -> None:
+        with self._mu:
+            self.fastpath_fallbacks += 1
+
+    def record_mp_batch(self, nbytes: int, encode_s: float,
+                        write_s: float) -> None:
+        with self._mu:
+            self.mp_batches += 1
+            self.mp_bytes += nbytes
+            self.mp_stage_s["encode"] += encode_s
+            self.mp_stage_s["write"] += write_s
+
+    def record_mp_complete(self, seconds: float) -> None:
+        with self._mu:
+            self.mp_stage_s["complete"] += seconds
+
     def snapshot(self) -> dict:
         with self._mu:
             return {
@@ -223,6 +263,13 @@ class DataPathStats:
                 "degraded_reads": self.degraded_reads,
                 "degraded_bytes": self.degraded_bytes,
                 "degraded_seconds": self.degraded_s,
+                "healthy_reads": self.healthy_reads,
+                "healthy_bytes": self.healthy_bytes,
+                "healthy_stage_s": dict(self.healthy_stage_s),
+                "fastpath_fallbacks": self.fastpath_fallbacks,
+                "mp_batches": self.mp_batches,
+                "mp_bytes": self.mp_bytes,
+                "mp_stage_s": dict(self.mp_stage_s),
             }
 
 
@@ -276,6 +323,30 @@ class MetricsRegistry:
         self.degraded_seconds = Gauge(
             "mtpu_degraded_read_seconds_total",
             "Time spent reconstructing degraded reads")
+        # Healthy-read fast-path families: verify-only verdicts +
+        # systematic assembly, zero GF(2^8) work (MTPU_GET_FASTPATH).
+        self.healthy_reads = Gauge(
+            "mtpu_healthy_reads_total",
+            "GET segments served by the verify-only fast path")
+        self.healthy_bytes = Gauge(
+            "mtpu_healthy_read_bytes_total",
+            "Bytes served through the verify-only fast path")
+        self.healthy_stage_seconds = Gauge(
+            "mtpu_healthy_read_stage_seconds_total",
+            "Healthy-read fast path time by stage", ("stage",))
+        self.fastpath_fallbacks = Gauge(
+            "mtpu_get_fastpath_fallbacks_total",
+            "Fast-path reads that fell back to verify+decode")
+        # Multipart PUT pipeline families.
+        self.mp_batches = Gauge(
+            "mtpu_multipart_put_batches_total",
+            "Encode batches through the multipart PUT pipeline")
+        self.mp_bytes = Gauge(
+            "mtpu_multipart_put_bytes_total",
+            "Part bytes through the multipart PUT pipeline")
+        self.mp_stage_seconds = Gauge(
+            "mtpu_multipart_put_stage_seconds_total",
+            "Multipart PUT pipeline time by stage", ("stage",))
         self.drive_online = Gauge("mtpu_cluster_drives_online",
                                   "Online drives")
         self.drive_offline = Gauge("mtpu_cluster_drives_offline",
@@ -343,6 +414,15 @@ class MetricsRegistry:
         self.degraded_reads.set(snap["degraded_reads"])
         self.degraded_bytes.set(snap["degraded_bytes"])
         self.degraded_seconds.set(snap["degraded_seconds"])
+        self.healthy_reads.set(snap["healthy_reads"])
+        self.healthy_bytes.set(snap["healthy_bytes"])
+        for stage, s in snap["healthy_stage_s"].items():
+            self.healthy_stage_seconds.set(s, stage=stage)
+        self.fastpath_fallbacks.set(snap["fastpath_fallbacks"])
+        self.mp_batches.set(snap["mp_batches"])
+        self.mp_bytes.set(snap["mp_bytes"])
+        for stage, s in snap["mp_stage_s"].items():
+            self.mp_stage_seconds.set(s, stage=stage)
 
     def render(self) -> str:
         self._sync_datapath()
@@ -354,7 +434,11 @@ class MetricsRegistry:
                   self.heal_source_bytes, self.heal_stage_seconds,
                   self.heal_batches, self.heal_batch_occupancy,
                   self.degraded_reads, self.degraded_bytes,
-                  self.degraded_seconds, self.drive_online,
+                  self.degraded_seconds, self.healthy_reads,
+                  self.healthy_bytes, self.healthy_stage_seconds,
+                  self.fastpath_fallbacks, self.mp_batches,
+                  self.mp_bytes, self.mp_stage_seconds,
+                  self.drive_online,
                   self.drive_offline, self.cache_hits, self.cache_misses,
                   self.cache_evictions, self.cache_usage,
                   self.cache_max):

@@ -27,6 +27,7 @@ Everything degrades to inline execution when no pool is given — the
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import FIRST_COMPLETED, Executor, wait
 
 
@@ -66,30 +67,69 @@ class StagePipeline:
     ``prefetch_map`` generator), calls ``compute`` inline, and submits
     ``write`` to the pool keeping exactly one outstanding — batch *i*'s
     decode overlaps batch *i−1*'s staging-file appends while preserving
-    append order. With ``pool=None`` every stage runs inline."""
+    append order. With ``pool=None`` every stage runs inline.
+
+    ``on_batch(read_s, compute_s, write_s)``, when given, is invoked
+    once per batch with wall-clock seconds spent pulling the item from
+    `reads`, in `compute`, and in `write` — the per-stage attribution
+    the bench and /metrics surface for the multipart PUT pipeline.
+    With a pool the write time reported alongside a batch is the
+    previous batch's (they overlap by design); only the aggregate sums
+    are meaningful."""
 
     def __init__(self, pool: Executor | None):
         self.pool = pool
 
-    def run(self, reads, compute, write) -> int:
+    def run(self, reads, compute, write, on_batch=None) -> int:
         n = 0
+        clock = time.perf_counter
+        it = iter(reads)
         if self.pool is None:
-            for item in reads:
-                write(compute(item))
+            while True:
+                t0 = clock()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                t1 = clock()
+                res = compute(item)
+                t2 = clock()
+                write(res)
+                if on_batch is not None:
+                    on_batch(t1 - t0, t2 - t1, clock() - t2)
                 n += 1
             return n
         wfut = None
+        pend_rs = pend_cs = 0.0
+
+        def timed_write(res):
+            t0 = clock()
+            write(res)
+            return clock() - t0
+
         try:
-            for item in reads:
+            while True:
+                t0 = clock()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                t1 = clock()
                 res = compute(item)
+                t2 = clock()
                 if wfut is not None:
-                    wfut.result()
+                    w_s = wfut.result()
                     wfut = None
-                wfut = self.pool.submit(write, res)
+                    if on_batch is not None:
+                        on_batch(pend_rs, pend_cs, w_s)
+                pend_rs, pend_cs = t1 - t0, t2 - t1
+                wfut = self.pool.submit(timed_write, res)
                 n += 1
             if wfut is not None:
-                wfut.result()
+                w_s = wfut.result()
                 wfut = None
+                if on_batch is not None:
+                    on_batch(pend_rs, pend_cs, w_s)
         finally:
             # compute/read raised with a write still in flight: the
             # caller is about to clean up staging files — wait for the

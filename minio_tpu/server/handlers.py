@@ -200,8 +200,10 @@ class S3Handlers:
                 h["Content-Range"] = (
                     f"bytes {off}-{off + ln - 1}/{len(data)}")
                 h["Content-Length"] = str(ln)
-                return Response(206,
-                                b"" if head else data[off:off + ln], h)
+                # memoryview: the socket writer takes any buffer — no
+                # copy of the ranged window.
+                return Response(
+                    206, b"" if head else memoryview(data)[off:off + ln], h)
         return Response(200, b"" if head else data, h)
 
     def _read_plaintext(self, bucket: str, key: str, version_id: str,
@@ -737,10 +739,13 @@ class S3Handlers:
                 # Ranged reads on transformed objects decode the whole
                 # stream then slice by logical offsets (cf. the decrypt/
                 # decompress cleanup stack in GetObjectReader,
-                # cmd/object-api-utils.go:528).
+                # cmd/object-api-utils.go:528).  The slice is a
+                # memoryview: the decoded plaintext is already the only
+                # full-size buffer, and the socket writer takes any
+                # buffer — no second copy of the ranged window.
                 fi, full = self._read_plaintext(bucket, key, version_id,
                                                 headers)
-                data = full[offset:offset + length]
+                data = memoryview(full)[offset:offset + length]
             else:
                 # Untransformed data streams straight off the erasure
                 # engine in device-batch chunks — O(batch) memory

@@ -210,6 +210,27 @@ int ec_get_verify(const uint8_t* const* frames, const int32_t* sel,
   return nbad;
 }
 
+// Healthy-GET verdict-only pass: hash-verify every frame of every
+// selected row, touch nothing else.  No gather, no GF — the fast path
+// asks "are all k data shards intact?" and, on yes, assembles the
+// object from systematic slices (they ARE the plaintext).  ok[j]
+// (init 1) is cleared on row j's first mismatch; returns bad rows.
+int ec_verify_frames(const uint8_t* const* frames, int ksel, int nb,
+                     size_t S, const int8_t* at, const int32_t* corr,
+                     const uint8_t* tag, uint8_t* ok, uint8_t* scratch) {
+  const size_t frame = 32 + S;
+  uint8_t digest[32];
+  int nbad = 0;
+  for (int j = 0; j < ksel; ++j) {
+    for (int b = 0; b < nb; ++b) {
+      const uint8_t* f = frames[j] + (size_t)b * frame;
+      mxh_row(f + 32, S, at, corr, tag, digest, scratch);
+      if (std::memcmp(digest, f, 32) != 0) { ok[j] = 0; ++nbad; break; }
+    }
+  }
+  return nbad;
+}
+
 // Whole-row GF transform with per-row pointers: dsts[t] = sum_c
 // M[t][c] * srcs[c] over len bytes — the heal path reconstructs full
 // logical shard rows without ever stacking them into a batch matrix.

@@ -76,6 +76,11 @@ def _load_inner():
         ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p]
+    lib.ec_verify_frames.restype = ctypes.c_int
+    lib.ec_verify_frames.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_size_t, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
     lib.ec_gf_rows.restype = None
     lib.ec_gf_rows.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p,
@@ -234,12 +239,16 @@ def put_frame(blocks: np.ndarray, k: int, m: int,
 
 
 def get_verify(frames: list, sel: list[int], nb: int, S: int, k: int,
-               m: int, targets: list[int]
+               m: int, targets: list[int], out=None
                ) -> tuple[np.ndarray, np.ndarray, int]:
     """Verify + gather + reconstruct one batch of framed shard segments.
 
     frames[j]: buffer (bytes/mmap/ndarray) holding nb frames of (32|S)
     for shard index sel[j]; len(frames) == len(sel) == the chosen K rows.
+    `out`: optional writable buffer of nb*k*S bytes the data rows are
+    gathered into directly (the healthy-GET fast path hands a slice of
+    the final object buffer, saving the assemble copy); when omitted a
+    fresh array is allocated.
     Returns (y (nb, k, S) data rows, ok flags per selected row, nbad).
     On nbad > 0, y is unusable — drop the bad rows and retry with spares.
     """
@@ -250,7 +259,11 @@ def get_verify(frames: list, sel: list[int], nb: int, S: int, k: int,
                          "(C kernel srcs[] bound)")
     lib = load()
     ksel = len(sel)
-    y = np.empty((nb, k, S), dtype=np.uint8)
+    if out is None:
+        y = np.empty((nb, k, S), dtype=np.uint8)
+    else:
+        y = np.frombuffer(out, dtype=np.uint8, count=nb * k * S)
+        y = y.reshape(nb, k, S)
     ok = np.ones(ksel, dtype=np.uint8)
     sel_a = np.ascontiguousarray(sel, dtype=np.int32)
     tgt_a = np.ascontiguousarray(targets, dtype=np.int32)
@@ -273,6 +286,32 @@ def get_verify(frames: list, sel: list[int], nb: int, S: int, k: int,
         tag.ctypes.data, y.ctypes.data, ok.ctypes.data,
         scratch.ctypes.data)
     return y, ok, nbad
+
+
+def verify_frames(frames: list, nb: int, S: int
+                  ) -> tuple[np.ndarray, int]:
+    """Verdict-only bitrot check of framed shard segments (mxh256).
+
+    frames[j]: buffer holding nb frames of (32|S).  Hashes every frame,
+    compares digests, touches nothing else — no gather, no GF(2^8).
+    Returns (ok flags per row, nbad).  The healthy-GET fast path and
+    bench stage attribution use this to price verification separately
+    from assembly.  ctypes releases the GIL for the whole batch.
+    """
+    if len(frames) > MAX_ROWS:
+        raise ValueError(f"ksel {len(frames)} > {MAX_ROWS} "
+                         "(C kernel srcs[] bound)")
+    lib = load()
+    ksel = len(frames)
+    ok = np.ones(ksel, dtype=np.uint8)
+    at, corr, tag = _mxh_material(S)
+    scratch = _scratch(S)
+    keep: list = []
+    ptrs = (ctypes.c_void_p * ksel)(*[_raddr(f, keep) for f in frames])
+    nbad = lib.ec_verify_frames(
+        ptrs, ksel, nb, S, at.ctypes.data, corr.ctypes.data,
+        tag.ctypes.data, ok.ctypes.data, scratch.ctypes.data)
+    return ok, nbad
 
 
 def gf_transform_rows(srcs: list, sel: list[int], k: int, m: int,

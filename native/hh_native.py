@@ -43,6 +43,10 @@ def load():
         lib.hh256.argtypes = [
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
             ctypes.c_void_p]
+        lib.hh256_frames.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_void_p,
+            ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -69,6 +73,25 @@ def hh256_rows_native(rows: np.ndarray,
     out = np.empty((n, 32), dtype=np.uint8)
     lib.hh256_rows(rows.ctypes.data, n, ln, _key_bytes(key),
                    out.ctypes.data)
+    return out
+
+
+def hh256_frames_native(buf, n: int, stride: int, off: int, length: int,
+                        key: bytes | None = None) -> np.ndarray:
+    """Hash n strided segments buf[i*stride+off : +length] -> (n, 32).
+
+    The verify-only entry for bitrot-framed shard files: digests the
+    data region of every [32B digest | shard] frame in place, with no
+    gather copy.  ctypes releases the GIL for the whole batch, so the
+    healthy-GET fast path can fan shard files out across the pool.
+    """
+    lib = load()
+    arr = np.frombuffer(buf, dtype=np.uint8)   # zero-copy view
+    if n and (n - 1) * stride + off + length > arr.size:
+        raise ValueError("strided frames overrun buffer")
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.hh256_frames(arr.ctypes.data, n, stride, off, length,
+                     _key_bytes(key), out.ctypes.data)
     return out
 
 

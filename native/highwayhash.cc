@@ -332,6 +332,29 @@ void hh256_rows(const uint8_t* rows, size_t n_rows, size_t row_len,
     HashOne(key, rows + r * row_len, row_len, out + r * 32);
 }
 
+// Frame-strided batch: hashes n segments buf[i*stride+off : +len] —
+// the healthy-GET verify-only entry for HighwayHash-framed shard files
+// ([32B digest | shard] frames verified in place, no gather copy).
+void hh256_frames(const uint8_t* buf, size_t n, size_t stride, size_t off,
+                  size_t len, const uint8_t* key32, uint8_t* out) {
+  uint64_t key[4];
+  std::memcpy(key, key32, 32);
+  size_t r = 0;
+#if defined(__AVX512BW__)
+  for (; r + 2 <= n; r += 2) {
+    StateV sa, sb;
+    size_t done;
+    const uint8_t* a = buf + r * stride + off;
+    const uint8_t* b = buf + (r + 1) * stride + off;
+    HashPairBulk(key, a, b, len, sa, sb, &done);
+    FinishOne(sa, a, len, done, out + r * 32);
+    FinishOne(sb, b, len, done, out + (r + 1) * 32);
+  }
+#endif
+  for (; r < n; ++r)
+    HashOne(key, buf + r * stride + off, len, out + r * 32);
+}
+
 // Streaming-free one-shot for arbitrary buffers (whole-file digests).
 void hh256(const uint8_t* data, size_t len, const uint8_t* key32,
            uint8_t* out) {

@@ -21,3 +21,16 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402  (after the jax platform pinning above)
+
+
+@pytest.fixture(params=["1", "0"], ids=["fastpath", "oracle"])
+def fastpath_mode(request, monkeypatch):
+    """Tier-1 guard for the healthy-read fast path: every test that uses
+    this fixture runs twice — once on the verify-only fast path
+    (MTPU_GET_FASTPATH=1, the default) and once on the fused
+    verify+decode oracle path (=0) — so the two implementations stay
+    byte-exact under the same assertions."""
+    monkeypatch.setenv("MTPU_GET_FASTPATH", request.param)
+    return request.param
