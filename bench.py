@@ -122,6 +122,7 @@ def e2e_bench(n_put: int = 64, n_parts: int = 4,
         dt = time.perf_counter() - t0
         out["put_e2e_2p2_noetag_gbps"] = n_put * (1 << 20) / dt / 1e9
         out.update(_put_stages(es4, objs[0]))
+        out.update(_span_attribution(es4))
 
         # config 2: EC:8+4 multipart, 64 MiB parts
         es12 = ErasureSet([LocalDrive(f"{root}/b{i}") for i in range(12)],
@@ -345,6 +346,42 @@ def _get_stages(es12) -> dict:
         stages["get_stage_error"] = f"{type(e).__name__}: {e}"
     return {k2: round(v, 3) if isinstance(v, float) else v
             for k2, v in stages.items()}
+
+
+def _span_attribution(es) -> dict:
+    """Span-tree attribution of one traced 16 MiB PUT + GET: the
+    trace-plane cross-check of _put_stages/_get_stages.  Where those
+    probes re-run stages standalone and leave a put/get_stage_other_ms
+    residue, the span tree decomposes the ACTUAL request into named
+    engine/native/drive stages, and coverage_pct says how much of the
+    root wall time the direct children account for."""
+    from minio_tpu.observe import span as ospan
+
+    tracer = ospan.TRACER
+    out = {}
+    try:
+        rng = np.random.default_rng(11)
+        data = rng.integers(0, 256, 16 << 20, dtype=np.uint8).tobytes()
+        es.put_object("bench", "spanprobe", data)        # warm
+        es.get_object("bench", "spanprobe")
+        tracer.configure(ring=8, sample=1.0)
+        with tracer.root("api.PutObject", path="/bench/spanprobe"):
+            es.put_object("bench", "spanprobe", data)
+        with tracer.root("api.GetObject", path="/bench/spanprobe"):
+            es.get_object("bench", "spanprobe")
+        put_rec, get_rec = tracer.traces()[-2:]
+        for pref, rec in (("put", put_rec), ("get", get_rec)):
+            out[f"{pref}_span_total_16mib_ms"] = rec["dur_ms"]
+            out[f"{pref}_span_coverage_pct"] = \
+                100.0 * ospan.coverage(rec)
+            for name, ms in sorted(ospan.flatten(rec).items()):
+                out[f"{pref}_span_{name.replace('.', '_')}_ms"] = ms
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort
+        out["span_stage_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        tracer.configure(ring=0)
+    return {k: round(v, 3) if isinstance(v, float) else v
+            for k, v in out.items()}
 
 
 def _put_stages(es4, obj_bytes: bytes) -> dict:

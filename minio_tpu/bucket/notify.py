@@ -165,12 +165,28 @@ class WebhookTarget:
 
 
 class NotificationSystem:
-    """Per-bucket rules + a target registry; the TargetList.Send role."""
+    """Per-bucket rules + a target registry; the TargetList.Send role.
+
+    Beside the configured targets there is a live PubSub tap
+    (``subscribe_events``): ListenNotification streams attach there and
+    see EVERY event, configured rules or not — the reference likewise
+    feeds listen channels from its event PubSub independently of target
+    delivery (cmd/notification.go). Zero cost with no listeners: the
+    event record is only built when a rule matched or a tap exists.
+    """
 
     def __init__(self):
+        from ..observe.trace import PubSub
         self._mu = threading.Lock()
         self.targets: dict[str, object] = {}
         self.rules: dict[str, list[NotificationRule]] = {}
+        self.pubsub = PubSub()
+
+    def subscribe_events(self, maxlen: int = 1000):
+        return self.pubsub.subscribe(maxlen)
+
+    def unsubscribe_events(self, q) -> None:
+        self.pubsub.unsubscribe(q)
 
     def register_target(self, target) -> None:
         with self._mu:
@@ -197,4 +213,9 @@ class NotificationSystem:
             target.send(make_event(event_name, bucket, key, size, etag,
                                    version_id))
             sent += 1
+        if self.pubsub.num_subscribers:
+            self.pubsub.publish({
+                "bucket": bucket, "key": key, "eventName": event_name,
+                "record": make_event(event_name, bucket, key, size,
+                                     etag, version_id)})
         return sent

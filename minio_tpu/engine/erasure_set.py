@@ -27,6 +27,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
 from ..ops import fused
 from ..ops.erasure_cpu import ReedSolomonCPU
@@ -316,7 +317,9 @@ class ErasureSet:
 
         if self._serial_local(drives):
             return [call(d) for d in drives]
-        return list(self.pool.map(call, drives))
+        # wrap_ctx: per-drive spans born in pool threads still attach
+        # to the traced request (no-op when untraced).
+        return list(self.pool.map(ospan.wrap_ctx(call), drives))
 
     # -- bucket ops ----------------------------------------------------------
 
@@ -393,8 +396,9 @@ class ErasureSet:
 
         cf. erasureObjects.putObject, /root/reference/cmd/erasure-object.go:748.
         """
-        if not self.bucket_exists(bucket, cached=True):
-            raise ErrBucketNotFound(bucket)
+        with ospan.span("engine.bucket_check"):
+            if not self.bucket_exists(bucket, cached=True):
+                raise ErrBucketNotFound(bucket)
         with self.nslock.write_locked(bucket, obj):
             fi = self._put_object_locked(bucket, obj, data,
                                          metadata=metadata,
@@ -452,7 +456,8 @@ class ErasureSet:
         etag_fut = None
         if stream is None and "etag" not in meta:
             if self._SERIAL_FANOUT:
-                meta["etag"] = _etag(data)
+                with ospan.span("engine.etag"):
+                    meta["etag"] = _etag(data)
             else:
                 etag_fut = self._iter_pool.submit(_etag, data)
         if upgraded:
@@ -484,7 +489,8 @@ class ErasureSet:
 
         if stream is None and len(data) <= SMALL_FILE_THRESHOLD:
             if etag_fut is not None:
-                meta.setdefault("etag", etag_fut.result())
+                with ospan.span("engine.etag"):
+                    meta.setdefault("etag", etag_fut.result())
             return self._put_inline(bucket, obj, data, fi_for, k, parity,
                                     distribution, write_quorum, algo)
 
@@ -514,10 +520,12 @@ class ErasureSet:
         # parallelWriter+RenameData pair in the reference is likewise
         # one connection round per drive, cmd/erasure-object.go:1200).
         if stream is None and len(data) <= BATCH_BLOCKS * BLOCK_SIZE:
-            batches = list(self._encode_chunks(
-                [(data, True)], k, parity, algo))
+            with ospan.span("engine.encode"):
+                batches = list(self._encode_chunks(
+                    [(data, True)], k, parity, algo))
             if etag_fut is not None:
-                meta.setdefault("etag", etag_fut.result())
+                with ospan.span("engine.etag"):
+                    meta.setdefault("etag", etag_fut.result())
             per_drive = [Q.unshuffle_to_drives(b, distribution)
                          for b in batches]
 
@@ -534,7 +542,8 @@ class ErasureSet:
             # not leave committed versions on the survivors (the
             # reference likewise aborts before RenameData,
             # cmd/erasure-object.go:1200).
-            res = self._map_drives_positions(stage)
+            with ospan.span("engine.stage"):
+                res = self._map_drives_positions(stage)
             stage_errs = [e for _, e in res]
             err = Q.reduce_write_quorum_errs(stage_errs, write_quorum)
             if err is not None:
@@ -548,7 +557,8 @@ class ErasureSet:
                     SYS_VOL, f"{TMP_DIR}/{tmp_id}",
                     fi_for(pos, data_dir, None), bucket, obj)
 
-            res = self._map_drives_positions(publish)
+            with ospan.span("engine.publish"):
+                res = self._map_drives_positions(publish)
             errs = [e for _, e in res]
             err = Q.reduce_write_quorum_errs(errs, write_quorum)
             if err is not None:
@@ -568,8 +578,9 @@ class ErasureSet:
         # leak per-drive staging files — they only get swept again at
         # drive startup.
         try:
-            for batch_shards in self._encode_chunks(counted_chunks(), k,
-                                                    parity, algo):
+            for batch_shards in ospan.timed_iter(
+                    self._encode_chunks(counted_chunks(), k, parity, algo),
+                    "engine.encode"):
                 # batch_shards: n framed byte strings in SHARD order.
                 per_drive = Q.unshuffle_to_drives(batch_shards,
                                                   distribution)
@@ -581,8 +592,9 @@ class ErasureSet:
                     d.append_file(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.1",
                                   per_drive[pos])
 
-                for pos, (_, e) in enumerate(
-                        self._map_drives_positions(write_one)):
+                with ospan.span("engine.write"):
+                    res = self._map_drives_positions(write_one)
+                for pos, (_, e) in enumerate(res):
                     if e is not None:
                         failed[pos] = True
                 if sum(1 for f in failed if not f) < write_quorum:
@@ -593,7 +605,8 @@ class ErasureSet:
                 sizeref["size"] = total
                 meta.setdefault("etag", md5.hexdigest())
             elif etag_fut is not None:
-                meta.setdefault("etag", etag_fut.result())
+                with ospan.span("engine.etag"):
+                    meta.setdefault("etag", etag_fut.result())
 
             def publish(pos):
                 d = self.drives[pos]
@@ -602,7 +615,8 @@ class ErasureSet:
                 d.rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}",
                               fi_for(pos, data_dir, None), bucket, obj)
 
-            res = self._map_drives_positions(publish)
+            with ospan.span("engine.publish"):
+                res = self._map_drives_positions(publish)
             errs = [e for _, e in res]
             err = Q.reduce_write_quorum_errs(errs, write_quorum)
             if err is not None:
@@ -623,7 +637,8 @@ class ErasureSet:
                     distribution, write_quorum, algo: str) -> FileInfo:
         """Small objects: framed shards live inline in each drive's xl.meta
         (cf. inline data, /root/reference/cmd/xl-storage.go:1183)."""
-        shards = self._encode_full(data, k, parity, algo)  # n framed strings
+        with ospan.span("engine.encode"):
+            shards = self._encode_full(data, k, parity, algo)  # n framed
         per_drive = Q.unshuffle_to_drives(shards, distribution)
 
         def write_one(pos):
@@ -632,7 +647,8 @@ class ErasureSet:
                 raise ErrDiskNotFound("offline")
             d.write_metadata(bucket, obj, fi_for(pos, "", per_drive[pos]))
 
-        res = self._map_drives_positions(write_one)
+        with ospan.span("engine.write"):
+            res = self._map_drives_positions(write_one)
         errs = [e for _, e in res]
         err = Q.reduce_write_quorum_errs(errs, write_quorum)
         if err is not None:
@@ -679,7 +695,7 @@ class ErasureSet:
                 return fn(pos), None
             except Exception as e:  # noqa: BLE001
                 return None, e
-        return list(self.pool.map(call, range(self.n)))
+        return list(self.pool.map(ospan.wrap_ctx(call), range(self.n)))
 
     # -- encode drivers ------------------------------------------------------
 
@@ -852,7 +868,10 @@ class ErasureSet:
                 return fi, data
             return fi, data[offset:offset + length]
 
-        buf = bytearray(length)
+        # The zeroed destination buffer is real time at 10s of MiB
+        # (~0.3 ms/MiB of page faults) — price it as its own stage.
+        with ospan.span("engine.alloc"):
+            buf = bytearray(length)
         mv = memoryview(buf)
         segs = self._plan_segments(fi, offset, length)
         offs = []
@@ -865,15 +884,17 @@ class ErasureSet:
 
         def read_seg(i):
             pn, off, ln = segs[i]
-            self._read_part(bucket, obj, fi, part_number=pn,
-                            offset=off, length=ln,
-                            dst=mv[offs[i]:offs[i] + ln],
-                            healthy=not degraded)
+            with ospan.span("engine.read_part"):
+                self._read_part(bucket, obj, fi, part_number=pn,
+                                offset=off, length=ln,
+                                dst=mv[offs[i]:offs[i] + ln],
+                                healthy=not degraded)
         if self._serial_local() and not degraded:
             for i in range(len(segs)):
                 read_seg(i)
         else:
-            for _ in pl.prefetch_map(read_seg, range(len(segs)),
+            for _ in pl.prefetch_map(ospan.wrap_ctx(read_seg),
+                                     range(len(segs)),
                                      self._iter_pool, depth=1):
                 pass
         return fi, buf
@@ -985,10 +1006,12 @@ class ErasureSet:
 
         def read_seg(seg):
             pn, off, ln = seg
-            return self._read_part(bucket, obj, fi, part_number=pn,
-                                   offset=off, length=ln,
-                                   healthy=not degraded)
-        return fi, pl.prefetch_map(read_seg, segs, pool, depth=1)
+            with ospan.span("engine.read_part"):
+                return self._read_part(bucket, obj, fi, part_number=pn,
+                                       offset=off, length=ln,
+                                       healthy=not degraded)
+        return fi, pl.prefetch_map(ospan.wrap_ctx(read_seg), segs, pool,
+                                   depth=1)
 
     def _read_v1_object(self, bucket, obj, fi) -> bytes:
         """Whole-object read of a legacy (xl.json) object: per-drive
@@ -1083,8 +1106,9 @@ class ErasureSet:
 
     def _read_metadata(self, bucket, obj, version_id=""):
         version_id = normalize_version_id(version_id)
-        res = self._map_drives(
-            lambda d: d.read_version(bucket, obj, version_id))
+        with ospan.span("engine.quorum"):
+            res = self._map_drives(
+                lambda d: d.read_version(bucket, obj, version_id))
         metas = [fi for fi, _ in res]
         errs = [e for _, e in res]
         n_found = sum(1 for f in metas if f is not None)
@@ -1248,7 +1272,8 @@ class ErasureSet:
                 for s in want:
                     rows[s] = read_shard(order[s])
             else:
-                futs = {s: self.pool.submit(read_shard, order[s])
+                rs = ospan.wrap_ctx(read_shard)
+                futs = {s: self.pool.submit(rs, order[s])
                         for s in want}
                 first_err = None
                 for s, fut in futs.items():
@@ -1338,6 +1363,9 @@ class ErasureSet:
             DATA_PATH.record_healthy_read(
                 length, read_s=t_read - t0, verify_s=t_verify - t_read,
                 assemble_s=asm_s + (done - ta))
+            ospan.record("engine.read", t_read - t0)
+            ospan.record("engine.verify", t_verify - t_read)
+            ospan.record("engine.assemble", asm_s + (done - ta))
             return (res,)
 
         # BLOCK_SIZE % k gate: the padded (non-dividing k) layout needs
@@ -1368,23 +1396,25 @@ class ErasureSet:
             # the GIL, so overlapping them pays even on the 1-core host
             # (unlike the healthy path, where the K reads are page-cache
             # hits and pool hops only add latency).
-            if self._serial_local() and not degraded:
-                for s in active:
-                    tried.add(s)
-                    try:
-                        rows[s] = read_shard(order[s])
-                    except Exception:  # noqa: BLE001 — spare read
-                        pass
-            else:
-                futs = {}
-                for s in active:
-                    tried.add(s)
-                    futs[s] = self.pool.submit(read_shard, order[s])
-                for s, fut in futs.items():
-                    try:
-                        rows[s] = fut.result()
-                    except Exception:  # noqa: BLE001 — spare read
-                        pass
+            with ospan.span("engine.read"):
+                if self._serial_local() and not degraded:
+                    for s in active:
+                        tried.add(s)
+                        try:
+                            rows[s] = read_shard(order[s])
+                        except Exception:  # noqa: BLE001 — spare read
+                            pass
+                else:
+                    rs = ospan.wrap_ctx(read_shard)
+                    futs = {}
+                    for s in active:
+                        tried.add(s)
+                        futs[s] = self.pool.submit(rs, order[s])
+                    for s, fut in futs.items():
+                        try:
+                            rows[s] = fut.result()
+                        except Exception:  # noqa: BLE001 — spare read
+                            pass
             if len(rows) < k:
                 continue
             sel = sorted(rows)[:k]
@@ -1396,9 +1426,10 @@ class ErasureSet:
                 # chosen row, gather data rows, reconstruct the missing
                 # ones. A digest mismatch surfaces exactly like an I/O
                 # failure: drop the row, fetch a spare, run again.
-                y_fused, okf, nbad = fused_host.get_verify(
-                    [rows[s][3] for s in sel], sel, nb, shard_size, k, m,
-                    missing)
+                with ospan.span("engine.verify_decode"):
+                    y_fused, okf, nbad = fused_host.get_verify(
+                        [rows[s][3] for s in sel], sel, nb, shard_size,
+                        k, m, missing)
                 if nbad:
                     for j, s in enumerate(sel):
                         if not okf[j]:
@@ -1411,24 +1442,25 @@ class ErasureSet:
             x = np.empty((nb, k, shard_size), dtype=np.uint8)
             for i, s in enumerate(sel):
                 x[:, i, :] = rows[s][1]                      # (nb, K, S)
-            if algo in fused.DEVICE_ALGOS and self._use_device \
-                    and bitrot_io.device_preferred(algo) \
-                    and not _mesh_mode():
-                digests, dev_out = fused.verify_and_transform(
-                    x, k, m, tuple(sel), tuple(missing), algo=algo)
-                digests = np.asarray(digests)
-            else:
-                # Host path (host-hashed algorithm, no TPU, or an algo
-                # whose native host kernel beats its device verify —
-                # bitrot_io.device_preferred): digest on host,
-                # reconstruct via the backend picker only if rows are
-                # missing.
-                flat = x.reshape(nb * k, shard_size)
-                digests = bitrot_io._hash_batch(flat, algo).reshape(
-                    nb, k, hs)
-                dev_out = self._transform(
-                    k, m, x, tuple(sel), tuple(missing)) if missing \
-                    else None
+            with ospan.span("engine.verify_decode"):
+                if algo in fused.DEVICE_ALGOS and self._use_device \
+                        and bitrot_io.device_preferred(algo) \
+                        and not _mesh_mode():
+                    digests, dev_out = fused.verify_and_transform(
+                        x, k, m, tuple(sel), tuple(missing), algo=algo)
+                    digests = np.asarray(digests)
+                else:
+                    # Host path (host-hashed algorithm, no TPU, or an
+                    # algo whose native host kernel beats its device
+                    # verify — bitrot_io.device_preferred): digest on
+                    # host, reconstruct via the backend picker only if
+                    # rows are missing.
+                    flat = x.reshape(nb * k, shard_size)
+                    digests = bitrot_io._hash_batch(flat, algo).reshape(
+                        nb, k, hs)
+                    dev_out = self._transform(
+                        k, m, x, tuple(sel), tuple(missing)) if missing \
+                        else None
             bad = [sel[i] for i in range(k)
                    if not np.array_equal(digests[:, i], rows[sel[i]][0])]
             if not bad:
@@ -1441,6 +1473,7 @@ class ErasureSet:
         # missing, sel IS [0..k), so x already holds them — the full
         # blocks then flow to the caller with no further copy (when
         # BLOCK_SIZE divides evenly, x's natural layout IS the data).
+        ta_asm = time.monotonic()
         y = None
         if nb:
             if y_fused is not None:
@@ -1497,6 +1530,7 @@ class ErasureSet:
         else:
             data = np.concatenate(pieces)
             res = data[lo:lo + length].tobytes()
+        ospan.record("engine.assemble", time.monotonic() - ta_asm)
         if degraded:
             DATA_PATH.record_degraded_read(length,
                                            time.monotonic() - t_deg)

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
 from ..parallel import pipeline as pl
 from ..storage import bitrot_io
@@ -433,11 +434,12 @@ def _heal_data(es: ErasureSet, bucket: str, obj: str, fi: FileInfo,
             else:
                 _heal_part_serial(es, bucket, obj, fi, part, sources,
                                   targets, need, tmp_id)
-        for pos in targets:
-            fi_pos = _fi_for_drive(fi, pos)
-            _ensure_bucket_on(es.drives[pos], bucket)
-            es.drives[pos].rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}",
-                                       fi_pos, bucket, obj)
+        with ospan.span("heal.publish"):
+            for pos in targets:
+                fi_pos = _fi_for_drive(fi, pos)
+                _ensure_bucket_on(es.drives[pos], bucket)
+                es.drives[pos].rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}",
+                                           fi_pos, bucket, obj)
         DATA_PATH.record_heal_object()
     finally:
         for pos in targets:
@@ -710,9 +712,16 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
     # The pipeline threads pay off even on the 1-core host: reads,
     # appends, and the native decode all release the GIL, so disk I/O
     # for neighboring batches genuinely overlaps the C pass.
+    def bridge(read_s, compute_s, write_s):
+        # Runs in the (possibly traced) caller thread — an
+        # admin-triggered heal shows its stage times in the trace.
+        ospan.record("heal.read", read_s)
+        ospan.record("heal.decode", compute_s)
+        ospan.record("heal.write", write_s)
+
     pl.StagePipeline(es._iter_pool).run(
         pl.prefetch_map(read_batch, batches, es._iter_pool, depth=1),
-        compute, write_batch)
+        compute, write_batch, on_batch=bridge)
 
     if tail_shard:
         # Tail fragment (one short frame per shard): CPU oracle codec,

@@ -18,6 +18,7 @@ import hashlib
 import time
 import uuid
 
+from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
 from ..parallel import pipeline as pl
 from ..storage import bitrot_io
@@ -184,8 +185,10 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
                 raise err
         finally:
             _cleanup_stage(es, stage)
-        DATA_PATH.record_mp_batch(total, t1 - t0,
-                                  time.perf_counter() - t1)
+        t2 = time.perf_counter()
+        DATA_PATH.record_mp_batch(total, t1 - t0, t2 - t1)
+        ospan.record("mp.encode", t1 - t0)
+        ospan.record("mp.write", t2 - t1)
         return ObjectPartInfo(number=part_number, size=total,
                               actual_size=total, etag=etag)
 
@@ -224,6 +227,10 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
     def record(read_s, compute_s, write_s):
         nbytes, seen[0] = total - seen[0], total
         DATA_PATH.record_mp_batch(nbytes, read_s + compute_s, write_s)
+        # on_batch runs in the caller (traced) thread: bridge the
+        # pipeline's measured stage times into the span tree.
+        ospan.record("mp.encode", read_s + compute_s)
+        ospan.record("mp.write", write_s)
 
     try:
         # Encode of batch i+1 (the `reads` pull) overlaps the shard
@@ -250,7 +257,8 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
             d.write_all(SYS_VOL, f"{path}/part.{part_number}.meta",
                         part_meta)
 
-        res = es._map_drives_positions(publish)
+        with ospan.span("mp.publish"):
+            res = es._map_drives_positions(publish)
         err = Q.reduce_write_quorum_errs([e for _, e in res],
                                          write_quorum)
         if err is not None:
@@ -459,7 +467,8 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
     # per-drive chains assemble concurrently instead of serially, even
     # on the 1-core host (the work is syscalls, not Python).
     t0 = time.perf_counter()
-    with es.nslock.write_locked(bucket, obj, timeout=30.0):
+    with es.nslock.write_locked(bucket, obj, timeout=30.0), \
+            ospan.span("mp.publish"):
         res = es._map_drives_positions(publish, parallel=True)
     DATA_PATH.record_mp_complete(time.perf_counter() - t0)
     errs = [e for _, e in res]

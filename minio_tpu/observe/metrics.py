@@ -347,6 +347,29 @@ class MetricsRegistry:
         self.mp_stage_seconds = Gauge(
             "mtpu_multipart_put_stage_seconds_total",
             "Multipart PUT pipeline time by stage", ("stage",))
+        # Span-aggregate families (rendered from observe.span TRACER):
+        # per-API traced-request percentiles + per-stage span histograms
+        # ("le" carries the cumulative bucket bound in ms).
+        self.trace_api_count = Gauge(
+            "mtpu_trace_api_requests_total",
+            "Traced requests by API (span roots)", ("api",))
+        self.trace_api_errors = Gauge(
+            "mtpu_trace_api_errors_total",
+            "Traced error requests by API", ("api",))
+        self.trace_api_latency = Gauge(
+            "mtpu_trace_api_latency_ms",
+            "Traced request latency percentiles in ms",
+            ("api", "quantile"))
+        self.trace_stage_ms = Gauge(
+            "mtpu_trace_stage_ms_total",
+            "Summed span time by API and stage in ms", ("api", "stage"))
+        self.trace_stage_count = Gauge(
+            "mtpu_trace_stage_spans_total",
+            "Span count by API and stage", ("api", "stage"))
+        self.trace_stage_hist = Gauge(
+            "mtpu_trace_stage_duration_ms_bucket",
+            "Cumulative span duration histogram by API and stage",
+            ("api", "stage", "le"))
         self.drive_online = Gauge("mtpu_cluster_drives_online",
                                   "Online drives")
         self.drive_offline = Gauge("mtpu_cluster_drives_offline",
@@ -424,8 +447,33 @@ class MetricsRegistry:
         for stage, s in snap["mp_stage_s"].items():
             self.mp_stage_seconds.set(s, stage=stage)
 
+    def _sync_spans(self) -> None:
+        # Imported lazily: span.py is the one observe module allowed to
+        # stay import-light (it sits on every request's hot path).
+        from .span import BUCKETS_MS, TRACER
+        snap = TRACER.snapshot()
+        for api, a in snap["apis"].items():
+            self.trace_api_count.set(a["count"], api=api)
+            self.trace_api_errors.set(a["errors"], api=api)
+            for q in ("p50", "p90", "p99"):
+                self.trace_api_latency.set(a[f"{q}_ms"], api=api,
+                                           quantile=q)
+            for stage, st in a["stages"].items():
+                self.trace_stage_count.set(st["count"], api=api,
+                                           stage=stage)
+                self.trace_stage_ms.set(st["total_ms"], api=api,
+                                        stage=stage)
+                cum = 0
+                for i, bound in enumerate(BUCKETS_MS):
+                    cum += st["buckets"][i]
+                    le = ("+Inf" if bound == float("inf")
+                          else f"{bound:g}")
+                    self.trace_stage_hist.set(cum, api=api, stage=stage,
+                                              le=le)
+
     def render(self) -> str:
         self._sync_datapath()
+        self._sync_spans()
         out: list[str] = []
         for m in (self.api_requests, self.api_errors, self.inflight,
                   self.latency, self.bytes_rx, self.bytes_tx,
@@ -438,6 +486,9 @@ class MetricsRegistry:
                   self.healthy_bytes, self.healthy_stage_seconds,
                   self.fastpath_fallbacks, self.mp_batches,
                   self.mp_bytes, self.mp_stage_seconds,
+                  self.trace_api_count, self.trace_api_errors,
+                  self.trace_api_latency, self.trace_stage_ms,
+                  self.trace_stage_count, self.trace_stage_hist,
                   self.drive_online,
                   self.drive_offline, self.cache_hits, self.cache_misses,
                   self.cache_evictions, self.cache_usage,

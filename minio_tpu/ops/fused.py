@@ -30,6 +30,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..observe import span as ospan
 from . import erasure_jax, erasure_pallas
 from .highwayhash import MAGIC_KEY
 from .highwayhash_jax import _hh256_impl
@@ -37,6 +38,19 @@ from .mxhash_jax import mxh256_rows
 
 # Algorithms with a device digest kernel (usable in the fused paths).
 DEVICE_ALGOS = ("mxh256", "highwayhash256S", "highwayhash256")
+
+
+def _traced_dispatch(name: str, fn, *args):
+    """Run a jitted kernel call; inside a traced request the span covers
+    dispatch AND device completion (block_until_ready), so the trace
+    attributes real device time. Untraced calls stay fully async —
+    callers sync via np.asarray exactly as before."""
+    if not ospan.active():
+        return fn(*args)
+    with ospan.span(name):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        return out
 
 
 def _digest_rows(x2d: jax.Array, algo: str, key: bytes) -> jax.Array:
@@ -89,10 +103,11 @@ def verify_and_transform(x, k: int, m: int, sources: tuple[int, ...],
     """
     x = jnp.asarray(x, dtype=jnp.uint8)
     if not targets:
-        return _hash_rows_jit(algo, key)(x), None
+        return _traced_dispatch("device.verify",
+                                _hash_rows_jit(algo, key), x), None
     fn = _verify_transform_jit(k, m, tuple(sources), tuple(targets),
                                algo, key)
-    return fn(x)
+    return _traced_dispatch("device.verify_transform", fn, x)
 
 
 @functools.lru_cache(maxsize=64)
@@ -123,4 +138,5 @@ def encode_and_hash(x, k: int, m: int, algo: str = "highwayhash256S",
     (n_shards, n_blocks) order.
     """
     x = jnp.asarray(x, dtype=jnp.uint8)
-    return _encode_hash_jit(k, m, algo, key)(x)
+    return _traced_dispatch("device.encode_hash",
+                            _encode_hash_jit(k, m, algo, key), x)

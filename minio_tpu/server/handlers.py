@@ -14,10 +14,12 @@ from __future__ import annotations
 import datetime
 import email.utils
 import hashlib
+import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 
 from ..engine.pools import ServerPools
+from ..observe.span import span as _span
 from ..storage.errors import ErrObjectNotFound, StorageError
 from ..storage.xlmeta import FileInfo
 from .api_errors import S3Error, from_storage_error
@@ -752,19 +754,21 @@ class S3Handlers:
                 # (the GetObjectReader role without a cleanup stack).
                 try:
                     if hasattr(self.pools, "get_object_iter"):
-                        fi, body_iter = self.pools.get_object_iter(
-                            bucket, key, offset, length, version_id)
-                        # Pull the FIRST chunk eagerly: once headers are
-                        # on the wire a failure can only sever the
-                        # connection, so quorum/bitrot errors that
-                        # surface immediately must still become S3
-                        # error responses.
-                        import itertools
-                        first = next(body_iter, b"")
+                        with _span("engine.get_object"):
+                            fi, body_iter = self.pools.get_object_iter(
+                                bucket, key, offset, length, version_id)
+                            # Pull the FIRST chunk eagerly: once
+                            # headers are on the wire a failure can
+                            # only sever the connection, so quorum/
+                            # bitrot errors that surface immediately
+                            # must still become S3 error responses.
+                            import itertools
+                            first = next(body_iter, b"")
                         body_iter = itertools.chain((first,), body_iter)
                     else:        # FS/gateway layers: whole-object read
-                        fi, data = self.pools.get_object(
-                            bucket, key, offset, length, version_id)
+                        with _span("engine.get_object"):
+                            fi, data = self.pools.get_object(
+                                bucket, key, offset, length, version_id)
                 except StorageError as e:
                     raise from_storage_error(e) from None
         elif transformed and sse.is_encrypted(fi.metadata):
@@ -962,10 +966,11 @@ class S3Handlers:
             metadata.update(transform_meta)
 
         try:
-            fi = self.pools.put_object(bucket, key, stored,
-                                       metadata=metadata,
-                                       versioned=versioned,
-                                       parity=parity)
+            with _span("engine.put_object"):
+                fi = self.pools.put_object(bucket, key, stored,
+                                           metadata=metadata,
+                                           versioned=versioned,
+                                           parity=parity)
         except StorageError as e:
             raise from_storage_error(e) from None
         if replaced_tiered:
@@ -1338,17 +1343,86 @@ class S3Handlers:
         return Response(200, _xml(root), {"Content-Type": "application/xml"})
 
     def put_part(self, bucket: str, key: str, query: dict,
-                 body: bytes) -> Response:
+                 body, headers: dict[str, str] | None = None) -> Response:
         upload_id = query.get("uploadId", [""])[0]
         part_number = int(query.get("partNumber", ["0"])[0])
         if not (1 <= part_number <= 10000):
             raise S3Error("InvalidArgument", "part number out of range")
+        h = {k.lower(): v for k, v in (headers or {}).items()}
+        if "x-amz-copy-source" in h:
+            from ..utils import streams
+            if streams.is_reader(body):
+                # Copy requests carry no meaningful body; drain so the
+                # keep-alive socket isn't left desynced (same rule as
+                # the CopyObject branch in put_object).
+                while body.read(1 << 20):
+                    pass
+            return self._upload_part_copy(bucket, key, upload_id,
+                                          part_number, h)
         try:
             info = self.pools.put_object_part(bucket, key, upload_id,
                                               part_number, body)
         except StorageError as e:
             raise from_storage_error(e) from None
         return Response(200, headers={"ETag": f'"{info.etag}"'})
+
+    def _upload_part_copy(self, bucket: str, key: str, upload_id: str,
+                          part_number: int, h: dict[str, str]) -> Response:
+        """UploadPartCopy (cf. CopyObjectPartHandler,
+        cmd/object-handlers.go): source an upload part from an existing
+        object (optionally a byte range of it). The source is read as
+        PLAINTEXT — decrypt/decompress applied — because the part joins
+        a new EC stream with its own framing/transforms; copied and
+        uploaded parts must complete byte-identical."""
+        src = urllib.parse.unquote(h["x-amz-copy-source"]).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        src_vid = ""
+        if "?versionId=" in src_key:
+            src_key, _, src_vid = src_key.partition("?versionId=")
+        if not src_bucket or not src_key:
+            raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+        src_h = {
+            "x-amz-server-side-encryption-customer-algorithm": h.get(
+                "x-amz-copy-source-server-side-encryption-"
+                "customer-algorithm", ""),
+            "x-amz-server-side-encryption-customer-key": h.get(
+                "x-amz-copy-source-server-side-encryption-"
+                "customer-key", ""),
+            "x-amz-server-side-encryption-customer-key-md5": h.get(
+                "x-amz-copy-source-server-side-encryption-"
+                "customer-key-md5", ""),
+        }
+        try:
+            fi, data = self._read_plaintext(src_bucket, src_key, src_vid,
+                                            src_h)
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        rng = h.get("x-amz-copy-source-range", "")
+        if rng:
+            if not rng.startswith("bytes="):
+                raise S3Error("InvalidArgument",
+                              "x-amz-copy-source-range must be bytes=")
+            start_s, _, end_s = rng[len("bytes="):].partition("-")
+            try:
+                start = int(start_s)
+                end = int(end_s) if end_s else len(data) - 1
+            except ValueError:
+                raise S3Error("InvalidArgument", rng) from None
+            # UploadPartCopy ranges are strict: both ends must lie
+            # inside the source object (unlike GET's RFC 7233 clamping).
+            if start < 0 or end < start or end >= len(data):
+                raise S3Error("InvalidRange", rng)
+            data = memoryview(data)[start:end + 1]
+        try:
+            info = self.pools.put_object_part(bucket, key, upload_id,
+                                              part_number, bytes(data))
+        except StorageError as e:
+            raise from_storage_error(e) from None
+        root = ET.Element("CopyPartResult", xmlns=S3_NS)
+        _el(root, "ETag", f'"{info.etag}"')
+        _el(root, "LastModified", _iso(time.time_ns()))
+        return Response(200, _xml(root),
+                        {"Content-Type": "application/xml"})
 
     def complete_multipart(self, bucket: str, key: str, query: dict,
                            body: bytes) -> Response:
@@ -1397,9 +1471,9 @@ class S3Handlers:
                 pass
 
         try:
-            fi = self.pools.complete_multipart_upload(bucket, key, upload_id,
-                                                      parts,
-                                                      versioned=versioned)
+            with _span("engine.complete_multipart"):
+                fi = self.pools.complete_multipart_upload(
+                    bucket, key, upload_id, parts, versioned=versioned)
         except StorageError as e:
             raise from_storage_error(e) from None
         etag = fi.metadata.get("etag", "")

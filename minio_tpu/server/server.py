@@ -19,6 +19,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..engine.pools import ServerPools
+from ..observe import span as ospan
 from ..utils import streams
 from .api_errors import S3Error
 from .handlers import Response, S3Handlers, error_response
@@ -27,6 +28,72 @@ from .sigv4 import (STREAMING_PAYLOAD, UNSIGNED_PAYLOAD, Credentials,
                     verify_header_signature, verify_presigned)
 
 MAX_HEADER_BODY = 5 * 1024 ** 3      # max single PUT (5 GiB part limit)
+
+
+def _api_name(method: str, path: str, query: dict, headers) -> str:
+    """S3/admin API name for the request's root span — the per-API key
+    traces aggregate under (the role of api-router.go handler names in
+    the reference's trace/metrics labels). Best-effort: unrecognized
+    shapes fall back to method-qualified names rather than guessing."""
+    if path.startswith("/minio/admin/"):
+        # version prefixes v1/v3 are the same length — same strip
+        # _dispatch_admin uses.
+        sub = path[len("/minio/admin/v1/"):].strip("/")
+        return "admin." + ((sub.split("/", 1)[0] or "Service"))
+    if path.startswith("/minio/"):
+        if path == "/minio/listen":
+            return "api.ListenNotification"
+        return "internal." + path[len("/minio/"):].strip("/").replace(
+            "/", ".")
+    parts = path.strip("/").split("/", 1)
+    bucket = parts[0]
+    key = parts[1] if len(parts) > 1 else ""
+    if not bucket:
+        return "api.ListBuckets" if method == "GET" else f"api.{method}Root"
+    if key:
+        if method == "GET":
+            return ("api.ListParts" if "uploadId" in query
+                    else "api.GetObject")
+        if method == "HEAD":
+            return "api.HeadObject"
+        if method == "PUT":
+            if "partNumber" in query and "uploadId" in query:
+                return ("api.UploadPartCopy"
+                        if "x-amz-copy-source" in headers
+                        else "api.UploadPart")
+            if "x-amz-copy-source" in headers:
+                return "api.CopyObject"
+            return "api.PutObject"
+        if method == "POST":
+            if "uploads" in query:
+                return "api.NewMultipartUpload"
+            if "uploadId" in query:
+                return "api.CompleteMultipartUpload"
+            return f"api.{method}Object"
+        if method == "DELETE":
+            return ("api.AbortMultipartUpload" if "uploadId" in query
+                    else "api.DeleteObject")
+        return f"api.{method}Object"
+    if method == "GET":
+        if "events" in query:
+            return "api.ListenNotification"
+        if "location" in query:
+            return "api.GetBucketLocation"
+        if "uploads" in query:
+            return "api.ListMultipartUploads"
+        if "versions" in query:
+            return "api.ListObjectVersions"
+        return "api.ListObjects"
+    if method == "HEAD":
+        return "api.HeadBucket"
+    if method == "PUT":
+        return "api.PutBucket" if not query else "api.PutBucketConfig"
+    if method == "DELETE":
+        return ("api.DeleteBucket" if not query
+                else "api.DeleteBucketConfig")
+    if method == "POST" and "delete" in query:
+        return "api.DeleteMultipleObjects"
+    return f"api.{method}Bucket"
 
 
 class S3Server:
@@ -109,9 +176,11 @@ class S3Server:
             def _respond(self, resp: Response):
                 self.send_response(resp.status)
                 body = resp.body or b""
+                chunked = resp.headers.get(
+                    "Transfer-Encoding") == "chunked"
                 for k, v in resp.headers.items():
                     self.send_header(k, v)
-                if "Content-Length" not in resp.headers:
+                if "Content-Length" not in resp.headers and not chunked:
                     self.send_header("Content-Length", str(len(body)))
                 self.send_header("x-amz-request-id", self.request_id)
                 # security headers on every response (the
@@ -127,10 +196,33 @@ class S3Server:
                     # Streamed body: chunks flow socket-ward as they
                     # decode; a mid-stream failure can only sever the
                     # connection (headers are gone), same as the
-                    # reference once the response has begun.
-                    for chunk in resp.body_iter:
-                        if chunk:
-                            self.wfile.write(chunk)
+                    # reference once the response has begun. With
+                    # Transfer-Encoding: chunked (the admin trace /
+                    # listen streams, unknown total length) each chunk
+                    # gets HTTP/1.1 chunked framing and the connection
+                    # stays reusable after the terminal chunk.
+                    if chunked:
+                        try:
+                            for chunk in resp.body_iter:
+                                if chunk:
+                                    self.wfile.write(
+                                        b"%x\r\n" % len(chunk)
+                                        + chunk + b"\r\n")
+                                    self.wfile.flush()
+                            self.wfile.write(b"0\r\n\r\n")
+                        except (BrokenPipeError, ConnectionResetError):
+                            # Stream consumer hung up mid-flight: close
+                            # the generator (runs its unsubscribe
+                            # cleanup) and drop the connection.
+                            close = getattr(resp.body_iter, "close",
+                                            None)
+                            if close is not None:
+                                close()
+                            self.close_connection = True
+                    else:
+                        for chunk in resp.body_iter:
+                            if chunk:
+                                self.wfile.write(chunk)
                 elif body:
                     self.wfile.write(body)
 
@@ -170,12 +262,21 @@ class S3Server:
                     return
                 t0 = _time.perf_counter()
                 outer.metrics.inflight.inc(1)
+                # Root span: one per request, open through dispatch AND
+                # the response write (a streamed GET does its engine
+                # reads inside _respond). NOOP unless someone is
+                # tracing (ring configured or live trace subscriber).
+                rspan = ospan.TRACER.root(
+                    _api_name(self.command, path, query, self.headers),
+                    method=self.command, path=path)
+                rspan.__enter__()
                 access_key = ""
                 try:
                     if outer.handlers is None and \
                             not path.startswith("/minio/health/"):
                         raise S3Error("ServerNotInitialized")
-                    if path.startswith("/minio/admin/"):
+                    if path.startswith("/minio/admin/") or \
+                            path == "/minio/listen":
                         resp = outer._dispatch(self, path, query)
                     elif path.startswith("/minio/"):
                         resp = outer._dispatch_internal(self, path, query)
@@ -256,7 +357,17 @@ class S3Server:
                             t.send(entry)
                         except Exception:  # noqa: BLE001
                             continue
-                self._respond(resp)
+                sb = ("" if path.startswith("/minio/")
+                      else path.lstrip("/"))
+                rspan.tag(status=resp.status, bytes=resp_size,
+                          bucket=sb.split("/", 1)[0],
+                          object=(sb.split("/", 1)[1]
+                                  if "/" in sb else ""),
+                          error=resp.status >= 400)
+                try:
+                    self._respond(resp)
+                finally:
+                    rspan.__exit__(None, None, None)
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
 
@@ -649,6 +760,8 @@ class S3Server:
         "tier": "admin:SetTier",
         "inspect": "admin:InspectData",
         "kms": "admin:KMSKeyStatus",
+        "top": "admin:ServerTrace",
+        "listen": "admin:ListenNotification",
         "bandwidth": "admin:BandwidthMonitor",
         "pools": "admin:ServerInfo",
         "site-replication": "admin:SiteReplicationInfo",
@@ -898,6 +1011,28 @@ class S3Server:
             items = list(self._trace_ring)
             self._trace_ring.clear()
             return j({"trace": items})
+        if sub == "trace" and method == "POST":
+            # Live span-trace stream (cf. TraceHandler,
+            # cmd/admin-handlers.go): chunked NDJSON of completed
+            # request span trees off the span PubSub, server-side
+            # filtered. `duration` (seconds) bounds the stream for
+            # polling clients; without it the stream runs until the
+            # client hangs up.
+            from ..observe.span import TRACER, TraceFilter
+            flat = {k: v[0] if v else "" for k, v in query.items()}
+            filt = TraceFilter.from_query(flat)
+            try:
+                max_s = float(flat.get("duration", 0) or 0)
+            except ValueError:
+                max_s = 0.0
+            return Response(
+                200, b"",
+                {"Content-Type": "application/x-ndjson",
+                 "Transfer-Encoding": "chunked"},
+                body_iter=self._span_stream(TRACER, filt, max_s))
+        if sub == "top/apis" and method == "GET":
+            from ..observe.span import TRACER
+            return j(TRACER.snapshot())
         if sub == "console" and method == "GET":
             n = int(query.get("n", ["100"])[0] or 100)
             return j({"log": self.log_ring.tail(n)})
@@ -1357,6 +1492,99 @@ class S3Server:
         raise S3Error("MethodNotAllowed",
                       f"unknown admin endpoint {sub!r}")
 
+    def _span_stream(self, tracer, filt, max_s: float,
+                     poll: float = 0.05):
+        """Generator behind POST /minio/admin/v3/trace: drain the span
+        PubSub, apply server-side filters, frame as NDJSON. Subscribing
+        is what turns tracing on — requests arriving while at least one
+        stream is open get real span trees."""
+        import json as _json
+        import time as _time
+        q = tracer.subscribe(2000)
+        try:
+            deadline = (_time.monotonic() + max_s) if max_s > 0 else None
+            last = _time.monotonic()
+            while deadline is None or _time.monotonic() < deadline:
+                sent = False
+                while q:
+                    rec = q.popleft()
+                    if filt.matches(rec):
+                        yield _json.dumps(rec).encode() + b"\n"
+                        sent = True
+                now = _time.monotonic()
+                if sent:
+                    last = now
+                elif now - last > 5.0:
+                    # Keepalive blank line: NDJSON consumers skip it,
+                    # and the write is how we notice a client hangup.
+                    yield b"\n"
+                    last = now
+                _time.sleep(poll)
+        finally:
+            tracer.unsubscribe(q)
+
+    def _listen_response(self, bucket: str, query: dict) -> Response:
+        """ListenNotification: `GET /{bucket}?events=...` (and the
+        minio extension `GET /minio/listen` with bucket="") as a
+        chunked NDJSON stream of live S3 event records (cf.
+        ListenNotificationHandler, cmd/bucket-notification-handlers.go).
+        `duration` (seconds) bounds the stream for polling clients."""
+        notify = getattr(self.handlers, "notify", None)
+        if notify is None or not hasattr(notify, "subscribe_events"):
+            raise S3Error("NotImplemented", "notifications not enabled")
+        if bucket and not self.pools.bucket_exists(bucket):
+            raise S3Error("NoSuchBucket", bucket)
+        prefix = query.get("prefix", [""])[0]
+        suffix = query.get("suffix", [""])[0]
+        names = [n for ns in query.get("events", [])
+                 for n in ns.split(",") if n]
+        try:
+            max_s = float(query.get("duration", ["0"])[0] or 0)
+        except ValueError:
+            max_s = 0.0
+        return Response(
+            200, b"",
+            {"Content-Type": "application/x-ndjson",
+             "Transfer-Encoding": "chunked"},
+            body_iter=self._listen_stream(notify, bucket, prefix,
+                                          suffix, names, max_s))
+
+    def _listen_stream(self, notify, bucket, prefix, suffix, names,
+                       max_s: float, poll: float = 0.05):
+        import json as _json
+        import time as _time
+        from fnmatch import fnmatch
+        q = notify.subscribe_events(2000)
+        try:
+            deadline = (_time.monotonic() + max_s) if max_s > 0 else None
+            last = _time.monotonic()
+            while deadline is None or _time.monotonic() < deadline:
+                sent = False
+                while q:
+                    ev = q.popleft()
+                    if bucket and ev["bucket"] != bucket:
+                        continue
+                    key = ev["key"]
+                    if prefix and not key.startswith(prefix):
+                        continue
+                    if suffix and not key.endswith(suffix):
+                        continue
+                    if names and not any(fnmatch(ev["eventName"], pat)
+                                         for pat in names):
+                        continue
+                    yield _json.dumps(
+                        {"Records": [ev["record"]]}).encode() + b"\n"
+                    sent = True
+                now = _time.monotonic()
+                if sent:
+                    last = now
+                elif now - last > 5.0:
+                    yield b"\n"
+                    last = now
+                _time.sleep(poll)
+        finally:
+            notify.unsubscribe_events(q)
+
     def _dispatch_internal(self, req, path: str, query: dict) -> Response:
         """Unauthenticated infra endpoints: health + metrics
         (cf. cmd/metrics-router.go:46, cmd/healthcheck-handler.go)."""
@@ -1404,6 +1632,12 @@ class S3Server:
         if path.startswith("/minio/admin/"):
             return self._dispatch_admin(access_key, method, path, query,
                                         body)
+        if path == "/minio/listen":
+            # Cluster-wide listen (minio extension): admin-plane
+            # authorization, then the same event stream with no bucket
+            # restriction.
+            self._admin_authorize(access_key, "listen", method)
+            return self._listen_response("", query)
 
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0] if parts[0] else ""
@@ -1752,6 +1986,12 @@ class S3Server:
                 return self._handle_post_upload(bucket, ctype, body)
             raise S3Error("MethodNotAllowed")
         if method == "GET":
+            if "events" in query:
+                # ListenBucketNotification: the `events` query is what
+                # distinguishes the live stream from the stored
+                # `?notification` config (the reference registers the
+                # listen route with Queries("events", ...)).
+                return self._listen_response(bucket, query)
             if "location" in query:
                 return h.get_bucket_location(bucket)
             if "versioning" in query:
@@ -1770,7 +2010,7 @@ class S3Server:
         h = self.handlers
         if method == "PUT":
             if "partNumber" in query and "uploadId" in query:
-                return h.put_part(bucket, key, query, body)
+                return h.put_part(bucket, key, query, body, headers)
             if "tagging" in query:
                 return h.put_object_tagging(bucket, key, query, body)
             if "retention" in query:

@@ -16,6 +16,7 @@ import numpy as np
 
 import os
 
+from ..observe import span as ospan
 from ..ops.highwayhash import HighwayHash256, highwayhash256_batch
 from .errors import ErrFileCorrupt
 
@@ -158,7 +159,8 @@ def _hash_batch(blocks: np.ndarray,
                 algo: str = DEFAULT_ALGO) -> np.ndarray:
     """(n, L) uint8 -> (n, digest_size) digests for the given algorithm."""
     try:
-        return ALGORITHMS[algo][1](blocks)
+        with ospan.span("host.hash_batch"):
+            return ALGORITHMS[algo][1](blocks)
     except KeyError:
         raise ErrFileCorrupt(f"unknown bitrot algorithm {algo!r}") from None
 

@@ -78,7 +78,10 @@ class LocalDrive:
         self._meta_lock = threading.Lock()
         self.disk_id: str = ""
         self.endpoint = root
-        self._osc = oscounters.Counters()   # per-drive syscall stats
+        # per-drive syscall stats; doubles as the per-drive I/O span
+        # source inside traced requests (observe/span.py)
+        self._osc = oscounters.Counters(
+            drive=os.path.basename(self.root))
         # Positive volume-existence cache: every data-path call
         # re-stats the volume dir otherwise (~8 stats per PUT across a
         # stripe). Same-process deletes invalidate; a cross-process

@@ -18,6 +18,14 @@ import subprocess
 
 import numpy as np
 
+try:
+    from minio_tpu.observe.span import span as _span
+except Exception:  # standalone shim use: tracing becomes a no-op
+    import contextlib
+
+    def _span(name):
+        return contextlib.nullcontext()
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "ecio.cc")
 _DEPS = (_SRC, os.path.join(_DIR, "mxh256.cc"),
@@ -231,10 +239,11 @@ def put_frame(blocks: np.ndarray, k: int, m: int,
     mats = affine_qwords(pmat)
     at, corr, tag = _mxh_material(S)
     scratch = _scratch(S)
-    lib.ec_put_frame(blocks.ctypes.data, nb, k, m, S, tabs.ctypes.data,
-                     mats.ctypes.data,
-                     at.ctypes.data, corr.ctypes.data, tag.ctypes.data,
-                     ptrs, scratch.ctypes.data)
+    with _span("native.put_frame"):
+        lib.ec_put_frame(blocks.ctypes.data, nb, k, m, S,
+                         tabs.ctypes.data, mats.ctypes.data,
+                         at.ctypes.data, corr.ctypes.data,
+                         tag.ctypes.data, ptrs, scratch.ctypes.data)
     return views if outs is None else outs
 
 
@@ -280,11 +289,12 @@ def get_verify(frames: list, sel: list[int], nb: int, S: int, k: int,
     scratch = _scratch(S)
     keep: list = []
     ptrs = (ctypes.c_void_p * ksel)(*[_raddr(f, keep) for f in frames])
-    nbad = lib.ec_get_verify(
-        ptrs, sel_a.ctypes.data, ksel, nb, S, k, tabs_ptr, mats_ptr,
-        tgt_a.ctypes.data, len(targets), at.ctypes.data, corr.ctypes.data,
-        tag.ctypes.data, y.ctypes.data, ok.ctypes.data,
-        scratch.ctypes.data)
+    with _span("native.get_verify"):
+        nbad = lib.ec_get_verify(
+            ptrs, sel_a.ctypes.data, ksel, nb, S, k, tabs_ptr, mats_ptr,
+            tgt_a.ctypes.data, len(targets), at.ctypes.data,
+            corr.ctypes.data, tag.ctypes.data, y.ctypes.data,
+            ok.ctypes.data, scratch.ctypes.data)
     return y, ok, nbad
 
 

@@ -24,6 +24,18 @@ from minio_tpu.storage.drive import LocalDrive
 from minio_tpu.storage.errors import ErrObjectNotFound
 
 
+def free_port():
+    """An OS-assigned free TCP port.  SO_REUSEADDR lets the server grab
+    it even if this probe socket lingers in TIME_WAIT on slow hosts."""
+    import socket
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def two_pools(tmp, n0=4, n1=4):
     p0 = ErasureSets([LocalDrive(f"{tmp}/p0-{i}") for i in range(n0)],
                      set_drive_count=n0)
@@ -147,16 +159,11 @@ class TestClusterBootPools:
     def test_single_node_cluster_two_pools(self, tmp_path):
         """URL-endpoint boot with TWO pool args: per-pool formats share
         one deployment id; the object layer is a 2-pool ServerPools."""
-        import socket
-
         from minio_tpu.server.cluster import boot_cluster_node
         from minio_tpu.server.server import S3Server
         from minio_tpu.server.sigv4 import Credentials
 
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
+        port = free_port()
         # one GROUP per pool (the CLI maps one --drives flag per group)
         args = [[f"http://127.0.0.1:{port}{tmp_path}/cp0-{{1...4}}"],
                 [f"http://127.0.0.1:{port}{tmp_path}/cp1-{{1...4}}"]]
@@ -168,7 +175,7 @@ class TestClusterBootPools:
 
         node, srv, pools = boot_cluster_node(
             args, "127.0.0.1", port, creds, server_factory=factory,
-            timeout=30)
+            timeout=120)   # shared CI hosts stall; 30s boots flaked
         try:
             assert len(pools.pools) == 2
             assert (pools.pools[0].deployment_id
@@ -190,18 +197,13 @@ class TestCLIPools:
     def test_server_cli_two_pool_groups(self, tmp_path):
         """`--drives '/a{1...4} /b{1...4}'` boots a 2-pool server whose
         S3 surface spreads objects over both pools' drive trees."""
-        import socket
-
         from minio_tpu.server.client import S3Client
 
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
+        port = free_port()
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
 
-        def boot():
+        def boot(port):
             return subprocess.Popen(
                 [sys.executable, "-m", "minio_tpu.server",
                  "--drives",
@@ -209,10 +211,10 @@ class TestCLIPools:
                  "--port", str(port)],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 env=env)
-        proc = boot()
+        proc = boot(port)
         try:
-            url = f"http://127.0.0.1:{port}/minio/health/ready"
             for attempt in (0, 1):       # one re-boot: the shared CI
+                url = f"http://127.0.0.1:{port}/minio/health/ready"
                 deadline = time.monotonic() + 240   # host stalls hard
                 ready = False
                 while time.monotonic() < deadline:
@@ -230,15 +232,27 @@ class TestCLIPools:
                     break
                 proc.kill()
                 try:
-                    proc.wait(timeout=15)   # release the port before
-                except subprocess.TimeoutExpired:   # rebinding it
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
                     pass
                 out = proc.stdout.read() or b""
                 assert attempt == 0, f"server never ready: {out[-500:]}"
-                proc = boot()
+                # A fresh port dodges TIME_WAIT / a squatter that beat
+                # us to the one the dead server was probing.
+                port = free_port()
+                proc = boot(port)
             cli = S3Client(f"http://127.0.0.1:{port}", "minioadmin",
                            "minioadmin")
-            cli.make_bucket("bkt")
+            # Ready flipped, but a stalled host can still drop the
+            # first connect on the floor; retry transport errors only.
+            for tries_left in (2, 1, 0):
+                try:
+                    cli.make_bucket("bkt")
+                    break
+                except (OSError, TimeoutError):
+                    if not tries_left:
+                        raise
+                    time.sleep(1.0)
             blobs = {}
             for i in range(8):
                 data = os.urandom(150_000 + i)

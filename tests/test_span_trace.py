@@ -1,0 +1,455 @@
+"""Request-scoped span tracing + admin trace/listen streaming plane.
+
+Covers the observe.span subsystem (zero-allocation disabled path, ring
+retention, filters, PUT/GET span-tree coverage), the admin NDJSON trace
+stream and top/apis aggregates, ListenNotification event streams, and
+UploadPartCopy — plus the tracing-off overhead smoke guard.
+"""
+
+import hashlib
+import json
+import threading
+import time
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from minio_tpu.bucket.notify import NotificationSystem
+from minio_tpu.engine.erasure_set import ErasureSet
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.observe import span as ospan
+from minio_tpu.server.client import S3Client, S3ClientError
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ACCESS, SECRET = "spanadmin", "spanadmin-secret"
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@pytest.fixture(autouse=True)
+def tracer_reset():
+    """TRACER is process-global: leave every test with tracing off."""
+    yield
+    ospan.TRACER.configure(ring=0, sample=1.0)
+    ospan.TRACER.reset()
+
+
+@pytest.fixture()
+def es(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(drives)
+    es.make_bucket("b")
+    return es
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    srv = S3Server(pools, Credentials(ACCESS, SECRET),
+                   notify=NotificationSystem()).start()
+    cli = S3Client(srv.endpoint, ACCESS, SECRET)
+    yield srv, cli
+    srv.shutdown()
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+class TestSpanUnits:
+    def test_disabled_path_allocates_no_spans(self, es):
+        """Tracing off: root() returns the NOOP singleton and a full
+        engine GET materialises zero Span objects (SPAN_ALLOCS is the
+        allocation sentinel incremented by Span.__init__)."""
+        es.put_object("b", "o", payload(1 << 20))
+        before = ospan.SPAN_ALLOCS
+        assert ospan.TRACER.root("api.GetObject") is ospan.NOOP
+        with ospan.span("engine.nothing"):
+            pass
+        ospan.record("engine.nothing", 0.001)
+        _, got = es.get_object("b", "o")
+        assert len(got) == 1 << 20
+        assert ospan.SPAN_ALLOCS == before
+
+    def test_ring_keeps_newest_n(self):
+        ospan.TRACER.configure(ring=3, sample=1.0)
+        for i in range(7):
+            with ospan.TRACER.root(f"api.Op{i}"):
+                pass
+        names = [r["name"] for r in ospan.TRACER.traces()]
+        assert names == ["api.Op4", "api.Op5", "api.Op6"]
+
+    def test_ring_resize_preserves_existing(self):
+        ospan.TRACER.configure(ring=4, sample=1.0)
+        with ospan.TRACER.root("api.Keep"):
+            pass
+        ospan.TRACER.configure(ring=8, sample=1.0)
+        assert [r["name"] for r in ospan.TRACER.traces()] == ["api.Keep"]
+
+    def test_filter_model(self):
+        rec_ok = {"name": "api.GetObject", "dur_ms": 5.0, "error": False,
+                  "tags": {"path": "/b/x"}}
+        rec_err = {"name": "api.GetObject", "dur_ms": 0.2, "error": True,
+                   "tags": {"path": "/other/y"}}
+        f = ospan.TraceFilter.from_query(
+            {"err": "true", "path": "/b", "min-duration-ms": "1"})
+        assert not f.matches(rec_ok)      # not an error
+        assert not f.matches(rec_err)     # wrong prefix + too fast
+        assert ospan.TraceFilter.from_query({}).matches(rec_ok)
+        assert ospan.TraceFilter(err_only=True).matches(rec_err)
+        assert not ospan.TraceFilter(min_ms=1.0).matches(rec_err)
+        assert ospan.TraceFilter(path_prefix="/b").matches(rec_ok)
+
+    def test_subscriber_alone_enables_tracing(self):
+        assert not ospan.TRACER.enabled
+        q = ospan.TRACER.subscribe()
+        try:
+            assert ospan.TRACER.enabled
+            with ospan.TRACER.root("api.X", path="/p"):
+                with ospan.span("stage.one"):
+                    pass
+            assert len(q) == 1
+            assert q[0]["spans"][0]["name"] == "stage.one"
+        finally:
+            ospan.TRACER.unsubscribe(q)
+        assert not ospan.TRACER.enabled
+
+    def test_put_get_trace_coverage(self, es):
+        """A traced 16 MiB PUT and GET each yield >= 5 distinct named
+        child spans summing to >= 80% of the root wall time."""
+        data = payload(16 << 20, seed=9)
+        es.put_object("b", "big", data)          # warm (compile, cache)
+        es.get_object("b", "big")
+        ospan.TRACER.configure(ring=8, sample=1.0)
+        with ospan.TRACER.root("api.PutObject", path="/b/big"):
+            es.put_object("b", "big", data)
+        with ospan.TRACER.root("api.GetObject", path="/b/big"):
+            _, got = es.get_object("b", "big")
+        assert bytes(got) == data
+        put_rec, get_rec = ospan.TRACER.traces()[-2:]
+        for rec in (put_rec, get_rec):
+            stages = ospan.flatten(rec)
+            assert len(stages) >= 5, stages
+            assert ospan.coverage(rec) >= 0.8, (rec["name"],
+                                                rec["dur_ms"], stages)
+
+    def test_aggregates_snapshot(self, es):
+        ospan.TRACER.configure(ring=4, sample=1.0)
+        for _ in range(3):
+            with ospan.TRACER.root("api.PutObject", path="/b/agg"):
+                es.put_object("b", "agg", payload(1 << 20))
+        snap = ospan.TRACER.snapshot()
+        api = snap["apis"]["api.PutObject"]
+        assert api["count"] == 3 and api["errors"] == 0
+        assert api["p50_ms"] > 0 and api["avg_ms"] > 0
+        assert "engine.encode" in api["stages"]
+        enc = api["stages"]["engine.encode"]
+        assert enc["count"] >= 3
+        assert sum(enc["buckets"]) == enc["count"]
+
+    def test_span_metrics_exported(self, es):
+        from minio_tpu.observe.metrics import MetricsRegistry
+        ospan.TRACER.configure(ring=4, sample=1.0)
+        with ospan.TRACER.root("api.PutObject", path="/b/m"):
+            es.put_object("b", "m", payload(1 << 20))
+        text = MetricsRegistry().render()
+        assert 'mtpu_trace_api_requests_total{api="api.PutObject"} 1' \
+            in text
+        assert 'mtpu_trace_stage_duration_ms_bucket{api="api.PutObject"' \
+            in text and 'le="+Inf"' in text
+
+
+class TestAdminTraceEndpoints:
+    def _collect(self, cli, query, out):
+        st, _, body = cli.request("POST", "/minio/admin/v3/trace",
+                                  query=query)
+        out.append((st, body))
+
+    def test_trace_stream_delivers_request(self, stack):
+        srv, cli = stack
+        cli.make_bucket("tbk")
+        out = []
+        t = threading.Thread(target=self._collect, args=(
+            cli, {"duration": "2"}, out))
+        t.start()
+        # Wait for the stream subscription to flip TRACER.enabled.
+        deadline = time.monotonic() + 5
+        while not ospan.TRACER.enabled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ospan.TRACER.enabled
+        cli.put_object("tbk", "hello", payload(1 << 20))
+        t.join(timeout=15)
+        assert out and out[0][0] == 200
+        recs = [json.loads(line) for line in out[0][1].splitlines()
+                if line.strip()]
+        puts = [r for r in recs if r["name"] == "api.PutObject"]
+        assert puts, recs
+        rec = puts[0]
+        tags = rec["tags"]
+        assert tags["path"] == "/tbk/hello"
+        assert tags["bucket"] == "tbk" and tags["object"] == "hello"
+        assert tags["status"] == 200 and not rec["error"]
+        assert any(c["name"].startswith("engine.")
+                   for c in rec.get("spans", []))
+
+    def test_trace_stream_err_filter(self, stack):
+        srv, cli = stack
+        cli.make_bucket("tfk")
+        cli.put_object("tfk", "x", b"data")
+        out = []
+        t = threading.Thread(target=self._collect, args=(
+            cli, {"duration": "2", "err": "true"}, out))
+        t.start()
+        deadline = time.monotonic() + 5
+        while not ospan.TRACER.enabled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        cli.get_object("tfk", "x")                       # 200: filtered
+        with pytest.raises(S3ClientError):
+            cli.get_object("tfk", "missing")             # 404: streamed
+        t.join(timeout=15)
+        recs = [json.loads(line) for line in out[0][1].splitlines()
+                if line.strip()]
+        assert recs and all(r["error"] for r in recs)
+        assert any(r["tags"]["path"] == "/tfk/missing" for r in recs)
+
+    def test_top_apis_route(self, stack):
+        srv, cli = stack
+        ospan.TRACER.configure(ring=16, sample=1.0)
+        cli.make_bucket("tak")
+        cli.put_object("tak", "o", payload(1 << 20))
+        cli.get_object("tak", "o")
+        # The root span commits after the response bytes are written, so
+        # the aggregate can land just after the client returns.
+        deadline = time.monotonic() + 5
+        while "api.GetObject" not in ospan.TRACER.snapshot()["apis"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        st, _, body = cli.request("GET", "/minio/admin/v3/top/apis")
+        assert st == 200
+        snap = json.loads(body)
+        assert "api.PutObject" in snap["apis"]
+        assert "api.GetObject" in snap["apis"]
+        put = snap["apis"]["api.PutObject"]
+        assert put["count"] >= 1 and put["stages"]
+        assert snap["bucket_bounds_ms"][0] == 0.05
+
+    def test_trace_requires_admin_auth(self, stack):
+        srv, cli = stack
+        bad = S3Client(srv.endpoint, "nobody", "nobody-secret")
+        st, _, _ = bad.request("POST", "/minio/admin/v3/trace",
+                               query={"duration": "1"})
+        assert st == 403
+
+
+class TestListenNotification:
+    def _listen(self, cli, path, query, out):
+        st, _, body = cli.request("GET", path, query=query)
+        out.append((st, body))
+
+    def test_put_during_listen_delivers_created_event(self, stack):
+        srv, cli = stack
+        cli.make_bucket("lbk")
+        out = []
+        t = threading.Thread(target=self._listen, args=(
+            cli, "/lbk", {"events": "s3:ObjectCreated:*",
+                         "duration": "2"}, out))
+        t.start()
+        notify = srv.handlers.notify
+        deadline = time.monotonic() + 5
+        while not notify.pubsub.num_subscribers \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert notify.pubsub.num_subscribers
+        cli.put_object("lbk", "dir/new.bin", b"event payload")
+        t.join(timeout=15)
+        assert out and out[0][0] == 200
+        lines = [json.loads(line) for line in out[0][1].splitlines()
+                 if line.strip()]
+        recs = [r["Records"][0] for r in lines if "Records" in r]
+        assert recs, out[0][1]
+        ev = recs[0]
+        assert ev["eventName"] == "s3:ObjectCreated:Put"
+        assert ev["s3"]["bucket"]["name"] == "lbk"
+        assert ev["s3"]["object"]["key"] == "dir/new.bin"
+
+    def test_listen_filters_prefix_and_event(self, stack):
+        srv, cli = stack
+        cli.make_bucket("lfk")
+        out = []
+        t = threading.Thread(target=self._listen, args=(
+            cli, "/lfk", {"events": "s3:ObjectRemoved:*",
+                         "prefix": "logs/", "duration": "2"}, out))
+        t.start()
+        notify = srv.handlers.notify
+        deadline = time.monotonic() + 5
+        while not notify.pubsub.num_subscribers \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        cli.put_object("lfk", "logs/a", b"x")       # wrong event type
+        cli.put_object("lfk", "data/b", b"y")
+        cli.delete_object("lfk", "data/b")          # wrong prefix
+        cli.delete_object("lfk", "logs/a")          # the one match
+        t.join(timeout=15)
+        lines = [json.loads(line) for line in out[0][1].splitlines()
+                 if line.strip()]
+        recs = [r["Records"][0] for r in lines if "Records" in r]
+        assert len(recs) == 1, recs
+        assert recs[0]["eventName"].startswith("s3:ObjectRemoved:")
+        assert recs[0]["s3"]["object"]["key"] == "logs/a"
+
+    def test_global_listen_route(self, stack):
+        srv, cli = stack
+        cli.make_bucket("lgk")
+        out = []
+        t = threading.Thread(target=self._listen, args=(
+            cli, "/minio/listen", {"duration": "2"}, out))
+        t.start()
+        notify = srv.handlers.notify
+        deadline = time.monotonic() + 5
+        while not notify.pubsub.num_subscribers \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        cli.put_object("lgk", "o", b"z")
+        t.join(timeout=15)
+        assert out and out[0][0] == 200
+        lines = [json.loads(line) for line in out[0][1].splitlines()
+                 if line.strip()]
+        assert any(r["Records"][0]["s3"]["bucket"]["name"] == "lgk"
+                   for r in lines if "Records" in r)
+
+
+class TestUploadPartCopy:
+    def _initiate(self, cli, bucket, key):
+        _, _, body = cli.request("POST", f"/{bucket}/{key}",
+                                 query={"uploads": ""})
+        return ET.fromstring(body).findtext(f"{NS}UploadId")
+
+    def _complete(self, cli, bucket, key, uid, parts):
+        root = ET.Element("CompleteMultipartUpload")
+        for n, etag in parts:
+            p = ET.SubElement(root, "Part")
+            ET.SubElement(p, "PartNumber").text = str(n)
+            ET.SubElement(p, "ETag").text = etag
+        st, _, body = cli.request("POST", f"/{bucket}/{key}",
+                                  query={"uploadId": uid},
+                                  body=ET.tostring(root))
+        assert st == 200, body
+        return body
+
+    def test_copy_part_completes_byte_identical(self, stack):
+        srv, cli = stack
+        cli.make_bucket("src")
+        cli.make_bucket("dst")
+        src = payload(6 << 20, seed=3)
+        tail = payload(1 << 20, seed=4)
+        cli.put_object("src", "big", src)
+
+        uid = self._initiate(cli, "dst", "out")
+        st, _, body = cli.request(
+            "PUT", "/dst/out",
+            query={"partNumber": "1", "uploadId": uid},
+            headers={"x-amz-copy-source": "/src/big"})
+        assert st == 200, body
+        cp = ET.fromstring(body)
+        assert cp.tag == f"{NS}CopyPartResult"
+        etag1 = cp.findtext(f"{NS}ETag").strip('"')
+        # A copy-sourced part is byte-identical to an uploaded one:
+        # same content md5, hence the same part ETag.
+        assert etag1 == hashlib.md5(src).hexdigest()
+        assert cp.findtext(f"{NS}LastModified")
+        _, h, _ = cli.request("PUT", "/dst/out",
+                              query={"partNumber": "2", "uploadId": uid},
+                              body=tail)
+        etag2 = h["ETag"].strip('"')
+        self._complete(cli, "dst", "out", uid, [(1, etag1), (2, etag2)])
+        assert cli.get_object("dst", "out") == src + tail
+
+    def test_copy_part_with_range(self, stack):
+        srv, cli = stack
+        cli.make_bucket("rsrc")
+        cli.make_bucket("rdst")
+        src = payload(8 << 20, seed=5)
+        cli.put_object("rsrc", "obj", src)
+        uid = self._initiate(cli, "rdst", "out")
+        lo, hi = 1 << 20, (7 << 20) - 1                # 6 MiB slice
+        st, _, body = cli.request(
+            "PUT", "/rdst/out",
+            query={"partNumber": "1", "uploadId": uid},
+            headers={"x-amz-copy-source": "/rsrc/obj",
+                     "x-amz-copy-source-range": f"bytes={lo}-{hi}"})
+        assert st == 200, body
+        etag = ET.fromstring(body).findtext(f"{NS}ETag").strip('"')
+        assert etag == hashlib.md5(src[lo:hi + 1]).hexdigest()
+        self._complete(cli, "rdst", "out", uid, [(1, etag)])
+        assert cli.get_object("rdst", "out") == src[lo:hi + 1]
+
+    def test_copy_part_errors(self, stack):
+        srv, cli = stack
+        cli.make_bucket("esrc")
+        cli.make_bucket("edst")
+        cli.put_object("esrc", "obj", b"0123456789")
+        uid = self._initiate(cli, "edst", "out")
+        st, _, body = cli.request(
+            "PUT", "/edst/out",
+            query={"partNumber": "1", "uploadId": uid},
+            headers={"x-amz-copy-source": "/esrc/missing"})
+        assert st == 404 and b"NoSuchKey" in body
+        # Range beyond the source is a hard error (unlike ranged GET).
+        st, _, body = cli.request(
+            "PUT", "/edst/out",
+            query={"partNumber": "1", "uploadId": uid},
+            headers={"x-amz-copy-source": "/esrc/obj",
+                     "x-amz-copy-source-range": "bytes=5-100"})
+        assert st == 416 and b"InvalidRange" in body
+
+
+class TestDisabledOverhead:
+    def test_healthy_get_overhead_under_3pct(self, es):
+        """Tracing off must cost <3% on the healthy-GET path vs a
+        baseline with the span hooks stubbed to bare no-ops.  min-of-N
+        timing with whole-measurement retries rides out CI noise."""
+        data = payload(1 << 20, seed=1)
+        es.put_object("b", "o", data)
+        for _ in range(5):
+            es.get_object("b", "o")                     # warm
+
+        def best_ms(n=30):
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                es.get_object("b", "o")
+                best = min(best, time.perf_counter() - t0)
+            return best * 1e3
+
+        def noop_span(name):
+            return ospan.NOOP
+
+        def noop_record(name, seconds, **tags):
+            return None
+
+        saved = (ospan.span, ospan.record, ospan.wrap_ctx,
+                 ospan.timed_iter)
+        assert not ospan.TRACER.enabled
+        try:
+            for attempt in range(3):
+                with_hooks = best_ms()
+                ospan.span = noop_span
+                ospan.record = noop_record
+                ospan.wrap_ctx = lambda fn: fn
+                ospan.timed_iter = lambda gen, name: gen
+                baseline = best_ms()
+                (ospan.span, ospan.record, ospan.wrap_ctx,
+                 ospan.timed_iter) = saved
+                if with_hooks <= baseline * 1.03:
+                    break
+            assert with_hooks <= baseline * 1.03, \
+                f"disabled tracing {with_hooks:.3f}ms vs " \
+                f"baseline {baseline:.3f}ms"
+        finally:
+            (ospan.span, ospan.record, ospan.wrap_ctx,
+             ospan.timed_iter) = saved
