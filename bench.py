@@ -227,6 +227,48 @@ def e2e_bench(n_put: int = 64, n_parts: int = 4,
             for k, v in out.items()}
 
 
+def concurrent_bench(duration_s: float = 4.0,
+                     object_mib: int = 1) -> dict:
+    """Concurrent data-plane suite (the dispatch-coalescer numbers):
+    closed-loop mixed PUT/GET at 1/4/16 clients via tools/loadgen,
+    reporting aggregate GB/s, p50/p99 latency, and the mean coalesced
+    batch occupancy per client count.  The 1-client run doubles as the
+    1-client x N-serial baseline (a closed loop at the same wall time
+    is the serial schedule), so `conc_16c_vs_serial_speedup` is the
+    acceptance ratio directly."""
+    import shutil
+    import tempfile
+
+    from minio_tpu.engine.erasure_set import ErasureSet
+    from minio_tpu.storage.drive import LocalDrive
+    from tools.loadgen import run_load
+
+    out = {}
+    root = tempfile.mkdtemp(prefix="mtpu-conc-")
+    try:
+        es = ErasureSet([LocalDrive(f"{root}/d{i}") for i in range(4)])
+        es.make_bucket("bench")
+        rng = np.random.default_rng(5)
+        warm = rng.integers(0, 256, object_mib << 20,
+                            dtype=np.uint8).tobytes()
+        es.put_object("bench", "warm", warm)            # compile warm-up
+        es.get_object("bench", "warm")
+        for n in (1, 4, 16):
+            r = run_load(es, clients=n, object_size=object_mib << 20,
+                         put_frac=0.5, duration_s=duration_s,
+                         bucket="bench", seed=n)
+            out[f"conc{n}_gbps"] = r["gbps"]
+            out[f"conc{n}_p50_ms"] = r["p50_ms"]
+            out[f"conc{n}_p99_ms"] = r["p99_ms"]
+            out[f"conc{n}_occupancy"] = r["co_occupancy"]
+        if out["conc1_gbps"] > 0:
+            out["conc_16c_vs_serial_speedup"] = round(
+                out["conc16_gbps"] / out["conc1_gbps"], 2)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _best_of(f, n=5):
     """Best-of-n ms timing for the stage-attribution probes."""
     f()
@@ -683,8 +725,9 @@ def main() -> None:
         res = subprocess.run(
             [sys.executable, "-c",
              "import json, sys; sys.path.insert(0, sys.argv[1]); "
-             "from bench import e2e_bench; "
-             "print(json.dumps(e2e_bench()))", here],
+             "from bench import e2e_bench, concurrent_bench; "
+             "r = e2e_bench(); r.update(concurrent_bench()); "
+             "print(json.dumps(r))", here],
             env=env, capture_output=True, text=True, timeout=600)
         if res.returncode != 0:
             raise RuntimeError(res.stderr[-300:])
@@ -756,7 +799,7 @@ def main() -> None:
     # e2e object-layer configs + tunnel context measured above
     for k, v in results.items():
         if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup",
-                        "_ms_tmpfs", "_pct", "_pct_tmpfs"))
+                        "_ms_tmpfs", "_pct", "_pct_tmpfs", "_occupancy"))
                 or k.startswith("tunnel_") or k == "host_cores"):
             extras.setdefault(k, v)
     if "put_stage_md5_ms_tmpfs" in extras:

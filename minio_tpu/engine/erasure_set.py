@@ -29,7 +29,7 @@ import numpy as np
 
 from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
-from ..ops import fused
+from ..ops import coalesce, fused
 from ..ops.erasure_cpu import ReedSolomonCPU
 from ..ops.erasure_jax import ReedSolomonTPU
 from ..parallel import pipeline as pl
@@ -128,6 +128,17 @@ def _etag(data: bytes) -> str:
     return hashlib.md5(data).hexdigest()
 
 
+#: Drive-pool thread tag (see ErasureSet.__init__): lets fan-out helpers
+#: detect they are ALREADY on this set's drive pool and run inline
+#: instead of nested-submitting — a task queued behind its own parent is
+#: the one thread-pool deadlock shape this engine can produce.
+_POOL_LOCAL = __import__("threading").local()
+
+
+def _tag_pool_thread(tag: str) -> None:
+    _POOL_LOCAL.tag = tag
+
+
 def _now_ns() -> int:
     return time.time_ns()
 
@@ -146,11 +157,20 @@ class ErasureSet:
         self.default_parity = (self.n // 2 if default_parity is None
                                else default_parity)
         self.set_index = set_index
-        self.pool = ThreadPoolExecutor(max_workers=max(self.n, 4))
-        # Prefetch tasks (get_object_iter segments) WAIT on self.pool
-        # leaf tasks; giving them their own executor makes a nested-
-        # submit deadlock impossible no matter how many streams are
-        # concurrently draining.
+        # Pool-nesting invariant: work running ON self.pool must never
+        # block on another self.pool future.  Two mechanisms enforce it:
+        # (1) layered executors — prefetch tasks (get_object_iter
+        # segments) WAIT on self.pool leaf tasks, so they get their own
+        # _iter_pool; coalesced-dispatch futures resolve on the
+        # coalescer's dedicated thread, never this pool; and (2) the
+        # initializer tags every pool thread so fan-out helpers
+        # (_map_drives, _map_drives_positions, _hash_shard_frames, the
+        # read-shard fan-outs) detect re-entry and run inline instead
+        # of nested-submitting behind their own parent task.
+        self._pool_tag = f"drive-pool-{set_index}-{id(self)}"
+        self.pool = ThreadPoolExecutor(max_workers=max(self.n, 4),
+                                       initializer=_tag_pool_thread,
+                                       initargs=(self._pool_tag,))
         self._iter_pool = ThreadPoolExecutor(max_workers=8)
         self._codec_cache: dict[tuple[int, int], ReedSolomonTPU] = {}
         self._cpu_cache: dict[tuple[int, int], ReedSolomonCPU] = {}
@@ -315,7 +335,7 @@ class ErasureSet:
             except Exception as e:  # noqa: BLE001 — quorum layer classifies
                 return None, e
 
-        if self._serial_local(drives):
+        if self._serial_local(drives) or self._on_drive_pool():
             return [call(d) for d in drives]
         # wrap_ctx: per-drive spans born in pool threads still attach
         # to the traced request (no-op when untraced).
@@ -449,17 +469,16 @@ class ErasureSet:
 
         distribution = Q.hash_order(f"{bucket}/{obj}", self.n)
         meta = dict(metadata or {})
-        # Overlap the MD5 etag with encode+write: hashlib releases the
-        # GIL, so the digest runs beside the codec instead of adding
-        # ~2 ms/MiB of serial latency. Resolved before publish. On a
-        # 1-core host there is nothing to overlap with — inline it.
-        etag_fut = None
+        # Overlap the MD5 etag with encode+write: the body is queued to
+        # a digest worker in 1 MiB views and hashed WHILE the shard
+        # pipeline encodes/writes (hashlib, the codec kernels, and file
+        # IO all release the GIL, so the overlap is real even on the
+        # 1-core host, where the up-front digest was the measured PUT
+        # wall).  Resolved before publish; byte-identical ETags.
+        etag_md5 = None
         if stream is None and "etag" not in meta:
-            if self._SERIAL_FANOUT:
-                with ospan.span("engine.etag"):
-                    meta["etag"] = _etag(data)
-            else:
-                etag_fut = self._iter_pool.submit(_etag, data)
+            etag_md5 = streams.PipelinedMD5()
+            etag_md5.feed(data)
         if upgraded:
             meta["x-mtpu-internal-erasure-upgraded"] = f"{offline}-offline"
         version_id = new_uuid() if versioned else ""
@@ -488,9 +507,9 @@ class ErasureSet:
                 erasure=ec, inline_data=inline)
 
         if stream is None and len(data) <= SMALL_FILE_THRESHOLD:
-            if etag_fut is not None:
+            if etag_md5 is not None:
                 with ospan.span("engine.etag"):
-                    meta.setdefault("etag", etag_fut.result())
+                    meta.setdefault("etag", etag_md5.hexdigest())
             return self._put_inline(bucket, obj, data, fi_for, k, parity,
                                     distribution, write_quorum, algo)
 
@@ -500,7 +519,11 @@ class ErasureSet:
         tmp_id = f"put-{uuid.uuid4().hex}"
         failed = [d is None for d in self.drives]
 
-        md5 = hashlib.md5()
+        # Streamed bodies pipeline their digest too: each pulled chunk
+        # is queued to the digest worker and hashes under the NEXT
+        # chunk's read+encode instead of serially before it.
+        md5 = streams.PipelinedMD5() if stream is not None \
+            else hashlib.md5()
         total = 0
 
         def counted_chunks():
@@ -520,12 +543,16 @@ class ErasureSet:
         # parallelWriter+RenameData pair in the reference is likewise
         # one connection round per drive, cmd/erasure-object.go:1200).
         if stream is None and len(data) <= BATCH_BLOCKS * BLOCK_SIZE:
-            with ospan.span("engine.encode"):
-                batches = list(self._encode_chunks(
-                    [(data, True)], k, parity, algo))
-            if etag_fut is not None:
+            try:
+                with ospan.span("engine.encode"):
+                    batches = list(self._encode_chunks(
+                        [(data, True)], k, parity, algo))
+            finally:
+                if etag_md5 is not None:
+                    etag_md5.close()     # worker drains what's queued
+            if etag_md5 is not None:
                 with ospan.span("engine.etag"):
-                    meta.setdefault("etag", etag_fut.result())
+                    meta.setdefault("etag", etag_md5.hexdigest())
             per_drive = [Q.unshuffle_to_drives(b, distribution)
                          for b in batches]
 
@@ -604,9 +631,9 @@ class ErasureSet:
             if stream is not None:
                 sizeref["size"] = total
                 meta.setdefault("etag", md5.hexdigest())
-            elif etag_fut is not None:
+            elif etag_md5 is not None:
                 with ospan.span("engine.etag"):
-                    meta.setdefault("etag", etag_fut.result())
+                    meta.setdefault("etag", etag_md5.hexdigest())
 
             def publish(pos):
                 d = self.drives[pos]
@@ -623,7 +650,13 @@ class ErasureSet:
                 raise err
         finally:
             # Always sweep staging: publish renames the winners away;
-            # failed/partial drives still hold tmp shard files.
+            # failed/partial drives still hold tmp shard files.  The
+            # digest workers must be released too — an abandoned one
+            # would hold its slot until the idle backstop.
+            if etag_md5 is not None:
+                etag_md5.close()
+            if isinstance(md5, streams.PipelinedMD5):
+                md5.close()
             self._cleanup_tmp(tmp_id)
         fi = fi_for(0, data_dir, None)
         # Partial success (quorum met, some drives failed): queue for MRF
@@ -674,6 +707,14 @@ class ErasureSet:
             isinstance(d, (LocalDrive, type(None)))
             for d in (self.drives if drives is None else drives))
 
+    def _on_drive_pool(self) -> bool:
+        """True when the calling thread IS one of this set's drive-pool
+        workers: a nested fan-out must run inline — submitting to the
+        pool it occupies and blocking on the result can deadlock once
+        every worker does the same (the hazard the prefetch _iter_pool
+        comment in __init__ guards the iterator path against)."""
+        return getattr(_POOL_LOCAL, "tag", None) == self._pool_tag
+
     def _map_drives_positions(self, fn, parallel: bool = False) -> list:
         """Like _map_drives but fn gets the drive *position*.
 
@@ -681,7 +722,8 @@ class ErasureSet:
         host — for syscall-heavy per-drive work (multipart complete's
         publish: per-part stat + meta read + renames) where the GIL is
         released in the kernel and overlap beats pool overhead."""
-        if not parallel and self._serial_local():
+        if (not parallel and self._serial_local()) \
+                or self._on_drive_pool():
             out = []
             for pos in range(self.n):
                 try:
@@ -717,6 +759,82 @@ class ErasureSet:
         chunks = streams.batched_chunks(data, None,
                                         BATCH_BLOCKS * BLOCK_SIZE)
         yield from self._encode_chunks(chunks, k, m, algo)
+
+    # -- coalesced-dispatch kernels (ops/coalesce.py) ------------------------
+    #
+    # Each factory returns an fn(stacked, spans, ctx) closure computing
+    # one coalesced batch; the coalescer key carries every parameter the
+    # closure captures, so items from different requests (and different
+    # ErasureSet instances of the same geometry — the kernels are pure
+    # functions of (k, m, algo, S)) stack along the block axis.
+
+    def _pf_kernel(self, k: int, m: int, shard_size: int):
+        """Fused host encode (ecio put_frame): parity + digests + frame
+        layout in one C pass over the stacked blocks.  Output goes into
+        a pooled per-dispatch buffer (fresh mmap-sized allocations per
+        dispatch would pay ~0.5 ms/MiB in page faults — the reason the
+        direct path uses a per-thread arena, which a cross-request
+        result cannot safely alias); shard i's frames are contiguous,
+        so item j's framed views are plain slices."""
+        fused_host = _ecio_mod()
+        frame_len = bitrot_io.digest_size("mxh256") + shard_size
+
+        def kernel(stacked, spans, ctx):
+            nb = stacked.shape[0]
+            per = nb * frame_len
+            buf = ctx.rent((k + m) * per)
+            outs = [buf[i * per:(i + 1) * per] for i in range(k + m)]
+            fused_host.put_frame(stacked, k, m, outs=outs)
+            return [[o[lo * frame_len:hi * frame_len] for o in outs]
+                    for lo, hi in spans]
+
+        return kernel
+
+    def _enc_kernel(self, k: int, m: int, algo: str, fused_dev: bool):
+        """Device/native encode over the stacked blocks; device shapes
+        are padded to BATCH_BLOCKS buckets so coalesced batch sizes
+        don't multiply jit compiles.  Returns (parity, digests) per
+        span — the same pair the direct dispatch produces, so the
+        framing path downstream is shared."""
+
+        def kernel(stacked, spans, ctx):
+            if fused_dev:
+                x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
+                parity, digests = fused.encode_and_hash(x, k, m,
+                                                        algo=algo)
+                parity = np.asarray(parity)[:n]
+                digests = np.asarray(digests)[:, :n]
+                return [(parity[lo:hi], digests[:, lo:hi])
+                        for lo, hi in spans]
+            if self._use_device:
+                x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
+                parity = np.asarray(
+                    self._codec(k, m).encode_blocks(x))[:n]
+            else:
+                parity = np.asarray(
+                    self._native(k, m).encode_blocks(stacked))
+            return [(parity[lo:hi], None) for lo, hi in spans]
+
+        return kernel
+
+    def _vt_kernel(self, k: int, m: int, sources: tuple, targets: tuple,
+                   algo: str):
+        """Fused device verify(+reconstruct) over stacked (B, K, S)
+        gathers — the healthy-verify / degraded-decode / heal work
+        item.  Digest layout is (B, K, hs): axis 0 is the concat axis
+        for both outputs."""
+
+        def kernel(stacked, spans, ctx):
+            x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
+            digests, out = fused.verify_and_transform(
+                x, k, m, sources, targets, algo=algo)
+            digests = np.asarray(digests)[:n]
+            out = np.asarray(out)[:n] if targets else None
+            return [(digests[lo:hi],
+                     out[lo:hi] if out is not None else None)
+                    for lo, hi in spans]
+
+        return kernel
 
     def _encode_chunks(self, chunks, k: int, m: int,
                        algo: str | None = None,
@@ -760,6 +878,16 @@ class ErasureSet:
             return bitrot_io.frame_shard_views(
                 blocks, np.asarray(parity), digests, algo)
 
+        # Cross-request coalescing (MTPU_COALESCE, ops/coalesce.py):
+        # instead of dispatching this request's batch directly, submit
+        # it to the shared coalescer — concurrent requests' compatible
+        # batches stack into ONE kernel launch and each request gets
+        # its slice back through a future.  The future slots into the
+        # same one-deep `pending` pipeline the direct device path uses,
+        # so in-request overlap is preserved while cross-request
+        # batching happens underneath.
+        co = coalesce.get() if coalesce.enabled() else None
+
         # Double-buffered pipeline: dispatch batch i, then frame/yield
         # batch i-1 while the device works — hides dispatch+transfer
         # latency (large through the axon tunnel) behind host framing
@@ -768,6 +896,26 @@ class ErasureSet:
         pending = None
         arenas = None       # two alternating fused-output buffers
         flip = 0
+        # Retired coalesced put_frame handles: their results alias a
+        # POOLED dispatch buffer, and a pipelined consumer may still be
+        # writing batch i when batch i+1 is pulled — so a buffer is
+        # only recycled two yields after its batch was handed out.
+        retired: list = []
+
+        def flush(p):
+            tag = p[0]
+            if tag == "pf":
+                framed = p[1].result()
+                retired.append(p[1])
+                if len(retired) > 2:
+                    retired.pop(0).release()
+                return framed
+            if tag == "co":
+                parity, digests = p[2].result()
+                p[2].release()       # fresh arrays — nothing pooled
+                return frame(p[1], parity, digests)
+            return frame(p[1], p[2], p[3])
+
         frame_len = bitrot_io.digest_size("mxh256") + shard_size
         for chunk, is_last in chunks:
             buf = np.frombuffer(chunk, dtype=np.uint8)
@@ -785,7 +933,14 @@ class ErasureSet:
                     blocks[:, :BLOCK_SIZE] = batch.reshape(nb, BLOCK_SIZE)
                     blocks = blocks.reshape(nb, k, shard_size)
                 if fused_host is not None:
-                    if double_buffer:
+                    if co is not None:
+                        h = co.submit(
+                            ("pf", k, m, shard_size), blocks,
+                            self._pf_kernel(k, m, shard_size), weight=nb)
+                        if pending is not None:
+                            yield flush(pending)
+                        pending = ("pf", h)
+                    elif double_buffer:
                         per = BATCH_BLOCKS * frame_len
                         if arenas is None:
                             arenas = _db_arenas((k + m) * per)
@@ -804,11 +959,29 @@ class ErasureSet:
                 if _mesh_mode():
                     # Multi-device: place the shard matmul on the mesh
                     # (blocks x lanes SPMD); digests hash on host.
+                    # Mesh placement stays direct — SPMD shapes don't
+                    # stack across requests.
                     parity = self._mesh_encode(k, m, blocks)
                 if parity is not None:
-                    digests = None
-                elif algo in fused.DEVICE_ALGOS and self._use_device \
-                        and bitrot_io.device_preferred(algo):
+                    if pending is not None:
+                        yield flush(pending)
+                    pending = ("arr", blocks, parity, None)
+                    continue
+                fused_dev = (algo in fused.DEVICE_ALGOS
+                             and self._use_device
+                             and bitrot_io.device_preferred(algo))
+                if co is not None:
+                    tag = ("fd" if fused_dev
+                           else "dev" if self._use_device else "nat")
+                    h = co.submit(
+                        ("enc", tag, k, m, algo, shard_size), blocks,
+                        self._enc_kernel(k, m, algo, fused_dev),
+                        weight=nb)
+                    if pending is not None:
+                        yield flush(pending)
+                    pending = ("co", blocks, h)
+                    continue
+                if fused_dev:
                     parity, digests = fused.encode_and_hash(blocks, k, m,
                                                             algo=algo)
                 elif self._use_device:
@@ -823,13 +996,13 @@ class ErasureSet:
                     parity, digests = \
                         self._native(k, m).encode_blocks(blocks), None
                 if pending is not None:
-                    yield frame(*pending)
-                pending = (blocks, parity, digests)
+                    yield flush(pending)
+                pending = ("arr", blocks, parity, digests)
 
             tail = buf[n_full * BLOCK_SIZE:]
             if is_last:
                 if pending is not None:
-                    yield frame(*pending)
+                    yield flush(pending)
                     pending = None
                 if tail.size:
                     cpu = self._cpu(k, m)
@@ -1213,6 +1386,7 @@ class ErasureSet:
         if (not self._use_device and algo == "mxh256"
                 and not _mesh_mode() and k + m <= 64):
             fused_host = _ecio_mod()
+        co = coalesce.get() if coalesce.enabled() else None
 
         def read_shard(pos: int):
             """Fetch + structurally parse one shard's frame range.
@@ -1268,7 +1442,7 @@ class ErasureSet:
             t0 = time.monotonic()
             want = [s for s in range(k) if s not in rows]
             tried.update(want)
-            if self._serial_local():
+            if self._serial_local() or self._on_drive_pool():
                 for s in want:
                     rows[s] = read_shard(order[s])
             else:
@@ -1290,7 +1464,16 @@ class ErasureSet:
             t_read = time.monotonic()
             asm_s = 0.0
             y = None
-            if nb and fused_host is not None:
+            # Verify routing: under concurrent traffic (coalescer hot —
+            # work queued/dispatching, recent occupancy >1, or another
+            # read in flight) the bitrot digest rides the shared
+            # dispatcher so many GETs verify in one kernel launch; a
+            # lone stream keeps the direct fused path — no thread
+            # handoff on the single-client latency path.  Byte-exact
+            # either way (same digests, same comparisons).
+            use_co = (co is not None and nb > 0
+                      and (self._use_device or co.hot()))
+            if nb and fused_host is not None and not use_co:
                 # mxh256 host: ONE C pass verifies every frame AND
                 # gathers the systematic rows straight into the final
                 # object buffer — targets=[] means the GF unit is never
@@ -1318,7 +1501,22 @@ class ErasureSet:
                 for s in range(k):
                     y[:, s, :] = rows[s][1]
                 asm_s += time.monotonic() - tg
-                if algo in fused.DEVICE_ALGOS and self._use_device \
+                if use_co:
+                    # Coalesced digest over the already-gathered rows
+                    # (the gather IS the assembly, so this adds no
+                    # copy): stacked with other requests' verify/encode
+                    # digest work into one batched hash kernel.
+                    h = co.submit(
+                        ("digest", algo, shard_size),
+                        y.reshape(nb * k, shard_size),
+                        coalesce.make_digest_kernel(
+                            algo, BATCH_BLOCKS * k if self._use_device
+                            else 0),
+                        weight=nb)
+                    digests = h.result().reshape(nb, k, hs)
+                    h.release()
+                    got = [digests[:, s] for s in range(k)]
+                elif algo in fused.DEVICE_ALGOS and self._use_device \
                         and bitrot_io.device_preferred(algo) \
                         and not _mesh_mode():
                     digests = np.asarray(fused.verify_and_transform(
@@ -1373,10 +1571,18 @@ class ErasureSet:
         if (_get_fastpath() and healthy is not False and not degraded
                 and BLOCK_SIZE % k == 0
                 and all(s in candidates for s in range(k))):
+            # Inflight-read signal: a GET-only storm queues no encode
+            # work, so concurrency is only visible to hot() through
+            # this counter.
+            if co is not None:
+                co.note_read(1)
             try:
                 got = fast_path()
             except (StorageError, OSError):
                 got = None
+            finally:
+                if co is not None:
+                    co.note_read(-1)
             if got is not None:
                 return got[0]
             DATA_PATH.record_fastpath_fallback()
@@ -1397,7 +1603,8 @@ class ErasureSet:
             # (unlike the healthy path, where the K reads are page-cache
             # hits and pool hops only add latency).
             with ospan.span("engine.read"):
-                if self._serial_local() and not degraded:
+                if (self._serial_local() and not degraded) \
+                        or self._on_drive_pool():
                     for s in active:
                         tried.add(s)
                         try:
@@ -1446,9 +1653,23 @@ class ErasureSet:
                 if algo in fused.DEVICE_ALGOS and self._use_device \
                         and bitrot_io.device_preferred(algo) \
                         and not _mesh_mode():
-                    digests, dev_out = fused.verify_and_transform(
-                        x, k, m, tuple(sel), tuple(missing), algo=algo)
-                    digests = np.asarray(digests)
+                    if co is not None:
+                        # Coalesced fused verify(+reconstruct): the
+                        # same (sel, missing) geometry from concurrent
+                        # degraded reads shares one device launch.
+                        h = co.submit(
+                            ("vt", k, m, tuple(sel), tuple(missing),
+                             algo, shard_size), x,
+                            self._vt_kernel(k, m, tuple(sel),
+                                            tuple(missing), algo),
+                            weight=nb)
+                        digests, dev_out = h.result()
+                        h.release()
+                    else:
+                        digests, dev_out = fused.verify_and_transform(
+                            x, k, m, tuple(sel), tuple(missing),
+                            algo=algo)
+                        digests = np.asarray(digests)
                 else:
                     # Host path (host-hashed algorithm, no TPU, or an
                     # algo whose native host kernel beats its device
@@ -1456,8 +1677,16 @@ class ErasureSet:
                     # host, reconstruct via the backend picker only if
                     # rows are missing.
                     flat = x.reshape(nb * k, shard_size)
-                    digests = bitrot_io._hash_batch(flat, algo).reshape(
-                        nb, k, hs)
+                    if co is not None and co.hot():
+                        h = co.submit(
+                            ("digest", algo, shard_size), flat,
+                            coalesce.make_digest_kernel(algo),
+                            weight=nb)
+                        digests = h.result().reshape(nb, k, hs)
+                        h.release()
+                    else:
+                        digests = bitrot_io._hash_batch(
+                            flat, algo).reshape(nb, k, hs)
                     dev_out = self._transform(
                         k, m, x, tuple(sel), tuple(missing)) if missing \
                         else None
@@ -1566,7 +1795,7 @@ class ErasureSet:
                     np.frombuffer(buf, dtype=np.uint8).reshape(
                         nb, frame)[:, hs:])
                 return bitrot_io._hash_batch(rows, algo)
-        if self._serial_local():
+        if self._serial_local() or self._on_drive_pool():
             return [one(b) for b in bufs]
         return list(self.pool.map(one, bufs))
 

@@ -517,7 +517,7 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
     i's decode and batch i-1's writes. A bitrot hit or read failure
     drops the source and promotes a spare for that batch onward, exactly
     like the GET path's spare-read policy."""
-    from ..ops import fused
+    from ..ops import coalesce, fused
     from .erasure_set import _ecio_mod, _mesh_mode
     ec = fi.erasure
     dist = ec.distribution
@@ -657,16 +657,38 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
             x = np.empty((nb, k, S), dtype=np.uint8)
             for i, s in enumerate(cur):
                 x[:, i, :] = bufs[s][:, hs:]
+            co = coalesce.get() if coalesce.enabled() else None
             if es._use_device and algo in fused.DEVICE_ALGOS \
                     and bitrot_io.device_preferred(algo) \
                     and not _mesh_mode():
-                digests, rebuilt = fused.verify_and_transform(
-                    x, k, m, tuple(cur), tuple(need), algo=algo)
-                digests = np.asarray(digests)
-                rebuilt = np.asarray(rebuilt) if need else None
+                if co is not None:
+                    # Heal shares the verify_and_transform queue with
+                    # degraded GETs — concurrent heals of sibling parts
+                    # (same damage pattern) pack into one dispatch.
+                    h = co.submit(
+                        ("vt", k, m, tuple(cur), tuple(need), algo, S),
+                        x, es._vt_kernel(k, m, tuple(cur), tuple(need),
+                                         algo), weight=nb)
+                    digests, rebuilt = h.result()
+                    h.release()
+                    if not need:
+                        rebuilt = None
+                else:
+                    digests, rebuilt = fused.verify_and_transform(
+                        x, k, m, tuple(cur), tuple(need), algo=algo)
+                    digests = np.asarray(digests)
+                    rebuilt = np.asarray(rebuilt) if need else None
             else:
-                digests = bitrot_io._hash_batch(
-                    x.reshape(nb * k, S), algo).reshape(nb, k, hs)
+                if co is not None and co.hot():
+                    h = co.submit(("digest", algo, S),
+                                  x.reshape(nb * k, S),
+                                  coalesce.make_digest_kernel(algo),
+                                  weight=nb)
+                    digests = h.result().reshape(nb, k, hs)
+                    h.release()
+                else:
+                    digests = bitrot_io._hash_batch(
+                        x.reshape(nb * k, S), algo).reshape(nb, k, hs)
                 rebuilt = np.asarray(es._transform(
                     k, m, x, tuple(cur), tuple(need))) if need else None
             bad = [cur[i] for i in range(k)

@@ -161,9 +161,16 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
         # (append, rename, meta) per drive.
         t0 = time.perf_counter()
         total = len(data)
-        etag = hashlib.md5(data).hexdigest()
-        per_drive = Q.unshuffle_to_drives(
-            es._encode_full(bytes(data), k, m, algo), ec.distribution)
+        # ETag digest overlaps the encode dispatch (same bytes, same
+        # order: byte-identical to hashlib.md5(data)).
+        etag_md5 = streams.PipelinedMD5()
+        etag_md5.feed(data)
+        try:
+            per_drive = Q.unshuffle_to_drives(
+                es._encode_full(bytes(data), k, m, algo), ec.distribution)
+        finally:
+            etag_md5.close()
+        etag = etag_md5.hexdigest()
         part_meta = _part_meta_blob(part_number, etag, total, algo)
         t1 = time.perf_counter()
 
@@ -193,7 +200,7 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
                               actual_size=total, etag=etag)
 
     failed = [d is None for d in es.drives]
-    md5 = hashlib.md5()
+    md5 = streams.PipelinedMD5()
     total = 0
 
     def counted_chunks():
@@ -264,6 +271,7 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
         if err is not None:
             raise err
     finally:
+        md5.close()
         _cleanup_stage(es, stage)
     return ObjectPartInfo(number=part_number, size=total,
                           actual_size=total, etag=etag)

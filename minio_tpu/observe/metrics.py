@@ -197,6 +197,14 @@ class DataPathStats:
             self.mp_bytes = 0
             self.mp_stage_s = {"encode": 0.0, "write": 0.0,
                                "complete": 0.0}
+            # Cross-request dispatch coalescing (ops/coalesce.py):
+            # items = per-request submissions, dispatches = kernel
+            # launches, so items/dispatches is the mean batch occupancy
+            # and dispatches/items the dispatches-per-request ratio.
+            self.co_dispatches = 0
+            self.co_items = 0
+            self.co_weight = 0           # 1 MiB-block budget units
+            self.co_wait_s = 0.0         # summed per-item queue wait
 
     def record_heal_batch(self, blocks: int, capacity: int,
                           source_bytes: int, out_bytes: int,
@@ -247,6 +255,14 @@ class DataPathStats:
         with self._mu:
             self.mp_stage_s["complete"] += seconds
 
+    def record_coalesce_dispatch(self, items: int, weight: int,
+                                 wait_s: float) -> None:
+        with self._mu:
+            self.co_dispatches += 1
+            self.co_items += items
+            self.co_weight += weight
+            self.co_wait_s += wait_s
+
     def snapshot(self) -> dict:
         with self._mu:
             return {
@@ -270,6 +286,15 @@ class DataPathStats:
                 "mp_batches": self.mp_batches,
                 "mp_bytes": self.mp_bytes,
                 "mp_stage_s": dict(self.mp_stage_s),
+                "co_dispatches": self.co_dispatches,
+                "co_items": self.co_items,
+                "co_weight": self.co_weight,
+                "co_wait_s": self.co_wait_s,
+                "co_occupancy": (self.co_items / self.co_dispatches
+                                 if self.co_dispatches else 0.0),
+                "co_dispatches_per_item": (
+                    self.co_dispatches / self.co_items
+                    if self.co_items else 0.0),
             }
 
 
@@ -347,6 +372,24 @@ class MetricsRegistry:
         self.mp_stage_seconds = Gauge(
             "mtpu_multipart_put_stage_seconds_total",
             "Multipart PUT pipeline time by stage", ("stage",))
+        # Cross-request dispatch-coalescing families (MTPU_COALESCE).
+        self.co_dispatches = Gauge(
+            "mtpu_coalesce_dispatches_total",
+            "Coalesced kernel launches")
+        self.co_items = Gauge(
+            "mtpu_coalesce_items_total",
+            "Work items submitted to the dispatch coalescer")
+        self.co_blocks = Gauge(
+            "mtpu_coalesce_block_weight_total",
+            "Summed work-item weight through coalesced dispatches "
+            "(1 MiB-block units)")
+        self.co_occupancy = Gauge(
+            "mtpu_coalesce_batch_occupancy_items",
+            "Mean work items per coalesced dispatch (>1 = cross-request "
+            "batching is happening)")
+        self.co_wait_seconds = Gauge(
+            "mtpu_coalesce_queue_wait_seconds_total",
+            "Summed per-item queue wait before dispatch")
         # Span-aggregate families (rendered from observe.span TRACER):
         # per-API traced-request percentiles + per-stage span histograms
         # ("le" carries the cumulative bucket bound in ms).
@@ -446,6 +489,11 @@ class MetricsRegistry:
         self.mp_bytes.set(snap["mp_bytes"])
         for stage, s in snap["mp_stage_s"].items():
             self.mp_stage_seconds.set(s, stage=stage)
+        self.co_dispatches.set(snap["co_dispatches"])
+        self.co_items.set(snap["co_items"])
+        self.co_blocks.set(snap["co_weight"])
+        self.co_occupancy.set(snap["co_occupancy"])
+        self.co_wait_seconds.set(snap["co_wait_s"])
 
     def _sync_spans(self) -> None:
         # Imported lazily: span.py is the one observe module allowed to
@@ -486,6 +534,8 @@ class MetricsRegistry:
                   self.healthy_bytes, self.healthy_stage_seconds,
                   self.fastpath_fallbacks, self.mp_batches,
                   self.mp_bytes, self.mp_stage_seconds,
+                  self.co_dispatches, self.co_items, self.co_blocks,
+                  self.co_occupancy, self.co_wait_seconds,
                   self.trace_api_count, self.trace_api_errors,
                   self.trace_api_latency, self.trace_stage_ms,
                   self.trace_stage_count, self.trace_stage_hist,

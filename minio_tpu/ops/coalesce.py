@@ -1,0 +1,437 @@
+"""Cross-request dispatch coalescing for the erasure/bitrot data plane.
+
+PRs 1-3 made each *individual* request's kernel traffic batched, but
+every dispatch still belongs to exactly one request: N concurrent 1 MiB
+PUTs cost N small `encode_and_hash` launches instead of one large one,
+and dispatch overhead dominates exactly where the accelerator should
+shine.  This module applies the insight behind continuous batching in
+inference serving (Orca-style iteration-level scheduling) to object
+storage: a single dispatcher thread drains per-kernel queues that all
+in-flight requests submit to, packs compatible work items into ONE
+batched kernel call, and scatters the per-item slices back through
+futures.
+
+Scheduling contract:
+
+- items are compatible when they share a key `(kind, k, m, algo,
+  shard_size, ...)` — same kernel, same geometry, so their block axes
+  simply concatenate;
+- the dispatcher always serves the key whose HEAD item is oldest
+  (FIFO across requests — no request is starved because another key is
+  busier), and never skips a head item because it is large: an item
+  bigger than the batch budget dispatches alone;
+- adaptive window: when recent traffic shows no concurrency
+  (occupancy EMA ~1) a lone item fires immediately — a single-client
+  request never waits.  Under load the dispatcher holds the head item
+  up to MTPU_COALESCE_WINDOW_US for company, and the serialization of
+  dispatches itself does most of the packing: arrivals during an
+  in-flight kernel call land in the next batch for free;
+- bounded-queue backpressure: submit() blocks while the total queued
+  weight exceeds a small multiple of the batch budget, so a flood of
+  writers cannot buffer unbounded shard batches in memory.
+
+Env (read per call so tests flip them without re-importing):
+
+- MTPU_COALESCE=0 disables coalescing — the direct-dispatch oracle the
+  equivalence tests diff against;
+- MTPU_COALESCE_WINDOW_US: max time the oldest queued item waits for
+  company once the window engages (default 250);
+- MTPU_COALESCE_MAX_BATCH: batch budget in 1 MiB-block weight units
+  (default 64 — two full per-request encode batches per dispatch).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..observe import span as ospan
+from ..observe.metrics import DATA_PATH
+
+
+def enabled() -> bool:
+    return os.environ.get("MTPU_COALESCE", "1") != "0"
+
+
+def window_s() -> float:
+    try:
+        us = float(os.environ.get("MTPU_COALESCE_WINDOW_US", "250"))
+    except ValueError:
+        us = 250.0
+    return max(0.0, us) / 1e6
+
+
+def max_batch() -> int:
+    try:
+        return max(1, int(os.environ.get("MTPU_COALESCE_MAX_BATCH", "64")))
+    except ValueError:
+        return 64
+
+
+def pad_batch(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
+    """Zero-pad axis 0 up to the next multiple so jit'd device kernels
+    see a bounded set of shapes (32, 64, ...) instead of one compile per
+    coalesced batch size.  Returns (padded, original_n)."""
+    n = x.shape[0]
+    pad = (-n) % multiple
+    if not pad:
+        return x, n
+    return np.concatenate(
+        [x, np.zeros((pad,) + x.shape[1:], dtype=x.dtype)]), n
+
+
+class _BufPool:
+    """Free-list of uint8 scratch buffers for kernels whose OUTPUT is
+    large (the fused host put_frame writes ~2x the data size of framed
+    shards): a fresh mmap-threshold allocation per dispatch pays
+    ~0.5 ms/MiB in page faults, so released dispatch buffers are reused
+    — the cross-request analogue of ecio_native's per-thread arena,
+    which the coalescer cannot use because results outlive the
+    dispatcher thread's next call."""
+
+    KEEP = 4
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._bufs: list[np.ndarray] = []
+
+    def rent(self, nbytes: int) -> np.ndarray:
+        with self._mu:
+            for i, b in enumerate(self._bufs):
+                if b.size >= nbytes:
+                    return self._bufs.pop(i)
+        return np.empty(nbytes, dtype=np.uint8)
+
+    def give(self, buf: np.ndarray) -> None:
+        with self._mu:
+            self._bufs.append(buf)
+            if len(self._bufs) > self.KEEP:
+                self._bufs.sort(key=lambda b: b.size)
+                self._bufs.pop(0)       # drop the smallest
+
+
+class DispatchCtx:
+    """Per-dispatch context handed to kernels.  `rent()` borrows a
+    pooled scratch buffer that is returned to the pool once every item
+    of the dispatch has been release()d by its consumer (refcounted —
+    an unreleased handle just forfeits reuse, never corrupts)."""
+
+    __slots__ = ("_pool", "_mu", "_refs", "buf")
+
+    def __init__(self, pool: _BufPool, nitems: int):
+        self._pool = pool
+        self._mu = threading.Lock()
+        self._refs = nitems
+        self.buf = None
+
+    def rent(self, nbytes: int) -> np.ndarray:
+        self.buf = self._pool.rent(nbytes)
+        return self.buf
+
+    def _deref(self) -> None:
+        with self._mu:
+            self._refs -= 1
+            done = self._refs == 0
+        if done and self.buf is not None:
+            self._pool.give(self.buf)
+            self.buf = None
+
+
+class Handle:
+    """Future for one submitted work item.  `result()` blocks until the
+    dispatcher resolved the item (and bridges the measured queue wait
+    into the caller's span tree as the `coalesce.wait` stage);
+    `release()` tells the buffer pool the caller is done with any
+    pooled views this result aliases."""
+
+    __slots__ = ("_ev", "_res", "_exc", "_t_enq", "_t_disp", "_ctx",
+                 "weight", "nrows")
+
+    def __init__(self, weight: int, nrows: int):
+        self._ev = threading.Event()
+        self._res = None
+        self._exc: BaseException | None = None
+        self._t_enq = time.monotonic()
+        self._t_disp: float | None = None
+        self._ctx: DispatchCtx | None = None
+        self.weight = weight
+        self.nrows = nrows
+
+    def result(self, timeout: float | None = 120.0):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("coalesced dispatch did not complete")
+        if self._t_disp is not None:
+            ospan.record("coalesce.wait",
+                         max(0.0, self._t_disp - self._t_enq))
+            self._t_disp = None
+        if self._exc is not None:
+            raise self._exc
+        return self._res
+
+    def release(self) -> None:
+        ctx, self._ctx = self._ctx, None
+        if ctx is not None:
+            ctx._deref()
+
+
+class DispatchCoalescer:
+    """The shared scheduler: per-key FIFO queues + one daemon dispatcher
+    thread (started lazily on first submit)."""
+
+    #: queued-weight cap as a multiple of the batch budget — beyond
+    #: this, submit() blocks (backpressure) instead of buffering.
+    QUEUE_FACTOR = 4
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._work = threading.Condition(self._mu)
+        self._space = threading.Condition(self._mu)
+        self._queues: dict[tuple, deque] = {}
+        self._fns: dict[tuple, object] = {}
+        self._pending_weight = 0
+        self._pending_items = 0
+        self._dispatching = False
+        self._inline = 0
+        self._inflight_reads = 0
+        # Occupancy EMA drives the adaptive window: ~1.0 means lone
+        # requests (fire immediately), >1 means concurrent traffic is
+        # actually packing (waiting the window pays for itself).
+        self._ema = 1.0
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+        self._bufs = _BufPool()
+        # Lifetime stats (mirrored into DATA_PATH per dispatch).
+        self.dispatches = 0
+        self.items = 0
+        self.weight = 0
+        self.wait_s = 0.0
+        self.max_items = 0
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, key: tuple, payload: np.ndarray, fn,
+               weight: int | None = None) -> Handle:
+        """Queue one work item.  `payload` is the item's batch (axis 0
+        is the concat axis); `fn(stacked, spans, ctx)` computes the
+        whole coalesced batch and returns one result per (lo, hi) span;
+        `weight` is the item's cost in budget units (default: axis-0
+        length).  All submitters of a key MUST pass an equivalent fn —
+        the key encodes every parameter the kernel closes over."""
+        payload = np.asarray(payload)
+        nrows = int(payload.shape[0]) if payload.ndim else 1
+        h = Handle(int(weight) if weight is not None else nrows, nrows)
+        cap = self.QUEUE_FACTOR * max_batch()
+        with self._mu:
+            if self._stopped:
+                raise RuntimeError("coalescer closed")
+            # Idle fast path: nothing queued, nothing in flight, no
+            # recent packing — run the dispatch on THIS thread (direct
+            # semantics: a lone request pays zero handoff latency, the
+            # measured ~25% single-client PUT tax of waking a scheduler
+            # thread per batch on a 1-core host).  A concurrent submit
+            # observes `_inline` and queues instead, so the moment two
+            # requests overlap, packing begins.
+            inline = (not self._pending_items and not self._dispatching
+                      and self._inline == 0 and self._ema <= 1.05)
+            if inline:
+                self._inline += 1
+            else:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._loop, name="mtpu-coalesce",
+                        daemon=True)
+                    self._thread.start()
+                # Backpressure: an item never waits on its OWN weight
+                # (a single oversized item must always be admissible).
+                while self._pending_weight and \
+                        self._pending_weight + h.weight > cap:
+                    self._space.wait(0.05)
+                    cap = self.QUEUE_FACTOR * max_batch()
+                q = self._queues.get(key)
+                if q is None:
+                    q = self._queues[key] = deque()
+                self._fns[key] = fn
+                q.append((payload, h))
+                self._pending_weight += h.weight
+                self._pending_items += 1
+                self._work.notify()
+        if inline:
+            try:
+                self._dispatch([(payload, h)], h.weight, fn)
+            finally:
+                with self._mu:
+                    self._inline -= 1
+        return h
+
+    # -- routing signals -----------------------------------------------------
+
+    def hot(self) -> bool:
+        """Whether routing MORE work through the coalescer is likely to
+        batch (vs. adding a thread handoff to a lone request): work is
+        queued or dispatching right now, recent dispatches packed >1
+        item, or >1 read is concurrently in flight."""
+        return (self._pending_items > 0 or self._dispatching
+                or self._inline > 0 or self._ema > 1.05
+                or self._inflight_reads > 1)
+
+    def note_read(self, delta: int) -> None:
+        """Healthy-GET concurrency signal (GET-only storms never queue
+        encode work, so queue depth alone cannot ignite hot())."""
+        with self._mu:
+            self._inflight_reads += delta
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _queue_weight(self, q: deque) -> int:
+        return sum(h.weight for _, h in q)
+
+    def _pick_key(self):
+        oldest_key, oldest_t = None, None
+        for key, q in self._queues.items():
+            if q and (oldest_t is None or q[0][1]._t_enq < oldest_t):
+                oldest_key, oldest_t = key, q[0][1]._t_enq
+        return oldest_key
+
+    def _loop(self) -> None:
+        while True:
+            with self._mu:
+                key = self._pick_key()
+                while key is None:
+                    if self._stopped:
+                        return
+                    self._work.wait()
+                    key = self._pick_key()
+                q = self._queues[key]
+                budget = max_batch()
+                # Adaptive window: only wait for company when the
+                # occupancy EMA says concurrent traffic exists; always
+                # bounded by the oldest item's age.
+                if self._ema > 1.05 and self._queue_weight(q) < budget:
+                    deadline = q[0][1]._t_enq + window_s()
+                    while (self._queue_weight(q) < budget
+                           and not self._stopped):
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._work.wait(left)
+                items: list[tuple] = []
+                w = 0
+                while q and (not items or w + q[0][1].weight <= budget):
+                    payload, h = q.popleft()
+                    items.append((payload, h))
+                    w += h.weight
+                self._pending_weight -= w
+                self._pending_items -= len(items)
+                fn = self._fns[key]
+                self._dispatching = True
+                self._space.notify_all()
+            self._dispatch(items, w, fn)
+            with self._mu:
+                self._dispatching = False
+
+    def _dispatch(self, items: list[tuple], w: int, fn) -> None:
+        t_disp = time.monotonic()
+        ctx = DispatchCtx(self._bufs, len(items))
+        try:
+            if len(items) == 1:
+                stacked = items[0][0]
+            else:
+                stacked = np.concatenate([p for p, _ in items], axis=0)
+            spans = []
+            lo = 0
+            for _, h in items:
+                spans.append((lo, lo + h.nrows))
+                lo += h.nrows
+            results = fn(stacked, spans, ctx)
+        except BaseException as e:  # noqa: BLE001 — fan the error out
+            for _, h in items:
+                h._t_disp = t_disp
+                h._exc = e
+                h._ev.set()
+            return
+        wait_sum = 0.0
+        for (_, h), res in zip(items, results):
+            wait_sum += t_disp - h._t_enq
+            h._t_disp = t_disp
+            h._ctx = ctx
+            h._res = res
+            h._ev.set()
+        with self._mu:
+            self.dispatches += 1
+            self.items += len(items)
+            self.weight += w
+            self.wait_s += wait_sum
+            self.max_items = max(self.max_items, len(items))
+            self._ema = 0.75 * self._ema + 0.25 * len(items)
+        DATA_PATH.record_coalesce_dispatch(len(items), w, wait_sum)
+
+    # -- lifecycle / introspection ------------------------------------------
+
+    def close(self) -> None:
+        with self._mu:
+            self._stopped = True
+            self._work.notify_all()
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "dispatches": self.dispatches,
+                "items": self.items,
+                "weight": self.weight,
+                "wait_s": self.wait_s,
+                "max_items": self.max_items,
+                "occupancy": (self.items / self.dispatches
+                              if self.dispatches else 0.0),
+                "pending_items": self._pending_items,
+                "pending_weight": self._pending_weight,
+            }
+
+
+# -- shared kernels ----------------------------------------------------------
+
+def make_digest_kernel(algo: str, pad_rows: int = 0):
+    """Batched bitrot digest over stacked (N, S) rows — the healthy-GET
+    verify and heal-verify workhorse.  `pad_rows`: bound jit shapes on
+    device backends (0 = host kernels, no padding needed)."""
+    from ..storage import bitrot_io
+
+    def kernel(stacked, spans, ctx):
+        if pad_rows:
+            x, n = pad_batch(stacked, pad_rows)
+            out = bitrot_io._hash_batch(x, algo)[:n]
+        else:
+            out = bitrot_io._hash_batch(stacked, algo)
+        return [out[lo:hi] for lo, hi in spans]
+
+    return kernel
+
+
+# -- process singleton -------------------------------------------------------
+
+_CO: DispatchCoalescer | None = None
+_CO_MU = threading.Lock()
+
+
+def get() -> DispatchCoalescer:
+    global _CO
+    co = _CO
+    if co is None:
+        with _CO_MU:
+            if _CO is None:
+                _CO = DispatchCoalescer()
+            co = _CO
+    return co
+
+
+def reset() -> None:
+    """Tests: retire the singleton (its daemon thread exits) so flag
+    changes start from a cold scheduler."""
+    global _CO
+    with _CO_MU:
+        if _CO is not None:
+            _CO.close()
+        _CO = None

@@ -30,14 +30,22 @@ import threading
 import time
 from concurrent.futures import FIRST_COMPLETED, Executor, wait
 
+from ..observe import span as ospan
+
 
 def prefetch_map(fn, items, pool: Executor | None, depth: int = 1):
     """Yield ``fn(item)`` in order with up to `depth` calls in flight
-    ahead of the consumer. ``pool=None`` or ``depth<1`` runs inline."""
+    ahead of the consumer. ``pool=None`` or ``depth<1`` runs inline.
+
+    Pooled calls carry the caller's span context (wrap_ctx): stage
+    timings — and the `coalesce.wait` queue-wait a stage records when
+    it blocks on a coalesced cross-request dispatch — attach to the
+    request that submitted the work, not to an anonymous pool thread."""
     if pool is None or depth < 1:
         for item in items:
             yield fn(item)
         return
+    fn = ospan.wrap_ctx(fn)
     pending = []
     it = iter(items)
     try:
@@ -102,6 +110,7 @@ class StagePipeline:
         wfut = None
         pend_rs = pend_cs = 0.0
 
+        @ospan.wrap_ctx
         def timed_write(res):
             t0 = clock()
             write(res)
@@ -194,6 +203,7 @@ def run_window(fn, items, pool: Executor | None, window: int,
 
     it = enumerate(items)
     futs = {}
+    pooled_fn = ospan.wrap_ctx(fn)
 
     def submit_next() -> bool:
         if stop is not None and stop.is_set():
@@ -202,7 +212,7 @@ def run_window(fn, items, pool: Executor | None, window: int,
             idx, item = next(it)
         except StopIteration:
             return False
-        futs[pool.submit(fn, item)] = (idx, item)
+        futs[pool.submit(pooled_fn, item)] = (idx, item)
         return True
 
     for _ in range(window):

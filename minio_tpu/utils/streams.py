@@ -11,6 +11,75 @@ as an iterator of assembled ranges.
 from __future__ import annotations
 
 import hashlib
+import queue as _queue
+
+#: Dedicated digest workers for PipelinedMD5.  They must NOT share an
+#: engine pool: an md5 worker occupies its slot for a whole PUT, and a
+#: worker that only ever drains its own queue can never deadlock — the
+#: same isolation argument as ErasureSet._iter_pool.
+_MD5_POOL = None
+
+
+def _md5_pool():
+    global _MD5_POOL
+    if _MD5_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _MD5_POOL = ThreadPoolExecutor(max_workers=4,
+                                       thread_name_prefix="mtpu-md5")
+    return _MD5_POOL
+
+
+class PipelinedMD5:
+    """MD5 streamed through a worker thread so the S3 ETag digest
+    overlaps encode+write instead of running serially before them
+    (hashlib releases the GIL for buffers >2 KiB, and the codec/IO
+    stages release it too, so the overlap is real even on one core —
+    bench measured the up-front digest as the single-part PUT wall at
+    ~1.5 ms/MiB).  Same bytes in the same order, so the hex digest is
+    byte-identical to hashlib.md5(body).
+
+    update()/hexdigest() mirror hashlib's; close() is the abandon path
+    (PUT failed before the etag was needed) and a worker-side idle
+    timeout backstops paths that miss it, so an exception can never
+    leak a pool slot."""
+
+    _IDLE_TIMEOUT = 60.0
+
+    def __init__(self):
+        self._q = _queue.SimpleQueue()
+        self._closed = False
+        self._fut = _md5_pool().submit(self._run)
+
+    def _run(self) -> str:
+        h = hashlib.md5()
+        while True:
+            try:
+                piece = self._q.get(timeout=self._IDLE_TIMEOUT)
+            except _queue.Empty:     # abandoned mid-stream
+                return h.hexdigest()
+            if piece is None:
+                return h.hexdigest()
+            h.update(piece)
+
+    def update(self, piece) -> None:
+        self._q.put(piece)
+
+    def feed(self, data, chunk_len: int = 1 << 20) -> None:
+        """Queue an entire in-memory body as chunk-sized views (no
+        copies) — the bytes-path shape: queue everything, then encode
+        while the worker digests."""
+        mv = memoryview(data)
+        for off in range(0, len(mv), chunk_len):
+            self._q.put(mv[off:off + chunk_len])
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+
+    def hexdigest(self) -> str:
+        self.close()
+        return self._fut.result()
 
 
 class StreamError(IOError):

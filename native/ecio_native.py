@@ -233,6 +233,18 @@ def put_frame(blocks: np.ndarray, k: int, m: int,
         ptrs = (ctypes.c_void_p * (k + m))(
             *[v.ctypes.data for v in views])
     else:
+        # Caller-owned buffers (mmap'd staging files, the coalescer's
+        # pooled dispatch slices): validate before handing raw pointers
+        # to C — an undersized slice here is a heap overwrite, not an
+        # IndexError.
+        if len(outs) != k + m:
+            raise ValueError(f"put_frame outs: {len(outs)} buffers "
+                             f"for {k + m} shards")
+        for i, o in enumerate(outs):
+            if memoryview(o).nbytes < nb * frame:
+                raise ValueError(
+                    f"put_frame outs[{i}]: {memoryview(o).nbytes} bytes "
+                    f"< {nb * frame} required")
         ptrs = (ctypes.c_void_p * (k + m))(*[_addr(o) for o in outs])
     pmat = gf256.parity_matrix(k, m)
     tabs = tables_for_matrix(pmat)

@@ -25,6 +25,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402  (after the jax platform pinning above)
 
 
+def pytest_configure(config):
+    # No pytest.ini in this repo: register markers here so tier-1's
+    # `-m "not slow"` deselects the stress/load tests without warnings.
+    config.addinivalue_line(
+        "markers",
+        "slow: stress/load tests excluded from the tier-1 run")
+
+
 @pytest.fixture(params=["1", "0"], ids=["fastpath", "oracle"])
 def fastpath_mode(request, monkeypatch):
     """Tier-1 guard for the healthy-read fast path: every test that uses
@@ -34,3 +42,19 @@ def fastpath_mode(request, monkeypatch):
     byte-exact under the same assertions."""
     monkeypatch.setenv("MTPU_GET_FASTPATH", request.param)
     return request.param
+
+
+@pytest.fixture(params=["1", "0"], ids=["coalesce", "direct"])
+def coalesce_mode(request, monkeypatch):
+    """Oracle guard for cross-request dispatch coalescing: tests using
+    this fixture run once through the DispatchCoalescer
+    (MTPU_COALESCE=1, the default) and once on the direct-dispatch
+    oracle (=0).  The singleton is retired on both edges so each run
+    starts from a cold scheduler (no occupancy EMA or queued work
+    bleeding between parametrizations)."""
+    from minio_tpu.ops import coalesce
+
+    coalesce.reset()
+    monkeypatch.setenv("MTPU_COALESCE", request.param)
+    yield request.param
+    coalesce.reset()
