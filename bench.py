@@ -227,6 +227,75 @@ def e2e_bench(n_put: int = 64, n_parts: int = 4,
             for k, v in out.items()}
 
 
+def hedge_bench(n_get: int = 80, slow_ms: float = 25.0) -> dict:
+    """Tail-latency config: healthy GETs against a stripe with ONE
+    drive injected slow (NaughtyDrive.slow — the aging-disk fault class
+    hedged reads exist for).  Reports GET p50/p99 with speculative
+    parity reads off (MTPU_HEDGE=0, the sequential oracle) and on; the
+    acceptance ratio is the p99 improvement.  cf. Dean & Barroso, "The
+    Tail at Scale" — with erasure coding the hedge is nearly free: the
+    parity shard is an alternative source, not a duplicate request."""
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.engine.erasure_set import ErasureSet
+    from minio_tpu.storage.naughty import NaughtyDrive
+
+    out = {}
+    root = tempfile.mkdtemp(prefix="mtpu-hedge-")
+    saved = {k: os.environ.get(k) for k in ("MTPU_HEDGE", "MTPU_HEDGE_MS")}
+    try:
+        drives = [NaughtyDrive(f"{root}/d{i}") for i in range(6)]
+        es = ErasureSet(drives, default_parity=2)
+        # The 1-core serial fan-out never launches concurrent reads, so
+        # there is nothing to hedge; force the pool path (multi-core
+        # deployments take it by default).
+        es._SERIAL_FANOUT = False
+        es.make_bucket("bench")
+        data = np.random.default_rng(11).integers(
+            0, 256, 1 << 20, dtype=np.uint8).tobytes()
+        es.put_object("bench", "obj", data)
+        es.get_object("bench", "obj")                  # warm-up
+        # One straggler drive: every shard read on it stalls slow_ms.
+        # Pick a drive the warm-up GET actually read from (a data-shard
+        # holder for this object) — slowing a parity spare would leave
+        # the healthy path nothing to hedge against.
+        victim = max(drives,
+                     key=lambda d: d.calls.get("read_file", 0)
+                     + d.calls.get("read_file_view", 0))
+        victim.slow("read_file", slow_ms / 1e3)
+        victim.slow("read_file_view", slow_ms / 1e3)
+
+        def run(flag):
+            os.environ["MTPU_HEDGE"] = flag
+            os.environ["MTPU_HEDGE_MS"] = "5"
+            lat = []
+            for _ in range(n_get):
+                t0 = time.perf_counter()
+                _, got = es.get_object("bench", "obj")
+                lat.append((time.perf_counter() - t0) * 1e3)
+                assert bytes(got) == data
+            lat.sort()
+            return lat[len(lat) // 2], lat[int(len(lat) * 0.99)]
+
+        p50_off, p99_off = run("0")
+        p50_on, p99_on = run("1")
+        out["get_slowdrive_nohedge_p50_ms"] = round(p50_off, 2)
+        out["get_slowdrive_nohedge_p99_ms"] = round(p99_off, 2)
+        out["get_slowdrive_hedged_p50_ms"] = round(p50_on, 2)
+        out["get_slowdrive_hedged_p99_ms"] = round(p99_on, 2)
+        out["get_hedge_p99_speedup"] = round(p99_off / max(p99_on, 1e-6), 2)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def concurrent_bench(duration_s: float = 4.0,
                      object_mib: int = 1) -> dict:
     """Concurrent data-plane suite (the dispatch-coalescer numbers):
@@ -725,8 +794,9 @@ def main() -> None:
         res = subprocess.run(
             [sys.executable, "-c",
              "import json, sys; sys.path.insert(0, sys.argv[1]); "
-             "from bench import e2e_bench, concurrent_bench; "
+             "from bench import e2e_bench, concurrent_bench, hedge_bench; "
              "r = e2e_bench(); r.update(concurrent_bench()); "
+             "r.update(hedge_bench()); "
              "print(json.dumps(r))", here],
             env=env, capture_output=True, text=True, timeout=600)
         if res.returncode != 0:

@@ -9,6 +9,7 @@ put path; drive reconnects implicitly resolve on the next retry.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import OrderedDict
@@ -16,11 +17,20 @@ from collections import OrderedDict
 
 class MRFQueue:
     def __init__(self, heal_fn, *, max_items: int = 10000,
-                 retry_interval: float = 1.0, max_attempts: int = 8):
+                 retry_interval: float = 1.0, max_attempts: int = 8,
+                 max_interval: float = 60.0, jitter: float = 0.25,
+                 seed: int | None = None):
         self.heal_fn = heal_fn          # (bucket, obj, version_id) -> None
         self.max_items = max_items
         self.retry_interval = retry_interval
         self.max_attempts = max_attempts
+        # Exponential backoff is capped (a drive that stays dead for
+        # minutes shouldn't push retries out to hours) and jittered so
+        # entries enqueued together — one failed PUT burst — don't
+        # hammer the recovering drive in lockstep on every round.
+        self.max_interval = max_interval
+        self.jitter = jitter
+        self._rng = random.Random(seed)
         self._mu = threading.Lock()
         # key -> {"bucket","obj","vid","attempts","next_try"}
         self._q: OrderedDict[str, dict] = OrderedDict()
@@ -29,6 +39,11 @@ class MRFQueue:
         self._thread: threading.Thread | None = None
         self.healed = 0
         self.dropped = 0
+        self.retries = 0
+
+    def _backoff(self, attempts: int) -> float:
+        base = min(self.max_interval, self.retry_interval * (2 ** attempts))
+        return base * (1.0 + self.jitter * self._rng.random())
 
     def enqueue(self, bucket: str, obj: str, version_id: str = "") -> None:
         key = f"{bucket}/{obj}@{version_id}"
@@ -57,6 +72,7 @@ class MRFQueue:
                 self.heal_fn(item["bucket"], item["obj"], item["vid"])
             except Exception:  # noqa: BLE001 — retry with backoff
                 with self._mu:
+                    self.retries += 1
                     if key in self._q:
                         it = self._q[key]
                         it["attempts"] += 1
@@ -64,8 +80,8 @@ class MRFQueue:
                             del self._q[key]
                             self.dropped += 1
                         else:
-                            it["next_try"] = now + self.retry_interval * \
-                                (2 ** it["attempts"])
+                            it["next_try"] = now + \
+                                self._backoff(it["attempts"])
                 continue
             with self._mu:
                 self._q.pop(key, None)
@@ -88,3 +104,19 @@ class MRFQueue:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
+
+
+def attach_mrf(pools, **kw) -> list[MRFQueue]:
+    """Server-boot wiring: one started MRFQueue per ErasureSets pool,
+    healing through the pool's own heal_object (routes to the right
+    set), attached to every set so the engine's partial-write paths
+    find `es.mrf`.  Returns the queues (callers keep them for stop())."""
+    queues = []
+    for pool in getattr(pools, "pools", [pools]):
+        def heal(bucket, obj, vid, _p=pool):
+            _p.heal_object(bucket, obj, vid)
+        q = MRFQueue(heal, **kw).start()
+        for es in getattr(pool, "sets", [pool]):
+            es.mrf = q
+        queues.append(q)
+    return queues

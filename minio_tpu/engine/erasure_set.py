@@ -21,12 +21,14 @@ from __future__ import annotations
 
 import hashlib
 import os
+import queue as _queuemod
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..cluster.dynamic_timeout import DynamicTimeout
 from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
 from ..ops import coalesce, fused
@@ -43,6 +45,7 @@ from ..storage.errors import (ErrBucketExists, ErrBucketNotFound,
                               ErrObjectNotFound, ErrVersionNotFound,
                               ErrVolumeExists, ErrVolumeNotFound,
                               StorageError)
+from ..storage.health_wrap import drive_available
 from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo, XLMeta,
                               new_uuid, normalize_version_id)
 from ..utils import streams
@@ -132,6 +135,27 @@ def _etag(data: bytes) -> str:
 #: detect they are ALREADY on this set's drive pool and run inline
 #: instead of nested-submitting — a task queued behind its own parent is
 #: the one thread-pool deadlock shape this engine can produce.
+def _hedge_enabled() -> bool:
+    """Hedged shard-read gate (MTPU_HEDGE, default on).
+
+    The Tail-at-Scale move: when a stripe read's stragglers outlive an
+    adaptive delay, speculatively read parity spares and take whichever
+    k distinct shards answer first — erasure coding makes the hedge
+    nearly free since any k of k+m reconstruct.  MTPU_HEDGE=0 is the
+    wait-for-your-shard oracle (read per call so tests flip it live)."""
+    return os.environ.get("MTPU_HEDGE", "1") != "0"
+
+
+def _hedge_fixed_ms() -> float | None:
+    """MTPU_HEDGE_MS pins the hedge delay (tests/benchmarks); unset
+    means the per-set DynamicTimeout adapts it from observed reads."""
+    v = os.environ.get("MTPU_HEDGE_MS", "")
+    try:
+        return float(v) if v else None
+    except ValueError:
+        return None
+
+
 _POOL_LOCAL = __import__("threading").local()
 
 
@@ -197,6 +221,15 @@ class ErasureSet:
         # exactly like the bucket-existence cache above.
         self._fi_cache: dict[tuple, tuple] = {}
         self._fi_gen: dict[str, int] = {}
+        # Hedged-read state: the hedge delay adapts like a lock deadline
+        # (log_timeout when the timer fires, log_success when the
+        # slowest needed shard beat it), and per-drive-position read
+        # EWMAs let the 1-core serial host decide when fanning out is
+        # worth the thread hops (a known-slow drive) vs. pure overhead
+        # (every drive fast).  Lock-free float updates: a lost race
+        # skews a hint, nothing more.
+        self._hedge_dyn = DynamicTimeout(0.05, 0.002, 2.0)
+        self._read_ewma_ms = [0.0] * self.n
         from .metacache import Metacache
         self.metacache = Metacache(self)
 
@@ -441,7 +474,9 @@ class ErasureSet:
         parity = self.clamp_parity(parity)
         # Parity upgrade: offline drives become parity so the write keeps
         # full reconstruction capability (cf. erasure-object.go:766-800).
-        offline = sum(1 for d in self.drives if d is None)
+        # Breaker-OFFLINE drives count too — their writes fail fast, so
+        # the stripe needs the same extra parity as a physical hole.
+        offline = sum(1 for d in self.drives if not drive_available(d))
         upgraded = False
         if offline and parity < self.n // 2:
             parity = min(parity + offline, self.n // 2)
@@ -715,6 +750,104 @@ class ErasureSet:
         comment in __init__ guards the iterator path against)."""
         return getattr(_POOL_LOCAL, "tag", None) == self._pool_tag
 
+    # -- hedged shard reads --------------------------------------------------
+
+    def _note_read_ms(self, pos: int, ms: float) -> None:
+        cur = self._read_ewma_ms[pos]
+        self._read_ewma_ms[pos] = ms if cur == 0.0 else 0.25 * ms + 0.75 * cur
+
+    def _hedge_delay_s(self) -> float:
+        fixed = _hedge_fixed_ms()
+        if fixed is not None:
+            return fixed / 1e3
+        return self._hedge_dyn.timeout()
+
+    def _hedge_worthwhile(self, positions: list[int]) -> bool:
+        """Serial-host hedge ignition: fanning k reads across threads
+        costs real milliseconds on a 1-core box, so only do it when the
+        per-position EWMAs actually show a straggler — one position
+        markedly slower than the fastest known (or >5 ms absolute)."""
+        known = [self._read_ewma_ms[p] for p in positions
+                 if self._read_ewma_ms[p] > 0.0]
+        if not known:
+            return False
+        return max(known) > max(5.0, 4.0 * min(known))
+
+    def _hedged_fetch(self, read_shard, order, rows, tried, want,
+                      spares, k: int) -> set[int]:
+        """First-k-wins gather.  Launch `want` shard reads concurrently;
+        if stragglers outlive the adaptive hedge delay, launch parity
+        `spares` to cover them; a FAILED read promotes a spare
+        immediately (no timer).  Fills `rows` until k distinct shards
+        answered (or everything failed) and returns the shard indices
+        still in flight — abandoned losers whose results are ignored.
+        The caller must un-`tried` those so a later retry round may
+        re-read them.  Slow drives need no explicit demerit here: their
+        in-flight wrapper call is still timing, so the breaker's latency
+        ledger sees every straggle.
+        """
+        q: _queuemod.Queue = _queuemod.Queue()
+        inflight: set[int] = set()
+
+        def launch(s):
+            tried.add(s)
+            inflight.add(s)
+            pos = order[s]
+
+            def run():
+                try:
+                    q.put((s, read_shard(pos), None))
+                except BaseException as e:  # noqa: BLE001 — marshalled
+                    q.put((s, None, e))
+            self.pool.submit(ospan.wrap_ctx(run))
+
+        for s in want:
+            launch(s)
+        spares = list(spares)
+        t0 = time.monotonic()
+        deadline = t0 + self._hedge_delay_s()
+        fired = False
+        hedged: set[int] = set()
+        n_spares = wins = 0
+        while len(rows) < k and inflight:
+            if not fired and spares:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    # Timer: cover every straggler with a spare at once
+                    # (k-len(rows) are missing; that many spares close
+                    # the read if every straggler is truly stuck).
+                    for _ in range(min(len(spares), k - len(rows))):
+                        s = spares.pop(0)
+                        hedged.add(s)
+                        launch(s)
+                        n_spares += 1
+                    fired = True
+                    self._hedge_dyn.log_timeout()
+                    continue
+                try:
+                    item = q.get(timeout=left)
+                except _queuemod.Empty:
+                    continue
+            else:
+                # Every launched read puts exactly one item — blocking
+                # without a timeout cannot hang while inflight is
+                # non-empty.
+                item = q.get()
+            s, r, err = item
+            inflight.discard(s)
+            if err is None:
+                rows[s] = r
+                if s in hedged:
+                    wins += 1
+            elif spares:
+                sp = spares.pop(0)
+                launch(sp)
+                n_spares += 1
+        if not fired:
+            self._hedge_dyn.log_success(time.monotonic() - t0)
+        DATA_PATH.record_hedge(fired=fired, spares=n_spares, wins=wins)
+        return inflight
+
     def _map_drives_positions(self, fn, parallel: bool = False) -> list:
         """Like _map_drives but fn gets the drive *position*.
 
@@ -817,6 +950,19 @@ class ErasureSet:
 
         return kernel
 
+    def _direct_encode(self, blocks, k: int, m: int, algo: str):
+        """The no-coalescer encode for one (nb, K, S) batch — the same
+        (parity, digests) pair `_enc_kernel` produces.  Used as the
+        per-request fallback when a coalesced handle fails (poisoned
+        batch neighbor / dead dispatcher)."""
+        fused_dev = (algo in fused.DEVICE_ALGOS and self._use_device
+                     and bitrot_io.device_preferred(algo))
+        if fused_dev:
+            return fused.encode_and_hash(blocks, k, m, algo=algo)
+        if self._use_device:
+            return self._codec(k, m).encode_blocks(blocks), None
+        return self._native(k, m).encode_blocks(blocks), None
+
     def _vt_kernel(self, k: int, m: int, sources: tuple, targets: tuple,
                    algo: str):
         """Fused device verify(+reconstruct) over stacked (B, K, S)
@@ -903,16 +1049,28 @@ class ErasureSet:
         retired: list = []
 
         def flush(p):
+            # Coalesced handles can FAIL (a poisoned batch neighbor, a
+            # dead dispatcher): each tag recomputes its span through the
+            # direct reference path — this request's bytes, this
+            # request's kernels, nobody else's fault surface.
             tag = p[0]
             if tag == "pf":
-                framed = p[1].result()
+                try:
+                    framed = p[1].result()
+                except Exception:  # noqa: BLE001 — direct fallback
+                    DATA_PATH.record_co_fallback()
+                    return fused_host.put_frame(p[2], k, m)
                 retired.append(p[1])
                 if len(retired) > 2:
                     retired.pop(0).release()
                 return framed
             if tag == "co":
-                parity, digests = p[2].result()
-                p[2].release()       # fresh arrays — nothing pooled
+                try:
+                    parity, digests = p[2].result()
+                    p[2].release()   # fresh arrays — nothing pooled
+                except Exception:  # noqa: BLE001 — direct fallback
+                    DATA_PATH.record_co_fallback()
+                    parity, digests = self._direct_encode(p[1], k, m, algo)
                 return frame(p[1], parity, digests)
             return frame(p[1], p[2], p[3])
 
@@ -939,7 +1097,7 @@ class ErasureSet:
                             self._pf_kernel(k, m, shard_size), weight=nb)
                         if pending is not None:
                             yield flush(pending)
-                        pending = ("pf", h)
+                        pending = ("pf", h, blocks)
                     elif double_buffer:
                         per = BATCH_BLOCKS * frame_len
                         if arenas is None:
@@ -1395,7 +1553,11 @@ class ErasureSet:
             full blocks are NOT hash-verified here — that happens batched
             on device (or in the fused native pass, which consumes `raw`).
             The (tiny) tail fragment verifies on host immediately.
+            Successful reads feed the per-position EWMA that drives
+            hedge ignition on serial hosts (failures don't: a fast
+            error must not make a drive look fast).
             """
+            t_rs = time.monotonic()
             d = self.drives[pos]
             if d is None:
                 raise ErrDiskNotFound("offline")
@@ -1419,6 +1581,7 @@ class ErasureSet:
             # Views, no copy: the selected rows are gathered into one
             # contiguous (nb, K, S) buffer in a single strided pass
             # below — copying here would double the memory traffic.
+            self._note_read_ms(pos, (time.monotonic() - t_rs) * 1e3)
             return frames[:, :hs], frames[:, hs:], tail, buf[:nb * frame]
 
         order = Q.shuffle_by_distribution(list(range(self.n)), dist)
@@ -1426,11 +1589,12 @@ class ErasureSet:
         # parity as spares (cf. preferReaders, cmd/erasure-decode.go:101).
         rows: dict[int, tuple] = {}
         tried: set[int] = set()
-        # Offline drives can never yield a shard — skipping them up
-        # front means a degraded read goes straight to the parity
-        # spares instead of burning a retry round per dead position.
+        # Offline drives — physical holes AND breaker-open circuits —
+        # can never yield a shard: skipping them up front means a
+        # degraded read goes straight to the parity spares instead of
+        # burning a retry round per dead position.
         candidates = [s for s in range(k + m)
-                      if self.drives[order[s]] is not None]
+                      if drive_available(self.drives[order[s]])]
         degraded = any(s < k for s in range(k + m) if s not in candidates)
         t_deg = time.monotonic() if degraded else 0.0
         lo = offset - b0 * BLOCK_SIZE
@@ -1441,11 +1605,32 @@ class ErasureSet:
             the decode loop goes straight to the parity spares)."""
             t0 = time.monotonic()
             want = [s for s in range(k) if s not in rows]
-            tried.update(want)
-            if self._serial_local() or self._on_drive_pool():
+            # Hedge gate: pool fan-out hosts hedge by default; the
+            # 1-core serial host ignites only when the EWMAs show a
+            # straggler (otherwise serial page-cache reads win).
+            use_hedge = (
+                _hedge_enabled() and want and not self._on_drive_pool()
+                and (not self._serial_local()
+                     or self._hedge_worthwhile([order[s] for s in want])))
+            if use_hedge:
+                spares = [s for s in candidates
+                          if s >= k and s not in rows]
+                abandoned = self._hedged_fetch(
+                    read_shard, order, rows, tried, want, spares, k)
+                for s in abandoned:
+                    tried.discard(s)
+                if any(s not in rows for s in range(k)):
+                    # A parity spare won the race (or a data read
+                    # failed): the row set isn't purely systematic, so
+                    # the decode loop below reconstructs from these
+                    # rows — no re-read, just GF work for the holes.
+                    return None
+            elif self._serial_local() or self._on_drive_pool():
+                tried.update(want)
                 for s in want:
                     rows[s] = read_shard(order[s])
             else:
+                tried.update(want)
                 rs = ospan.wrap_ctx(read_shard)
                 futs = {s: self.pool.submit(rs, order[s])
                         for s in want}
@@ -1513,8 +1698,14 @@ class ErasureSet:
                             algo, BATCH_BLOCKS * k if self._use_device
                             else 0),
                         weight=nb)
-                    digests = h.result().reshape(nb, k, hs)
-                    h.release()
+                    try:
+                        digests = h.result().reshape(nb, k, hs)
+                        h.release()
+                    except Exception:  # noqa: BLE001 — direct fallback
+                        DATA_PATH.record_co_fallback()
+                        digests = bitrot_io._hash_batch(
+                            y.reshape(nb * k, shard_size),
+                            algo).reshape(nb, k, hs)
                     got = [digests[:, s] for s in range(k)]
                 elif algo in fused.DEVICE_ALGOS and self._use_device \
                         and bitrot_io.device_preferred(algo) \
@@ -1611,6 +1802,19 @@ class ErasureSet:
                             rows[s] = read_shard(order[s])
                         except Exception:  # noqa: BLE001 — spare read
                             pass
+                elif _hedge_enabled():
+                    # Hedged degraded fan-out: instead of a barrier on
+                    # ALL active futures (one tail-slow survivor stalls
+                    # the stripe), take the first k arrivals and cover
+                    # stragglers/failures from the remaining spares.
+                    remaining = [s for s in candidates
+                                 if s not in tried and s not in rows
+                                 and s not in active]
+                    abandoned = self._hedged_fetch(
+                        read_shard, order, rows, tried, active,
+                        remaining, k)
+                    for s in abandoned:
+                        tried.discard(s)
                 else:
                     rs = ospan.wrap_ctx(read_shard)
                     futs = {}
@@ -1663,8 +1867,15 @@ class ErasureSet:
                             self._vt_kernel(k, m, tuple(sel),
                                             tuple(missing), algo),
                             weight=nb)
-                        digests, dev_out = h.result()
-                        h.release()
+                        try:
+                            digests, dev_out = h.result()
+                            h.release()
+                        except Exception:  # noqa: BLE001 — fallback
+                            DATA_PATH.record_co_fallback()
+                            digests, dev_out = fused.verify_and_transform(
+                                x, k, m, tuple(sel), tuple(missing),
+                                algo=algo)
+                            digests = np.asarray(digests)
                     else:
                         digests, dev_out = fused.verify_and_transform(
                             x, k, m, tuple(sel), tuple(missing),
@@ -1682,8 +1893,13 @@ class ErasureSet:
                             ("digest", algo, shard_size), flat,
                             coalesce.make_digest_kernel(algo),
                             weight=nb)
-                        digests = h.result().reshape(nb, k, hs)
-                        h.release()
+                        try:
+                            digests = h.result().reshape(nb, k, hs)
+                            h.release()
+                        except Exception:  # noqa: BLE001 — fallback
+                            DATA_PATH.record_co_fallback()
+                            digests = bitrot_io._hash_batch(
+                                flat, algo).reshape(nb, k, hs)
                     else:
                         digests = bitrot_io._hash_batch(
                             flat, algo).reshape(nb, k, hs)

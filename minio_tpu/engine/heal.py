@@ -669,8 +669,15 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
                         ("vt", k, m, tuple(cur), tuple(need), algo, S),
                         x, es._vt_kernel(k, m, tuple(cur), tuple(need),
                                          algo), weight=nb)
-                    digests, rebuilt = h.result()
-                    h.release()
+                    try:
+                        digests, rebuilt = h.result()
+                        h.release()
+                    except Exception:  # noqa: BLE001 — direct fallback
+                        DATA_PATH.record_co_fallback()
+                        digests, rebuilt = fused.verify_and_transform(
+                            x, k, m, tuple(cur), tuple(need), algo=algo)
+                        digests = np.asarray(digests)
+                        rebuilt = np.asarray(rebuilt) if need else None
                     if not need:
                         rebuilt = None
                 else:
@@ -684,8 +691,13 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
                                   x.reshape(nb * k, S),
                                   coalesce.make_digest_kernel(algo),
                                   weight=nb)
-                    digests = h.result().reshape(nb, k, hs)
-                    h.release()
+                    try:
+                        digests = h.result().reshape(nb, k, hs)
+                        h.release()
+                    except Exception:  # noqa: BLE001 — direct fallback
+                        DATA_PATH.record_co_fallback()
+                        digests = bitrot_io._hash_batch(
+                            x.reshape(nb * k, S), algo).reshape(nb, k, hs)
                 else:
                     digests = bitrot_io._hash_batch(
                         x.reshape(nb * k, S), algo).reshape(nb, k, hs)

@@ -194,7 +194,12 @@ class ErasureSets:
             for d in s.drives:
                 if d is None:
                     continue
-                info = d.disk_info()
+                try:
+                    info = d.disk_info()
+                except StorageError:
+                    # Breaker-OFFLINE (circuit open) or otherwise dead:
+                    # report the capacity we can still see.
+                    continue
                 total += info["total"]
                 free += info["free"]
         return {"total": total, "free": free}

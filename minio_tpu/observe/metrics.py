@@ -205,6 +205,23 @@ class DataPathStats:
             self.co_items = 0
             self.co_weight = 0           # 1 MiB-block budget units
             self.co_wait_s = 0.0         # summed per-item queue wait
+            # Dispatch fault containment: batch faults are coalesced
+            # dispatches that raised (members then retried solo),
+            # fallbacks are call sites that recomputed a span through
+            # the direct reference path after a failed handle.
+            self.co_batch_faults = 0
+            self.co_member_retries = 0
+            self.co_fallbacks = 0
+            # Hedged shard reads (Tail-at-Scale first-k-wins): fired =
+            # hedge timers that expired, spares = speculative parity
+            # reads launched, wins = spare rows used in the final k.
+            self.hedged_reads = 0
+            self.hedge_fired = 0
+            self.hedge_spares = 0
+            self.hedge_wins = 0
+            # Drive circuit-breaker transitions by target state.
+            self.drive_transitions = {"ok": 0, "suspect": 0,
+                                      "offline": 0}
 
     def record_heal_batch(self, blocks: int, capacity: int,
                           source_bytes: int, out_bytes: int,
@@ -263,6 +280,30 @@ class DataPathStats:
             self.co_weight += weight
             self.co_wait_s += wait_s
 
+    def record_co_fault(self, members: int) -> None:
+        """A coalesced dispatch raised; `members` spans were retried
+        individually (0 = single-item dispatch, nothing to contain)."""
+        with self._mu:
+            self.co_batch_faults += 1
+            self.co_member_retries += members
+
+    def record_co_fallback(self) -> None:
+        with self._mu:
+            self.co_fallbacks += 1
+
+    def record_hedge(self, fired: bool, spares: int, wins: int) -> None:
+        with self._mu:
+            self.hedged_reads += 1
+            if fired:
+                self.hedge_fired += 1
+            self.hedge_spares += spares
+            self.hedge_wins += wins
+
+    def record_drive_transition(self, to_state: str) -> None:
+        with self._mu:
+            if to_state in self.drive_transitions:
+                self.drive_transitions[to_state] += 1
+
     def snapshot(self) -> dict:
         with self._mu:
             return {
@@ -295,6 +336,14 @@ class DataPathStats:
                 "co_dispatches_per_item": (
                     self.co_dispatches / self.co_items
                     if self.co_items else 0.0),
+                "co_batch_faults": self.co_batch_faults,
+                "co_member_retries": self.co_member_retries,
+                "co_fallbacks": self.co_fallbacks,
+                "hedged_reads": self.hedged_reads,
+                "hedge_fired": self.hedge_fired,
+                "hedge_spares": self.hedge_spares,
+                "hedge_wins": self.hedge_wins,
+                "drive_transitions": dict(self.drive_transitions),
             }
 
 
@@ -390,6 +439,50 @@ class MetricsRegistry:
         self.co_wait_seconds = Gauge(
             "mtpu_coalesce_queue_wait_seconds_total",
             "Summed per-item queue wait before dispatch")
+        # Dispatch fault-containment families (PR 5).
+        self.co_batch_faults = Gauge(
+            "mtpu_coalesce_batch_faults_total",
+            "Coalesced dispatches that raised and were retried "
+            "member-by-member")
+        self.co_member_retries = Gauge(
+            "mtpu_coalesce_member_retries_total",
+            "Batch member spans retried individually after a fault")
+        self.co_fallbacks = Gauge(
+            "mtpu_coalesce_fallbacks_total",
+            "Call sites that recomputed a span through the direct "
+            "path after a failed coalesced handle")
+        # Hedged shard-read families (MTPU_HEDGE).
+        self.hedged_reads = Gauge(
+            "mtpu_hedged_reads_total",
+            "Stripe reads gathered through the first-k-wins path")
+        self.hedge_fired = Gauge(
+            "mtpu_hedge_timers_fired_total",
+            "Hedge delays that expired (stragglers covered by spares)")
+        self.hedge_spares = Gauge(
+            "mtpu_hedge_spare_reads_total",
+            "Speculative parity-shard reads launched")
+        self.hedge_wins = Gauge(
+            "mtpu_hedge_wins_total",
+            "Hedged spare rows that made the final k")
+        # Drive circuit-breaker state (0=ok 1=suspect 2=offline) and
+        # lifetime transitions by target state.
+        self.drive_state = Gauge(
+            "mtpu_drive_state",
+            "Per-drive breaker state: 0 ok, 1 suspect, 2 offline",
+            ("pool", "set", "drive"))
+        self.drive_transitions = Gauge(
+            "mtpu_drive_state_transitions_total",
+            "Breaker state transitions by target state", ("state",))
+        # MRF heal-queue families.
+        self.mrf_pending = Gauge(
+            "mtpu_mrf_pending", "Objects queued for MRF heal")
+        self.mrf_healed = Gauge(
+            "mtpu_mrf_healed_total", "Objects healed off the MRF queue")
+        self.mrf_dropped = Gauge(
+            "mtpu_mrf_dropped_total",
+            "MRF entries dropped (attempts exhausted or queue shed)")
+        self.mrf_retries = Gauge(
+            "mtpu_mrf_retries_total", "Failed MRF heal attempts")
         # Span-aggregate families (rendered from observe.span TRACER):
         # per-API traced-request percentiles + per-stage span histograms
         # ("le" carries the cumulative bucket bound in ms).
@@ -451,17 +544,45 @@ class MetricsRegistry:
             self.cache_usage.set(c["usage_bytes"])
             self.cache_max.set(c["max_bytes"])
         online = offline = 0
-        for pool in pools.pools:
-            for es in getattr(pool, "sets", [pool]):
-                for d in es.drives:
+        mrf_pending = mrf_healed = mrf_dropped = mrf_retries = 0
+        mrf_seen: set[int] = set()
+        _STATE = {"ok": 0, "suspect": 1, "offline": 2}
+        for pi, pool in enumerate(pools.pools):
+            for si, es in enumerate(getattr(pool, "sets", [pool])):
+                for di, d in enumerate(es.drives):
+                    state = 2
                     if d is None:
                         offline += 1
                     elif hasattr(d, "is_online") and not d.is_online():
                         offline += 1
+                    elif hasattr(d, "health_state") \
+                            and d.health_state() == "offline":
+                        # Breaker-open circuit: physically present but
+                        # out of the data path.
+                        offline += 1
                     else:
                         online += 1
+                        if hasattr(d, "health_state"):
+                            state = _STATE.get(d.health_state(), 0)
+                        else:
+                            state = 0
+                    self.drive_state.set(state, pool=str(pi),
+                                         set=str(si), drive=str(di))
+                mrf = getattr(es, "mrf", None)
+                if mrf is not None and id(mrf) not in mrf_seen:
+                    # One queue may serve every set of a pool — count
+                    # it once.
+                    mrf_seen.add(id(mrf))
+                    mrf_pending += mrf.pending()
+                    mrf_healed += mrf.healed
+                    mrf_dropped += mrf.dropped
+                    mrf_retries += getattr(mrf, "retries", 0)
         self.drive_online.set(online)
         self.drive_offline.set(offline)
+        self.mrf_pending.set(mrf_pending)
+        self.mrf_healed.set(mrf_healed)
+        self.mrf_dropped.set(mrf_dropped)
+        self.mrf_retries.set(mrf_retries)
         if scanner is not None:
             usage = scanner.latest_usage()
             if usage is not None:
@@ -494,6 +615,15 @@ class MetricsRegistry:
         self.co_blocks.set(snap["co_weight"])
         self.co_occupancy.set(snap["co_occupancy"])
         self.co_wait_seconds.set(snap["co_wait_s"])
+        self.co_batch_faults.set(snap["co_batch_faults"])
+        self.co_member_retries.set(snap["co_member_retries"])
+        self.co_fallbacks.set(snap["co_fallbacks"])
+        self.hedged_reads.set(snap["hedged_reads"])
+        self.hedge_fired.set(snap["hedge_fired"])
+        self.hedge_spares.set(snap["hedge_spares"])
+        self.hedge_wins.set(snap["hedge_wins"])
+        for state, n in snap["drive_transitions"].items():
+            self.drive_transitions.set(n, state=state)
 
     def _sync_spans(self) -> None:
         # Imported lazily: span.py is the one observe module allowed to
@@ -536,6 +666,12 @@ class MetricsRegistry:
                   self.mp_bytes, self.mp_stage_seconds,
                   self.co_dispatches, self.co_items, self.co_blocks,
                   self.co_occupancy, self.co_wait_seconds,
+                  self.co_batch_faults, self.co_member_retries,
+                  self.co_fallbacks, self.hedged_reads,
+                  self.hedge_fired, self.hedge_spares, self.hedge_wins,
+                  self.drive_state, self.drive_transitions,
+                  self.mrf_pending, self.mrf_healed, self.mrf_dropped,
+                  self.mrf_retries,
                   self.trace_api_count, self.trace_api_errors,
                   self.trace_api_latency, self.trace_stage_ms,
                   self.trace_stage_count, self.trace_stage_hist,

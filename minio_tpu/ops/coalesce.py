@@ -203,6 +203,11 @@ class DispatchCoalescer:
         self._ema = 1.0
         self._thread: threading.Thread | None = None
         self._stopped = False
+        # Set (to the fatal exception) if the dispatcher thread ever
+        # dies: queued handles are failed and every later submit runs
+        # inline on the caller — degraded to direct dispatch, but no
+        # submitter can hang on a scheduler that no longer exists.
+        self._broken: BaseException | None = None
         self._bufs = _BufPool()
         # Lifetime stats (mirrored into DATA_PATH per dispatch).
         self.dispatches = 0
@@ -210,6 +215,8 @@ class DispatchCoalescer:
         self.weight = 0
         self.wait_s = 0.0
         self.max_items = 0
+        self.batch_faults = 0
+        self.member_retries = 0
 
     # -- submission ----------------------------------------------------------
 
@@ -235,8 +242,9 @@ class DispatchCoalescer:
             # thread per batch on a 1-core host).  A concurrent submit
             # observes `_inline` and queues instead, so the moment two
             # requests overlap, packing begins.
-            inline = (not self._pending_items and not self._dispatching
-                      and self._inline == 0 and self._ema <= 1.05)
+            inline = (self._broken is not None
+                      or (not self._pending_items and not self._dispatching
+                          and self._inline == 0 and self._ema <= 1.05))
             if inline:
                 self._inline += 1
             else:
@@ -297,41 +305,70 @@ class DispatchCoalescer:
         return oldest_key
 
     def _loop(self) -> None:
-        while True:
-            with self._mu:
-                key = self._pick_key()
-                while key is None:
-                    if self._stopped:
-                        return
-                    self._work.wait()
+        try:
+            while True:
+                with self._mu:
                     key = self._pick_key()
-                q = self._queues[key]
-                budget = max_batch()
-                # Adaptive window: only wait for company when the
-                # occupancy EMA says concurrent traffic exists; always
-                # bounded by the oldest item's age.
-                if self._ema > 1.05 and self._queue_weight(q) < budget:
-                    deadline = q[0][1]._t_enq + window_s()
-                    while (self._queue_weight(q) < budget
-                           and not self._stopped):
-                        left = deadline - time.monotonic()
-                        if left <= 0:
-                            break
-                        self._work.wait(left)
-                items: list[tuple] = []
-                w = 0
-                while q and (not items or w + q[0][1].weight <= budget):
-                    payload, h = q.popleft()
-                    items.append((payload, h))
-                    w += h.weight
-                self._pending_weight -= w
-                self._pending_items -= len(items)
-                fn = self._fns[key]
-                self._dispatching = True
-                self._space.notify_all()
-            self._dispatch(items, w, fn)
-            with self._mu:
-                self._dispatching = False
+                    while key is None:
+                        if self._stopped:
+                            return
+                        self._work.wait()
+                        key = self._pick_key()
+                    q = self._queues[key]
+                    budget = max_batch()
+                    # Adaptive window: only wait for company when the
+                    # occupancy EMA says concurrent traffic exists; always
+                    # bounded by the oldest item's age.
+                    if self._ema > 1.05 and self._queue_weight(q) < budget:
+                        deadline = q[0][1]._t_enq + window_s()
+                        while (self._queue_weight(q) < budget
+                               and not self._stopped):
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            self._work.wait(left)
+                    items: list[tuple] = []
+                    w = 0
+                    while q and (not items or w + q[0][1].weight <= budget):
+                        payload, h = q.popleft()
+                        items.append((payload, h))
+                        w += h.weight
+                    self._pending_weight -= w
+                    self._pending_items -= len(items)
+                    fn = self._fns[key]
+                    self._dispatching = True
+                    self._space.notify_all()
+                self._dispatch(items, w, fn)
+                with self._mu:
+                    self._dispatching = False
+        except BaseException as e:  # noqa: BLE001 — scheduler death
+            # _dispatch contains kernel faults itself, so anything
+            # escaping here is scheduler logic dying — fail everything
+            # queued rather than leaving submitters parked on handles
+            # no thread will ever resolve.
+            self._abort(e)
+
+    def _abort(self, exc: BaseException) -> None:
+        """Dispatcher death: error every queued handle, route all future
+        submits inline (direct-dispatch degradation — correctness and
+        liveness over packing)."""
+        with self._mu:
+            self._broken = exc
+            victims: list[Handle] = []
+            for q in self._queues.values():
+                victims.extend(h for _, h in q)
+                q.clear()
+            self._queues.clear()
+            self._fns.clear()
+            self._pending_weight = 0
+            self._pending_items = 0
+            self._dispatching = False
+            self._space.notify_all()
+            self._work.notify_all()
+        err = RuntimeError(f"coalescer dispatcher died: {exc!r}")
+        for h in victims:
+            h._exc = err
+            h._ev.set()
 
     def _dispatch(self, items: list[tuple], w: int, fn) -> None:
         t_disp = time.monotonic()
@@ -347,10 +384,39 @@ class DispatchCoalescer:
                 spans.append((lo, lo + h.nrows))
                 lo += h.nrows
             results = fn(stacked, spans, ctx)
-        except BaseException as e:  # noqa: BLE001 — fan the error out
-            for _, h in items:
+        except BaseException as e:  # noqa: BLE001 — contain the fault
+            if ctx.buf is not None:
+                self._bufs.give(ctx.buf)
+                ctx.buf = None
+            with self._mu:
+                self.batch_faults += 1
+            if len(items) == 1:
+                h = items[0][1]
                 h._t_disp = t_disp
                 h._exc = e
+                h._ev.set()
+                DATA_PATH.record_co_fault(0)
+                return
+            # Fault containment: a packed batch carries spans from
+            # UNRELATED requests — one poisoned member must not fail
+            # its neighbors.  Retry each span as its own dispatch; only
+            # the member(s) that still fail get the exception.
+            DATA_PATH.record_co_fault(len(items))
+            for payload, h in items:
+                mctx = DispatchCtx(self._bufs, 1)
+                try:
+                    res = fn(payload, [(0, h.nrows)], mctx)[0]
+                except BaseException as me:  # noqa: BLE001 — guilty span
+                    if mctx.buf is not None:
+                        self._bufs.give(mctx.buf)
+                        mctx.buf = None
+                    h._exc = me
+                else:
+                    h._ctx = mctx
+                    h._res = res
+                with self._mu:
+                    self.member_retries += 1
+                h._t_disp = t_disp
                 h._ev.set()
             return
         wait_sum = 0.0
@@ -374,7 +440,22 @@ class DispatchCoalescer:
     def close(self) -> None:
         with self._mu:
             self._stopped = True
+            # Anything still queued will never be served — fail it now
+            # (a retiring scheduler must not leave submitters waiting
+            # out their result() timeout).
+            victims: list[Handle] = []
+            for q in self._queues.values():
+                victims.extend(h for _, h in q)
+                q.clear()
+            self._queues.clear()
+            self._fns.clear()
+            self._pending_weight = 0
+            self._pending_items = 0
             self._work.notify_all()
+            self._space.notify_all()
+        for h in victims:
+            h._exc = RuntimeError("coalescer closed")
+            h._ev.set()
 
     def stats(self) -> dict:
         with self._mu:
@@ -388,6 +469,9 @@ class DispatchCoalescer:
                               if self.dispatches else 0.0),
                 "pending_items": self._pending_items,
                 "pending_weight": self._pending_weight,
+                "batch_faults": self.batch_faults,
+                "member_retries": self.member_retries,
+                "broken": self._broken is not None,
             }
 
 

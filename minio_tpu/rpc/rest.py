@@ -31,7 +31,9 @@ serves all inter-node planes on the main server port, routed by path.
 
 from __future__ import annotations
 
+import errno
 import http.client
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -49,9 +51,44 @@ _ERR_CLASSES = {
 DEFAULT_PLANE_VERSIONS: dict[str, str] = {"health": "v1"}
 
 
+#: errnos that signal a transient peer/network condition rather than a
+#: local programming error (cf. xnet.IsNetworkOrHostDown,
+#: /root/reference/internal/net/net.go — connection refused/reset, broken
+#: pipe, unreachable host, timed out).
+_RETRYABLE_ERRNOS = frozenset({
+    errno.ECONNREFUSED, errno.ECONNRESET, errno.ECONNABORTED,
+    errno.EPIPE, errno.EHOSTUNREACH, errno.ENETUNREACH,
+    errno.ETIMEDOUT, errno.EAGAIN})
+
+
+def _is_retryable(exc: BaseException) -> bool:
+    """Transport faults worth one more try on an idempotent call:
+    refused/reset/broken-pipe/timeout/server-hung-up.  Anything else
+    (DNS garbage, SSL handshake, protocol violation) is not transient."""
+    if isinstance(exc, (TimeoutError, ConnectionError,
+                        http.client.RemoteDisconnected)):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in _RETRYABLE_ERRNOS or isinstance(
+            exc, ConnectionError)
+    if isinstance(exc, http.client.HTTPException):
+        # BadStatusLine("") == peer closed the socket mid-response.
+        return isinstance(exc, http.client.BadStatusLine)
+    return False
+
+
 class NetworkError(Exception):
     """Transport-level failure (connect/timeout/HTTP) — NOT an application
-    error; quorum logic treats these as drive-offline."""
+    error; quorum logic treats these as drive-offline.
+
+    `retryable` marks faults that are plausibly transient (connection
+    refused/reset, broken pipe, timeout, peer hung up) — the client
+    retries idempotent calls on these before declaring the endpoint
+    offline; a non-retryable transport error offlines immediately."""
+
+    def __init__(self, msg: str, *, retryable: bool = False):
+        super().__init__(msg)
+        self.retryable = retryable
 
 
 class RPCVersionMismatch(Exception):
@@ -243,8 +280,12 @@ class RPCClient:
                                  daemon=True).start()
 
     def _health_loop(self) -> None:
+        # Jittered probe interval: when a node dies, every peer's client
+        # marks it offline within one quorum round — un-jittered probes
+        # would then hit the rebooting node in lockstep forever.
         while not self._closed:
-            time.sleep(self.check_interval)
+            time.sleep(self.check_interval *
+                       (0.5 + random.random()))
             try:
                 self._raw_call(HEALTH_METHOD, {}, timeout=2.0)
                 with self._lock:
@@ -281,22 +322,41 @@ class RPCClient:
             resp = conn.getresponse()
             data = resp.read()
         except (OSError, http.client.HTTPException) as e:
-            raise NetworkError(f"{self.host}:{self.port} {method}: {e}") \
-                from None
+            raise NetworkError(f"{self.host}:{self.port} {method}: {e}",
+                               retryable=_is_retryable(e)) from None
         finally:
             conn.close()
         if resp.status != 200:
             raise unpack_error(data)
         return msgpackx.unpackb(data) if data else None
 
-    def call(self, method: str, payload: dict | None = None) -> object:
+    #: Extra attempts for idempotent calls on a retryable transport
+    #: fault, before the endpoint is declared offline.
+    RETRIES = 2
+
+    def call(self, method: str, payload: dict | None = None,
+             idempotent: bool = False) -> object:
         """RPC with offline short-circuit (a StorageError from the peer
         does NOT mark it offline — only transport failures do; an
-        RPCVersionMismatch is a deployment error, not a health event)."""
+        RPCVersionMismatch is a deployment error, not a health event).
+
+        `idempotent=True` (reads, stats, listings) permits a short
+        bounded retry — exponential backoff with jitter — on *retryable*
+        transport faults (reset/refused/timeout) before `_mark_offline`:
+        a single dropped connection under load shouldn't eject a healthy
+        peer from every quorum for a full health-check interval.  Writes
+        never retry here: the caller can't tell a lost request from a
+        lost response, so replaying one may double-apply."""
         if not self._online:
             raise NetworkError(f"{self.host}:{self.port} is offline")
-        try:
-            return self._raw_call(method, payload or {})
-        except NetworkError:
-            self._mark_offline()
-            raise
+        attempts = self.RETRIES + 1 if idempotent else 1
+        for i in range(attempts):
+            try:
+                return self._raw_call(method, payload or {})
+            except NetworkError as e:
+                if e.retryable and i + 1 < attempts:
+                    time.sleep(0.05 * (2 ** i) *
+                               (1.0 + 0.5 * random.random()))
+                    continue
+                self._mark_offline()
+                raise

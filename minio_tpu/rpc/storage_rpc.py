@@ -34,6 +34,18 @@ _DRIVE_METHODS = [
     "get_disk_id", "list_raw", "clear_tmp", "init_sys_volume",
 ]
 
+#: Read-type methods safe to replay on a retryable transport fault
+#: (connection reset/refused/timeout) — replaying a read can't
+#: double-apply, so the client is allowed a short bounded retry before
+#: declaring the peer offline.  Everything else (writes, renames,
+#: deletes) fails fast: a lost response is indistinguishable from a
+#: lost request.
+_IDEMPOTENT_METHODS = frozenset({
+    "read_all", "read_file", "read_version", "stat_volume", "list_dir",
+    "walk_dir", "walk_page", "file_size", "disk_info", "get_disk_id",
+    "list_volumes", "list_raw", "verify_file",
+})
+
 
 def register_storage_rpc(server, drives: list[LocalDrive]) -> None:
     """Expose `drives` (this node's local drives) on an RPCServer or
@@ -100,7 +112,8 @@ class RemoteDrive:
         try:
             result = self._client.call(
                 f"storage.{method}",
-                {"drive": self._idx, "args": wire_args, "kwargs": kwargs})
+                {"drive": self._idx, "args": wire_args, "kwargs": kwargs},
+                idempotent=method in _IDEMPOTENT_METHODS)
         except NetworkError as e:
             raise ErrDiskNotFound(str(e)) from None
         if isinstance(result, dict) and "__fi__" in result:

@@ -193,15 +193,24 @@ def main(argv: list[str] | None = None) -> int:
         else:
             pool_paths.append(
                 [p for a in group for p in expand_ellipses(a)])
+    from ..background.mrf import attach_mrf
+    from ..storage.health_wrap import wrap_drives
+
     pool_sets: list[ErasureSets] = []
     for paths in pool_paths:
-        drives = [LocalDrive(p) for p in paths]
+        # Health wrap at boot: per-API latency/error stats plus the
+        # drive circuit breaker (ok -> suspect -> offline + background
+        # probe), the xl-storage-disk-id-check.go:68 layering.
+        drives = wrap_drives([LocalDrive(p) for p in paths])
         pool_sets.append(ErasureSets(
             drives,
             set_drive_count=args.set_drive_count or len(drives),
             deployment_id=(pool_sets[0].deployment_id
                            if pool_sets else None)))
     pools = ServerPools(pool_sets)
+    # MRF heal queues: writes that missed a breaker-offline drive heal
+    # back to full width as soon as the drive recovers.
+    mrf_queues = attach_mrf(pools)
 
     # Full subsystem stack, the newAllSubsystems role
     # (cmd/server-main.go:441): IAM, scanner, notifications.
@@ -261,6 +270,8 @@ def main(argv: list[str] | None = None) -> int:
         break
     srv.shutdown()
     scanner.stop()
+    for q in mrf_queues:
+        q.stop()
     return 0
 
 

@@ -124,9 +124,14 @@ class ClusterNode:
         for ep in eps:
             self.node_locals.setdefault(ep.node, []).append(ep)
 
-        # My drives (served to peers + used directly).
-        self.local_drives = [LocalDrive(ep.path) for ep in eps
-                             if ep.is_local(my_host, my_port)]
+        # My drives (served to peers + used directly), health-wrapped so
+        # the circuit breaker trips on the node that OWNS the drive —
+        # peers then see fast ErrDiskNotFound over the wire instead of
+        # each discovering the sick drive independently.
+        from ..storage.health_wrap import wrap_drives
+        self.local_drives = wrap_drives(
+            [LocalDrive(ep.path) for ep in eps
+             if ep.is_local(my_host, my_port)])
 
         # Peers (every node but me).
         self.peer_clients: dict[tuple[str, int], RPCClient] = {
@@ -158,6 +163,8 @@ class ClusterNode:
         """Stop peer health-check loops (restart/shutdown path)."""
         for cli in self.peer_clients.values():
             cli.close()
+        for q in getattr(self, "mrf_queues", []):
+            q.stop()
 
     # -- drive construction --------------------------------------------------
 
@@ -332,8 +339,10 @@ def boot_cluster_node(endpoint_args: list[str], my_host: str,
         fmt = node.wait_format(drives, timeout=timeout)
         node.wait_peers_verified(fmt[0]["id"], timeout=timeout)
         pools = node.build_object_layer(drives, fmt=fmt)
+        from ..background.mrf import attach_mrf
         from ..background.scanner import DataScanner
         from ..iam.iam import IAMSys
+        node.mrf_queues = attach_mrf(pools)
         iam = IAMSys(pools)
         node.peer_registry.on_reload("iam", iam.load)
         server.bind_object_layer(pools, iam=iam,

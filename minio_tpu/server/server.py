@@ -962,12 +962,33 @@ class S3Server:
             for pi, pool in enumerate(self.pools.pools):
                 for si, s in enumerate(pool.sets):
                     for di, d in enumerate(s.drives):
-                        drives.append({
+                        if d is None:
+                            state = "offline"
+                        elif hasattr(d, "health_state"):
+                            # HealthWrappedDrive: live breaker state
+                            # (ok / suspect / offline-circuit-open).
+                            state = d.health_state()
+                        elif hasattr(d, "is_online") and not d.is_online():
+                            state = "offline"
+                        else:
+                            state = "ok"
+                        row = {
                             "pool_index": pi, "set_index": si,
                             "drive_index": di,
-                            "state": "ok" if d is not None else "offline",
+                            "state": state,
                             "endpoint": getattr(d, "root", ""),
-                        })
+                        }
+                        if hasattr(d, "health_info"):
+                            hi = d.health_info()
+                            row["breaker"] = {
+                                "consecutive_errors":
+                                    hi.get("consecutive_errors", 0),
+                                "consecutive_slow":
+                                    hi.get("consecutive_slow", 0),
+                                "last_fault": hi.get("last_fault", ""),
+                                "transitions": hi.get("transitions", []),
+                            }
+                        drives.append(row)
             return j({
                 "mode": "online" if ok else "degraded",
                 "deploymentID": self.pools.deployment_id,

@@ -1,0 +1,105 @@
+"""Seeded chaos drive — probabilistic fault injection over NaughtyDrive.
+
+Where NaughtyDrive is a scalpel (fail THIS method on THAT call — the
+quorum-edge proofs), ChaosDrive is weather: every intercepted call rolls
+a seeded RNG for intermittent errors, latency spikes, and torn writes,
+the fault mix a real aging disk produces.  The chaos test matrix sweeps
+PUT/GET/ranged-GET/heal over several seeds and asserts the system-level
+invariants no single-fault test can: zero data loss for acknowledged
+writes, clean quorum errors (never corrupt bytes) under the storm, and
+heal convergence back to full stripe width once it passes.
+
+Seeding makes a failing run replayable: the per-drive fault sequence is
+a pure function of (seed, call order), so a seed that breaks an
+invariant is a reproducer, not an anecdote.
+
+All NaughtyDrive programming (fail/slow/offline/heal_thyself) still
+works — chaos layers IN FRONT of the deterministic program, so a test
+can run background weather plus one scripted fault.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from .errors import ErrDiskNotFound, StorageError
+from .naughty import INTERCEPTED, NaughtyDrive
+
+#: Mutating calls eligible for torn-write injection (prefix lands on
+#: disk, then the call fails — the partial artifact must never become
+#: visible data).
+TORN_METHODS = ("write_all", "create_file", "append_file")
+
+
+class ErrChaosInjected(StorageError):
+    """Marker for chaos-injected faults (distinguishable in logs)."""
+
+
+class ChaosDrive(NaughtyDrive):
+    """NaughtyDrive with seeded probabilistic error/latency/torn faults.
+
+    rates are per-call probabilities; slow_s is the spike magnitude.
+    `injected` counts what actually fired; `chaos_off()` stops the
+    weather (the heal-convergence phase of the matrix).
+    """
+
+    def __init__(self, root: str, seed: int = 0, create: bool = True, *,
+                 error_rate: float = 0.0, slow_rate: float = 0.0,
+                 slow_s: float = 0.005, torn_rate: float = 0.0,
+                 methods: tuple[str, ...] = INTERCEPTED):
+        super().__init__(root, create=create)
+        self._chaos_rng = random.Random(seed)
+        self._chaos_mu = threading.Lock()
+        self.seed = seed
+        self.error_rate = error_rate
+        self.slow_rate = slow_rate
+        self.slow_s = slow_s
+        self.torn_rate = torn_rate
+        self.injected = {"errors": 0, "slow": 0, "torn": 0}
+        for name in methods:
+            real = getattr(self, name, None)   # the naughty wrapper
+            if real is None:
+                continue
+            setattr(self, name, self._chaos_wrap(name, real))
+
+    def chaos_off(self) -> "ChaosDrive":
+        """Stop injecting (rates to zero); the scripted naughty program,
+        if any, keeps running."""
+        with self._chaos_mu:
+            self.error_rate = self.slow_rate = self.torn_rate = 0.0
+        return self
+
+    def _chaos_wrap(self, name, real):
+        def chaotic(*a, **kw):
+            with self._chaos_mu:
+                # One draw per fault class per call keeps the sequence a
+                # function of call count alone (rates don't shift it).
+                r_slow = self._chaos_rng.random()
+                r_torn = self._chaos_rng.random()
+                r_err = self._chaos_rng.random()
+                do_slow = r_slow < self.slow_rate
+                do_torn = (r_torn < self.torn_rate
+                           and name in TORN_METHODS)
+                do_err = r_err < self.error_rate
+                if do_slow:
+                    self.injected["slow"] += 1
+                if do_torn:
+                    self.injected["torn"] += 1
+                elif do_err:
+                    self.injected["errors"] += 1
+            if do_slow:
+                time.sleep(self.slow_s)
+            if do_torn:
+                data = a[2] if len(a) >= 3 else kw.get("data", b"")
+                half = bytes(memoryview(data)[:max(0, len(data) // 2)])
+                try:
+                    real(a[0], a[1], half)
+                except Exception:  # noqa: BLE001 — already failing the call
+                    pass
+                raise ErrChaosInjected(f"chaos[{self.seed}]: torn {name}")
+            if do_err:
+                raise ErrDiskNotFound(f"chaos[{self.seed}]: {name} error")
+            return real(*a, **kw)
+        return chaotic

@@ -16,6 +16,7 @@ the faults hit the same code a real flaky disk would.
 from __future__ import annotations
 
 import threading
+import time
 
 from .drive import LocalDrive
 from .errors import ErrDiskNotFound
@@ -39,6 +40,9 @@ class NaughtyDrive(LocalDrive):
       fail_from(method, call=N, exc=...) fail from the Nth call onward
       fail_always(method, exc=...)       every call
       offline(exc=...)                   EVERY intercepted method fails
+      slow(method, delay_s, ...)         delay (don't fail) calls — the
+                                         tail-latency fault class the
+                                         hedged-read path exists for
     Counters in .calls[method] record invocations (including failed).
     """
 
@@ -49,6 +53,8 @@ class NaughtyDrive(LocalDrive):
         self._on_call: dict[tuple[str, int], Exception] = {}
         self._from_call: dict[str, tuple[int, Exception]] = {}
         self._always: dict[str, Exception] = {}
+        self._slow_on: dict[tuple[str, int], float] = {}
+        self._slow_from: dict[str, tuple[int, float]] = {}
         self._offline_exc: Exception | None = None
         for name in INTERCEPTED:
             real = getattr(self, name, None)
@@ -71,6 +77,14 @@ class NaughtyDrive(LocalDrive):
                     start, e = self._from_call[name]
                     if n >= start:
                         exc = e
+                delay = self._slow_on.pop((name, n), 0.0)
+                if name in self._slow_from:
+                    start, d = self._slow_from[name]
+                    if n >= start:
+                        delay = max(delay, d)
+            if delay > 0.0:
+                time.sleep(delay)   # outside the lock: slowness must not
+                                    # serialize the drive's other methods
             if exc is not None:
                 raise exc
             return real(*a, **kw)
@@ -99,11 +113,25 @@ class NaughtyDrive(LocalDrive):
         self._offline_exc = exc or ErrDiskNotFound("injected offline")
         return self
 
+    def slow(self, method: str, delay_s: float, on_call: int | None = None,
+             from_call: int | None = None) -> "NaughtyDrive":
+        """Delay `method` by delay_s: on its Nth next call (on_call), from
+        the Nth call onward (from_call), or every call (neither given)."""
+        if on_call is not None:
+            self._slow_on[(method, self.calls.get(method, 0) + on_call)] = \
+                delay_s
+        else:
+            start = self.calls.get(method, 0) + (from_call or 1)
+            self._slow_from[method] = (start, delay_s)
+        return self
+
     def heal_thyself(self) -> "NaughtyDrive":
         """Clear the whole fault program (the drive 'recovers')."""
         with self._mu_naughty:
             self._on_call.clear()
             self._from_call.clear()
             self._always.clear()
+            self._slow_on.clear()
+            self._slow_from.clear()
             self._offline_exc = None
         return self

@@ -31,6 +31,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: stress/load tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tests (smoke subset runs in "
+        "tier-1; the full soak matrix is also marked slow)")
 
 
 @pytest.fixture(params=["1", "0"], ids=["fastpath", "oracle"])
@@ -58,3 +62,22 @@ def coalesce_mode(request, monkeypatch):
     monkeypatch.setenv("MTPU_COALESCE", request.param)
     yield request.param
     coalesce.reset()
+
+
+@pytest.fixture(params=["1", "0"], ids=["hedge", "nohedge"])
+def hedge_mode(request, monkeypatch):
+    """Oracle guard for hedged shard reads: tests using this fixture
+    run once with speculative parity reads armed (MTPU_HEDGE=1, the
+    default) and once on the sequential oracle (=0) — results must be
+    byte-identical; hedging may only change latency."""
+    monkeypatch.setenv("MTPU_HEDGE", request.param)
+    return request.param
+
+
+@pytest.fixture(params=["1", "0"], ids=["breaker", "nobreaker"])
+def breaker_mode(request, monkeypatch):
+    """Oracle guard for the drive circuit breaker: MTPU_BREAKER=0 pins
+    every HealthWrappedDrive to passive stats-only behavior (always
+    "ok", no fast-fail, no exclusion)."""
+    monkeypatch.setenv("MTPU_BREAKER", request.param)
+    return request.param

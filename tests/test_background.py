@@ -114,6 +114,35 @@ class TestMRF:
         assert mrf.dropped == 1
         assert len(calls) == 3
 
+    def test_backoff_is_exponential_capped_and_jittered(self):
+        mrf = MRFQueue(lambda b, o, v: None, retry_interval=0.5,
+                       max_interval=4.0, jitter=0.25, seed=7)
+        for attempts, base in ((0, 0.5), (1, 1.0), (2, 2.0), (3, 4.0),
+                               (10, 4.0)):      # capped past 2^3
+            for _ in range(20):
+                d = mrf._backoff(attempts)
+                assert base <= d <= base * 1.25, (attempts, d)
+        # jitter actually varies (same attempt, different delays)
+        assert len({mrf._backoff(1) for _ in range(10)}) > 1
+
+    def test_failed_attempt_defers_and_counts_retries(self):
+        boom = [True]
+        def heal(b, o, v):
+            if boom[0]:
+                raise RuntimeError("drive still dead")
+        mrf = MRFQueue(heal, retry_interval=30.0, max_attempts=8)
+        mrf.enqueue("b", "o")
+        assert mrf.drain_once() == 0
+        assert mrf.retries == 1 and mrf.pending() == 1
+        # backed off: the entry is NOT due again right now
+        assert mrf.drain_once() == 0
+        assert mrf.retries == 1                 # not retried in lockstep
+        boom[0] = False
+        with mrf._mu:                           # force due (skip the wait)
+            next(iter(mrf._q.values()))["next_try"] = 0.0
+        assert mrf.drain_once() == 1
+        assert mrf.healed == 1 and mrf.pending() == 0
+
     def test_mrf_end_to_end_restores_stripe(self, pools):
         """Full loop: degraded PUT -> MRF -> real heal -> drive restored."""
         es = pools.pools[0].sets[0]

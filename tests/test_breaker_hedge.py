@@ -1,0 +1,328 @@
+"""Drive circuit breaker + hedged shard reads (fault-survival plane).
+
+Breaker: the xl-storage-disk-id-check.go state machine — consecutive
+errors/latency breaches walk a drive OK -> SUSPECT -> OFFLINE, an open
+circuit fails fast without touching the hardware, a probe (or one clean
+call while SUSPECT) closes it.  The engine excludes OFFLINE drives from
+read fan-outs; writes that miss them land in the MRF queue.
+
+Hedge: after an adaptive delay, a healthy read covers stragglers with
+speculative parity-shard reads, first-k-wins.  MTPU_HEDGE=0 is the
+sequential oracle — results must be byte-identical either way.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.background.mrf import MRFQueue
+from minio_tpu.engine.erasure_set import ErasureSet
+from minio_tpu.observe.metrics import DATA_PATH
+from minio_tpu.storage.errors import ErrDiskNotFound, ErrFileNotFound
+from minio_tpu.storage.health_wrap import (HealthWrappedDrive,
+                                           drive_available, wrap_drives)
+from minio_tpu.storage.naughty import NaughtyDrive
+
+
+def payload(size=300_000, seed=1):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture
+def fast_breaker(monkeypatch):
+    """Small thresholds so trips take a handful of calls, and a long
+    probe interval so the background prober can't close a circuit the
+    test is still asserting open."""
+    monkeypatch.setenv("MTPU_BREAKER_ERRS", "2")
+    monkeypatch.setenv("MTPU_BREAKER_OFFLINE_ERRS", "4")
+    monkeypatch.setenv("MTPU_BREAKER_PROBE_S", "30")
+
+
+def _wrapped_naughty(tmp_path, tag="bd"):
+    nd = NaughtyDrive(str(tmp_path / tag))
+    wd = HealthWrappedDrive(nd)
+    wd.make_volume("v")
+    wd.write_all("v", "f", b"data")
+    return nd, wd
+
+
+def _trip(wd, n, method="read_all"):
+    for _ in range(n):
+        with pytest.raises(ErrDiskNotFound):
+            getattr(wd, method)("v", "f")
+
+
+class TestBreakerStateMachine:
+    def test_errors_walk_ok_suspect_offline(self, tmp_path, fast_breaker):
+        nd, wd = _wrapped_naughty(tmp_path)
+        nd.fail_always("read_all")
+        nd.fail_always("disk_info")        # keep the prober from closing
+        assert wd.health_state() == "ok"
+        _trip(wd, 2)
+        assert wd.health_state() == "suspect"
+        _trip(wd, 2)
+        assert wd.health_state() == "offline"
+        hi = wd.health_info()
+        assert [t["to"] for t in hi["transitions"]] == \
+            ["suspect", "offline"]
+        assert "read_all" in hi["last_fault"]
+
+    def test_open_circuit_fails_fast_without_touching_drive(
+            self, tmp_path, fast_breaker):
+        nd, wd = _wrapped_naughty(tmp_path)
+        nd.fail_always("read_all")
+        nd.fail_always("disk_info")
+        _trip(wd, 4)
+        calls_at_open = nd.calls.get("read_all", 0)
+        # Rejections come from the breaker, not the drive, and are not
+        # self-counted as fresh errors.
+        errs_at_open = wd.total_errors()
+        with pytest.raises(ErrDiskNotFound, match="circuit open"):
+            wd.read_all("v", "f")
+        with pytest.raises(ErrDiskNotFound, match="circuit open"):
+            wd.write_all("v", "g", b"x")
+        assert nd.calls.get("read_all", 0) == calls_at_open
+        assert nd.calls.get("write_all", 0) == 1       # only the setup
+        assert wd.total_errors() == errs_at_open
+
+    def test_clean_call_closes_suspect(self, tmp_path, fast_breaker):
+        nd, wd = _wrapped_naughty(tmp_path)
+        nd.fail("read_all", on_call=1)
+        nd.fail("read_all", on_call=2)
+        _trip(wd, 2)
+        assert wd.health_state() == "suspect"
+        assert wd.read_all("v", "f") == b"data"
+        assert wd.health_state() == "ok"
+
+    def test_probe_closes_open_circuit(self, tmp_path, fast_breaker):
+        nd, wd = _wrapped_naughty(tmp_path)
+        nd.fail_always("read_all")
+        nd.fail_always("disk_info")
+        _trip(wd, 4)
+        assert wd.health_state() == "offline"
+        assert not wd.probe_now()          # still dead
+        assert wd.health_state() == "offline"
+        nd.heal_thyself()                  # drive recovers
+        assert wd.probe_now()
+        assert wd.health_state() == "ok"
+        assert wd.read_all("v", "f") == b"data"
+
+    def test_background_prober_recovers(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_BREAKER_ERRS", "2")
+        monkeypatch.setenv("MTPU_BREAKER_OFFLINE_ERRS", "4")
+        monkeypatch.setenv("MTPU_BREAKER_PROBE_S", "0.02")
+        nd, wd = _wrapped_naughty(tmp_path)
+        nd.fail_always("read_all")
+        _trip(wd, 4)
+        assert wd.health_state() == "offline"
+        nd.heal_thyself()
+        deadline = time.monotonic() + 5.0
+        while wd.health_state() != "ok" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert wd.health_state() == "ok"
+
+    def test_slow_calls_trip_suspect(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_BREAKER_SLOW_MS", "1")
+        monkeypatch.setenv("MTPU_BREAKER_SLOW_CALLS", "3")
+        nd, wd = _wrapped_naughty(tmp_path)
+        nd.slow("read_all", 0.005)
+        for _ in range(3):
+            assert wd.read_all("v", "f") == b"data"
+        assert wd.health_state() == "suspect"
+        assert "ms" in wd.health_info()["last_fault"]
+
+    def test_benign_errors_do_not_count(self, tmp_path, fast_breaker):
+        _, wd = _wrapped_naughty(tmp_path)
+        for _ in range(6):
+            with pytest.raises(ErrFileNotFound):
+                wd.read_all("v", "missing")
+        assert wd.health_state() == "ok"
+        assert wd.health_info()["consecutive_errors"] == 0
+
+    def test_oracle_flag_disables_breaker(self, tmp_path, monkeypatch,
+                                          fast_breaker):
+        monkeypatch.setenv("MTPU_BREAKER", "0")
+        nd, wd = _wrapped_naughty(tmp_path)
+        nd.fail_always("read_all")
+        for _ in range(10):
+            with pytest.raises(ErrDiskNotFound):
+                wd.read_all("v", "f")
+        assert wd.health_state() == "ok"
+        # every call reached the real drive — no fast-fail
+        assert nd.calls["read_all"] == 10
+        nd.heal_thyself()
+        assert wd.read_all("v", "f") == b"data"
+
+    def test_drive_available_predicate(self, tmp_path, fast_breaker):
+        nd, wd = _wrapped_naughty(tmp_path)
+        assert drive_available(wd)
+        assert not drive_available(None)
+        nd.fail_always("read_all")
+        nd.fail_always("disk_info")
+        _trip(wd, 4)
+        assert not drive_available(wd)
+
+
+class TestBreakerInEngine:
+    def _set(self, tmp_path, n=4):
+        inner = [NaughtyDrive(str(tmp_path / f"e{i}")) for i in range(n)]
+        drives = wrap_drives(inner)
+        es = ErasureSet(drives, default_parity=2)
+        es.make_bucket("bb")
+        return es, inner, drives
+
+    def _trip_offline(self, wd):
+        wd._drive.fail_always("read_all")
+        wd._drive.fail_always("disk_info")
+        for _ in range(4):
+            with pytest.raises(ErrDiskNotFound):
+                wd.read_all("bb", "nothing")
+        wd._drive.heal_thyself()           # raw drive is fine again, but
+        assert wd.health_state() == "offline"   # the circuit stays open
+
+    def test_offline_drive_excluded_from_reads(self, tmp_path,
+                                               fast_breaker):
+        es, inner, drives = self._set(tmp_path)
+        data = payload(seed=11)
+        es.put_object("bb", "o", data)
+        self._trip_offline(drives[0])
+        before = (inner[0].calls.get("read_file", 0),
+                  inner[0].calls.get("read_file_view", 0))
+        _, got = es.get_object("bb", "o")
+        assert bytes(got) == data
+        # the open circuit kept the engine off that drive entirely
+        assert (inner[0].calls.get("read_file", 0),
+                inner[0].calls.get("read_file_view", 0)) == before
+
+    def test_write_missing_offline_drive_feeds_mrf(self, tmp_path,
+                                                   fast_breaker):
+        es, inner, drives = self._set(tmp_path)
+        self._trip_offline(drives[1])
+        healed = []
+        es.mrf = MRFQueue(lambda b, o, v: healed.append((b, o, v)))
+        data = payload(seed=12)
+        es.put_object("bb", "o2", data)    # 3/4 drives: quorum holds
+        assert es.mrf.pending() == 1
+        _, got = es.get_object("bb", "o2")
+        assert bytes(got) == data
+        # circuit closes -> the queued heal converges the stripe
+        assert drives[1].probe_now()
+        assert es.mrf.drain_once() == 1
+        assert healed and healed[0][:2] == ("bb", "o2")
+
+    def test_breaker_oracle_equivalence(self, tmp_path, breaker_mode):
+        es, inner, drives = self._set(tmp_path)
+        data = payload(seed=13)
+        es.put_object("bb", "o3", data)
+        _, got = es.get_object("bb", "o3")
+        assert bytes(got) == data
+        _, part = es.get_object("bb", "o3", offset=1000, length=5000)
+        assert bytes(part) == data[1000:6000]
+
+
+class TestHedgedReads:
+    def _slow_set(self, tmp_path, monkeypatch, n=6, slow_s=0.08):
+        # Force the pool fan-out (the thing being hedged) even on a
+        # 1-core CI host.
+        monkeypatch.setattr(ErasureSet, "_SERIAL_FANOUT", False)
+        drives = [NaughtyDrive(str(tmp_path / f"h{i}")) for i in range(n)]
+        es = ErasureSet(drives, default_parity=2)
+        es.make_bucket("hb")
+        data = payload(seed=21)
+        es.put_object("hb", "o", data)
+        es.get_object("hb", "o")           # warm: counters find a
+        victim = max(drives,               # data-shard holder
+                     key=lambda d: d.calls.get("read_file", 0)
+                     + d.calls.get("read_file_view", 0))
+        victim.slow("read_file", slow_s)
+        victim.slow("read_file_view", slow_s)
+        return es, data, victim
+
+    def test_hedge_covers_slow_drive(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_HEDGE", "1")
+        monkeypatch.setenv("MTPU_HEDGE_MS", "3")
+        es, data, _ = self._slow_set(tmp_path, monkeypatch)
+        before = DATA_PATH.snapshot()
+        t0 = time.monotonic()
+        _, got = es.get_object("hb", "o")
+        dt = time.monotonic() - t0
+        assert bytes(got) == data
+        after = DATA_PATH.snapshot()
+        assert after["hedged_reads"] > before["hedged_reads"]
+        assert after["hedge_fired"] > before["hedge_fired"]
+        assert after["hedge_spares"] > before["hedge_spares"]
+        # The slow read (80 ms) was NOT on the critical path: the spare
+        # answered.  Generous CI bound, still far under the injected
+        # stall.
+        assert dt < 0.075, f"hedge did not cover straggler: {dt:.3f}s"
+
+    def test_hedge_oracle_byte_equivalence(self, tmp_path, monkeypatch,
+                                           hedge_mode):
+        monkeypatch.setenv("MTPU_HEDGE_MS", "3")
+        es, data, victim = self._slow_set(tmp_path, monkeypatch,
+                                          slow_s=0.02)
+        for off, ln in [(0, -1), (777, 100_000), (len(data) - 5, 5)]:
+            _, got = es.get_object("hb", "o", offset=off, length=ln)
+            want = data[off:] if ln == -1 else data[off:off + ln]
+            assert bytes(got) == want, (hedge_mode, off, ln)
+
+    def test_hedge_disabled_launches_no_spares(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv("MTPU_HEDGE", "0")
+        es, data, _ = self._slow_set(tmp_path, monkeypatch, slow_s=0.01)
+        before = DATA_PATH.snapshot()["hedged_reads"]
+        _, got = es.get_object("hb", "o")
+        assert bytes(got) == data
+        assert DATA_PATH.snapshot()["hedged_reads"] == before
+
+    def test_degraded_read_hedges_too(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_HEDGE", "1")
+        monkeypatch.setenv("MTPU_HEDGE_MS", "3")
+        es, data, victim = self._slow_set(tmp_path, monkeypatch,
+                                          slow_s=0.05)
+        # knock out a different drive entirely -> degraded decode loop
+        hole = next(i for i, d in enumerate(es.drives)
+                    if d is not victim)
+        saved, es.drives[hole] = es.drives[hole], None
+        try:
+            _, got = es.get_object("hb", "o")
+            assert bytes(got) == data
+        finally:
+            es.drives[hole] = saved
+
+    def test_failed_read_promotes_spare_immediately(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("MTPU_HEDGE", "1")
+        # Huge delay: any spare launched must be due to the FAILURE
+        # promotion, not the timer.
+        monkeypatch.setenv("MTPU_HEDGE_MS", "60000")
+        monkeypatch.setattr(ErasureSet, "_SERIAL_FANOUT", False)
+        drives = [NaughtyDrive(str(tmp_path / f"f{i}")) for i in range(6)]
+        es = ErasureSet(drives, default_parity=2)
+        es.make_bucket("hb")
+        data = payload(seed=22)
+        es.put_object("hb", "o", data)
+        es.get_object("hb", "o")
+        victim = max(drives,
+                     key=lambda d: d.calls.get("read_file", 0)
+                     + d.calls.get("read_file_view", 0))
+        victim.fail_always("read_file")
+        victim.fail_always("read_file_view")
+        t0 = time.monotonic()
+        _, got = es.get_object("hb", "o")
+        assert bytes(got) == data
+        assert time.monotonic() - t0 < 10.0     # never waited the timer
+
+    def test_serial_host_ignites_on_straggler_ewma(self, tmp_path):
+        drives = [NaughtyDrive(str(tmp_path / f"s{i}")) for i in range(4)]
+        es = ErasureSet(drives, default_parity=2)
+        # no EWMA data yet -> never worth fanning out on a serial host
+        assert not es._hedge_worthwhile([0, 1])
+        es._note_read_ms(0, 0.4)
+        es._note_read_ms(1, 0.5)
+        assert not es._hedge_worthwhile([0, 1])      # uniform + fast
+        for _ in range(8):
+            es._note_read_ms(1, 40.0)                # one straggler
+        assert es._hedge_worthwhile([0, 1])

@@ -1,0 +1,215 @@
+"""Seeded chaos matrix: system invariants under probabilistic faults.
+
+ChaosDrive rolls a seeded RNG on every storage call for intermittent
+errors, latency spikes, and torn writes — the fault mix of a real aging
+disk, replayable because the sequence is a pure function of (seed, call
+order).  The matrix sweeps PUT/GET/ranged-GET/heal over several seeds
+and asserts what no single-fault test can:
+
+  - zero data loss: every ACKNOWLEDGED write reads back byte-identical
+    (during the storm a read may fail with a clean StorageError, but
+    bytes that do come back are never wrong);
+  - rejected writes stay invisible — no partial artifact becomes data;
+  - quorum edges stay clean errors, never corrupt bytes;
+  - once the weather stops, heal converges: a bounded number of passes
+    restores full stripe width and the next pass heals nothing.
+
+A one-seed smoke runs in tier-1; the full seed matrix is `slow`.
+"""
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine import heal as heal_mod
+from minio_tpu.engine.erasure_set import BLOCK_SIZE, ErasureSet
+from minio_tpu.storage.chaos import ChaosDrive, ErrChaosInjected
+from minio_tpu.storage.errors import StorageError
+
+pytestmark = pytest.mark.chaos
+
+
+def payload(size, seed):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def build_set(tmp, seed, n=4, m=2, tag=""):
+    """Chaos drives start calm (rates 0) so bucket/format setup is
+    deterministic; storm() turns the weather on."""
+    drives = [ChaosDrive(f"{tmp}/{tag}s{seed}d{i}", seed=seed * 101 + i)
+              for i in range(n)]
+    es = ErasureSet(drives, default_parity=m)
+    es.make_bucket("cb")
+    return es, drives
+
+
+def storm(drives, error_rate=0.05, slow_rate=0.05, torn_rate=0.04,
+          slow_s=0.002):
+    for d in drives:
+        d.error_rate = error_rate
+        d.slow_rate = slow_rate
+        d.torn_rate = torn_rate
+        d.slow_s = slow_s
+
+
+SIZES = [700, 64_000, 300_000, BLOCK_SIZE + 77]
+
+
+def run_scenario(tmp_path, seed, n=4, m=2, sizes=SIZES, rounds=1):
+    es, drives = build_set(str(tmp_path), seed, n=n, m=m)
+    rng = np.random.default_rng(seed)
+    storm(drives)
+
+    acknowledged: dict[str, bytes] = {}
+    rejected: list[str] = []
+    for i, size in enumerate(sizes):
+        name = f"o{i}"
+        data = payload(size, seed * 1000 + i)
+        try:
+            es.put_object("cb", name, data)
+        except StorageError:
+            rejected.append(name)
+        else:
+            acknowledged[name] = data
+
+    # -- reads under the storm: exact bytes or a clean error ----------
+    for _ in range(rounds):
+        for name, data in acknowledged.items():
+            try:
+                _, got = es.get_object("cb", name)
+            except StorageError:
+                continue                    # clean failure is allowed
+            assert bytes(got) == data, (seed, name, "full GET corrupt")
+            if len(data) > 10:
+                off = int(rng.integers(0, len(data) - 2))
+                ln = int(rng.integers(1, len(data) - off))
+                try:
+                    _, part = es.get_object("cb", name, offset=off,
+                                            length=ln)
+                except StorageError:
+                    continue
+                assert bytes(part) == data[off:off + ln], \
+                    (seed, name, off, ln, "ranged GET corrupt")
+
+    # -- weather stops: heal must converge ----------------------------
+    for d in drives:
+        d.chaos_off()
+    for name in acknowledged:
+        for _ in range(2 * n):
+            rs = heal_mod.heal_object(es, "cb", name, deep=True)
+            if all(not r.healed for r in rs):
+                break
+        rs = heal_mod.heal_object(es, "cb", name, deep=True)
+        assert all(not r.healed for r in rs), \
+            (seed, name, "heal did not converge")
+        for r in rs:
+            assert r.after == [heal_mod.DRIVE_OK] * n, (seed, name)
+
+    # -- zero data loss, full width restored --------------------------
+    for name, data in acknowledged.items():
+        _, got = es.get_object("cb", name)
+        assert bytes(got) == data, (seed, name, "data loss after heal")
+    # rejected writes never became visible objects
+    for name in rejected:
+        with pytest.raises(StorageError):
+            es.get_object("cb", name)
+    return es, drives, acknowledged
+
+
+class TestChaosSmoke:
+    def test_one_seed_matrix(self, tmp_path):
+        """Tier-1 smoke: one seed through the full scenario."""
+        es, drives, acked = run_scenario(tmp_path, seed=7)
+        # the storm actually injected something, or this tested nothing
+        assert sum(sum(d.injected.values()) for d in drives) > 0
+
+    def test_determinism_same_seed_same_faults(self, tmp_path):
+        """A failing seed is a reproducer: identical call sequences on
+        identical seeds inject identical fault sequences."""
+        logs = []
+        for run in ("a", "b"):
+            d = ChaosDrive(f"{tmp_path}/det{run}", seed=42)
+            d.make_volume("v")
+            d.error_rate, d.slow_rate, d.torn_rate = 0.3, 0.2, 0.2
+            d.slow_s = 0.0
+            outcomes = []
+            for i in range(60):
+                try:
+                    d.write_all("v", f"f{i}", b"x" * 64)
+                    outcomes.append("ok")
+                except StorageError as e:
+                    outcomes.append(type(e).__name__)
+            logs.append((outcomes, dict(d.injected)))
+        assert logs[0] == logs[1]
+
+    def test_torn_write_never_becomes_data(self, tmp_path):
+        """One drive tearing EVERY write: the stripe still quorums, the
+        readback is byte-exact — the half-written artifacts on the torn
+        drive never serve."""
+        es, drives = build_set(str(tmp_path), seed=3, tag="torn")
+        drives[0].torn_rate = 1.0
+        data = payload(300_000, seed=31)
+        es.put_object("cb", "t", data)
+        _, got = es.get_object("cb", "t")
+        assert bytes(got) == data
+        assert drives[0].injected["torn"] > 0
+        # ... and heal repairs the torn drive once the weather stops
+        drives[0].chaos_off()
+        r = heal_mod.heal_object(es, "cb", "t", deep=True)[0]
+        assert 0 in r.healed_drives or r.before[0] == heal_mod.DRIVE_OK
+        r2 = heal_mod.heal_object(es, "cb", "t", deep=True)[0]
+        assert not r2.healed and r2.after == [heal_mod.DRIVE_OK] * 4
+
+    def test_quorum_edge_stays_clean(self, tmp_path):
+        """m fully-dead drives: exact bytes.  m+1: a clean StorageError
+        — never wrong bytes, never a hang."""
+        es, drives = build_set(str(tmp_path), seed=5, tag="edge")
+        data = payload(200_000, seed=51)
+        es.put_object("cb", "q", data)
+        for d in drives[:2]:                    # = m
+            d.error_rate = 1.0
+        _, got = es.get_object("cb", "q")
+        assert bytes(got) == data
+        drives[2].error_rate = 1.0              # m + 1
+        with pytest.raises(StorageError):
+            es.get_object("cb", "q")
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_seed_matrix_4p2(self, tmp_path, seed):
+        run_scenario(tmp_path, seed=seed, rounds=3)
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_seed_matrix_6p2(self, tmp_path, seed):
+        run_scenario(tmp_path, seed=seed, n=6, m=2,
+                     sizes=SIZES + [2 * BLOCK_SIZE + 1234], rounds=2)
+
+    def test_put_retry_under_storm_eventually_lands(self, tmp_path):
+        """A client retrying rejected PUTs (fresh attempt, same key)
+        eventually lands every object, and all land byte-exact."""
+        es, drives = build_set(str(tmp_path), seed=9, n=6, m=2,
+                               tag="retry")
+        storm(drives, error_rate=0.12, torn_rate=0.08)
+        want = {}
+        for i in range(6):
+            data = payload(150_000 + i * 7919, seed=900 + i)
+            for attempt in range(25):
+                try:
+                    es.put_object("cb", f"r{i}", data)
+                    break
+                except StorageError:
+                    continue
+            else:
+                pytest.fail(f"object r{i} never landed in 25 attempts")
+            want[f"r{i}"] = data
+        for d in drives:
+            d.chaos_off()
+        for name, data in want.items():
+            for _ in range(12):
+                rs = heal_mod.heal_object(es, "cb", name, deep=True)
+                if all(not r.healed for r in rs):
+                    break
+            _, got = es.get_object("cb", name)
+            assert bytes(got) == data

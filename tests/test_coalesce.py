@@ -183,6 +183,135 @@ class TestScheduler:
         assert n2 == 4 and same.shape == (4, 2)
 
 
+POISON = 66
+
+
+def picky_kernel(stacked, spans, ctx):
+    """Sums spans but refuses any span containing the POISON byte —
+    so a packed batch fails wholesale, and the per-member retry can
+    isolate exactly the guilty span."""
+    out = []
+    for lo, hi in spans:
+        if (stacked[lo:hi] == POISON).any():
+            raise ValueError("poisoned span")
+        out.append(int(stacked[lo:hi].sum()))
+    return out
+
+
+class TestFaultContainment:
+    def test_poisoned_member_fails_only_itself(self):
+        """One bad item in a packed batch: the batch dispatch faults,
+        the per-member retry resolves the innocent neighbors with
+        results and pins the exception on the guilty handle alone."""
+        co = coalesce.DispatchCoalescer()
+        co._ema = 2.0                 # force queued (non-inline) mode
+        gate = threading.Event()
+        warm = sum_kernel(gate=gate, block_first=True)
+        key = ("fc", 1)
+        h0 = co.submit(key, np.ones(1, dtype=np.uint8), warm)
+        time.sleep(0.05)              # dispatcher blocked: pile up a batch
+        good1 = co.submit(key, np.full(2, 3, dtype=np.uint8),
+                          picky_kernel)
+        bad = co.submit(key, np.full(2, POISON, dtype=np.uint8),
+                        picky_kernel)
+        good2 = co.submit(key, np.full(4, 2, dtype=np.uint8),
+                          picky_kernel)
+        gate.set()
+        assert h0.result(5.0) == 1
+        assert good1.result(5.0) == 6
+        assert good2.result(5.0) == 8
+        with pytest.raises(ValueError, match="poisoned"):
+            bad.result(5.0)
+        st = co.stats()
+        assert st["batch_faults"] == 1
+        assert st["member_retries"] == 3
+        assert not st["broken"]
+        # the scheduler survives: later work still dispatches
+        assert co.submit(key, np.ones(5, dtype=np.uint8),
+                         picky_kernel).result(5.0) == 5
+        co.close()
+
+    def test_single_poisoned_item_keeps_direct_error(self):
+        co = coalesce.DispatchCoalescer()
+        h = co.submit(("solo-p",), np.full(2, POISON, dtype=np.uint8),
+                      picky_kernel)
+        with pytest.raises(ValueError, match="poisoned"):
+            h.result(5.0)
+        st = co.stats()
+        assert st["batch_faults"] == 1 and st["member_retries"] == 0
+        co.close()
+
+    def test_dispatcher_death_fails_queued_never_hangs(self,
+                                                       monkeypatch):
+        """Scheduler-logic death (not a kernel fault): every queued
+        handle errors promptly — no submitter waits out its result()
+        timeout on a thread that no longer exists — and later submits
+        degrade to inline direct dispatch."""
+        co = coalesce.DispatchCoalescer()
+        co._ema = 5.0                 # force the queued path
+        monkeypatch.setattr(
+            co, "_pick_key",
+            lambda: (_ for _ in ()).throw(RuntimeError("scheduler bug")))
+        h = co.submit(("dead",), np.ones(3, dtype=np.uint8),
+                      sum_kernel())
+        with pytest.raises(RuntimeError, match="dispatcher died"):
+            h.result(5.0)
+        assert co.stats()["broken"]
+        # liveness after death: submits run inline, results still flow
+        h2 = co.submit(("dead",), np.ones(4, dtype=np.uint8),
+                       sum_kernel())
+        assert h2.result(1.0) == 4
+        co.close()
+
+    def test_close_fails_pending_handles(self):
+        co = coalesce.DispatchCoalescer()
+        co._ema = 2.0
+        gate = threading.Event()
+        h0 = co.submit(("cl",), np.ones(2, dtype=np.uint8),
+                       sum_kernel(gate=gate, block_first=True))
+        time.sleep(0.05)              # dispatcher blocked in h0
+        h1 = co.submit(("cl",), np.ones(3, dtype=np.uint8),
+                       sum_kernel())
+        co.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            h1.result(5.0)
+        gate.set()                    # the in-flight dispatch finishes
+        assert h0.result(5.0) == 2
+
+    def test_engine_falls_back_when_handles_fail(self, tmp_path,
+                                                 monkeypatch):
+        """A coalescer whose every handle errors must not fail reads:
+        the engine's verify sites fall back to the direct kernel and
+        count the fallback."""
+        class FailHandle:
+            def result(self, timeout=None):
+                raise RuntimeError("coalescer dispatcher died: stub")
+
+            def release(self):
+                pass
+
+        class BrokenCoalescer:
+            def submit(self, key, payload, fn, weight=None):
+                return FailHandle()
+
+            def hot(self):
+                return True           # force the coalesced verify route
+
+            def note_read(self, delta):
+                pass
+
+        monkeypatch.setenv("MTPU_COALESCE", "1")
+        monkeypatch.setattr(coalesce, "get", lambda: BrokenCoalescer())
+        es = make_set(tmp_path, n=4, name="fb")
+        es.make_bucket("b")
+        data = payload(BLOCK_SIZE + 99, seed=90)
+        before = DATA_PATH.snapshot()["co_fallbacks"]
+        es.put_object("b", "fb", data)
+        _, got = es.get_object("b", "fb")
+        assert bytes(got) == data
+        assert DATA_PATH.snapshot()["co_fallbacks"] > before
+
+
 def _mixed_workload(es, data_by_obj, ops, seed):
     """One client: run `ops` randomized PUT/GET/ranged-GET ops,
     returning a list of (kind, detail) mismatches (empty == pass)."""

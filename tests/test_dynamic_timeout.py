@@ -1,0 +1,80 @@
+"""DynamicTimeout dead-band behavior (cmd/dynamic-timeouts.go:36).
+
+The adaptive deadline has three regimes per WINDOW of outcomes:
+>=33% timeouts grows, <5% shrinks gradually, and the band between MUST
+hold steady — without it a workload whose tail sits near the deadline
+oscillates (shrink snaps onto the fast majority, the next window times
+out the tail, grow crawls back, repeat).  The hedged-read delay rides
+this class, so the band is also what keeps hedge rates stable.
+"""
+
+from minio_tpu.cluster.dynamic_timeout import DynamicTimeout
+
+
+def run_window(dt, timeout_frac, took_s=0.2):
+    n_to = round(dt.WINDOW * timeout_frac)
+    for i in range(dt.WINDOW):
+        if i < n_to:
+            dt.log_timeout()
+        else:
+            dt.log_success(took_s)
+
+
+class TestDeadBand:
+    def test_band_holds_exactly(self):
+        """10% timeouts sits inside [SHRINK_TRIGGER, GROW_TRIGGER):
+        the deadline must not move in either direction."""
+        dt = DynamicTimeout(1.0, 0.1)
+        held = dt.timeout()
+        for _ in range(6):
+            run_window(dt, timeout_frac=0.10)
+            assert dt.timeout() == held
+
+    def test_band_edges(self):
+        # just below GROW_TRIGGER: hold
+        dt = DynamicTimeout(1.0, 0.1)
+        run_window(dt, timeout_frac=0.32)
+        assert dt.timeout() == 1.0
+        # at GROW_TRIGGER: grow
+        run_window(dt, timeout_frac=0.34)
+        assert dt.timeout() > 1.0
+        # just above SHRINK_TRIGGER: hold
+        dt2 = DynamicTimeout(1.0, 0.1)
+        run_window(dt2, timeout_frac=0.06)
+        assert dt2.timeout() == 1.0
+        # below SHRINK_TRIGGER with fast successes: shrink
+        dt3 = DynamicTimeout(1.0, 0.1)
+        run_window(dt3, timeout_frac=0.0, took_s=0.05)
+        assert dt3.timeout() < 1.0
+
+    def test_no_oscillation_around_the_tail(self):
+        """The scenario the band exists for: 90% of ops at 0.2 s, 10%
+        timing out at a 1.0 s deadline.  Whatever value the first
+        windows settle on must then stay fixed — no grow/shrink cycle."""
+        dt = DynamicTimeout(1.0, 0.1)
+        seen = set()
+        for _ in range(12):
+            run_window(dt, timeout_frac=0.10, took_s=0.2)
+            seen.add(dt.timeout())
+        assert len(seen) == 1, f"deadline oscillated: {sorted(seen)}"
+
+    def test_shrink_is_gradual_and_floored(self):
+        dt = DynamicTimeout(8.0, 1.0)
+        run_window(dt, timeout_frac=0.0, took_s=0.01)
+        # at most one GROW step down per window
+        assert dt.timeout() >= 8.0 / dt.GROW - 1e-9
+        for _ in range(40):
+            run_window(dt, timeout_frac=0.0, took_s=0.01)
+        assert dt.timeout() == 1.0          # minimum holds
+
+    def test_grow_is_capped(self):
+        dt = DynamicTimeout(1.0, 0.1, 2.0)
+        for _ in range(10):
+            run_window(dt, timeout_frac=1.0)
+        assert dt.timeout() == 2.0
+
+    def test_partial_window_never_moves(self):
+        dt = DynamicTimeout(1.0, 0.1)
+        for _ in range(dt.WINDOW - 1):
+            dt.log_timeout()
+        assert dt.timeout() == 1.0          # window not full yet
