@@ -338,6 +338,216 @@ def concurrent_bench(duration_s: float = 4.0,
     return out
 
 
+def digest_bench(duration_s: float = 3.0) -> dict:
+    """Native multi-buffer digest plane suite (MTPU_NATIVE_DIGEST):
+
+      digest_md5_hashlib_gbps      one hashlib.md5 stream (the oracle —
+                                   and the old serial ETag wall)
+      digest_md5_native_xN_gbps    N incremental streams in SIMD
+                                   lockstep through native/digest.cc,
+                                   aggregate rate (acceptance: >= 3x)
+      digest_sha256_*_gbps         8-buffer batch, hashlib vs native
+      digest_conc{4,8}_put[_oracle]_gbps
+                                   closed-loop PUT-only 1 MiB loadgen
+                                   runs, native lanes vs hashlib oracle
+      digest_sigv4_streamed_gbps / digest_put_unsigned_gbps
+                                   aws-chunked signed PUT vs the same
+                                   PUT unsigned over HTTP (the chunk
+                                   sha256 chain is the delta)
+      digest_mp_put[_oracle]_gbps  2x32 MiB multipart parts, part-ETag
+                                   lanes on vs off
+    """
+    import hashlib
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.engine import multipart as mp
+    from minio_tpu.engine.erasure_set import ErasureSet
+    from minio_tpu.storage.drive import LocalDrive
+    from tools.loadgen import run_load
+
+    def best_rate(fn, nbytes, n=3):
+        fn()
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return nbytes / best / 1e9
+
+    out = {}
+    rng = np.random.default_rng(3)
+
+    # -- kernel: single hashlib stream vs N-lane native aggregate ------------
+    try:
+        from native import digest_native as dn
+        dn.load()
+        out["digest_isa"] = dn.isa()
+        lanes = dn.md5_lanes()
+        bufs = [rng.integers(0, 256, 8 << 20, dtype=np.uint8).tobytes()
+                for _ in range(lanes)]
+        one = best_rate(lambda: hashlib.md5(bufs[0]).digest(), len(bufs[0]))
+        agg = best_rate(lambda: dn.md5_batch(bufs),
+                        sum(len(b) for b in bufs))
+        out["digest_md5_hashlib_gbps"] = round(one, 2)
+        out[f"digest_md5_native_x{lanes}_gbps"] = round(agg, 2)
+        out["digest_md5_lane_speedup"] = round(agg / one, 2)
+        sha_h = best_rate(
+            lambda: [hashlib.sha256(b).digest() for b in bufs],
+            sum(len(b) for b in bufs))
+        sha_n = best_rate(lambda: dn.sha256_batch(bufs),
+                          sum(len(b) for b in bufs))
+        out["digest_sha256_hashlib_gbps"] = round(sha_h, 2)
+        out["digest_sha256_native_gbps"] = round(sha_n, 2)
+    except Exception as e:  # noqa: BLE001 — suite must still report
+        out["digest_native_error"] = f"{type(e).__name__}: {e}"
+
+    saved_flag = os.environ.get("MTPU_NATIVE_DIGEST")
+
+    def set_flag(v):
+        if v is None:
+            os.environ.pop("MTPU_NATIVE_DIGEST", None)
+        else:
+            os.environ["MTPU_NATIVE_DIGEST"] = v
+
+    # -- concurrent PUT: lanes on vs hashlib oracle --------------------------
+    root = tempfile.mkdtemp(prefix="mtpu-digest-")
+    try:
+        es = ErasureSet([LocalDrive(f"{root}/d{i}") for i in range(4)])
+        es.make_bucket("bench")
+        warm = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+        es.put_object("bench", "warm", warm)            # compile warm-up
+        for n in (4, 8):
+            for flag, tag in (("1", ""), ("0", "_oracle")):
+                set_flag(flag)
+                r = run_load(es, clients=n, object_size=1 << 20,
+                             put_frac=1.0, duration_s=duration_s,
+                             bucket="bench", seed=20 + n)
+                out[f"digest_conc{n}_put{tag}_gbps"] = r["gbps"]
+                if flag == "1":
+                    out[f"digest_conc{n}_lane_occupancy"] = \
+                        r["dg_md5_occupancy"]
+        set_flag("1")
+
+        # -- multipart part-ETag lanes on vs off -----------------------------
+        part = rng.integers(0, 256, 32 << 20, dtype=np.uint8).tobytes()
+        for flag, tag in (("1", ""), ("0", "_oracle")):
+            set_flag(flag)
+            up = mp.new_multipart_upload(es, "bench", f"mp{flag}")
+            mp.put_object_part(es, "bench", f"mp{flag}", up, 1, part)
+            t0 = time.perf_counter()
+            for pn in (2, 3):
+                mp.put_object_part(es, "bench", f"mp{flag}", up, pn, part)
+            dt = time.perf_counter() - t0
+            out[f"digest_mp_put{tag}_gbps"] = round(
+                2 * len(part) / dt / 1e9, 2)
+            etags = {p.number: p.etag
+                     for p in mp.list_parts(es, "bench", f"mp{flag}", up)}
+            mp.complete_multipart_upload(
+                es, "bench", f"mp{flag}", up,
+                [(pn, etags[pn]) for pn in sorted(etags)])
+    finally:
+        set_flag(saved_flag)
+        shutil.rmtree(root, ignore_errors=True)
+
+    # -- SigV4 streamed vs unsigned PUT over HTTP ----------------------------
+    try:
+        out.update(_sigv4_streamed_bench())
+    except Exception as e:  # noqa: BLE001
+        out["digest_sigv4_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _sigv4_streamed_bench(n_put: int = 8, obj_mib: int = 8) -> dict:
+    """aws-chunked (chunk-signed, sha256 per chunk) PUT vs the same PUT
+    with UNSIGNED-PAYLOAD, through the real HTTP front door.  The delta
+    is the price of streaming-SigV4 payload verification."""
+    import datetime
+    import http.client as hc
+    import shutil
+    import tempfile
+
+    from minio_tpu.engine.pools import ServerPools
+    from minio_tpu.engine.sets import ErasureSets
+    from minio_tpu.server import sigv4
+    from minio_tpu.server.client import S3Client
+    from minio_tpu.server.server import S3Server
+    from minio_tpu.storage.drive import LocalDrive
+
+    out = {}
+    root = tempfile.mkdtemp(prefix="mtpu-sigv4-")
+    srv = None
+    try:
+        pools = ServerPools([ErasureSets(
+            [LocalDrive(f"{root}/d{i}") for i in range(4)],
+            set_drive_count=4)])
+        srv = S3Server(pools, sigv4.Credentials("bench", "bench-secret")
+                       ).start()
+        cli = S3Client(srv.endpoint, "bench", "bench-secret")
+        cli.make_bucket("sv4")
+        payload = np.random.default_rng(9).integers(
+            0, 256, obj_mib << 20, dtype=np.uint8).tobytes()
+
+        def put_unsigned(key):
+            from minio_tpu.utils import streams
+            cli.put_object_stream("sv4", key, streams.BytesReader(payload),
+                                  len(payload))
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        scope = f"{amz_date[:8]}/{cli.creds.region}/s3/aws4_request"
+
+        def encode_chunked(key):
+            """Client-side signing/framing, done OUTSIDE the timed
+            region — the server's verify cost is what we measure."""
+            headers = {"Host": f"{cli.host}:{cli.port}"}
+            auth = sigv4.sign_request(cli.creds, "PUT", f"/sv4/{key}", {},
+                                      headers, sigv4.STREAMING_PAYLOAD,
+                                      now=now)
+            headers.update(auth)
+            seed_sig = auth["Authorization"].rsplit("Signature=", 1)[1]
+            wire = sigv4.encode_streaming_body(
+                cli.creds, scope, amz_date, seed_sig, payload,
+                chunk_size=1 << 20)
+            headers["Content-Length"] = str(len(wire))
+            return key, headers, wire
+
+        def put_chunked(key, headers, wire):
+            conn = hc.HTTPConnection(cli.host, cli.port, timeout=120)
+            try:
+                conn.request("PUT", f"/sv4/{key}", body=wire,
+                             headers=headers)
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(body[:200])
+            finally:
+                conn.close()
+
+        wires = [encode_chunked(f"c{i}") for i in range(n_put)]
+        put_unsigned("warm-u")                          # warm both paths
+        put_chunked(*encode_chunked("warm-c"))
+        t0 = time.perf_counter()
+        for i in range(n_put):
+            put_unsigned(f"u{i}")
+        dt_u = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for w in wires:
+            put_chunked(*w)
+        dt_c = time.perf_counter() - t0
+        total = n_put * len(payload)
+        out["digest_put_unsigned_gbps"] = round(total / dt_u / 1e9, 2)
+        out["digest_sigv4_streamed_gbps"] = round(total / dt_c / 1e9, 2)
+        out["digest_sigv4_overhead_pct"] = round(
+            100.0 * (dt_c - dt_u) / dt_u, 1)
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _best_of(f, n=5):
     """Best-of-n ms timing for the stage-attribution probes."""
     f()
@@ -794,9 +1004,10 @@ def main() -> None:
         res = subprocess.run(
             [sys.executable, "-c",
              "import json, sys; sys.path.insert(0, sys.argv[1]); "
-             "from bench import e2e_bench, concurrent_bench, hedge_bench; "
+             "from bench import (e2e_bench, concurrent_bench, "
+             "hedge_bench, digest_bench); "
              "r = e2e_bench(); r.update(concurrent_bench()); "
-             "r.update(hedge_bench()); "
+             "r.update(hedge_bench()); r.update(digest_bench()); "
              "print(json.dumps(r))", here],
             env=env, capture_output=True, text=True, timeout=600)
         if res.returncode != 0:
@@ -870,7 +1081,8 @@ def main() -> None:
     for k, v in results.items():
         if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup",
                         "_ms_tmpfs", "_pct", "_pct_tmpfs", "_occupancy"))
-                or k.startswith("tunnel_") or k == "host_cores"):
+                or k.startswith(("tunnel_", "digest_"))
+                or k == "host_cores"):
             extras.setdefault(k, v)
     if "put_stage_md5_ms_tmpfs" in extras:
         extras["put_attribution_note"] = (

@@ -665,7 +665,8 @@ class ErasureSet:
 
             if stream is not None:
                 sizeref["size"] = total
-                meta.setdefault("etag", md5.hexdigest())
+                with ospan.span("engine.etag"):
+                    meta.setdefault("etag", md5.hexdigest())
             elif etag_md5 is not None:
                 with ospan.span("engine.etag"):
                     meta.setdefault("etag", etag_md5.hexdigest())

@@ -222,6 +222,17 @@ class DataPathStats:
             # Drive circuit-breaker transitions by target state.
             self.drive_transitions = {"ok": 0, "suspect": 0,
                                       "offline": 0}
+            # Native digest plane (utils/digestlanes.py +
+            # native/digest.cc): md5 lane-scheduler ticks and batched
+            # sha256 calls.  streams/calls is the mean lane occupancy —
+            # >1 means independent digest streams really are advancing
+            # together through SIMD lanes.
+            self.dg_md5_calls = 0
+            self.dg_md5_streams = 0
+            self.dg_md5_bytes = 0
+            self.dg_sha_calls = 0
+            self.dg_sha_bufs = 0
+            self.dg_sha_bytes = 0
 
     def record_heal_batch(self, blocks: int, capacity: int,
                           source_bytes: int, out_bytes: int,
@@ -304,6 +315,20 @@ class DataPathStats:
             if to_state in self.drive_transitions:
                 self.drive_transitions[to_state] += 1
 
+    def record_digest_batch(self, streams: int, nbytes: int) -> None:
+        """One md5 lane-scheduler tick advanced `streams` streams by a
+        total of `nbytes` in a single native call."""
+        with self._mu:
+            self.dg_md5_calls += 1
+            self.dg_md5_streams += streams
+            self.dg_md5_bytes += nbytes
+
+    def record_sha_batch(self, bufs: int, nbytes: int) -> None:
+        with self._mu:
+            self.dg_sha_calls += 1
+            self.dg_sha_bufs += bufs
+            self.dg_sha_bytes += nbytes
+
     def snapshot(self) -> dict:
         with self._mu:
             return {
@@ -344,6 +369,15 @@ class DataPathStats:
                 "hedge_spares": self.hedge_spares,
                 "hedge_wins": self.hedge_wins,
                 "drive_transitions": dict(self.drive_transitions),
+                "dg_md5_calls": self.dg_md5_calls,
+                "dg_md5_streams": self.dg_md5_streams,
+                "dg_md5_bytes": self.dg_md5_bytes,
+                "dg_md5_occupancy": (
+                    self.dg_md5_streams / self.dg_md5_calls
+                    if self.dg_md5_calls else 0.0),
+                "dg_sha_calls": self.dg_sha_calls,
+                "dg_sha_bufs": self.dg_sha_bufs,
+                "dg_sha_bytes": self.dg_sha_bytes,
             }
 
 
@@ -464,6 +498,28 @@ class MetricsRegistry:
         self.hedge_wins = Gauge(
             "mtpu_hedge_wins_total",
             "Hedged spare rows that made the final k")
+        # Native digest-plane families (MTPU_NATIVE_DIGEST).
+        self.dg_md5_calls = Gauge(
+            "mtpu_digest_md5_lane_calls_total",
+            "Native multi-buffer MD5 lane-scheduler ticks")
+        self.dg_md5_streams = Gauge(
+            "mtpu_digest_md5_streams_total",
+            "Streams advanced across MD5 lane-scheduler ticks")
+        self.dg_md5_bytes = Gauge(
+            "mtpu_digest_md5_bytes_total",
+            "Bytes hashed through native MD5 lanes")
+        self.dg_md5_occupancy = Gauge(
+            "mtpu_digest_md5_lane_occupancy_streams",
+            "Mean streams per MD5 lane tick (>1 = lanes are shared)")
+        self.dg_sha_calls = Gauge(
+            "mtpu_digest_sha256_batch_calls_total",
+            "Batched native SHA256 calls")
+        self.dg_sha_bufs = Gauge(
+            "mtpu_digest_sha256_buffers_total",
+            "Buffers verified through batched native SHA256")
+        self.dg_sha_bytes = Gauge(
+            "mtpu_digest_sha256_bytes_total",
+            "Bytes hashed through batched native SHA256")
         # Drive circuit-breaker state (0=ok 1=suspect 2=offline) and
         # lifetime transitions by target state.
         self.drive_state = Gauge(
@@ -624,6 +680,13 @@ class MetricsRegistry:
         self.hedge_wins.set(snap["hedge_wins"])
         for state, n in snap["drive_transitions"].items():
             self.drive_transitions.set(n, state=state)
+        self.dg_md5_calls.set(snap["dg_md5_calls"])
+        self.dg_md5_streams.set(snap["dg_md5_streams"])
+        self.dg_md5_bytes.set(snap["dg_md5_bytes"])
+        self.dg_md5_occupancy.set(snap["dg_md5_occupancy"])
+        self.dg_sha_calls.set(snap["dg_sha_calls"])
+        self.dg_sha_bufs.set(snap["dg_sha_bufs"])
+        self.dg_sha_bytes.set(snap["dg_sha_bytes"])
 
     def _sync_spans(self) -> None:
         # Imported lazily: span.py is the one observe module allowed to
@@ -669,6 +732,9 @@ class MetricsRegistry:
                   self.co_batch_faults, self.co_member_retries,
                   self.co_fallbacks, self.hedged_reads,
                   self.hedge_fired, self.hedge_spares, self.hedge_wins,
+                  self.dg_md5_calls, self.dg_md5_streams,
+                  self.dg_md5_bytes, self.dg_md5_occupancy,
+                  self.dg_sha_calls, self.dg_sha_bufs, self.dg_sha_bytes,
                   self.drive_state, self.drive_transitions,
                   self.mrf_pending, self.mrf_healed, self.mrf_dropped,
                   self.mrf_retries,

@@ -76,10 +76,41 @@ def mxhash_self_test() -> None:
         raise SelfTestError("bitrot (mxh256) self-test mismatch")
 
 
+def digest_self_test() -> None:
+    """Validate EVERY compiled native digest path (not just the one
+    runtime dispatch would pick) against hashlib before serving: a
+    miscompiled SIMD body must refuse to boot, same contract as the
+    erasure/bitrot golden tests.  Skips silently when the native lib is
+    unavailable or disabled — the hashlib oracle needs no check."""
+    from ..utils import digestlanes
+    if not digestlanes.use_native():
+        return
+    from native import digest_native as dn
+
+    # Sizes straddling every padding boundary (RFC 1321 / FIPS 180-4:
+    # 55/56/57 one-vs-two pad blocks, 63/64/65 block edges).
+    sizes = (0, 1, 55, 56, 57, 63, 64, 65, 1000)
+    bufs = [bytes((i * 37 + j) % 256 for j in range(n))
+            for i, n in enumerate(sizes)]
+    for isa in dn.supported_md5_isas():
+        got = dn.md5_batch(bufs, isa)
+        want = [hashlib.md5(b).digest() for b in bufs]
+        if got != want:
+            raise SelfTestError(
+                f"md5 self-test mismatch on {dn.MD5_ISA_NAMES[isa]}")
+    for isa in dn.supported_sha_isas():
+        got = dn.sha256_batch(bufs, isa)
+        want = [hashlib.sha256(b).digest() for b in bufs]
+        if got != want:
+            raise SelfTestError(
+                f"sha256 self-test mismatch on {dn.SHA_ISA_NAMES[isa]}")
+
+
 def run_startup_self_tests() -> None:
     erasure_self_test()
     bitrot_self_test()
     mxhash_self_test()
+    digest_self_test()
     # Fail boot on a misconfigured bitrot write algorithm (clear config
     # error now, not a confusing per-request failure later).
     from ..storage.bitrot_io import write_algo

@@ -835,7 +835,7 @@ class S3Handlers:
             raise S3Error("KeyTooLongError")
         h = {k.lower(): v for k, v in headers.items()}
         from ..crypto import sse as _sse
-        from ..utils import streams
+        from ..utils import digestlanes, streams
         from . import extract as ex
         if "x-amz-copy-source" in h:
             if streams.is_reader(body):
@@ -874,12 +874,21 @@ class S3Handlers:
                                           str(n)})
         md5_hdr = h.get("content-md5")
         if md5_hdr:
+            # Conformance split (cf. internal/hash/reader.go): a header
+            # that does not decode to exactly one MD5 digest is
+            # InvalidDigest; a well-formed digest that disagrees with
+            # the body is BadDigest.  validate=True matters — lenient
+            # b64decode silently drops non-alphabet bytes and would
+            # misreport malformed headers as mismatches.  Runs before
+            # put_object, so nothing is staged for a rejected body.
             import base64
             try:
-                want = base64.b64decode(md5_hdr)
+                want = base64.b64decode(md5_hdr, validate=True)
             except Exception:  # noqa: BLE001
                 raise S3Error("InvalidDigest") from None
-            if hashlib.md5(body).digest() != want:
+            if len(want) != 16:
+                raise S3Error("InvalidDigest")
+            if digestlanes.md5_digest(body) != want:
                 raise S3Error("BadDigest")
         metadata = {k: v for k, v in h.items()
                     if k.startswith(AMZ_META_PREFIX)}

@@ -274,60 +274,34 @@ def decode_streaming_body(creds, headers: dict[str, str],
     Chunk framing: hex-size;chunk-signature=<sig>\r\n<data>\r\n ... with a
     rolling signature chain seeded from the request signature
     (cf. cmd/streaming-signature-v4.go).
-    """
-    lookup = _as_lookup(creds)
-    h = {k.lower(): v for k, v in headers.items()}
-    auth = h.get("authorization", "")
-    access_key, scope, _, seed_sig = _parse_auth_header(auth)
-    creds = lookup(access_key)
-    if creds is None:
-        raise S3Error("InvalidAccessKeyId")
-    amz_date = h.get("x-amz-date", "")
-    date = amz_date[:8]
-    region = scope.split("/")[1] if scope.count("/") >= 3 else creds.region
-    key = signing_key(creds.secret_key, date, region)
 
-    out = bytearray()
-    prev_sig = seed_sig
-    pos = 0
-    empty_hash = _sha256(b"")
-    while True:
-        nl = raw.find(b"\r\n", pos)
-        if nl < 0:
-            raise S3Error("IncompleteBody")
-        header = raw[pos:nl].decode("ascii", "replace")
-        size_hex, _, ext = header.partition(";")
-        try:
-            size = int(size_hex, 16)
-        except ValueError:
-            raise S3Error("IncompleteBody", "bad chunk size") from None
-        chunk_sig = ""
-        if ext.startswith("chunk-signature="):
-            chunk_sig = ext[len("chunk-signature="):]
-        data = raw[nl + 2:nl + 2 + size]
-        if len(data) != size:
-            raise S3Error("IncompleteBody")
-        sts = "\n".join([
-            "AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev_sig,
-            empty_hash, _sha256(data)])
-        want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
-        if not hmac.compare_digest(want, chunk_sig):
-            raise S3Error("SignatureDoesNotMatch", "chunk signature mismatch")
-        prev_sig = want
-        pos = nl + 2 + size
-        if raw[pos:pos + 2] == b"\r\n":
-            pos += 2
-        if size == 0:
-            break
-        out += data
-    return bytes(out)
+    Buffered-path wrapper over StreamingSigV4Reader: one parser, one
+    verifier (and one batched-sha256 plane) for both the buffered and
+    the streamed PUT paths — including the MAX_CHUNK_SIZE bound.
+    """
+    from ..utils import streams
+    return StreamingSigV4Reader(creds, headers,
+                                streams.BytesReader(raw)).read(-1)
+
+
+#: Largest accepted chunk-header line (hex size + extensions): a header
+#: that long is garbage, not framing — bound it so a malformed stream
+#: can't make the parser buffer forever hunting for CRLF.
+_MAX_CHUNK_HEADER = 16 * 1024
 
 
 class StreamingSigV4Reader:
     """Streaming decoder+verifier for aws-chunked request bodies — the
-    reader counterpart of decode_streaming_body, so a signed streaming
-    PUT flows to the erasure engine in O(chunk) memory
+    reader counterpart the buffered path also rides, so a signed
+    streaming PUT flows to the erasure engine in O(chunk) memory
     (cf. newSignV4ChunkedReader, cmd/streaming-signature-v4.go).
+
+    Verification is batched: each read() parses EVERY complete frame
+    already buffered, hashes all their payloads in one call through the
+    digest plane (utils/digestlanes.sha256_many — one GIL-released
+    native sha256 batch when MTPU_NATIVE_DIGEST=1), then walks the
+    cheap rolling HMAC chain over the digests.  The signature chain
+    only needs sha256(data_i) per chunk, so hashing order is free.
 
     Raises S3Error("SignatureDoesNotMatch") on a bad chunk signature,
     S3Error("IncompleteBody") on truncation — at the read() where the
@@ -350,75 +324,108 @@ class StreamingSigV4Reader:
         self._buf = bytearray()
         self._out = bytearray()
         self._eof = False
+        self._need_crlf = False      # data CRLF still to consume
+        self._saw_final = False      # zero-length chunk parsed
         self._empty_hash = _sha256(b"")
 
-    def _fill(self, n: int) -> None:
-        """Ensure >= n bytes buffered from the raw stream (or its EOF)."""
-        while len(self._buf) < n:
-            piece = self._raw.read(max(n - len(self._buf), 64 * 1024))
-            if not piece:
-                return
-            self._buf += piece
+    def _fill_some(self) -> bool:
+        """Pull one more piece from the raw stream; False at its EOF."""
+        piece = self._raw.read(1 << 20)
+        if not piece:
+            return False
+        self._buf += piece
+        return True
 
-    def _read_line(self) -> bytes:
-        while True:
-            nl = self._buf.find(b"\r\n")
-            if nl >= 0:
-                line = bytes(self._buf[:nl])
-                del self._buf[:nl + 2]
-                return line
-            before = len(self._buf)
-            self._fill(before + 4096)
-            if len(self._buf) == before:
-                raise S3Error("IncompleteBody")
+    def _parse_ready(self) -> list[tuple[bytes, str]]:
+        """Consume every complete frame currently buffered.  Framing
+        errors raise here; signatures are checked in _verify_frames."""
+        frames: list[tuple[bytes, str]] = []
+        while not self._saw_final:
+            if self._need_crlf:
+                if len(self._buf) < 2:
+                    break
+                # tolerate a missing data CRLF (matches the pre-reader
+                # decoder; some clients omit it on the final frame)
+                if self._buf[:2] == b"\r\n":
+                    del self._buf[:2]
+                self._need_crlf = False
+            # bounded find: a valid header line is tiny, and an
+            # unbounded scan would rescan a partially-buffered chunk's
+            # data on every fill (quadratic on large chunks)
+            nl = self._buf.find(b"\r\n", 0, _MAX_CHUNK_HEADER + 2)
+            if nl < 0:
+                if len(self._buf) > _MAX_CHUNK_HEADER:
+                    raise S3Error("IncompleteBody", "chunk header too long")
+                break
+            header = bytes(self._buf[:nl]).decode("ascii", "replace")
+            size_hex, _, ext = header.partition(";")
+            try:
+                size = int(size_hex, 16)
+            except ValueError:
+                raise S3Error("IncompleteBody", "bad chunk size") from None
+            # Bound per-chunk buffering: the declared chunk size is
+            # untrusted, and the whole chunk is buffered before its
+            # signature verifies — without a cap one authenticated PUT
+            # declaring a multi-GiB chunk defeats the O(batch) memory
+            # bound (the reference's signV4ChunkedReader hashes into
+            # the caller's bounded buffer). AWS SDKs emit <=1 MiB
+            # chunks; 16 MiB leaves generous headroom.
+            if size > MAX_CHUNK_SIZE:
+                raise S3Error("EntityTooLarge",
+                              f"chunk of {size} bytes exceeds the "
+                              f"{MAX_CHUNK_SIZE}-byte chunk limit")
+            if len(self._buf) - (nl + 2) < size:
+                break                # frame incomplete; wait for more
+            chunk_sig = ""
+            if ext.startswith("chunk-signature="):
+                chunk_sig = ext[len("chunk-signature="):]
+            data = bytes(self._buf[nl + 2:nl + 2 + size])
+            del self._buf[:nl + 2 + size]
+            self._need_crlf = True
+            frames.append((data, chunk_sig))
+            if size == 0:
+                self._saw_final = True
+        return frames
 
-    def _decode_chunk(self) -> None:
-        header = self._read_line().decode("ascii", "replace")
-        size_hex, _, ext = header.partition(";")
-        try:
-            size = int(size_hex, 16)
-        except ValueError:
-            raise S3Error("IncompleteBody", "bad chunk size") from None
-        # Bound per-chunk buffering: the declared chunk size is
-        # untrusted, and the whole chunk is buffered before its
-        # signature verifies — without a cap one authenticated PUT
-        # declaring a multi-GiB chunk defeats the O(batch) memory
-        # bound (the reference's signV4ChunkedReader hashes into the
-        # caller's bounded buffer). AWS SDKs emit <=1 MiB chunks;
-        # 16 MiB leaves generous headroom.
-        if size > MAX_CHUNK_SIZE:
-            raise S3Error("EntityTooLarge",
-                          f"chunk of {size} bytes exceeds the "
-                          f"{MAX_CHUNK_SIZE}-byte chunk limit")
-        chunk_sig = ""
-        if ext.startswith("chunk-signature="):
-            chunk_sig = ext[len("chunk-signature="):]
-        self._fill(size + 2)
-        if len(self._buf) < size:
-            raise S3Error("IncompleteBody")
-        data = bytes(self._buf[:size])
-        del self._buf[:size]
-        if self._buf[:2] == b"\r\n":
-            del self._buf[:2]
-        sts = "\n".join([
-            "AWS4-HMAC-SHA256-PAYLOAD", self._amz_date, self._scope,
-            self._prev_sig, self._empty_hash, _sha256(data)])
-        want = hmac.new(self._key, sts.encode(),
-                        hashlib.sha256).hexdigest()
-        if not hmac.compare_digest(want, chunk_sig):
-            raise S3Error("SignatureDoesNotMatch",
-                          "chunk signature mismatch")
-        self._prev_sig = want
-        if size == 0:
-            self._eof = True
-        else:
-            self._out += data
+    def _verify_frames(self, frames: list[tuple[bytes, str]]) -> None:
+        """Batch-hash all frame payloads, then walk the rolling HMAC
+        chain.  A mismatch raises before ANY frame of this batch (the
+        bad one or later) reaches the output buffer."""
+        from ..utils import digestlanes
+        hashes = digestlanes.sha256_many([d for d, _ in frames])
+        for (data, sig), dg in zip(frames, hashes):
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256-PAYLOAD", self._amz_date, self._scope,
+                self._prev_sig, self._empty_hash, dg.hex()])
+            want = hmac.new(self._key, sts.encode(),
+                            hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(want, sig):
+                raise S3Error("SignatureDoesNotMatch",
+                              "chunk signature mismatch")
+            self._prev_sig = want
+            if data:
+                self._out += data
+            else:
+                self._eof = True     # verified zero-length final chunk
 
     def read(self, n: int = -1) -> bytes:
+        if n < 0 and not self._eof:
+            # Drain-all (the buffered PUT path): slurp the source
+            # first so ONE sha256 batch covers every frame — filling
+            # chunk-by-chunk would hand _verify_frames one frame at a
+            # time and forfeit the multi-buffer batching.
+            while self._fill_some():
+                pass
         while not self._eof and (n < 0 or len(self._out) < n):
-            self._decode_chunk()
-        if n < 0:
-            n = len(self._out)
+            frames = self._parse_ready()
+            if frames:
+                self._verify_frames(frames)
+            elif not self._fill_some():
+                raise S3Error("IncompleteBody")
+        if n < 0 or n >= len(self._out):
+            out = bytes(self._out)
+            self._out.clear()
+            return out
         out = bytes(self._out[:n])
         del self._out[:n]
         return out

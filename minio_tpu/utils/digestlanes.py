@@ -1,0 +1,297 @@
+"""Shared multi-buffer digest lane scheduler (MTPU_NATIVE_DIGEST).
+
+MD5 is serial *within* one stream, but the S3 data plane runs many
+independent digest streams at once — concurrent PUT ETags, multipart
+part ETags, Content-MD5 verification.  native/digest.cc steps N
+incremental MD5 states through SIMD lanes in lockstep (AVX2 8-wide /
+SSE2 4-wide), so the aggregate rate on one core is lane-parallel.  This
+module owns the process-wide scheduler that multiplexes PipelinedMD5
+streams onto those shared lanes:
+
+  * producers append pieces to their stream (zero-copy: the views are
+    held, not copied, same contract as the hashlib queue path);
+  * one worker thread carves 64-byte-aligned runs from EVERY active
+    stream and advances them all in ONE GIL-released native call;
+  * finalize appends the RFC 1321 padding into the same lockstep call,
+    so a stream's digest is ready one tick after its last byte.
+
+MTPU_NATIVE_DIGEST=0 (or an unbuildable native lib) disables the plane;
+callers fall back to hashlib and produce byte-identical digests — the
+differential oracle the tests pin.
+
+Env knobs:
+  MTPU_NATIVE_DIGEST      1 (default) native lanes, 0 hashlib oracle
+  MTPU_DIGEST_TICK_CAP    max bytes carved per stream per tick (8 MiB)
+  MTPU_DIGEST_MAX_PENDING per-stream backpressure bound (64 MiB)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+_MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
+
+_native_mod = None
+_native_state = None       # None = unprobed, True/False after first probe
+_probe_mu = threading.Lock()
+
+
+def enabled() -> bool:
+    """The MTPU_NATIVE_DIGEST flag alone (not whether the lib builds)."""
+    return os.environ.get("MTPU_NATIVE_DIGEST", "1") != "0"
+
+
+def native_available() -> bool:
+    """True once native/digest.cc built and loaded (probed once)."""
+    global _native_mod, _native_state
+    if _native_state is None:
+        with _probe_mu:
+            if _native_state is None:
+                try:
+                    from native import digest_native
+                    digest_native.load()
+                    _native_mod = digest_native
+                    _native_state = True
+                except Exception:
+                    _native_state = False
+    return _native_state
+
+
+def use_native() -> bool:
+    return enabled() and native_available()
+
+
+class _Stream:
+    __slots__ = ("pieces", "carry", "total", "pending", "finalizing",
+                 "row", "done", "result", "error")
+
+    def __init__(self, row: int):
+        self.pieces: list = []
+        self.carry = b""
+        self.total = 0
+        self.pending = 0           # bytes queued but not yet hashed
+        self.finalizing = False
+        self.row = row
+        self.done = threading.Event()
+        self.result: bytes | None = None
+        self.error: BaseException | None = None
+
+
+class LaneScheduler:
+    """One worker thread owning the native MD5 lane states; every tick
+    advances ALL active streams in a single GIL-released call."""
+
+    def __init__(self):
+        from native import digest_native as dn
+        import numpy as np
+
+        from ..observe.metrics import DATA_PATH
+        self._dn = dn
+        self._np = np
+        self._dp = DATA_PATH
+        dn.load()
+        self.lanes = dn.md5_lanes()
+        self._cv = threading.Condition()
+        self._streams: set[_Stream] = set()
+        self._cap = 16
+        self._states = np.empty((self._cap, 4), dtype=np.uint32)
+        self._free = list(range(self._cap))
+        self._thread: threading.Thread | None = None
+        self._tick_cap = int(os.environ.get(
+            "MTPU_DIGEST_TICK_CAP", str(8 << 20)))
+        self._max_pending = int(os.environ.get(
+            "MTPU_DIGEST_MAX_PENDING", str(64 << 20)))
+
+    # -- producer side -------------------------------------------------------
+
+    def open(self) -> _Stream:
+        with self._cv:
+            if not self._free:
+                # grow the state table; existing row indices stay valid
+                ncap = self._cap * 2
+                ns = self._np.empty((ncap, 4), dtype=self._np.uint32)
+                ns[:self._cap] = self._states
+                self._free.extend(range(self._cap, ncap))
+                self._states = ns
+                self._cap = ncap
+            row = self._free.pop()
+            self._states[row] = _MD5_INIT
+            s = _Stream(row)
+            self._streams.add(s)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="mtpu-digest-lanes", daemon=True)
+                self._thread.start()
+            return s
+
+    def update(self, s: _Stream, piece) -> None:
+        if not isinstance(piece, (bytes, memoryview)):
+            piece = bytes(piece)     # bytearray callers may mutate after
+        with self._cv:
+            while (s.pending > self._max_pending and not s.finalizing
+                   and s.error is None):
+                self._cv.wait(timeout=1.0)
+            s.pieces.append(piece)
+            s.pending += len(piece)
+            s.total += len(piece)
+            self._cv.notify_all()
+
+    def finalize_async(self, s: _Stream) -> None:
+        """Ask the worker to pad+close the stream without waiting for
+        the result — the PipelinedMD5.close() contract: on the success
+        path the digest finishes under the caller's remaining work, on
+        the failure path the row is freed either way."""
+        with self._cv:
+            if not s.finalizing:
+                s.finalizing = True
+                self._cv.notify_all()
+
+    def digest(self, s: _Stream) -> bytes:
+        self.finalize_async(s)
+        s.done.wait()
+        if s.error is not None:
+            raise s.error
+        return s.result
+
+    def abandon(self, s: _Stream) -> None:
+        """Drop a stream without a digest (failed PUT)."""
+        with self._cv:
+            if s in self._streams:
+                self._streams.discard(s)
+                self._free.append(s.row)
+                s.error = RuntimeError("digest stream abandoned")
+                s.done.set()
+                self._cv.notify_all()
+
+    # -- worker side ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                work = self._collect_locked()
+                while not work:
+                    self._cv.wait()
+                    work = self._collect_locked()
+                states = self._states
+                nrows = self._cap
+            chunks = [b""] * nrows
+            closing = []
+            for s, pieces, carry, finalizing, total in work:
+                full = carry + b"".join(pieces) if (carry or len(pieces) != 1) \
+                    else pieces[0]
+                if finalizing:
+                    nb = len(full) // 64 * 64
+                    if nb and isinstance(full, (bytes, memoryview)):
+                        # large final flush: hash the aligned prefix
+                        # zero-copy this tick; the <64B pad-bearing
+                        # tail closes the stream on the next tick
+                        chunks[s.row] = memoryview(full)[:nb]
+                        with self._cv:
+                            s.carry = bytes(full[nb:])
+                    else:
+                        chunks[s.row] = (bytes(memoryview(full)[:nb])
+                                         + self._dn.md5_pad(
+                                             bytes(full[nb:]), total))
+                        closing.append((s, total))
+                else:
+                    nb = len(full) // 64 * 64
+                    if nb == len(full) and isinstance(full, (bytes,
+                                                             memoryview)):
+                        chunks[s.row] = full
+                        rest = b""
+                    else:
+                        # memoryview: the aligned prefix of an already-
+                        # materialized join must not cost a second copy
+                        chunks[s.row] = memoryview(full)[:nb]
+                        rest = bytes(full[nb:])
+                    with self._cv:
+                        s.carry = rest
+            nbytes = sum(len(c) for c in chunks)
+            err = None
+            try:
+                if nbytes:
+                    self._dn.md5_update_mb(states, chunks)
+            except BaseException as e:      # native fault: fail streams
+                err = e
+            self._dp.record_digest_batch(len(work), nbytes)
+            with self._cv:
+                for s, pieces, carry, finalizing, total in work:
+                    s.pending -= sum(len(p) for p in pieces) + len(carry)
+                    if err is not None:
+                        s.error = err
+                for s, total in closing:
+                    if s in self._streams:
+                        self._streams.discard(s)
+                        self._free.append(s.row)
+                        if err is None:
+                            s.result = self._dn.md5_finalize(
+                                self._states[s.row], total)
+                        s.done.set()
+                self._cv.notify_all()
+
+    def _collect_locked(self):
+        """Carve pending work under the lock; assembly happens outside.
+        Returns [(stream, pieces, carry, finalizing, total)]."""
+        work = []
+        for s in list(self._streams):
+            avail = len(s.carry) + sum(len(p) for p in s.pieces)
+            if s.finalizing or avail >= 64:
+                take, taken = [], 0
+                while s.pieces and (taken < self._tick_cap or s.finalizing):
+                    p = s.pieces.pop(0)
+                    take.append(p)
+                    taken += len(p)
+                if s.finalizing or take or len(s.carry) >= 64:
+                    carry = s.carry
+                    s.carry = b""
+                    work.append((s, take, carry, s.finalizing, s.total))
+        return work
+
+
+_SCHED: LaneScheduler | None = None
+_sched_mu = threading.Lock()
+
+
+def scheduler() -> LaneScheduler:
+    global _SCHED
+    if _SCHED is None:
+        with _sched_mu:
+            if _SCHED is None:
+                _SCHED = LaneScheduler()
+    return _SCHED
+
+
+# -- one-shot helpers (the "rides the same plane" entries) -------------------
+
+def md5_digest(data) -> bytes:
+    """MD5 of one in-memory buffer through the digest plane: on the
+    native path this shares lanes with every concurrent ETag stream
+    (Content-MD5 verification batches with in-flight PUTs); the oracle
+    is plain hashlib."""
+    if use_native():
+        sched = scheduler()
+        s = sched.open()
+        try:
+            mv = memoryview(data)
+            for off in range(0, len(mv), 1 << 20):
+                sched.update(s, mv[off:off + (1 << 20)])
+            return sched.digest(s)
+        finally:
+            sched.abandon(s)
+    return hashlib.md5(data).digest()
+
+
+def sha256_many(bufs) -> list[bytes]:
+    """SHA256 of many buffers: ONE GIL-released native batch call
+    (SHA-NI pairs when available) vs per-buffer hashlib on the oracle
+    path.  A single buffer stays on hashlib — OpenSSL's single-stream
+    SHA-NI is already optimal and the batch entry only wins when it can
+    pair streams or amortize the call."""
+    if len(bufs) >= 2 and use_native():
+        from ..observe.metrics import DATA_PATH
+        out = _native_mod.sha256_batch(bufs)
+        DATA_PATH.record_sha_batch(len(bufs), sum(len(b) for b in bufs))
+        return out
+    return [hashlib.sha256(b).digest() for b in bufs]

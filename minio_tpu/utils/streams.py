@@ -30,25 +30,40 @@ def _md5_pool():
 
 
 class PipelinedMD5:
-    """MD5 streamed through a worker thread so the S3 ETag digest
-    overlaps encode+write instead of running serially before them
-    (hashlib releases the GIL for buffers >2 KiB, and the codec/IO
-    stages release it too, so the overlap is real even on one core —
-    bench measured the up-front digest as the single-part PUT wall at
-    ~1.5 ms/MiB).  Same bytes in the same order, so the hex digest is
-    byte-identical to hashlib.md5(body).
+    """MD5 streamed off the caller's thread so the S3 ETag digest
+    overlaps encode+write instead of running serially before them.
+    Same bytes in the same order, so the hex digest is byte-identical
+    to hashlib.md5(body).
+
+    Two engines behind one API:
+
+      * native lanes (MTPU_NATIVE_DIGEST=1, default, when native/
+        digest.cc builds): the stream registers with the shared
+        multi-buffer lane scheduler (utils/digestlanes.py), so every
+        concurrent ETag stream in the process advances together through
+        SIMD lanes in one GIL-released call per tick — aggregate rate
+        is lane-parallel on one core;
+      * hashlib oracle (=0): the original dedicated-pool worker; the
+        byte-exactness oracle the differential tests pin.
 
     update()/hexdigest() mirror hashlib's; close() is the abandon path
-    (PUT failed before the etag was needed) and a worker-side idle
-    timeout backstops paths that miss it, so an exception can never
-    leak a pool slot."""
+    (PUT failed before the etag was needed); on the oracle path a
+    worker-side idle timeout backstops paths that miss close(), so an
+    exception can never leak a pool slot."""
 
     _IDLE_TIMEOUT = 60.0
 
     def __init__(self):
-        self._q = _queue.SimpleQueue()
-        self._closed = False
-        self._fut = _md5_pool().submit(self._run)
+        from . import digestlanes
+        self._stream = None
+        self._hex = None
+        if digestlanes.use_native():
+            self._sched = digestlanes.scheduler()
+            self._stream = self._sched.open()
+        else:
+            self._q = _queue.SimpleQueue()
+            self._closed = False
+            self._fut = _md5_pool().submit(self._run)
 
     def _run(self) -> str:
         h = hashlib.md5()
@@ -62,22 +77,36 @@ class PipelinedMD5:
             h.update(piece)
 
     def update(self, piece) -> None:
-        self._q.put(piece)
+        if self._stream is not None:
+            self._sched.update(self._stream, piece)
+        else:
+            self._q.put(piece)
 
     def feed(self, data, chunk_len: int = 1 << 20) -> None:
         """Queue an entire in-memory body as chunk-sized views (no
         copies) — the bytes-path shape: queue everything, then encode
-        while the worker digests."""
+        while the lanes/worker digest."""
         mv = memoryview(data)
         for off in range(0, len(mv), chunk_len):
-            self._q.put(mv[off:off + chunk_len])
+            self.update(mv[off:off + chunk_len])
 
     def close(self) -> None:
+        if self._stream is not None:
+            # Finalize, don't abandon: callers use close() both as the
+            # pre-hexdigest flush and as failure cleanup, and the lane
+            # row is freed either way once the worker pads the stream.
+            if self._hex is None:
+                self._sched.finalize_async(self._stream)
+            return
         if not self._closed:
             self._closed = True
             self._q.put(None)
 
     def hexdigest(self) -> str:
+        if self._stream is not None:
+            if self._hex is None:
+                self._hex = self._sched.digest(self._stream).hex()
+            return self._hex
         self.close()
         return self._fut.result()
 

@@ -1,0 +1,785 @@
+// Native batched digest plane: multi-buffer MD5 + batched SHA256.
+//
+// The S3 ETag is a serial MD5 — one stream cannot be SIMD-parallelized —
+// but N *independent* streams can step through the compression function
+// in lockstep, one 64-byte block per lane per iteration (the sha256-simd
+// / lane-interleaved idiom).  This file ships three MD5 block engines
+// (scalar, SSE2 x4, AVX2 x8) and two SHA256 engines (scalar, SHA-NI),
+// ALL compiled unconditionally — no -march=native; ISA-specific code
+// sits behind `#pragma GCC target` and is only executed after a CPUID
+// probe says the host supports it.  Every entry takes an `isa` selector
+// (0 = auto-pick best) so the selftest can force each compiled path.
+//
+// Layouts:
+//   states: n x 4 u32, lane-major (states[i*4+j] is word j of stream i).
+//   update entries require every per-stream length to be a multiple of
+//   64 (callers carry sub-block tails and append padding themselves, or
+//   use the one-shot batch entries which pad here).
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__) || defined(__i386__)
+#define MTPU_X86 1
+#include <cpuid.h>
+#include <immintrin.h>
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CPUID feature probes (cached).  __builtin_cpu_supports("sha") is not
+// accepted by every toolchain we build under, so probe leaf 7 directly.
+
+#ifdef MTPU_X86
+struct CpuFeatures {
+    bool sse2, ssse3, sse41, avx2, sha;
+    CpuFeatures() : sse2(false), ssse3(false), sse41(false),
+                    avx2(false), sha(false) {
+        unsigned a, b, c, d;
+        if (__get_cpuid(1, &a, &b, &c, &d)) {
+            sse2 = (d >> 26) & 1;
+            ssse3 = (c >> 9) & 1;
+            sse41 = (c >> 19) & 1;
+        }
+        if (__get_cpuid_count(7, 0, &a, &b, &c, &d)) {
+            avx2 = (b >> 5) & 1;
+            sha = (b >> 29) & 1;
+        }
+        // AVX2 additionally needs OS ymm-state support (XSAVE/xgetbv).
+        if (avx2) {
+            if (__get_cpuid(1, &a, &b, &c, &d) && ((c >> 27) & 1)) {
+                unsigned lo, hi;
+                __asm__("xgetbv" : "=a"(lo), "=d"(hi) : "c"(0));
+                if ((lo & 0x6) != 0x6) avx2 = false;
+            } else {
+                avx2 = false;
+            }
+        }
+    }
+};
+static const CpuFeatures CPU;
+#endif
+
+// isa selectors (mirrored in native/digest_native.py)
+enum { ISA_AUTO = 0, ISA_SCALAR = 1, ISA_SSE2 = 2, ISA_AVX2 = 3 };
+enum { SHA_AUTO = 0, SHA_SCALAR = 1, SHA_NI = 2 };
+
+static int md5_effective(int isa) {
+#ifdef MTPU_X86
+    int best = CPU.avx2 ? ISA_AVX2 : (CPU.sse2 ? ISA_SSE2 : ISA_SCALAR);
+#else
+    int best = ISA_SCALAR;
+#endif
+    if (isa == ISA_AUTO || isa > best) return best;
+    return isa;
+}
+
+static int sha_effective(int isa) {
+#ifdef MTPU_X86
+    int best = (CPU.sha && CPU.ssse3 && CPU.sse41) ? SHA_NI : SHA_SCALAR;
+#else
+    int best = SHA_SCALAR;
+#endif
+    if (isa == SHA_AUTO || isa > best) return best;
+    return isa;
+}
+
+static inline uint32_t ld32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+// ---------------------------------------------------------------------------
+// MD5 tables (RFC 1321): per-step constant, rotate, message-word index.
+
+static const uint32_t MD5_K[64] = {
+    0xd76aa478u, 0xe8c7b756u, 0x242070dbu, 0xc1bdceeeu,
+    0xf57c0fafu, 0x4787c62au, 0xa8304613u, 0xfd469501u,
+    0x698098d8u, 0x8b44f7afu, 0xffff5bb1u, 0x895cd7beu,
+    0x6b901122u, 0xfd987193u, 0xa679438eu, 0x49b40821u,
+    0xf61e2562u, 0xc040b340u, 0x265e5a51u, 0xe9b6c7aau,
+    0xd62f105du, 0x02441453u, 0xd8a1e681u, 0xe7d3fbc8u,
+    0x21e1cde6u, 0xc33707d6u, 0xf4d50d87u, 0x455a14edu,
+    0xa9e3e905u, 0xfcefa3f8u, 0x676f02d9u, 0x8d2a4c8au,
+    0xfffa3942u, 0x8771f681u, 0x6d9d6122u, 0xfde5380cu,
+    0xa4beea44u, 0x4bdecfa9u, 0xf6bb4b60u, 0xbebfbc70u,
+    0x289b7ec6u, 0xeaa127fau, 0xd4ef3085u, 0x04881d05u,
+    0xd9d4d039u, 0xe6db99e5u, 0x1fa27cf8u, 0xc4ac5665u,
+    0xf4292244u, 0x432aff97u, 0xab9423a7u, 0xfc93a039u,
+    0x655b59c3u, 0x8f0ccc92u, 0xffeff47du, 0x85845dd1u,
+    0x6fa87e4fu, 0xfe2ce6e0u, 0xa3014314u, 0x4e0811a1u,
+    0xf7537e82u, 0xbd3af235u, 0x2ad7d2bbu, 0xeb86d391u};
+
+static const uint8_t MD5_S[64] = {
+    7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+    5, 9, 14, 20, 5, 9, 14, 20, 5, 9, 14, 20, 5, 9, 14, 20,
+    4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+    6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21};
+
+static const uint8_t MD5_IDX[64] = {
+    0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+    1, 6, 11, 0, 5, 10, 15, 4, 9, 14, 3, 8, 13, 2, 7, 12,
+    5, 8, 11, 14, 1, 4, 7, 10, 13, 0, 3, 6, 9, 12, 15, 2,
+    0, 7, 14, 5, 12, 3, 10, 1, 8, 15, 6, 13, 4, 11, 2, 9};
+
+static const uint32_t MD5_INIT[4] = {
+    0x67452301u, 0xefcdab89u, 0x98badcfeu, 0x10325476u};
+
+// ---------------------------------------------------------------------------
+// MD5 scalar block engine.
+
+static inline uint32_t rotl32(uint32_t x, int s) {
+    return (x << s) | (x >> (32 - s));
+}
+
+static void md5_blocks_scalar(uint32_t* st, const uint8_t* p,
+                              size_t nblocks) {
+    uint32_t a = st[0], b = st[1], c = st[2], d = st[3];
+    for (size_t blk = 0; blk < nblocks; ++blk, p += 64) {
+        uint32_t X[16];
+        for (int w = 0; w < 16; ++w) X[w] = ld32(p + 4 * w);
+        uint32_t A = a, B = b, C = c, D = d;
+        for (int i = 0; i < 64; ++i) {
+            uint32_t f;
+            if (i < 16)      f = (B & C) | (~B & D);
+            else if (i < 32) f = (D & B) | (~D & C);
+            else if (i < 48) f = B ^ C ^ D;
+            else             f = C ^ (B | ~D);
+            uint32_t sum = A + f + X[MD5_IDX[i]] + MD5_K[i];
+            uint32_t nb = B + rotl32(sum, MD5_S[i]);
+            A = D; D = C; C = B; B = nb;
+        }
+        a += A; b += B; c += C; d += D;
+    }
+    st[0] = a; st[1] = b; st[2] = c; st[3] = d;
+}
+
+#ifdef MTPU_X86
+
+// ---------------------------------------------------------------------------
+// MD5 SSE2 x4 block engine: 4 independent streams, one u32 lane each.
+// SSE2 is baseline on x86_64 so no target pragma is needed.
+
+// 4x4 u32 transpose: rows in, columns out (message-word gather without
+// per-word scalar loads).
+static inline void transpose4x4(__m128i r[4]) {
+    __m128i t0 = _mm_unpacklo_epi32(r[0], r[1]);
+    __m128i t1 = _mm_unpackhi_epi32(r[0], r[1]);
+    __m128i t2 = _mm_unpacklo_epi32(r[2], r[3]);
+    __m128i t3 = _mm_unpackhi_epi32(r[2], r[3]);
+    r[0] = _mm_unpacklo_epi64(t0, t2);
+    r[1] = _mm_unpackhi_epi64(t0, t2);
+    r[2] = _mm_unpacklo_epi64(t1, t3);
+    r[3] = _mm_unpackhi_epi64(t1, t3);
+}
+
+static void md5_blocks_x4(uint32_t* st, const uint8_t* const* p,
+                          size_t nblocks) {
+    __m128i a = _mm_setr_epi32((int)st[0], (int)st[4], (int)st[8],
+                               (int)st[12]);
+    __m128i b = _mm_setr_epi32((int)st[1], (int)st[5], (int)st[9],
+                               (int)st[13]);
+    __m128i c = _mm_setr_epi32((int)st[2], (int)st[6], (int)st[10],
+                               (int)st[14]);
+    __m128i d = _mm_setr_epi32((int)st[3], (int)st[7], (int)st[11],
+                               (int)st[15]);
+    const __m128i ones = _mm_set1_epi32(-1);
+    for (size_t blk = 0; blk < nblocks; ++blk) {
+        const size_t off = blk * 64;
+        // Gather the 16 message words per lane by transposing four
+        // 4x4 u32 tiles (each lane's 64-byte block is 4 xmm loads).
+        __m128i X[16];
+        for (int q = 0; q < 4; ++q) {
+            __m128i* t = &X[q * 4];
+            for (int l = 0; l < 4; ++l)
+                t[l] = _mm_loadu_si128(
+                    (const __m128i*)(p[l] + off + 16 * q));
+            transpose4x4(t);
+        }
+        __m128i A = a, B = b, C = c, D = d;
+        for (int i = 0; i < 64; ++i) {
+            __m128i f;
+            if (i < 16)
+                f = _mm_or_si128(_mm_and_si128(B, C),
+                                 _mm_andnot_si128(B, D));
+            else if (i < 32)
+                f = _mm_or_si128(_mm_and_si128(D, B),
+                                 _mm_andnot_si128(D, C));
+            else if (i < 48)
+                f = _mm_xor_si128(B, _mm_xor_si128(C, D));
+            else
+                f = _mm_xor_si128(
+                    C, _mm_or_si128(B, _mm_xor_si128(D, ones)));
+            __m128i sum = _mm_add_epi32(
+                _mm_add_epi32(A, f),
+                _mm_add_epi32(X[MD5_IDX[i]],
+                              _mm_set1_epi32((int)MD5_K[i])));
+            const int s = MD5_S[i];
+            __m128i rot = _mm_or_si128(_mm_slli_epi32(sum, s),
+                                       _mm_srli_epi32(sum, 32 - s));
+            __m128i nb = _mm_add_epi32(B, rot);
+            A = D; D = C; C = B; B = nb;
+        }
+        a = _mm_add_epi32(a, A);
+        b = _mm_add_epi32(b, B);
+        c = _mm_add_epi32(c, C);
+        d = _mm_add_epi32(d, D);
+    }
+    uint32_t la[4], lb[4], lc[4], ld[4];
+    _mm_storeu_si128((__m128i*)la, a);
+    _mm_storeu_si128((__m128i*)lb, b);
+    _mm_storeu_si128((__m128i*)lc, c);
+    _mm_storeu_si128((__m128i*)ld, d);
+    for (int i = 0; i < 4; ++i) {
+        st[i * 4 + 0] = la[i];
+        st[i * 4 + 1] = lb[i];
+        st[i * 4 + 2] = lc[i];
+        st[i * 4 + 3] = ld[i];
+    }
+}
+
+// ---------------------------------------------------------------------------
+// MD5 AVX2 x8 block engine: 8 independent streams.
+
+#pragma GCC push_options
+#pragma GCC target("avx2")
+
+// 8x8 u32 transpose: rows in, columns out.
+static inline void transpose8x8(__m256i r[8]) {
+    __m256i t0 = _mm256_unpacklo_epi32(r[0], r[1]);
+    __m256i t1 = _mm256_unpackhi_epi32(r[0], r[1]);
+    __m256i t2 = _mm256_unpacklo_epi32(r[2], r[3]);
+    __m256i t3 = _mm256_unpackhi_epi32(r[2], r[3]);
+    __m256i t4 = _mm256_unpacklo_epi32(r[4], r[5]);
+    __m256i t5 = _mm256_unpackhi_epi32(r[4], r[5]);
+    __m256i t6 = _mm256_unpacklo_epi32(r[6], r[7]);
+    __m256i t7 = _mm256_unpackhi_epi32(r[6], r[7]);
+    __m256i u0 = _mm256_unpacklo_epi64(t0, t2);
+    __m256i u1 = _mm256_unpackhi_epi64(t0, t2);
+    __m256i u2 = _mm256_unpacklo_epi64(t1, t3);
+    __m256i u3 = _mm256_unpackhi_epi64(t1, t3);
+    __m256i u4 = _mm256_unpacklo_epi64(t4, t6);
+    __m256i u5 = _mm256_unpackhi_epi64(t4, t6);
+    __m256i u6 = _mm256_unpacklo_epi64(t5, t7);
+    __m256i u7 = _mm256_unpackhi_epi64(t5, t7);
+    r[0] = _mm256_permute2x128_si256(u0, u4, 0x20);
+    r[1] = _mm256_permute2x128_si256(u1, u5, 0x20);
+    r[2] = _mm256_permute2x128_si256(u2, u6, 0x20);
+    r[3] = _mm256_permute2x128_si256(u3, u7, 0x20);
+    r[4] = _mm256_permute2x128_si256(u0, u4, 0x31);
+    r[5] = _mm256_permute2x128_si256(u1, u5, 0x31);
+    r[6] = _mm256_permute2x128_si256(u2, u6, 0x31);
+    r[7] = _mm256_permute2x128_si256(u3, u7, 0x31);
+}
+
+static void md5_blocks_x8(uint32_t* st, const uint8_t* const* p,
+                          size_t nblocks) {
+    __m256i a = _mm256_setr_epi32(
+        (int)st[0], (int)st[4], (int)st[8], (int)st[12], (int)st[16],
+        (int)st[20], (int)st[24], (int)st[28]);
+    __m256i b = _mm256_setr_epi32(
+        (int)st[1], (int)st[5], (int)st[9], (int)st[13], (int)st[17],
+        (int)st[21], (int)st[25], (int)st[29]);
+    __m256i c = _mm256_setr_epi32(
+        (int)st[2], (int)st[6], (int)st[10], (int)st[14], (int)st[18],
+        (int)st[22], (int)st[26], (int)st[30]);
+    __m256i d = _mm256_setr_epi32(
+        (int)st[3], (int)st[7], (int)st[11], (int)st[15], (int)st[19],
+        (int)st[23], (int)st[27], (int)st[31]);
+    const __m256i ones = _mm256_set1_epi32(-1);
+    for (size_t blk = 0; blk < nblocks; ++blk) {
+        const size_t off = blk * 64;
+        // Gather message words by transposing two 8x8 u32 tiles (each
+        // lane's 64-byte block is 2 ymm loads: words 0-7 and 8-15).
+        __m256i X[16];
+        for (int hx = 0; hx < 2; ++hx) {
+            __m256i* t = &X[hx * 8];
+            for (int l = 0; l < 8; ++l)
+                t[l] = _mm256_loadu_si256(
+                    (const __m256i*)(p[l] + off + 32 * hx));
+            transpose8x8(t);
+        }
+        __m256i A = a, B = b, C = c, D = d;
+        for (int i = 0; i < 64; ++i) {
+            __m256i f;
+            if (i < 16)
+                f = _mm256_or_si256(_mm256_and_si256(B, C),
+                                    _mm256_andnot_si256(B, D));
+            else if (i < 32)
+                f = _mm256_or_si256(_mm256_and_si256(D, B),
+                                    _mm256_andnot_si256(D, C));
+            else if (i < 48)
+                f = _mm256_xor_si256(B, _mm256_xor_si256(C, D));
+            else
+                f = _mm256_xor_si256(
+                    C, _mm256_or_si256(B, _mm256_xor_si256(D, ones)));
+            __m256i sum = _mm256_add_epi32(
+                _mm256_add_epi32(A, f),
+                _mm256_add_epi32(X[MD5_IDX[i]],
+                                 _mm256_set1_epi32((int)MD5_K[i])));
+            const int s = MD5_S[i];
+            __m256i rot = _mm256_or_si256(_mm256_slli_epi32(sum, s),
+                                          _mm256_srli_epi32(sum, 32 - s));
+            __m256i nb = _mm256_add_epi32(B, rot);
+            A = D; D = C; C = B; B = nb;
+        }
+        a = _mm256_add_epi32(a, A);
+        b = _mm256_add_epi32(b, B);
+        c = _mm256_add_epi32(c, C);
+        d = _mm256_add_epi32(d, D);
+    }
+    uint32_t la[8], lb[8], lc[8], ld[8];
+    _mm256_storeu_si256((__m256i*)la, a);
+    _mm256_storeu_si256((__m256i*)lb, b);
+    _mm256_storeu_si256((__m256i*)lc, c);
+    _mm256_storeu_si256((__m256i*)ld, d);
+    for (int i = 0; i < 8; ++i) {
+        st[i * 4 + 0] = la[i];
+        st[i * 4 + 1] = lb[i];
+        st[i * 4 + 2] = lc[i];
+        st[i * 4 + 3] = ld[i];
+    }
+}
+
+#pragma GCC pop_options
+
+#endif  // MTPU_X86
+
+// ---------------------------------------------------------------------------
+// Lockstep scheduler: groups live streams into lane-width packs, runs
+// min-remaining blocks per pack, drops drained lanes, regroups.  Streams
+// of unequal length degrade gracefully to narrower packs / scalar tails.
+
+static void md5_update_mb_impl(uint32_t* states, const uint8_t* const* ptrs,
+                               const uint64_t* nbytes, size_t n, int isa) {
+    const int eff = md5_effective(isa);
+    const uint8_t** cur = new const uint8_t*[n];
+    uint64_t* rem = new uint64_t[n];  // remaining whole blocks
+    size_t* idx = new size_t[n];
+    for (size_t i = 0; i < n; ++i) {
+        cur[i] = ptrs[i];
+        rem[i] = nbytes[i] / 64;
+    }
+    for (;;) {
+        size_t live = 0;
+        for (size_t i = 0; i < n; ++i)
+            if (rem[i]) idx[live++] = i;
+        if (!live) break;
+#ifdef MTPU_X86
+        int width = 1;
+        if (eff >= ISA_AVX2 && live >= 8) width = 8;
+        else if (eff >= ISA_SSE2 && live >= 4) width = 4;
+        if (width > 1) {
+            uint32_t pack_st[8 * 4];
+            const uint8_t* pack_p[8];
+            uint64_t run = ~0ull;
+            for (int l = 0; l < width; ++l) {
+                const size_t i = idx[l];
+                std::memcpy(&pack_st[l * 4], &states[i * 4], 16);
+                pack_p[l] = cur[i];
+                if (rem[i] < run) run = rem[i];
+            }
+            if (width == 8) md5_blocks_x8(pack_st, pack_p, run);
+            else            md5_blocks_x4(pack_st, pack_p, run);
+            for (int l = 0; l < width; ++l) {
+                const size_t i = idx[l];
+                std::memcpy(&states[i * 4], &pack_st[l * 4], 16);
+                cur[i] += run * 64;
+                rem[i] -= run;
+            }
+            continue;
+        }
+#endif
+        // Narrow tail: finish every live stream with the scalar engine.
+        for (size_t l = 0; l < live; ++l) {
+            const size_t i = idx[l];
+            md5_blocks_scalar(&states[i * 4], cur[i], rem[i]);
+            cur[i] += rem[i] * 64;
+            rem[i] = 0;
+        }
+        break;
+    }
+    delete[] cur;
+    delete[] rem;
+    delete[] idx;
+}
+
+// Build the MD5/SHA tail (padding) for a message of `len` bytes whose
+// last `len % 64` bytes are at `tail_src`.  Writes 64 or 128 bytes into
+// `out`; returns the tail length.  `len_big_endian` selects SHA256's
+// big-endian bit count vs MD5's little-endian.
+static size_t build_tail(const uint8_t* tail_src, uint64_t len,
+                         uint8_t* out, bool len_big_endian) {
+    const size_t rem = (size_t)(len % 64);
+    const size_t tail_len = rem < 56 ? 64 : 128;
+    std::memset(out, 0, tail_len);
+    if (rem) std::memcpy(out, tail_src, rem);
+    out[rem] = 0x80;
+    const uint64_t bits = len * 8;
+    uint8_t* lp = out + tail_len - 8;
+    if (len_big_endian) {
+        for (int i = 0; i < 8; ++i) lp[i] = (uint8_t)(bits >> (56 - 8 * i));
+    } else {
+        for (int i = 0; i < 8; ++i) lp[i] = (uint8_t)(bits >> (8 * i));
+    }
+    return tail_len;
+}
+
+static void md5_store_digest(const uint32_t* st, uint8_t* out) {
+    for (int j = 0; j < 4; ++j) {
+        const uint32_t v = st[j];
+        out[j * 4 + 0] = (uint8_t)v;
+        out[j * 4 + 1] = (uint8_t)(v >> 8);
+        out[j * 4 + 2] = (uint8_t)(v >> 16);
+        out[j * 4 + 3] = (uint8_t)(v >> 24);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// SHA256 scalar engine.
+
+static const uint32_t SHA_K[64] = {
+    0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u,
+    0x3956c25bu, 0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u,
+    0xd807aa98u, 0x12835b01u, 0x243185beu, 0x550c7dc3u,
+    0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u, 0xc19bf174u,
+    0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+    0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau,
+    0x983e5152u, 0xa831c66du, 0xb00327c8u, 0xbf597fc7u,
+    0xc6e00bf3u, 0xd5a79147u, 0x06ca6351u, 0x14292967u,
+    0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu, 0x53380d13u,
+    0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+    0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u,
+    0xd192e819u, 0xd6990624u, 0xf40e3585u, 0x106aa070u,
+    0x19a4c116u, 0x1e376c08u, 0x2748774cu, 0x34b0bcb5u,
+    0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu, 0x682e6ff3u,
+    0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+    0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+
+static const uint32_t SHA_INIT[8] = {
+    0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+    0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+
+static inline uint32_t rotr32(uint32_t x, int s) {
+    return (x >> s) | (x << (32 - s));
+}
+
+static void sha256_blocks_scalar(uint32_t* st, const uint8_t* p,
+                                 size_t nblocks) {
+    for (size_t blk = 0; blk < nblocks; ++blk, p += 64) {
+        uint32_t w[64];
+        for (int t = 0; t < 16; ++t)
+            w[t] = ((uint32_t)p[4 * t] << 24) | ((uint32_t)p[4 * t + 1] << 16)
+                 | ((uint32_t)p[4 * t + 2] << 8) | (uint32_t)p[4 * t + 3];
+        for (int t = 16; t < 64; ++t) {
+            const uint32_t s0 = rotr32(w[t - 15], 7) ^ rotr32(w[t - 15], 18)
+                              ^ (w[t - 15] >> 3);
+            const uint32_t s1 = rotr32(w[t - 2], 17) ^ rotr32(w[t - 2], 19)
+                              ^ (w[t - 2] >> 10);
+            w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+        }
+        uint32_t a = st[0], b = st[1], c = st[2], d = st[3];
+        uint32_t e = st[4], f = st[5], g = st[6], h = st[7];
+        for (int t = 0; t < 64; ++t) {
+            const uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+            const uint32_t ch = (e & f) ^ (~e & g);
+            const uint32_t t1 = h + S1 + ch + SHA_K[t] + w[t];
+            const uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+            const uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+            const uint32_t t2 = S0 + maj;
+            h = g; g = f; f = e; e = d + t1;
+            d = c; c = b; b = a; a = t1 + t2;
+        }
+        st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+        st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+    }
+}
+
+#ifdef MTPU_X86
+
+// ---------------------------------------------------------------------------
+// SHA256 SHA-NI engine (sha256rnds2 / sha256msg1 / sha256msg2).
+
+#pragma GCC push_options
+#pragma GCC target("sha,ssse3,sse4.1")
+
+static void sha256_blocks_ni(uint32_t* st, const uint8_t* p,
+                             size_t nblocks) {
+    const __m128i MASK = _mm_set_epi64x(
+        (long long)0x0c0d0e0f08090a0bull, (long long)0x0405060700010203ull);
+    __m128i TMP = _mm_loadu_si128((const __m128i*)&st[0]);     // DCBA
+    __m128i S1 = _mm_loadu_si128((const __m128i*)&st[4]);      // HGFE
+    TMP = _mm_shuffle_epi32(TMP, 0xB1);                        // CDAB
+    S1 = _mm_shuffle_epi32(S1, 0x1B);                          // EFGH
+    __m128i S0 = _mm_alignr_epi8(TMP, S1, 8);                  // ABEF
+    S1 = _mm_blend_epi16(S1, TMP, 0xF0);                       // CDGH
+    for (size_t blk = 0; blk < nblocks; ++blk, p += 64) {
+        const __m128i save0 = S0, save1 = S1;
+        __m128i msg[4];
+        for (int i = 0; i < 4; ++i)
+            msg[i] = _mm_shuffle_epi8(
+                _mm_loadu_si128((const __m128i*)(p + 16 * i)), MASK);
+        for (int g = 0; g < 16; ++g) {
+            __m128i wk = _mm_add_epi32(
+                msg[g & 3],
+                _mm_loadu_si128((const __m128i*)&SHA_K[g * 4]));
+            S1 = _mm_sha256rnds2_epu32(S1, S0, wk);
+            wk = _mm_shuffle_epi32(wk, 0x0E);
+            S0 = _mm_sha256rnds2_epu32(S0, S1, wk);
+            if (g < 12) {
+                // Schedule W[4(g+4) .. 4(g+4)+3] into msg[g & 3].
+                __m128i m0 = _mm_sha256msg1_epu32(msg[g & 3],
+                                                  msg[(g + 1) & 3]);
+                m0 = _mm_add_epi32(
+                    m0, _mm_alignr_epi8(msg[(g + 3) & 3],
+                                        msg[(g + 2) & 3], 4));
+                msg[g & 3] = _mm_sha256msg2_epu32(m0, msg[(g + 3) & 3]);
+            }
+        }
+        S0 = _mm_add_epi32(S0, save0);
+        S1 = _mm_add_epi32(S1, save1);
+    }
+    TMP = _mm_shuffle_epi32(S0, 0x1B);                         // FEBA
+    S1 = _mm_shuffle_epi32(S1, 0xB1);                          // DCHG
+    S0 = _mm_blend_epi16(TMP, S1, 0xF0);                       // DCBA
+    S1 = _mm_alignr_epi8(S1, TMP, 8);                          // HGFE
+    _mm_storeu_si128((__m128i*)&st[0], S0);
+    _mm_storeu_si128((__m128i*)&st[4], S1);
+}
+
+// Two independent streams interleaved through the SHA-NI pipeline:
+// sha256rnds2 has multi-cycle latency and a serial dependency chain
+// within one stream, so pairing nearly doubles aggregate throughput.
+static void sha256_ni_x2(uint32_t* sta, uint32_t* stb, const uint8_t* pa,
+                         const uint8_t* pb, size_t nblocks) {
+    const __m128i MASK = _mm_set_epi64x(
+        (long long)0x0c0d0e0f08090a0bull, (long long)0x0405060700010203ull);
+    __m128i TA = _mm_loadu_si128((const __m128i*)&sta[0]);
+    __m128i A1 = _mm_loadu_si128((const __m128i*)&sta[4]);
+    TA = _mm_shuffle_epi32(TA, 0xB1);
+    A1 = _mm_shuffle_epi32(A1, 0x1B);
+    __m128i A0 = _mm_alignr_epi8(TA, A1, 8);
+    A1 = _mm_blend_epi16(A1, TA, 0xF0);
+    __m128i TB = _mm_loadu_si128((const __m128i*)&stb[0]);
+    __m128i B1 = _mm_loadu_si128((const __m128i*)&stb[4]);
+    TB = _mm_shuffle_epi32(TB, 0xB1);
+    B1 = _mm_shuffle_epi32(B1, 0x1B);
+    __m128i B0 = _mm_alignr_epi8(TB, B1, 8);
+    B1 = _mm_blend_epi16(B1, TB, 0xF0);
+    for (size_t blk = 0; blk < nblocks; ++blk, pa += 64, pb += 64) {
+        const __m128i sa0 = A0, sa1 = A1, sb0 = B0, sb1 = B1;
+        __m128i ma[4], mb[4];
+        for (int i = 0; i < 4; ++i) {
+            ma[i] = _mm_shuffle_epi8(
+                _mm_loadu_si128((const __m128i*)(pa + 16 * i)), MASK);
+            mb[i] = _mm_shuffle_epi8(
+                _mm_loadu_si128((const __m128i*)(pb + 16 * i)), MASK);
+        }
+        for (int g = 0; g < 16; ++g) {
+            const __m128i k =
+                _mm_loadu_si128((const __m128i*)&SHA_K[g * 4]);
+            __m128i wka = _mm_add_epi32(ma[g & 3], k);
+            __m128i wkb = _mm_add_epi32(mb[g & 3], k);
+            A1 = _mm_sha256rnds2_epu32(A1, A0, wka);
+            B1 = _mm_sha256rnds2_epu32(B1, B0, wkb);
+            wka = _mm_shuffle_epi32(wka, 0x0E);
+            wkb = _mm_shuffle_epi32(wkb, 0x0E);
+            A0 = _mm_sha256rnds2_epu32(A0, A1, wka);
+            B0 = _mm_sha256rnds2_epu32(B0, B1, wkb);
+            if (g < 12) {
+                __m128i n0 = _mm_sha256msg1_epu32(ma[g & 3],
+                                                  ma[(g + 1) & 3]);
+                n0 = _mm_add_epi32(
+                    n0, _mm_alignr_epi8(ma[(g + 3) & 3],
+                                        ma[(g + 2) & 3], 4));
+                ma[g & 3] = _mm_sha256msg2_epu32(n0, ma[(g + 3) & 3]);
+                __m128i n1 = _mm_sha256msg1_epu32(mb[g & 3],
+                                                  mb[(g + 1) & 3]);
+                n1 = _mm_add_epi32(
+                    n1, _mm_alignr_epi8(mb[(g + 3) & 3],
+                                        mb[(g + 2) & 3], 4));
+                mb[g & 3] = _mm_sha256msg2_epu32(n1, mb[(g + 3) & 3]);
+            }
+        }
+        A0 = _mm_add_epi32(A0, sa0);
+        A1 = _mm_add_epi32(A1, sa1);
+        B0 = _mm_add_epi32(B0, sb0);
+        B1 = _mm_add_epi32(B1, sb1);
+    }
+    TA = _mm_shuffle_epi32(A0, 0x1B);
+    A1 = _mm_shuffle_epi32(A1, 0xB1);
+    A0 = _mm_blend_epi16(TA, A1, 0xF0);
+    A1 = _mm_alignr_epi8(A1, TA, 8);
+    _mm_storeu_si128((__m128i*)&sta[0], A0);
+    _mm_storeu_si128((__m128i*)&sta[4], A1);
+    TB = _mm_shuffle_epi32(B0, 0x1B);
+    B1 = _mm_shuffle_epi32(B1, 0xB1);
+    B0 = _mm_blend_epi16(TB, B1, 0xF0);
+    B1 = _mm_alignr_epi8(B1, TB, 8);
+    _mm_storeu_si128((__m128i*)&stb[0], B0);
+    _mm_storeu_si128((__m128i*)&stb[4], B1);
+}
+
+#pragma GCC pop_options
+
+#endif  // MTPU_X86
+
+static void sha256_store(const uint32_t* st, uint8_t* out) {
+    for (int j = 0; j < 8; ++j) {
+        const uint32_t v = st[j];
+        out[j * 4 + 0] = (uint8_t)(v >> 24);
+        out[j * 4 + 1] = (uint8_t)(v >> 16);
+        out[j * 4 + 2] = (uint8_t)(v >> 8);
+        out[j * 4 + 3] = (uint8_t)v;
+    }
+}
+
+#ifdef MTPU_X86
+
+// Hash a PAIR of buffers through the interleaved SHA-NI engine:
+// lockstep for the common bulk prefix, single-stream for the longer
+// remainder and the padding tails.
+static void sha256_pair_ni(const uint8_t* pa, uint64_t la, uint8_t* oa,
+                           const uint8_t* pb, uint64_t lb, uint8_t* ob) {
+    uint32_t sta[8], stb[8];
+    std::memcpy(sta, SHA_INIT, sizeof(sta));
+    std::memcpy(stb, SHA_INIT, sizeof(stb));
+    const uint64_t ba = la / 64, bb = lb / 64;
+    const uint64_t common = ba < bb ? ba : bb;
+    if (common) sha256_ni_x2(sta, stb, pa, pb, common);
+    if (ba > common) sha256_blocks_ni(sta, pa + common * 64, ba - common);
+    if (bb > common) sha256_blocks_ni(stb, pb + common * 64, bb - common);
+    uint8_t ta[128], tb[128];
+    const size_t tla = build_tail(pa + ba * 64, la, ta, true);
+    const size_t tlb = build_tail(pb + bb * 64, lb, tb, true);
+    if (tla == tlb) {
+        sha256_ni_x2(sta, stb, ta, tb, tla / 64);
+    } else {
+        sha256_blocks_ni(sta, ta, tla / 64);
+        sha256_blocks_ni(stb, tb, tlb / 64);
+    }
+    sha256_store(sta, oa);
+    sha256_store(stb, ob);
+}
+
+#endif  // MTPU_X86
+
+static void sha256_one(const uint8_t* p, uint64_t len, uint8_t* out,
+                       int eff) {
+    uint32_t st[8];
+    std::memcpy(st, SHA_INIT, sizeof(st));
+    const uint64_t bulk = len & ~63ull;
+#ifdef MTPU_X86
+    if (eff >= SHA_NI) {
+        if (bulk) sha256_blocks_ni(st, p, bulk / 64);
+    } else
+#endif
+    {
+        if (bulk) sha256_blocks_scalar(st, p, bulk / 64);
+    }
+    uint8_t tail[128];
+    const size_t tail_len = build_tail(p + bulk, len, tail, true);
+#ifdef MTPU_X86
+    if (eff >= SHA_NI) sha256_blocks_ni(st, tail, tail_len / 64);
+    else
+#endif
+        sha256_blocks_scalar(st, tail, tail_len / 64);
+    sha256_store(st, out);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API
+
+extern "C" {
+
+const char* mtpu_digest_isa() {
+#ifdef MTPU_X86
+    const bool shani = CPU.sha && CPU.ssse3 && CPU.sse41;
+    if (CPU.avx2) return shani ? "avx2+shani" : "avx2";
+    if (CPU.sse2) return shani ? "sse2+shani" : "sse2";
+#endif
+    return "scalar";
+}
+
+// 1 if the (family, isa) pair can execute on this host.  family:
+// 0 = md5, 1 = sha256.
+int mtpu_digest_supported(int family, int isa) {
+    if (family == 0)
+        return md5_effective(isa) == (isa == ISA_AUTO ? md5_effective(0)
+                                                      : isa);
+    return sha_effective(isa) == (isa == SHA_AUTO ? sha_effective(0) : isa);
+}
+
+int mtpu_md5_lanes(int isa) {
+    switch (md5_effective(isa)) {
+        case ISA_AVX2: return 8;
+        case ISA_SSE2: return 4;
+        default: return 1;
+    }
+}
+
+void mtpu_md5_init(uint32_t* states, size_t n) {
+    for (size_t i = 0; i < n; ++i)
+        std::memcpy(&states[i * 4], MD5_INIT, sizeof(MD5_INIT));
+}
+
+// Incremental multi-buffer update: every nbytes[i] must be a multiple
+// of 64 (callers carry sub-block tails).
+void mtpu_md5_update_mb(uint32_t* states, const void* const* ptrs,
+                        const uint64_t* nbytes, size_t n, int isa) {
+    md5_update_mb_impl(states, (const uint8_t* const*)ptrs, nbytes, n, isa);
+}
+
+// One-shot batch: pads and finalizes here; out is n x 16 bytes.
+void mtpu_md5_batch(const void* const* ptrs, const uint64_t* lens,
+                    size_t n, uint8_t* out, int isa) {
+    if (!n) return;
+    uint32_t* states = new uint32_t[n * 4];
+    mtpu_md5_init(states, n);
+    uint64_t* bulk = new uint64_t[n];
+    for (size_t i = 0; i < n; ++i) bulk[i] = lens[i] & ~63ull;
+    md5_update_mb_impl(states, (const uint8_t* const*)ptrs, bulk, n, isa);
+    uint8_t* tails = new uint8_t[n * 128];
+    const uint8_t** tptr = new const uint8_t*[n];
+    uint64_t* tlen = new uint64_t[n];
+    for (size_t i = 0; i < n; ++i) {
+        const uint8_t* p = (const uint8_t*)ptrs[i];
+        tlen[i] = build_tail(p + bulk[i], lens[i], &tails[i * 128], false);
+        tptr[i] = &tails[i * 128];
+    }
+    md5_update_mb_impl(states, tptr, tlen, n, isa);
+    for (size_t i = 0; i < n; ++i)
+        md5_store_digest(&states[i * 4], &out[i * 16]);
+    delete[] states;
+    delete[] bulk;
+    delete[] tails;
+    delete[] tptr;
+    delete[] tlen;
+}
+
+// Batched SHA256: hashes n buffers in one GIL-released call; out is
+// n x 32 bytes.
+void mtpu_sha256_batch(const void* const* ptrs, const uint64_t* lens,
+                       size_t n, uint8_t* out, int isa) {
+    const int eff = sha_effective(isa);
+#ifdef MTPU_X86
+    if (eff >= SHA_NI) {
+        size_t i = 0;
+        for (; i + 1 < n; i += 2)
+            sha256_pair_ni((const uint8_t*)ptrs[i], lens[i], &out[i * 32],
+                           (const uint8_t*)ptrs[i + 1], lens[i + 1],
+                           &out[(i + 1) * 32]);
+        if (i < n)
+            sha256_one((const uint8_t*)ptrs[i], lens[i], &out[i * 32], eff);
+        return;
+    }
+#endif
+    for (size_t i = 0; i < n; ++i)
+        sha256_one((const uint8_t*)ptrs[i], lens[i], &out[i * 32], eff);
+}
+
+}  // extern "C"

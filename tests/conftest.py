@@ -74,6 +74,17 @@ def hedge_mode(request, monkeypatch):
     return request.param
 
 
+@pytest.fixture(params=["1", "0"], ids=["lanes", "hashlib"])
+def digest_mode(request, monkeypatch):
+    """Oracle guard for the native multi-buffer digest plane: tests
+    using this fixture run once on the shared SIMD MD5 lanes + batched
+    sha256 (MTPU_NATIVE_DIGEST=1, the default) and once on the hashlib
+    oracle (=0) — ETags, Content-MD5 verdicts, and streaming-SigV4
+    decisions must be byte-identical."""
+    monkeypatch.setenv("MTPU_NATIVE_DIGEST", request.param)
+    return request.param
+
+
 @pytest.fixture(params=["1", "0"], ids=["breaker", "nobreaker"])
 def breaker_mode(request, monkeypatch):
     """Oracle guard for the drive circuit breaker: MTPU_BREAKER=0 pins

@@ -338,6 +338,179 @@ class TestHTTPStreaming:
         assert cli.get_object("hstrm7", "dst") == b"copy me"
 
 
+def _aws_chunked_put(cli, path, payload, chunk_size=256 * 1024,
+                     extra_headers=None, tamper_at=None):
+    """Issue an aws-chunked signed PUT; returns (status, body).  With
+    tamper_at=k, flips one payload byte inside chunk k AFTER signing —
+    a mid-stream chunk-signature-chain mismatch."""
+    import datetime
+    import http.client as hc
+    from minio_tpu.server import sigv4
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    scope = f"{amz_date[:8]}/{cli.creds.region}/s3/aws4_request"
+    headers = {"Host": f"{cli.host}:{cli.port}"}
+    headers.update(extra_headers or {})
+    auth = sigv4.sign_request(cli.creds, "PUT", path, {}, headers,
+                              sigv4.STREAMING_PAYLOAD, now=now)
+    headers.update(auth)
+    seed_sig = auth["Authorization"].rsplit("Signature=", 1)[1]
+    wire = bytearray(sigv4.encode_streaming_body(
+        cli.creds, scope, amz_date, seed_sig, payload,
+        chunk_size=chunk_size))
+    if tamper_at is not None:
+        # flip the first data byte of chunk tamper_at; frame layout is
+        # "<hex-size>;chunk-signature=<64 hex>\r\n<data>\r\n"
+        off = 0
+        for k in range(tamper_at + 1):
+            size = min(chunk_size, len(payload) - k * chunk_size)
+            header = len(f"{size:x}") + len(";chunk-signature=") + 64 + 2
+            if k == tamper_at:
+                wire[off + header] ^= 0xFF
+                break
+            off += header + size + 2
+    headers["Content-Length"] = str(len(wire))
+    conn = hc.HTTPConnection(cli.host, cli.port, timeout=60)
+    try:
+        conn.request("PUT", path, body=bytes(wire), headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class TestStreamingSigV4Edges:
+    def test_midstream_tampered_chunk_no_partial_object(self, srv, cli,
+                                                        digest_mode):
+        """A chunk-signature-chain mismatch after valid leading chunks
+        must 403 and leave NO object behind."""
+        cli.make_bucket("edge1")
+        payload = pattern_bytes(BLOCK_SIZE + 70_000, seed=21)
+        st, out = _aws_chunked_put(cli, "/edge1/tampered", payload,
+                                   chunk_size=64 * 1024, tamper_at=2)
+        assert st == 403, out
+        assert b"SignatureDoesNotMatch" in out
+        st, _, _ = cli.request("GET", "/edge1/tampered")
+        assert st == 404
+        # same request untampered succeeds (the chain itself is fine)
+        st, out = _aws_chunked_put(cli, "/edge1/tampered", payload,
+                                   chunk_size=64 * 1024)
+        assert st == 200, out
+        assert cli.get_object("edge1", "tampered") == payload
+
+    def test_oversized_chunk_declaration_rejected(self, srv, cli):
+        """A declared chunk size over MAX_CHUNK_SIZE must be rejected
+        before the server buffers it."""
+        import datetime
+        import http.client as hc
+        from minio_tpu.server import sigv4
+        cli.make_bucket("edge2")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        headers = {"Host": f"{cli.host}:{cli.port}"}
+        auth = sigv4.sign_request(cli.creds, "PUT", "/edge2/huge", {},
+                                  headers, sigv4.STREAMING_PAYLOAD,
+                                  now=now)
+        headers.update(auth)
+        wire = b"40000000;chunk-signature=" + b"0" * 64 + b"\r\n"
+        headers["Content-Length"] = str(len(wire))
+        headers["x-amz-decoded-content-length"] = str(0x40000000)
+        conn = hc.HTTPConnection(cli.host, cli.port, timeout=30)
+        try:
+            conn.request("PUT", "/edge2/huge", body=wire, headers=headers)
+            resp = conn.getresponse()
+            out = resp.read()
+        finally:
+            conn.close()
+        assert resp.status == 400, out
+        assert b"EntityTooLarge" in out
+
+    def test_zero_length_payload_final_chunk_only(self, srv, cli,
+                                                  digest_mode):
+        """An empty aws-chunked body is just the zero-length final
+        chunk (with its trailing CRLF) and must store an empty object."""
+        cli.make_bucket("edge3")
+        st, out = _aws_chunked_put(cli, "/edge3/empty", b"")
+        assert st == 200, out
+        assert cli.get_object("edge3", "empty") == b""
+
+
+class TestContentMD5Conformance:
+    """Content-MD5 semantics (cf. internal/hash/reader.go): malformed
+    header -> InvalidDigest, well-formed-but-wrong -> BadDigest, and a
+    rejected PUT stores nothing — on both the simple and the
+    aws-chunked path."""
+
+    @staticmethod
+    def _b64md5(data: bytes) -> str:
+        import base64
+        return base64.b64encode(hashlib.md5(data).digest()).decode()
+
+    def test_simple_put_good_digest(self, cli, digest_mode):
+        cli.make_bucket("md5a")
+        body = pattern_bytes(100_000, seed=31)
+        h = cli.put_object("md5a", "ok", body,
+                           headers={"Content-MD5": self._b64md5(body)})
+        assert h["ETag"].strip('"') == hashlib.md5(body).hexdigest()
+        assert cli.get_object("md5a", "ok") == body
+
+    def test_simple_put_mismatch_is_bad_digest(self, cli, digest_mode):
+        from minio_tpu.server.client import S3ClientError
+        cli.make_bucket("md5b")
+        body = pattern_bytes(50_000, seed=32)
+        with pytest.raises(S3ClientError) as ei:
+            cli.put_object("md5b", "bad", body,
+                           headers={"Content-MD5":
+                                    self._b64md5(b"other bytes")})
+        assert ei.value.code == "BadDigest"
+        st, _, _ = cli.request("GET", "/md5b/bad")
+        assert st == 404
+
+    def test_malformed_base64_is_invalid_digest(self, cli):
+        from minio_tpu.server.client import S3ClientError
+        cli.make_bucket("md5c")
+        with pytest.raises(S3ClientError) as ei:
+            cli.put_object("md5c", "mal", b"data",
+                           headers={"Content-MD5": "!!!not-base64!!!"})
+        assert ei.value.code == "InvalidDigest"
+        st, _, _ = cli.request("GET", "/md5c/mal")
+        assert st == 404
+
+    def test_wrong_length_digest_is_invalid_digest(self, cli):
+        import base64
+        from minio_tpu.server.client import S3ClientError
+        cli.make_bucket("md5d")
+        short = base64.b64encode(b"8 bytes!").decode()   # valid b64, not 16B
+        with pytest.raises(S3ClientError) as ei:
+            cli.put_object("md5d", "short", b"data",
+                           headers={"Content-MD5": short})
+        assert ei.value.code == "InvalidDigest"
+
+    def test_aws_chunked_good_digest(self, srv, cli, digest_mode):
+        cli.make_bucket("md5e")
+        body = pattern_bytes(300_000, seed=33)
+        st, out = _aws_chunked_put(
+            cli, "/md5e/ok", body,
+            extra_headers={"Content-MD5": self._b64md5(body),
+                           "x-amz-decoded-content-length":
+                           str(len(body))})
+        assert st == 200, out
+        assert cli.get_object("md5e", "ok") == body
+
+    def test_aws_chunked_mismatch_rejected_before_write(self, srv, cli,
+                                                        digest_mode):
+        cli.make_bucket("md5f")
+        body = pattern_bytes(300_000, seed=34)
+        st, out = _aws_chunked_put(
+            cli, "/md5f/bad", body,
+            extra_headers={"Content-MD5": self._b64md5(b"not the body"),
+                           "x-amz-decoded-content-length":
+                           str(len(body))})
+        assert st == 400, out
+        assert b"BadDigest" in out
+        st, _, _ = cli.request("GET", "/md5f/bad")
+        assert st == 404
+
+
 class TestConcurrentStreams:
     def test_many_concurrent_streamed_gets_no_deadlock(self, tmp_path):
         """More concurrent GET streams than pool workers must all make

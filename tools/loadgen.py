@@ -102,6 +102,11 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     d_disp = snap1["co_dispatches"] - snap0["co_dispatches"]
     d_items = snap1["co_items"] - snap0["co_items"]
     d_wait = snap1["co_wait_s"] - snap0["co_wait_s"]
+    # digest lane deltas: how hard the PUT mix drove the native
+    # multi-buffer MD5 plane (0s when MTPU_NATIVE_DIGEST=0)
+    d_dg_calls = snap1["dg_md5_calls"] - snap0["dg_md5_calls"]
+    d_dg_streams = snap1["dg_md5_streams"] - snap0["dg_md5_streams"]
+    d_dg_bytes = snap1["dg_md5_bytes"] - snap0["dg_md5_bytes"]
     return {
         "clients": clients,
         "object_size": object_size,
@@ -118,6 +123,10 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
         "co_occupancy": round(d_items / d_disp, 3) if d_disp else 0.0,
         "co_wait_ms_per_item": round(d_wait / d_items * 1e3, 4)
         if d_items else 0.0,
+        "dg_md5_calls": d_dg_calls,
+        "dg_md5_occupancy": round(d_dg_streams / d_dg_calls, 3)
+        if d_dg_calls else 0.0,
+        "dg_md5_gbps": round(d_dg_bytes / wall / 1e9, 3),
     }
 
 
@@ -137,7 +146,17 @@ def main(argv=None) -> int:
     ap.add_argument("--drives", type=int, default=4)
     ap.add_argument("--parity", type=int, default=None)
     ap.add_argument("--root", default="/tmp/mtpu-loadgen")
+    ap.add_argument("--profile", choices=("mixed", "put-digest"),
+                    default="mixed",
+                    help="put-digest: PUT-only 4 MiB objects — the "
+                    "ETag-digest-bound shape the multi-buffer MD5 "
+                    "lanes exist for (dg_md5_* in the output show "
+                    "lane occupancy and aggregate hash rate)")
     args = ap.parse_args(argv)
+    if args.profile == "put-digest":
+        args.mix = 1.0
+        if args.size_kib == 1024:          # only override the default
+            args.size_kib = 4096
 
     es = make_set(args.root, n=args.drives, parity=args.parity)
     res = run_load(es, clients=args.clients,
