@@ -359,10 +359,13 @@ class StreamingSigV4Reader:
                 break
             header = bytes(self._buf[:nl]).decode("ascii", "replace")
             size_hex, _, ext = header.partition(";")
-            try:
-                size = int(size_hex, 16)
-            except ValueError:
-                raise S3Error("IncompleteBody", "bad chunk size") from None
+            # strict hex only: int(x, 16) also accepts '-'/'+' signs and
+            # '_' separators, and a negative size would slip past the
+            # chunk-size/incomplete-frame checks and desync framing
+            if not size_hex or any(c not in "0123456789abcdefABCDEF"
+                                   for c in size_hex):
+                raise S3Error("IncompleteBody", "bad chunk size")
+            size = int(size_hex, 16)
             # Bound per-chunk buffering: the declared chunk size is
             # untrusted, and the whole chunk is buffered before its
             # signature verifies — without a cap one authenticated PUT

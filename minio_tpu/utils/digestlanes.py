@@ -98,6 +98,12 @@ class LaneScheduler:
         self._cap = 16
         self._states = np.empty((self._cap, 4), dtype=np.uint32)
         self._free = list(range(self._cap))
+        # rows the worker's in-flight native call is writing: their
+        # reuse is deferred to tick end so open() can never hand a row
+        # to a new stream while the (lock-free) native update still
+        # targets it
+        self._inflight_rows: set[int] = set()
+        self._deferred_free: list[int] = []
         self._thread: threading.Thread | None = None
         self._tick_cap = int(os.environ.get(
             "MTPU_DIGEST_TICK_CAP", str(8 << 20)))
@@ -160,7 +166,10 @@ class LaneScheduler:
         with self._cv:
             if s in self._streams:
                 self._streams.discard(s)
-                self._free.append(s.row)
+                if s.row in self._inflight_rows:
+                    self._deferred_free.append(s.row)
+                else:
+                    self._free.append(s.row)
                 s.error = RuntimeError("digest stream abandoned")
                 s.done.set()
                 self._cv.notify_all()
@@ -176,6 +185,7 @@ class LaneScheduler:
                     work = self._collect_locked()
                 states = self._states
                 nrows = self._cap
+                self._inflight_rows = {s.row for s, *_ in work}
             chunks = [b""] * nrows
             closing = []
             for s, pieces, carry, finalizing, total in work:
@@ -188,8 +198,10 @@ class LaneScheduler:
                         # zero-copy this tick; the <64B pad-bearing
                         # tail closes the stream on the next tick
                         chunks[s.row] = memoryview(full)[:nb]
+                        rest = bytes(full[nb:])
                         with self._cv:
-                            s.carry = bytes(full[nb:])
+                            s.carry = rest
+                            s.pending += len(rest)
                     else:
                         chunks[s.row] = (bytes(memoryview(full)[:nb])
                                          + self._dn.md5_pad(
@@ -208,6 +220,7 @@ class LaneScheduler:
                         rest = bytes(full[nb:])
                     with self._cv:
                         s.carry = rest
+                        s.pending += len(rest)
             nbytes = sum(len(c) for c in chunks)
             err = None
             try:
@@ -217,7 +230,19 @@ class LaneScheduler:
                 err = e
             self._dp.record_digest_batch(len(work), nbytes)
             with self._cv:
+                if self._states is not states:
+                    # open() grew the table mid-tick: it copied the
+                    # PRE-update rows into the new array, so merge the
+                    # rows the native call just advanced back in.  Row
+                    # reuse is blocked while in flight (_deferred_free),
+                    # so every work row still belongs to its stream.
+                    for s, *_ in work:
+                        self._states[s.row] = states[s.row]
                 for s, pieces, carry, finalizing, total in work:
+                    # pending tracks queued-but-unhashed bytes: the
+                    # whole collected run is consumed here, and any
+                    # unhashed remainder was re-added when s.carry was
+                    # set during assembly
                     s.pending -= sum(len(p) for p in pieces) + len(carry)
                     if err is not None:
                         s.error = err
@@ -229,6 +254,9 @@ class LaneScheduler:
                             s.result = self._dn.md5_finalize(
                                 self._states[s.row], total)
                         s.done.set()
+                self._free.extend(self._deferred_free)
+                self._deferred_free.clear()
+                self._inflight_rows.clear()
                 self._cv.notify_all()
 
     def _collect_locked(self):

@@ -37,9 +37,17 @@ def _build() -> str:
     if (not os.path.exists(_SO)
             or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
         # No -march=native on purpose: runtime dispatch is the contract.
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
-            check=True, capture_output=True, text=True)
+        # Compile to a private temp path and os.replace() into place so
+        # a concurrent booter never CDLLs a half-written .so.
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                check=True, capture_output=True, text=True)
+            os.replace(tmp, _SO)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     return _SO
 
 

@@ -127,6 +127,58 @@ class TestLaneScheduler:
         for got, want in results.values():
             assert got == want
 
+    def test_grow_under_load_byte_identical(self):
+        """More concurrent streams than the initial row capacity (16)
+        force the state-table grow path while worker ticks are in
+        flight.  open() copies pre-tick rows into the grown table, so
+        without the worker's post-tick merge every in-flight stream's
+        updates would be silently discarded."""
+        import random
+        sched = digestlanes.LaneScheduler()
+        n = 40
+        start = threading.Barrier(n)
+        results = {}
+        errors = []
+
+        def worker(i):
+            try:
+                rng = random.Random(7000 + i)
+                msg = _buf(rng.randrange(1, 300_000), i)
+                start.wait(30)
+                s = sched.open()
+                pos = 0
+                while pos < len(msg):
+                    k = rng.randrange(1, 20_000)
+                    sched.update(s, msg[pos:pos + k])
+                    pos += k
+                results[i] = (sched.digest(s), hashlib.md5(msg).digest())
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        assert sched._cap > 16          # the grow path actually ran
+        assert len(results) == n
+        for got, want in results.values():
+            assert got == want
+
+    def test_pending_drains_to_zero(self):
+        """pending must equal queued-but-unhashed bytes: carry bytes
+        re-queued across ticks are not double-decremented, so after a
+        long unaligned stream drains, pending returns exactly to 0."""
+        sched = digestlanes.LaneScheduler()
+        s = sched.open()
+        msg = _buf(200_065, salt=9)       # deliberately unaligned pieces
+        for off in range(0, len(msg), 1_003):
+            sched.update(s, msg[off:off + 1_003])
+        assert sched.digest(s) == hashlib.md5(msg).digest()
+        assert s.pending == 0
+
     def test_empty_stream(self):
         sched = digestlanes.scheduler()
         s = sched.open()

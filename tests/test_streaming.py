@@ -424,6 +424,39 @@ class TestStreamingSigV4Edges:
         assert resp.status == 400, out
         assert b"EntityTooLarge" in out
 
+    def test_negative_chunk_size_rejected(self, srv, cli):
+        """A signed/underscored/'+'-prefixed chunk-size field must be a
+        framing error: int(x, 16) would accept '-40' as -64, bypassing
+        the size cap and desyncing the frame parser."""
+        import datetime
+        import http.client as hc
+        from minio_tpu.server import sigv4
+        cli.make_bucket("edge4")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        for bad in (b"-40", b"+40", b"4_0", b""):
+            headers = {"Host": f"{cli.host}:{cli.port}"}
+            auth = sigv4.sign_request(cli.creds, "PUT", "/edge4/neg", {},
+                                      headers, sigv4.STREAMING_PAYLOAD,
+                                      now=now)
+            headers.update(auth)
+            wire = (bad + b";chunk-signature=" + b"0" * 64 + b"\r\n"
+                    + b"x" * 64 + b"\r\n0;chunk-signature=" + b"0" * 64
+                    + b"\r\n\r\n")
+            headers["Content-Length"] = str(len(wire))
+            headers["x-amz-decoded-content-length"] = "64"
+            conn = hc.HTTPConnection(cli.host, cli.port, timeout=30)
+            try:
+                conn.request("PUT", "/edge4/neg", body=wire,
+                             headers=headers)
+                resp = conn.getresponse()
+                out = resp.read()
+            finally:
+                conn.close()
+            assert resp.status == 400, (bad, out)
+            assert b"IncompleteBody" in out, (bad, out)
+        st, _, _ = cli.request("GET", "/edge4/neg")
+        assert st == 404
+
     def test_zero_length_payload_final_chunk_only(self, srv, cli,
                                                   digest_mode):
         """An empty aws-chunked body is just the zero-length final
