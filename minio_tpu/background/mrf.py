@@ -5,10 +5,26 @@ failed on SOME drives enqueue the object here; a background worker heals
 the stripe back to full width (immediately-retried with backoff rather
 than waiting for the scanner's next pass). The engine enqueues from its
 put path; drive reconnects implicitly resolve on the next retry.
+
+Persistence: with a `journal_path` the queue survives process death the
+same way the reference's healMRFDir does — every enqueue appends one
+JSONL record (flushed + fsynced: an acked-but-degraded write must not
+lose its pending heal to a kill -9), heals/drops append completion
+records, and the file is compacted into a checkpoint record (atomic
+tmp + rename) when the tail grows or on stop().  Boot replays the
+journal: pending entries re-enter the queue exactly once (completed
+keys cancel their enqueues) and the healed/dropped/retries counters
+carry over.
+
+Env knobs:
+  MTPU_MRF_FSYNC       1 (default) fsync each enqueue append, 0 flush only
+  MTPU_MRF_CKPT_EVERY  tail records between auto-checkpoints (256)
 """
 
 from __future__ import annotations
 
+import json
+import os
 import random
 import threading
 import time
@@ -19,7 +35,8 @@ class MRFQueue:
     def __init__(self, heal_fn, *, max_items: int = 10000,
                  retry_interval: float = 1.0, max_attempts: int = 8,
                  max_interval: float = 60.0, jitter: float = 0.25,
-                 seed: int | None = None):
+                 seed: int | None = None,
+                 journal_path: str | None = None):
         self.heal_fn = heal_fn          # (bucket, obj, version_id) -> None
         self.max_items = max_items
         self.retry_interval = retry_interval
@@ -40,6 +57,105 @@ class MRFQueue:
         self.healed = 0
         self.dropped = 0
         self.retries = 0
+        self.replayed = 0
+        self.journal_path = journal_path
+        self._jf = None
+        self._j_tail = 0                # records since last checkpoint
+        self._j_fsync = os.environ.get("MTPU_MRF_FSYNC", "1") != "0"
+        self._j_every = int(os.environ.get("MTPU_MRF_CKPT_EVERY", "256"))
+        if journal_path:
+            self._replay_journal()
+            self.checkpoint()           # compact the boot state
+
+    # -- journal -------------------------------------------------------------
+
+    def _replay_journal(self) -> None:
+        """Rebuild queue + counters from the journal.  A torn trailing
+        line (the append a kill interrupted) parses as garbage and is
+        ignored; everything before it is intact because records are
+        written with a single flushed write each."""
+        try:
+            with open(self.journal_path, "r", encoding="utf-8") as f:
+                raw = f.read()
+        except (FileNotFoundError, OSError):
+            return
+        pending: OrderedDict[str, dict] = OrderedDict()
+        for line in raw.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            op = rec.get("op")
+            if op == "ckpt":
+                pending = OrderedDict()
+                for e in rec.get("pending", ()):
+                    key = f"{e['b']}/{e['o']}@{e['vid']}"
+                    pending[key] = {"bucket": e["b"], "obj": e["o"],
+                                    "vid": e["vid"],
+                                    "attempts": int(e.get("attempts", 0))}
+                self.healed = int(rec.get("healed", 0))
+                self.dropped = int(rec.get("dropped", 0))
+                self.retries = int(rec.get("retries", 0))
+            elif op == "enq":
+                key = f"{rec['b']}/{rec['o']}@{rec['vid']}"
+                pending[key] = {"bucket": rec["b"], "obj": rec["o"],
+                                "vid": rec["vid"], "attempts": 0}
+            elif op == "done":
+                if pending.pop(rec.get("k"), None) is not None:
+                    self.healed += 1
+            elif op == "drop":
+                if pending.pop(rec.get("k"), None) is not None:
+                    self.dropped += 1
+        now = time.monotonic()
+        for key, it in pending.items():
+            it["next_try"] = now        # retry immediately after boot
+            self._q[key] = it
+        self.replayed = len(pending)
+
+    def _append_locked(self, rec: dict, durable: bool = False) -> None:
+        if not self.journal_path:
+            return
+        try:
+            if self._jf is None:
+                self._jf = open(self.journal_path, "a", encoding="utf-8")
+            self._jf.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._jf.flush()
+            if durable and self._j_fsync:
+                os.fsync(self._jf.fileno())
+            self._j_tail += 1
+        except OSError:
+            return                      # journal loss degrades to memory-only
+        if self._j_tail >= self._j_every:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> None:
+        if not self.journal_path:
+            return
+        rec = {"op": "ckpt", "healed": self.healed, "dropped": self.dropped,
+               "retries": self.retries,
+               "pending": [{"b": it["bucket"], "o": it["obj"],
+                            "vid": it["vid"], "attempts": it["attempts"]}
+                           for it in self._q.values()]}
+        tmp = self.journal_path + ".tmp"
+        try:
+            if self._jf is not None:
+                self._jf.close()
+                self._jf = None
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.journal_path)
+            self._j_tail = 0
+        except OSError:
+            pass
+
+    def checkpoint(self) -> None:
+        """Compact the journal to one ckpt record (drain/stop path)."""
+        with self._mu:
+            self._checkpoint_locked()
+
+    # -- queue ---------------------------------------------------------------
 
     def _backoff(self, attempts: int) -> float:
         base = min(self.max_interval, self.retry_interval * (2 ** attempts))
@@ -49,11 +165,14 @@ class MRFQueue:
         key = f"{bucket}/{obj}@{version_id}"
         with self._mu:
             if key not in self._q and len(self._q) >= self.max_items:
-                self._q.popitem(last=False)      # shed oldest under pressure
+                shed_key, _ = self._q.popitem(last=False)  # shed oldest
                 self.dropped += 1
+                self._append_locked({"op": "drop", "k": shed_key})
             self._q[key] = {"bucket": bucket, "obj": obj,
                             "vid": version_id, "attempts": 0,
                             "next_try": time.monotonic()}
+            self._append_locked({"op": "enq", "b": bucket, "o": obj,
+                                 "vid": version_id}, durable=True)
         self._wake.set()
 
     def pending(self) -> int:
@@ -79,12 +198,14 @@ class MRFQueue:
                         if it["attempts"] >= self.max_attempts:
                             del self._q[key]
                             self.dropped += 1
+                            self._append_locked({"op": "drop", "k": key})
                         else:
                             it["next_try"] = now + \
                                 self._backoff(it["attempts"])
                 continue
             with self._mu:
-                self._q.pop(key, None)
+                if self._q.pop(key, None) is not None:
+                    self._append_locked({"op": "done", "k": key})
             self.healed += 1
             healed += 1
         return healed
@@ -104,18 +225,47 @@ class MRFQueue:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
+        if self.journal_path:
+            self.checkpoint()
+            with self._mu:
+                if self._jf is not None:
+                    try:
+                        self._jf.close()
+                    except OSError:
+                        pass
+                    self._jf = None
 
 
-def attach_mrf(pools, **kw) -> list[MRFQueue]:
+def _pool_journal_path(pool) -> str | None:
+    """Journal home: the first local drive of the pool's first set —
+    under its reserved system namespace, next to tmp/ and multipart/."""
+    from ..storage.drive import SYS_VOL
+    for es in getattr(pool, "sets", [pool]):
+        for d in getattr(es, "drives", []):
+            root = getattr(d, "root", None)
+            if d is not None and root:
+                return os.path.join(root, SYS_VOL, "mrf-journal.jsonl")
+    return None
+
+
+def attach_mrf(pools, journal: bool = True, **kw) -> list[MRFQueue]:
     """Server-boot wiring: one started MRFQueue per ErasureSets pool,
     healing through the pool's own heal_object (routes to the right
     set), attached to every set so the engine's partial-write paths
-    find `es.mrf`.  Returns the queues (callers keep them for stop())."""
+    find `es.mrf`.  Returns the queues (callers keep them for stop()).
+
+    With `journal` (the boot default) each queue persists to the pool's
+    first local drive so pending heals survive restarts; pools with no
+    local drive stay memory-only."""
     queues = []
     for pool in getattr(pools, "pools", [pools]):
         def heal(bucket, obj, vid, _p=pool):
             _p.heal_object(bucket, obj, vid)
-        q = MRFQueue(heal, **kw).start()
+        jp = _pool_journal_path(pool) if journal else None
+        q = MRFQueue(heal, journal_path=jp, **kw).start()
+        if q.replayed:
+            from ..observe.metrics import DATA_PATH
+            DATA_PATH.record_mrf_replay(q.replayed)
         for es in getattr(pool, "sets", [pool]):
             es.mrf = q
         queues.append(q)

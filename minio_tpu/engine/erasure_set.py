@@ -49,6 +49,7 @@ from ..storage.health_wrap import drive_available
 from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo, XLMeta,
                               new_uuid, normalize_version_id)
 from ..utils import streams
+from ..utils.crashpoints import crash_point
 from . import quorum as Q
 
 BLOCK_SIZE = 1 << 20          # blockSizeV2, cmd/object-api-common.go:40
@@ -624,8 +625,11 @@ class ErasureSet:
             errs = [e for _, e in res]
             err = Q.reduce_write_quorum_errs(errs, write_quorum)
             if err is not None:
+                self._undo_publish(bucket, obj,
+                                   fi_for(0, data_dir, None), errs)
                 self._cleanup_tmp(tmp_id)
                 raise err
+            crash_point("put.post_publish")
             if any(errs):
                 # Only failed drives can still hold staging files —
                 # successful publishes renamed theirs away.
@@ -683,7 +687,10 @@ class ErasureSet:
             errs = [e for _, e in res]
             err = Q.reduce_write_quorum_errs(errs, write_quorum)
             if err is not None:
+                self._undo_publish(bucket, obj,
+                                   fi_for(0, data_dir, None), errs)
                 raise err
+            crash_point("put.post_publish")
         finally:
             # Always sweep staging: publish renames the winners away;
             # failed/partial drives still hold tmp shard files.  The
@@ -721,7 +728,9 @@ class ErasureSet:
         errs = [e for _, e in res]
         err = Q.reduce_write_quorum_errs(errs, write_quorum)
         if err is not None:
+            self._undo_publish(bucket, obj, fi_for(0, "", None), errs)
             raise err
+        crash_point("put.inline.post_meta")
         fi = fi_for(0, "", None)
         if self.mrf is not None and any(errs):
             # Same partial-success rule as the streaming path.
@@ -2309,3 +2318,19 @@ class ErasureSet:
         def rm(d):
             d.delete(SYS_VOL, f"{TMP_DIR}/{tmp_id}", recursive=True)
         self._map_drives(rm)
+
+    def _undo_publish(self, bucket, obj, fi, errs) -> None:
+        """Roll back a publish fan-out that missed write quorum: drives
+        that already renamed the version in must not keep it, or a
+        REJECTED PUT becomes readable whenever the successes still
+        reach READ quorum (read < write).  Best-effort — a drive that
+        also fails the undo is left for dangling-object cleanup."""
+        def undo(pos):
+            if errs[pos] is not None or self.drives[pos] is None:
+                return
+            try:
+                self.drives[pos].delete_version(bucket, obj,
+                                                fi.version_id)
+            except StorageError:
+                pass
+        self._map_drives_positions(undo)

@@ -28,6 +28,7 @@ from ..storage.errors import (ErrErasureWriteQuorum, ErrFileNotFound,
 from ..storage.xlmeta import (ErasureInfo, FileInfo, ObjectPartInfo,
                               XLMeta, new_uuid)
 from ..utils import msgpackx, streams
+from ..utils.crashpoints import crash_point
 from . import quorum as Q
 from .erasure_set import BATCH_BLOCKS, BLOCK_SIZE, ErasureSet
 
@@ -190,6 +191,7 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
                                              write_quorum)
             if err is not None:
                 raise err
+            crash_point("mp.part.post_publish")
         finally:
             _cleanup_stage(es, stage)
         t2 = time.perf_counter()
@@ -270,6 +272,7 @@ def put_object_part(es: ErasureSet, bucket: str, obj: str, upload_id: str,
                                          write_quorum)
         if err is not None:
             raise err
+        crash_point("mp.part.post_publish")
     finally:
         md5.close()
         _cleanup_stage(es, stage)
@@ -464,6 +467,7 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
         for i, p in enumerate(chosen):
             d.rename_file(SYS_VOL, f"{path}/part.{p.number}",
                           SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.{i + 1}")
+        crash_point("mp.complete.publish")
         d.rename_data(SYS_VOL, f"{TMP_DIR}/{tmp_id}", fi_for(pos),
                       bucket, obj)
 
@@ -507,6 +511,7 @@ def complete_multipart_upload(es: ErasureSet, bucket: str, obj: str,
                 pass
         es._map_drives_positions(rollback)
         raise err
+    crash_point("mp.complete.post_publish")
 
     # Success: sweep staging + the whole upload dir.
     def rm(d):

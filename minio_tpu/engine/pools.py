@@ -43,12 +43,19 @@ class ServerPools:
 
     def get_pool_idx(self, bucket: str, obj: str) -> int:
         """Existing pool wins; else most free space
-        (cf. getPoolIdx, erasure-server-pool.go:373)."""
+        (cf. getPoolIdx, erasure-server-pool.go:373).
+
+        Single pool short-circuits BEFORE the existence probe (the
+        reference's SinglePool() fast path): the probe needs read
+        quorum, and a key whose last write died mid-publish (one drive
+        holds the version — below quorum) would otherwise 503 every
+        overwrite PUT forever.  With one pool there is no placement
+        decision to protect, so the write must always proceed."""
+        if len(self.pools) == 1:
+            return 0
         existing = self._pool_with_object(bucket, obj)
         if existing is not None:
             return existing
-        if len(self.pools) == 1:
-            return 0
         frees = [p.disk_usage()["free"] for p in self.pools]
         return max(range(len(frees)), key=lambda i: frees[i])
 

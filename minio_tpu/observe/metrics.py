@@ -233,6 +233,18 @@ class DataPathStats:
             self.dg_sha_calls = 0
             self.dg_sha_bufs = 0
             self.dg_sha_bytes = 0
+            # Process-lifecycle accounting: boot-time recovery sweep
+            # (stale tmp entries + orphaned multipart staging removed),
+            # MRF journal entries replayed into the queue on boot, and
+            # graceful drains (leftover = requests still inflight when
+            # MTPU_DRAIN_TIMEOUT expired).
+            self.recovery_sweeps = 0
+            self.recovery_tmp_entries = 0
+            self.recovery_mp_stage = 0
+            self.mrf_replayed = 0
+            self.drains = 0
+            self.drain_leftover = 0
+            self.drain_s = 0.0
 
     def record_heal_batch(self, blocks: int, capacity: int,
                           source_bytes: int, out_bytes: int,
@@ -329,6 +341,24 @@ class DataPathStats:
             self.dg_sha_bufs += bufs
             self.dg_sha_bytes += nbytes
 
+    def record_recovery_sweep(self, tmp_entries: int,
+                              mp_stage: int) -> None:
+        """One drive's boot-time sweep of dead-epoch state."""
+        with self._mu:
+            self.recovery_sweeps += 1
+            self.recovery_tmp_entries += tmp_entries
+            self.recovery_mp_stage += mp_stage
+
+    def record_mrf_replay(self, entries: int) -> None:
+        with self._mu:
+            self.mrf_replayed += entries
+
+    def record_drain(self, leftover: int, seconds: float) -> None:
+        with self._mu:
+            self.drains += 1
+            self.drain_leftover += leftover
+            self.drain_s += seconds
+
     def snapshot(self) -> dict:
         with self._mu:
             return {
@@ -378,6 +408,13 @@ class DataPathStats:
                 "dg_sha_calls": self.dg_sha_calls,
                 "dg_sha_bufs": self.dg_sha_bufs,
                 "dg_sha_bytes": self.dg_sha_bytes,
+                "recovery_sweeps": self.recovery_sweeps,
+                "recovery_tmp_entries": self.recovery_tmp_entries,
+                "recovery_mp_stage": self.recovery_mp_stage,
+                "mrf_replayed": self.mrf_replayed,
+                "drains": self.drains,
+                "drain_leftover": self.drain_leftover,
+                "drain_seconds": self.drain_s,
             }
 
 
@@ -529,6 +566,27 @@ class MetricsRegistry:
         self.drive_transitions = Gauge(
             "mtpu_drive_state_transitions_total",
             "Breaker state transitions by target state", ("state",))
+        # Process-lifecycle families: boot recovery sweep + graceful
+        # drain (cmd/prepare-storage.go / cmd/signals.go analogues).
+        self.recovery_sweeps = Gauge(
+            "mtpu_recovery_drive_sweeps_total",
+            "Per-drive boot-time recovery sweeps run")
+        self.recovery_tmp = Gauge(
+            "mtpu_recovery_tmp_entries_swept_total",
+            "Stale tmp/trash entries removed at boot")
+        self.recovery_mp_stage = Gauge(
+            "mtpu_recovery_multipart_stage_swept_total",
+            "Orphaned multipart staging files removed at boot")
+        self.mrf_replayed = Gauge(
+            "mtpu_mrf_journal_replayed_total",
+            "MRF journal entries replayed into the queue on boot")
+        self.drains = Gauge(
+            "mtpu_drains_total", "Graceful drains started")
+        self.drain_leftover = Gauge(
+            "mtpu_drain_leftover_requests_total",
+            "Requests still inflight when the drain timeout expired")
+        self.drain_seconds = Gauge(
+            "mtpu_drain_seconds_total", "Time spent draining")
         # MRF heal-queue families.
         self.mrf_pending = Gauge(
             "mtpu_mrf_pending", "Objects queued for MRF heal")
@@ -687,6 +745,13 @@ class MetricsRegistry:
         self.dg_sha_calls.set(snap["dg_sha_calls"])
         self.dg_sha_bufs.set(snap["dg_sha_bufs"])
         self.dg_sha_bytes.set(snap["dg_sha_bytes"])
+        self.recovery_sweeps.set(snap["recovery_sweeps"])
+        self.recovery_tmp.set(snap["recovery_tmp_entries"])
+        self.recovery_mp_stage.set(snap["recovery_mp_stage"])
+        self.mrf_replayed.set(snap["mrf_replayed"])
+        self.drains.set(snap["drains"])
+        self.drain_leftover.set(snap["drain_leftover"])
+        self.drain_seconds.set(snap["drain_seconds"])
 
     def _sync_spans(self) -> None:
         # Imported lazily: span.py is the one observe module allowed to
@@ -737,7 +802,10 @@ class MetricsRegistry:
                   self.dg_sha_calls, self.dg_sha_bufs, self.dg_sha_bytes,
                   self.drive_state, self.drive_transitions,
                   self.mrf_pending, self.mrf_healed, self.mrf_dropped,
-                  self.mrf_retries,
+                  self.mrf_retries, self.recovery_sweeps,
+                  self.recovery_tmp, self.recovery_mp_stage,
+                  self.mrf_replayed, self.drains, self.drain_leftover,
+                  self.drain_seconds,
                   self.trace_api_count, self.trace_api_errors,
                   self.trace_api_latency, self.trace_stage_ms,
                   self.trace_stage_count, self.trace_stage_hist,

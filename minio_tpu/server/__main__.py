@@ -45,6 +45,22 @@ def bucket_dns_from_env(host: str, port: int):
         raise SystemExit(2) from None
 
 
+def install_signal_handlers(stop) -> None:
+    """SIGTERM and SIGINT both start a graceful drain (cmd/signals.go:
+    the reference treats them identically); a SECOND signal of either
+    kind forces immediate exit — the escape hatch when a drain hangs."""
+    def _sig(signum, frame):
+        if stop.is_set():
+            try:
+                os.write(2, b"minio_tpu: second signal, forcing exit\n")
+            except OSError:
+                pass
+            os._exit(130 if signum == signal.SIGINT else 143)
+        stop.set()
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="minio_tpu.server")
     ap.add_argument("--drives", required=True, action="append",
@@ -125,7 +141,7 @@ def main(argv: list[str] | None = None) -> int:
 
         import threading
         stop = threading.Event()
-        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        install_signal_handlers(stop)
         while True:
             try:
                 node, srv0, pools = boot_cluster_node(
@@ -160,6 +176,9 @@ def main(argv: list[str] | None = None) -> int:
                 node.close()
                 continue
             break
+        # Cluster stop path: same drain as standalone — inflight
+        # requests finish, heal/MRF checkpoint, then the node leaves.
+        srv0.drain()
         srv0.shutdown()
         if srv0.scanner is not None:
             srv0.scanner.stop()
@@ -196,21 +215,41 @@ def main(argv: list[str] | None = None) -> int:
     from ..background.mrf import attach_mrf
     from ..storage.health_wrap import wrap_drives
 
+    from ..storage.recovery import boot_recovery_sweep
+
     pool_sets: list[ErasureSets] = []
+    swept = {"drives": 0, "tmp_entries": 0, "mp_stage": 0}
     for paths in pool_paths:
         # Health wrap at boot: per-API latency/error stats plus the
         # drive circuit breaker (ok -> suspect -> offline + background
         # probe), the xl-storage-disk-id-check.go:68 layering.
-        drives = wrap_drives([LocalDrive(p) for p in paths])
+        local = [LocalDrive(p) for p in paths]
+        # Boot-time recovery sweep BEFORE the engine takes traffic:
+        # stale tmp/trash from the previous epoch, orphaned multipart
+        # staging (cmd/prepare-storage.go role).
+        rec = boot_recovery_sweep(local)
+        for key in swept:
+            swept[key] += rec[key]
+        drives = wrap_drives(local)
         pool_sets.append(ErasureSets(
             drives,
             set_drive_count=args.set_drive_count or len(drives),
             deployment_id=(pool_sets[0].deployment_id
                            if pool_sets else None)))
     pools = ServerPools(pool_sets)
+    if swept["tmp_entries"] or swept["mp_stage"]:
+        print(f"minio_tpu: recovery sweep: {swept['tmp_entries']} stale "
+              f"tmp entr(ies), {swept['mp_stage']} orphaned multipart "
+              f"staging file(s) across {swept['drives']} drive(s)",
+              flush=True)
     # MRF heal queues: writes that missed a breaker-offline drive heal
-    # back to full width as soon as the drive recovers.
+    # back to full width as soon as the drive recovers.  Journaled to
+    # each pool's first drive so pending heals survive restarts.
     mrf_queues = attach_mrf(pools)
+    replayed = sum(q.replayed for q in mrf_queues)
+    if replayed:
+        print(f"minio_tpu: MRF journal: replayed {replayed} pending "
+              f"heal(s)", flush=True)
 
     # Full subsystem stack, the newAllSubsystems role
     # (cmd/server-main.go:441): IAM, scanner, notifications.
@@ -223,12 +262,16 @@ def main(argv: list[str] | None = None) -> int:
     # Perpetual scanner lifecycle: an idle server crawls, accounts
     # usage, heals missing metadata, and bitrot-verifies every
     # deep_every-th cycle (cf. initDataScanner, cmd/server-main.go:441).
-    scanner = DataScanner(pools).start()
+    # MTPU_SCANNER=0 disables it (deterministic-write harnesses: the
+    # scanner's usage persistence writes through the same drive paths
+    # the crash points instrument).
+    scanner = (DataScanner(pools).start()
+               if os.environ.get("MTPU_SCANNER", "1") != "0" else None)
     notify = NotificationSystem()
 
     import threading
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    install_signal_handlers(stop)
     port = args.port
     while True:
         srv = S3Server(pools, creds, host=args.host, port=port,
@@ -268,8 +311,13 @@ def main(argv: list[str] | None = None) -> int:
             srv.shutdown()
             continue             # scanner keeps running across restarts
         break
+    # Graceful exit: drain (503 new requests, finish inflight, flush
+    # digest lanes, checkpoint heal frontier + MRF journal), THEN drop
+    # the listener and stop the background machinery.
+    srv.drain()
     srv.shutdown()
-    scanner.stop()
+    if scanner is not None:
+        scanner.stop()
     for q in mrf_queues:
         q.stop()
     return 0

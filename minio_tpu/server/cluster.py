@@ -132,6 +132,11 @@ class ClusterNode:
         self.local_drives = wrap_drives(
             [LocalDrive(ep.path) for ep in eps
              if ep.is_local(my_host, my_port)])
+        # Boot-time recovery sweep, each node for its own disks: stale
+        # tmp/trash from a dead epoch and orphaned multipart staging go
+        # before the cluster format/verify phases take traffic.
+        from ..storage.recovery import boot_recovery_sweep
+        boot_recovery_sweep(self.local_drives)
 
         # Peers (every node but me).
         self.peer_clients: dict[tuple[str, int], RPCClient] = {
@@ -345,8 +350,11 @@ def boot_cluster_node(endpoint_args: list[str], my_host: str,
         node.mrf_queues = attach_mrf(pools)
         iam = IAMSys(pools)
         node.peer_registry.on_reload("iam", iam.load)
-        server.bind_object_layer(pools, iam=iam,
-                                 scanner=DataScanner(pools).start())
+        import os as _os
+        scanner = (DataScanner(pools).start()
+                   if _os.environ.get("MTPU_SCANNER", "1") != "0"
+                   else None)
+        server.bind_object_layer(pools, iam=iam, scanner=scanner)
         return node, server, pools
     except Exception:
         server.shutdown()

@@ -13,6 +13,7 @@ happen inline before dispatch, mirroring cmd/generic-handlers.go.
 
 from __future__ import annotations
 
+import os as _os
 import secrets
 import threading
 import urllib.parse
@@ -156,6 +157,14 @@ class S3Server:
         self.scanner = scanner
         self.config = None                 # lazy ConfigSys (admin API)
         self.service_event = ""            # "" | "restart" | "stop"
+        # Graceful-drain plane (cmd/signals.go role): once draining,
+        # new S3 requests bounce with 503 + Retry-After while inflight
+        # ones finish.  The counter is ours, not metrics.inflight —
+        # that gauge closes before the response body is written, and a
+        # drain must wait for the LAST BYTE of every streamed GET.
+        self.draining = False
+        self._inflight = 0
+        self._drain_cv = threading.Condition()
         # Site-hook single-flight state is created EAGERLY: the lazy
         # `if getattr(...) is None: self._site_hook_mu = Lock()` dance
         # raced — two first-ever mutations on different handler threads
@@ -169,6 +178,12 @@ class S3Server:
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
             server_version = "MinioTPU"
+            # Per-connection socket timeout (StreamRequestHandler.setup
+            # applies it): a client that stalls mid-body for this long
+            # surfaces as TimeoutError in the dispatch below and maps
+            # to a clean RequestTimeout, not a raw traceback.
+            timeout = float(_os.environ.get("MTPU_SOCKET_TIMEOUT",
+                                            "60") or 60)
 
             def log_message(self, fmt, *args):  # quiet; tracing has its own
                 pass
@@ -227,6 +242,37 @@ class S3Server:
                     self.wfile.write(body)
 
             def _handle(self):
+                # Drain gate + inflight tracking around the WHOLE
+                # request (dispatch and response write): drain() blocks
+                # on this counter reaching zero, so a SIGTERM never
+                # severs a response mid-stream.
+                parsed = urllib.parse.urlsplit(self.path)
+                path = urllib.parse.unquote(parsed.path)
+                if outer.draining and not path.startswith(
+                        ("/minio/health/", "/minio/rpc/")):
+                    self.request_id = secrets.token_hex(8)
+                    resp = error_response(
+                        S3Error("ServiceUnavailable",
+                                "server is draining for shutdown"),
+                        path, self.request_id)
+                    resp.headers["Retry-After"] = "1"
+                    self.close_connection = True
+                    try:
+                        self._respond(resp)
+                    except (BrokenPipeError, ConnectionResetError,
+                            TimeoutError):
+                        pass
+                    return
+                with outer._drain_cv:
+                    outer._inflight += 1
+                try:
+                    self._handle_inner()
+                finally:
+                    with outer._drain_cv:
+                        outer._inflight -= 1
+                        outer._drain_cv.notify_all()
+
+            def _handle_inner(self):
                 import time as _time
                 self.request_id = secrets.token_hex(8)
                 parsed = urllib.parse.urlsplit(self.path)
@@ -293,6 +339,19 @@ class S3Server:
                     resp = error_response(
                         S3Error("IncompleteBody", str(e)), path,
                         self.request_id)
+                    self.close_connection = True
+                except TimeoutError:
+                    # Client stalled mid-body past the socket timeout:
+                    # a clean RequestTimeout + connection close, not an
+                    # unhandled socket.timeout traceback.
+                    resp = error_response(
+                        S3Error("RequestTimeout",
+                                "client read timed out mid-request"),
+                        path, self.request_id)
+                    self.close_connection = True
+                except (BrokenPipeError, ConnectionResetError):
+                    # Client went away mid-body: nothing to tell them.
+                    resp = Response(499, b"")
                     self.close_connection = True
                 except Exception as e:  # noqa: BLE001
                     outer.log.error(f"handler crash: {e}",
@@ -365,7 +424,11 @@ class S3Server:
                                   if "/" in sb else ""),
                           error=resp.status >= 400)
                 try:
-                    self._respond(resp)
+                    if resp.status != 499:
+                        self._respond(resp)
+                except (BrokenPipeError, ConnectionResetError,
+                        TimeoutError):
+                    self.close_connection = True
                 finally:
                     rspan.__exit__(None, None, None)
 
@@ -462,6 +525,74 @@ class S3Server:
         # healing for the life of the process.
         self._httpd.shutdown()
         self._httpd.server_close()
+
+    def drain(self, timeout: float | None = None) -> dict:
+        """Graceful drain (the cmd/signals.go handleSignals role).
+
+        Flips readiness to draining — new S3 requests bounce with
+        503 + Retry-After, /minio/health/ready goes 503 so balancers
+        stop routing here — then waits for every inflight request
+        (through its last response byte) up to MTPU_DRAIN_TIMEOUT.
+        Afterwards the durability state quiesces: digest lanes flush,
+        running heal sequences stop (their frontier trackers checkpoint
+        on the way out), and MRF journals persist.  Idempotent; the
+        caller still owns shutdown().
+        """
+        import time as _time
+        if timeout is None:
+            timeout = float(_os.environ.get("MTPU_DRAIN_TIMEOUT",
+                                            "10") or 10)
+        t0 = _time.monotonic()
+        deadline = t0 + timeout
+        with self._drain_cv:
+            first = not self.draining
+            self.draining = True
+            while self._inflight > 0:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    break
+                self._drain_cv.wait(timeout=min(left, 0.25))
+            leftover = self._inflight
+        # Digest lanes: every request-owned stream closed with the
+        # requests above; a bounded flush covers finalize_async tails
+        # still ticking through the lane scheduler.
+        try:
+            from ..utils import digestlanes
+            digestlanes.drain(timeout=1.0)
+        except Exception:  # noqa: BLE001 — drain must not die here
+            pass
+        # Heal frontier: stop running sequences; heal_drive saves its
+        # HealingTracker checkpoint in its finally as it unwinds.
+        hs = getattr(self, "heal_state", None)
+        if hs is not None:
+            for s in list(getattr(hs, "_seqs", {}).values()):
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+        # MRF: persist pending heals so the next boot replays them.
+        seen: set[int] = set()
+        if self.pools is not None:
+            for pool in getattr(self.pools, "pools", [self.pools]):
+                for es in getattr(pool, "sets", [pool]):
+                    q = getattr(es, "mrf", None)
+                    if q is not None and id(q) not in seen:
+                        seen.add(id(q))
+                        cp = getattr(q, "checkpoint", None)
+                        if cp is not None:
+                            try:
+                                cp()
+                            except Exception:  # noqa: BLE001
+                                pass
+        dur = _time.monotonic() - t0
+        if first:
+            from ..observe.metrics import DATA_PATH
+            DATA_PATH.record_drain(leftover, dur)
+            self.log.info(
+                f"drain complete: {leftover} request(s) leftover "
+                f"after {dur:.2f}s")
+        return {"draining": True, "leftover": leftover,
+                "duration_s": dur}
 
     @property
     def endpoint(self) -> str:
@@ -1506,6 +1637,9 @@ class S3Server:
 
             def _later():
                 _time.sleep(0.25)        # let the response flush
+                # Same drain as SIGTERM: inflight requests finish,
+                # heal/MRF state checkpoints, THEN the listener drops.
+                self.drain()
                 self.shutdown()
             _threading.Thread(target=_later, daemon=True).start()
             return j({"action": action, "acknowledged": True,
@@ -1615,7 +1749,10 @@ class S3Server:
         if path == "/minio/health/live":
             return Response(200)
         if path == "/minio/health/ready":
-            # ready = object layer bound (cluster boot done)
+            # ready = object layer bound (cluster boot done) AND not
+            # draining — load balancers stop routing here first.
+            if self.draining:
+                return Response(503, headers={"Retry-After": "1"})
             return Response(200 if self.pools is not None else 503)
         if self.pools is None:
             return Response(503)

@@ -20,6 +20,7 @@ can run background weather plus one scripted fault.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -29,8 +30,13 @@ from .naughty import INTERCEPTED, NaughtyDrive
 
 #: Mutating calls eligible for torn-write injection (prefix lands on
 #: disk, then the call fails — the partial artifact must never become
-#: visible data).
-TORN_METHODS = ("write_all", "create_file", "append_file")
+#: visible data).  rename_data tears BETWEEN its two halves: the data
+#: dir moves into place but xl.meta is never updated — the exact state
+#: a kill lands between shard publishes (crash point rename.pre_meta).
+#: Adding it here does NOT shift the seeded draw sequence: r_torn is
+#: drawn unconditionally for every intercepted call either way.
+TORN_METHODS = ("write_all", "create_file", "append_file",
+                "rename_data")
 
 
 class ErrChaosInjected(StorageError):
@@ -71,6 +77,28 @@ class ChaosDrive(NaughtyDrive):
             self.error_rate = self.slow_rate = self.torn_rate = 0.0
         return self
 
+    def _torn_rename_data(self, src_vol, src_dir, fi, dst_vol, dst_obj,
+                          **_kw) -> None:
+        """First half of rename_data only: the staged data dir moves
+        into place but xl.meta is never updated — the on-disk state a
+        kill leaves at crash point rename.pre_meta.  The unreferenced
+        data dir must stay invisible to reads, and heal's republish of
+        the SAME data_dir reclaims it."""
+        if not fi.uses_data_dir():
+            return               # inline version: nothing to tear
+        src = self._file_path(src_vol, src_dir)
+        if not os.path.isdir(src):
+            return
+        dst = self._file_path(dst_vol, os.path.join(dst_obj,
+                                                    fi.data_dir))
+        try:
+            self._ensure_parent_in_vol(dst_vol, dst)
+            if os.path.isdir(dst):
+                self._move_to_trash(dst)
+            os.replace(src, dst)
+        except OSError:
+            pass                 # tearing is best-effort; call fails next
+
     def _chaos_wrap(self, name, real):
         def chaotic(*a, **kw):
             with self._chaos_mu:
@@ -92,6 +120,10 @@ class ChaosDrive(NaughtyDrive):
             if do_slow:
                 time.sleep(self.slow_s)
             if do_torn:
+                if name == "rename_data":
+                    self._torn_rename_data(*a, **kw)
+                    raise ErrChaosInjected(
+                        f"chaos[{self.seed}]: torn rename_data")
                 data = a[2] if len(a) >= 3 else kw.get("data", b"")
                 half = bytes(memoryview(data)[:max(0, len(data) // 2)])
                 try:

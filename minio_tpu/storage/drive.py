@@ -27,6 +27,7 @@ import threading
 import uuid
 
 from . import bitrot_io, diskio, oscounters
+from ..utils.crashpoints import crash_point
 from .errors import (ErrDiskNotFound, ErrFileAccessDenied, ErrFileCorrupt,
                      ErrFileNotFound, ErrFileVersionNotFound, ErrIsNotRegular,
                      ErrPathNotFound, ErrVolumeExists, ErrVolumeNotEmpty,
@@ -182,7 +183,9 @@ class LocalDrive:
         with open(tmp, "wb") as f:
             f.write(data)
             f.flush()
+            crash_point("tmp.write.pre_fsync")
             os.fsync(f.fileno())
+        crash_point("tmp.write.post_fsync")
         with self._osc.timed("rename"):
             os.replace(tmp, p)
 
@@ -239,10 +242,12 @@ class LocalDrive:
         with open(p, "wb") as f:
             f.write(data)
             f.flush()
+            crash_point("shard.create.pre_fsync")
             # write_done syncs (fdatasync) before dropping cache; only
             # fsync ourselves when it didn't run (small/off-mode writes)
             if not diskio.write_done(f.fileno(), len(data)):
                 os.fsync(f.fileno())
+        crash_point("shard.create.post_fsync")
 
     def append_file(self, vol: str, path: str, data: bytes) -> None:
         with self._osc.timed('write'):
@@ -279,6 +284,7 @@ class LocalDrive:
             f.write(data)
             f.flush()
             diskio.write_done(f.fileno(), len(data))
+        crash_point("shard.append")
 
     def read_file(self, vol: str, path: str, offset: int = 0,
                   length: int = -1) -> bytes:
@@ -419,6 +425,7 @@ class LocalDrive:
                 meta = self._read_xlmeta(vol, obj)
             except (ErrFileNotFound, ErrFileCorrupt):
                 meta = XLMeta()
+            crash_point("meta.update")
             meta.add_version(fi)
             self._write_xlmeta(vol, obj, meta)
 
@@ -487,6 +494,7 @@ class LocalDrive:
                     self._move_to_trash(dst)
                 with self._osc.timed("rename"):
                     os.replace(src, dst)
+            crash_point("rename.pre_meta")
             meta.add_version(fi)
             self._write_xlmeta(dst_vol, dst_obj, meta, new=fresh)
             if old_dd:
@@ -703,6 +711,48 @@ class LocalDrive:
         tmp = os.path.join(self.root, SYS_VOL, TMP_DIR)
         for name in os.listdir(tmp):
             shutil.rmtree(os.path.join(tmp, name), ignore_errors=True)
+
+    def sweep_stale(self) -> dict:
+        """Boot-time recovery sweep (formatErasureCleanupTmpLocalEndpoints
+        role, cmd/prepare-storage.go): everything under tmp belongs to a
+        dead boot epoch — staged writes that never published, trash that
+        never finished deleting.  The whole tmp dir is renamed aside (one
+        atomic op, so a concurrent boot can't race the file walk), a
+        fresh one is created, and the aside tree is deleted.  Orphaned
+        multipart ``stage-*`` files (a part upload killed between encode
+        and rename) are swept too; parked part files and upload metadata
+        stay — the upload itself is still resumable.
+
+        Returns counts for the recovery metrics.
+        """
+        counts = {"tmp_entries": 0, "mp_stage": 0}
+        tmp = os.path.join(self.root, SYS_VOL, TMP_DIR)
+        try:
+            stale = os.listdir(tmp)
+        except FileNotFoundError:
+            stale = []
+        if stale:
+            counts["tmp_entries"] = len(stale)
+            aside = os.path.join(self.root, SYS_VOL,
+                                 f"{TMP_DIR}-old-{uuid.uuid4().hex}")
+            try:
+                os.replace(tmp, aside)
+            except OSError:
+                aside = tmp  # fall back to in-place removal
+            os.makedirs(tmp, exist_ok=True)
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            os.makedirs(tmp, exist_ok=True)
+        mp = os.path.join(self.root, SYS_VOL, MULTIPART_DIR)
+        for dirpath, _dirnames, filenames in os.walk(mp):
+            for name in filenames:
+                if name.startswith("stage-"):
+                    try:
+                        os.remove(os.path.join(dirpath, name))
+                        counts["mp_stage"] += 1
+                    except OSError:
+                        pass
+        return counts
 
     def __repr__(self) -> str:
         return f"LocalDrive({self.root!r})"

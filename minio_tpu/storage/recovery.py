@@ -1,0 +1,44 @@
+"""Boot-time recovery sweep orchestration.
+
+The formatErasureCleanupTmpLocalEndpoints role (cmd/prepare-storage.go):
+before a freshly-booted server takes traffic, every *local* drive sweeps
+the debris a dead process left behind — staged tmp writes that never
+published, trash renames that never finished, orphaned multipart
+``stage-*`` files.  The per-drive mechanics live in
+`LocalDrive.sweep_stale`; this module fans the sweep across a drive
+list (unwrapping health wrappers, skipping remote drives — each node
+sweeps only its own disks) and feeds the recovery metrics.
+
+This is an explicit boot step, NOT a LocalDrive.__init__ side effect:
+in-process tests and admin tools construct drives over live trees all
+the time, and a constructor that silently deletes tmp state would race
+the running engine that owns it.
+"""
+
+from __future__ import annotations
+
+from ..observe.metrics import DATA_PATH
+
+
+def boot_recovery_sweep(drives) -> dict:
+    """Sweep every local drive in `drives`; returns aggregate counts.
+
+    Accepts raw LocalDrives or health-wrapped ones (attribute
+    passthrough reaches sweep_stale); anything without a sweep —
+    remote drives, None gaps — is skipped.
+    """
+    totals = {"drives": 0, "tmp_entries": 0, "mp_stage": 0}
+    for d in drives:
+        sweep = getattr(d, "sweep_stale", None)
+        if sweep is None:
+            continue
+        try:
+            counts = sweep()
+        except OSError:
+            continue            # a dead drive must not block boot
+        totals["drives"] += 1
+        totals["tmp_entries"] += counts.get("tmp_entries", 0)
+        totals["mp_stage"] += counts.get("mp_stage", 0)
+        DATA_PATH.record_recovery_sweep(counts.get("tmp_entries", 0),
+                                        counts.get("mp_stage", 0))
+    return totals

@@ -1,0 +1,328 @@
+"""Kill-9 durability matrix driver.
+
+Each scenario runs a real server subprocess through three boots over
+ONE persistent drive tree:
+
+  boot A  (no crash armed)  write acked baseline objects, then SIGKILL
+          — proves acked writes survive a plain kill -9;
+  boot B  (MTPU_CRASH=point:nth armed)  drive the victim operation into
+          the armed crash point; the server hard-kills itself (os._exit
+          137) inside the durability-critical window;
+  boot C  (no crash armed)  the recovery boot: sweep runs, MRF journal
+          replays — assert the durability contract.
+
+The contract per scenario:
+  * every baseline (acked) object reads back byte-exact and verifies;
+  * the victim (unacked) object honors `expect`:
+      absent   — must NOT be visible (crash strictly before quorum),
+      durable  — MUST read back byte-exact (quorum committed pre-kill;
+                 unacked-but-durable is valid S3),
+      maybe    — either absent or byte-exact — NEVER torn/corrupt
+                 (mid-fan-out kills land on either side of quorum);
+  * every drive's tmp area is empty after the boot-time sweep;
+  * the system stays writable: a re-PUT of the victim key lands and
+    reads back exact.
+
+Used by tests/test_crash.py (pytest harness) and
+tools/chaos_report.py --crash-matrix (human-readable report).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+#: The seeded matrix: one row per instrumented crash point (several
+#: points get both an nth=1 "first drive" and a mid-fan-out variant).
+#: op selects the victim traffic; expect encodes the contract above.
+SCENARIOS = (
+    {"point": "tmp.write.pre_fsync", "nth": 1, "op": "put_inline",
+     "expect": "absent"},
+    {"point": "tmp.write.post_fsync", "nth": 1, "op": "put_inline",
+     "expect": "absent"},
+    {"point": "meta.update", "nth": 1, "op": "put_inline",
+     "expect": "absent"},
+    {"point": "meta.update", "nth": 3, "op": "put_inline",
+     "expect": "maybe"},
+    {"point": "put.inline.post_meta", "nth": 1, "op": "put_inline",
+     "expect": "durable"},
+    {"point": "shard.append", "nth": 2, "op": "put",
+     "expect": "absent"},
+    {"point": "rename.pre_meta", "nth": 1, "op": "put",
+     "expect": "absent"},
+    {"point": "rename.pre_meta", "nth": 3, "op": "put",
+     "expect": "maybe"},
+    {"point": "put.post_publish", "nth": 1, "op": "put",
+     "expect": "durable"},
+    {"point": "shard.create.pre_fsync", "nth": 2, "op": "mp_copy",
+     "expect": "absent"},
+    {"point": "shard.create.post_fsync", "nth": 2, "op": "mp_copy",
+     "expect": "absent"},
+    {"point": "mp.part.post_publish", "nth": 1, "op": "mp_part",
+     "expect": "absent"},
+    {"point": "mp.complete.publish", "nth": 2, "op": "mp",
+     "expect": "maybe"},
+    {"point": "mp.complete.post_publish", "nth": 1, "op": "mp",
+     "expect": "durable"},
+)
+
+BUCKET = "crashkit"
+N_DRIVES = 4
+PART_BIG = 5 * 1024 * 1024          # MIN_PART_SIZE: first multipart part
+READY_DEADLINE_S = 240.0
+
+
+class ScenarioError(AssertionError):
+    pass
+
+
+def free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _payload(seed: int, n: int) -> bytes:
+    return random.Random(seed).randbytes(n)
+
+
+def boot_server(base_dir: str, port: int, *, crash: str = "",
+                extra_env: dict | None = None) -> subprocess.Popen:
+    """One server subprocess over base_dir/d{1...N}.  The scanner is
+    off so the only writes through the instrumented drive paths are
+    the harness's own traffic (deterministic nth counting)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MTPU_SCANNER"] = "0"
+    env.pop("MTPU_CRASH", None)
+    if crash:
+        env["MTPU_CRASH"] = crash
+    if extra_env:
+        env.update(extra_env)
+    log = open(os.path.join(base_dir, "server.log"), "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "minio_tpu.server",
+         "--drives", f"{base_dir}/d{{1...{N_DRIVES}}}",
+         "--port", str(port)],
+        stdout=log, stderr=subprocess.STDOUT, env=env)
+
+
+def wait_ready(port: int, proc: subprocess.Popen,
+               deadline_s: float = READY_DEADLINE_S) -> bool:
+    url = f"http://127.0.0.1:{port}/minio/health/ready"
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return False
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if r.status == 200:
+                    return True
+        except Exception:  # noqa: BLE001 — keep polling
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def make_client(port: int):
+    from ..server.client import S3Client
+    return S3Client(f"http://127.0.0.1:{port}", "minioadmin",
+                    "minioadmin")
+
+
+def _retry(fn, attempts: int = 5, delay: float = 0.2):
+    last = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — fresh-boot transport
+            last = e
+            time.sleep(delay)
+    raise last
+
+
+def _get_or_absent(cli, key: str):
+    """GET -> bytes, or None when the object is not visible (NotFound
+    or a quorum-level read error — both count as 'absent'); a torn or
+    truncated body raises from the client's own checks."""
+    from ..server.client import S3ClientError
+    try:
+        return cli.get_object(BUCKET, key)
+    except S3ClientError:
+        return None
+
+
+def _victim(cli, op: str, data: bytes):
+    """Drive the victim operation; the armed crash point kills the
+    server mid-call, so any transport/S3 error here is expected."""
+    if op in ("put", "put_inline"):
+        cli.put_object(BUCKET, "victim", data)
+    elif op == "mp_part":
+        uid = cli.create_multipart(BUCKET, "victim")
+        cli.upload_part(BUCKET, "victim", uid, 1, data[:PART_BIG])
+    elif op == "mp_copy":
+        # UploadPartCopy is the wire path that hands the engine BYTES
+        # (uploaded part bodies stream), reaching the small-part fast
+        # path and its create_file crash points.
+        uid = cli.create_multipart(BUCKET, "victim")
+        cli.request("PUT", f"/{BUCKET}/victim",
+                    query={"uploadId": uid, "partNumber": "1"},
+                    headers={"x-amz-copy-source": f"/{BUCKET}/b-big"})
+    elif op == "mp":
+        uid = cli.create_multipart(BUCKET, "victim")
+        parts = [(1, cli.upload_part(BUCKET, "victim", uid, 1,
+                                     data[:PART_BIG])),
+                 (2, cli.upload_part(BUCKET, "victim", uid, 2,
+                                     data[PART_BIG:]))]
+        cli.complete_multipart(BUCKET, "victim", uid, parts)
+    else:
+        raise ValueError(f"unknown victim op {op!r}")
+
+
+def _victim_bytes(op: str, seed: int) -> bytes:
+    if op == "put_inline":
+        return _payload(seed, 8 * 1024)            # inline (< 128 KiB)
+    if op == "put":
+        return _payload(seed, 1 * 1024 * 1024)     # staged + published
+    return _payload(seed, PART_BIG + 64 * 1024)    # two multipart parts
+
+
+def tmp_residue(base_dir: str) -> list[str]:
+    """Entries still under any drive's tmp area (post-sweep: none)."""
+    left = []
+    for i in range(1, N_DRIVES + 1):
+        tmp = os.path.join(base_dir, f"d{i}", ".mtpu.sys", "tmp")
+        try:
+            left += [f"d{i}/{n}" for n in os.listdir(tmp)]
+        except FileNotFoundError:
+            pass
+    return left
+
+
+def run_scenario(sc: dict, base_dir: str, seed: int = 0) -> dict:
+    """Run one scenario over a FRESH base_dir; returns a result dict
+    (raises ScenarioError on contract violation)."""
+    os.makedirs(base_dir, exist_ok=True)
+    point, nth, op = sc["point"], sc["nth"], sc["op"]
+    expect = sc["expect"]
+    res = {"point": point, "nth": nth, "op": op, "expect": expect,
+           "seed": seed}
+    baseline = {
+        "b-inline": _payload(seed * 7 + 1, 8 * 1024),
+        "b-big": _payload(seed * 7 + 2, 1 * 1024 * 1024),
+    }
+    vbytes = _victim_bytes(op, seed * 7 + 3)
+
+    # -- boot A: acked baseline, then kill -9 -------------------------------
+    port = free_port()
+    proc = boot_server(base_dir, port)
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(f"{point}: boot A never became ready")
+        cli = make_client(port)
+        _retry(lambda: cli.make_bucket(BUCKET))
+        for key, val in baseline.items():
+            _retry(lambda k=key, v=val: cli.put_object(BUCKET, k, v))
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+    # -- boot B: armed crash point, victim op dies with the server ----------
+    port = free_port()
+    proc = boot_server(base_dir, port, crash=f"{point}:{nth}")
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(
+                f"{point}:{nth}: boot B died before the victim op "
+                f"(a boot-path write tripped the point)")
+        cli = make_client(port)
+        try:
+            _victim(cli, op, vbytes)
+            # A post-quorum point may let the reply out before _exit
+            # wins the race; the kill below still verifies the arm.
+        except Exception:  # noqa: BLE001 — expected: server died mid-op
+            pass
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    if proc.returncode != 137:
+        raise ScenarioError(
+            f"{point}:{nth}: boot B exit {proc.returncode}, wanted 137 "
+            f"(crash point never fired?)")
+
+    # -- boot C: recovery boot + assertions ---------------------------------
+    port = free_port()
+    proc = boot_server(base_dir, port)
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(f"{point}: recovery boot never ready")
+        left = tmp_residue(base_dir)
+        if left:
+            raise ScenarioError(
+                f"{point}: tmp not swept at boot: {left}")
+        cli = make_client(port)
+        for key, val in baseline.items():
+            got = _retry(lambda k=key: cli.get_object(BUCKET, k))
+            if got != val:
+                raise ScenarioError(
+                    f"{point}: acked {key} lost/corrupt after kill "
+                    f"({len(got)} vs {len(val)} bytes)")
+        got = _get_or_absent(cli, "victim")
+        res["victim_visible"] = got is not None
+        if got is not None and got != vbytes:
+            raise ScenarioError(
+                f"{point}: victim visible but TORN "
+                f"({len(got)} vs {len(vbytes)} bytes)")
+        if expect == "absent" and got is not None:
+            raise ScenarioError(
+                f"{point}: unacked victim visible pre-quorum")
+        if expect == "durable" and got is None:
+            raise ScenarioError(
+                f"{point}: quorum-committed victim lost")
+        # System stays writable: the victim key re-PUTs and verifies.
+        reput = _payload(seed * 7 + 4, 256 * 1024)
+        _retry(lambda: cli.put_object(BUCKET, "victim", reput))
+        if cli.get_object(BUCKET, "victim") != reput:
+            raise ScenarioError(f"{point}: re-PUT readback mismatch")
+        # Graceful exit: drain must complete and exit 0.
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        if proc.returncode != 0:
+            raise ScenarioError(
+                f"{point}: graceful exit returned {proc.returncode}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    res["ok"] = True
+    return res
+
+
+def run_matrix(scenarios=SCENARIOS, base_dir: str | None = None,
+               seed: int = 0, progress=None) -> list[dict]:
+    import tempfile
+    root = base_dir or tempfile.mkdtemp(prefix="mtpu-crash-")
+    results = []
+    for i, sc in enumerate(scenarios):
+        d = os.path.join(root, f"sc{i}-{sc['point'].replace('.', '_')}")
+        try:
+            r = run_scenario(sc, d, seed=seed)
+        except ScenarioError as e:
+            r = {**sc, "ok": False, "error": str(e)}
+        results.append(r)
+        if progress is not None:
+            mark = "ok" if r.get("ok") else f"FAIL: {r.get('error')}"
+            progress(f"[{i + 1}/{len(scenarios)}] "
+                     f"{sc['point']}:{sc['nth']} ({sc['op']}) {mark}")
+    return results

@@ -1,0 +1,115 @@
+"""Crash-point injection registry (MTPU_CRASH).
+
+The kill-9 durability harness needs the server to die at *named*
+points inside the durability-critical vertical — after the staged tmp
+bytes are written but before fsync, after j of n shard appends, after
+`rename_data` made the object visible but before the client got its
+200, mid-way through a multipart complete publish fan-out.  A plain
+SIGKILL from outside can't hit those windows deterministically, so the
+write path is instrumented with `crash_point("name")` calls and the
+environment arms them:
+
+    MTPU_CRASH=point            die on the first hit of `point`
+    MTPU_CRASH=point:3          die on the 3rd hit (process-wide count)
+    MTPU_CRASH=p1:2,p2          several points, first one reached wins
+
+Death is `os._exit` — no atexit, no finally blocks, no flushes — the
+closest a process can get to `kill -9` from the inside.  The exit
+status is 137 to read like a SIGKILL in harness logs.
+
+When nothing is armed (every normal boot), `crash_point` is a single
+falsy dict check — the hot path pays nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+# Canonical instrumented points, in write-path order.  The harness and
+# `tools/chaos_report.py --crash-matrix` enumerate this registry; keep
+# the docstrings next to the instrumentation honest.
+POINTS = (
+    # storage/drive.py — per-drive durability windows (use :nth for
+    # "after j of n drives" mid-fan-out kills; the hit counter is
+    # process-wide, so nth=j+1 dies after j drives finished the call)
+    "tmp.write.pre_fsync",       # _write_all: tmp bytes written, not fsynced
+    "tmp.write.post_fsync",      # _write_all: fsynced, before os.replace
+    "shard.create.pre_fsync",    # _create_file_impl: shard written, not synced
+    "shard.create.post_fsync",   # _create_file_impl: shard synced
+    "shard.append",              # _append_file_impl: one shard batch appended
+    "rename.pre_meta",           # rename_data: data dir moved, xl.meta not yet
+    "meta.update",               # write_metadata: before the xl.meta rewrite
+    # engine/erasure_set.py — quorum committed, client never told
+    "put.post_publish",          # PUT: rename_data quorum met, before reply
+    "put.inline.post_meta",      # inline PUT: xl.meta quorum met, before reply
+    # engine/multipart.py
+    "mp.part.post_publish",      # part PUT: part durable, before reply
+    "mp.complete.publish",       # complete: per-drive publish (use :nth)
+    "mp.complete.post_publish",  # complete: quorum met, before reply
+)
+
+_mu = threading.Lock()
+_armed: dict[str, int] = {}      # point -> remaining hits before death
+hits: dict[str, int] = {}        # point -> observed hit count (diagnostics)
+
+
+def _parse(spec: str) -> dict[str, int]:
+    armed: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, nth = part.partition(":")
+        if name not in POINTS:
+            # A typo'd point would arm nothing and the harness would
+            # wait forever for a death that can't come — die loudly at
+            # boot instead.
+            raise ValueError(
+                f"MTPU_CRASH: unknown crash point {name!r} "
+                f"(known: {', '.join(POINTS)})")
+        try:
+            n = int(nth) if nth else 1
+        except ValueError:
+            n = 1
+        armed[name] = max(1, n)
+    return armed
+
+
+def arm(spec: str) -> None:
+    """(Re)arm from a spec string — the env path and in-process tests."""
+    global _armed
+    with _mu:
+        _armed = _parse(spec)
+        hits.clear()
+
+
+def reset() -> None:
+    global _armed
+    with _mu:
+        _armed = {}
+        hits.clear()
+
+
+def crash_point(name: str) -> None:
+    """Die here if armed.  One falsy check when nothing is armed."""
+    if not _armed:
+        return
+    with _mu:
+        left = _armed.get(name)
+        if left is None:
+            return
+        hits[name] = hits.get(name, 0) + 1
+        if left > 1:
+            _armed[name] = left - 1
+            return
+    try:
+        os.write(2, f"MTPU_CRASH: dying at {name}\n".encode())
+    except OSError:
+        pass
+    os._exit(137)
+
+
+_spec = os.environ.get("MTPU_CRASH", "")
+if _spec:
+    arm(_spec)

@@ -30,6 +30,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+from time import monotonic as _now
 
 _MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
 
@@ -161,6 +162,20 @@ class LaneScheduler:
             raise s.error
         return s.result
 
+    def drain(self, timeout: float = 1.0) -> bool:
+        """Bounded wait for the lane set to empty (graceful shutdown):
+        every stream already has finalize_async pending or belongs to a
+        request the server drained, so this is normally instant.  A
+        stream that never finalizes only costs the timeout."""
+        deadline = _now() + timeout
+        with self._cv:
+            while self._streams:
+                left = deadline - _now()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=min(left, 0.1))
+        return True
+
     def abandon(self, s: _Stream) -> None:
         """Drop a stream without a digest (failed PUT)."""
         with self._cv:
@@ -289,6 +304,15 @@ def scheduler() -> LaneScheduler:
             if _SCHED is None:
                 _SCHED = LaneScheduler()
     return _SCHED
+
+
+def drain(timeout: float = 1.0) -> bool:
+    """Flush the process-wide scheduler if one exists (graceful drain
+    path); True when no streams remain.  Never instantiates lanes."""
+    s = _SCHED
+    if s is None:
+        return True
+    return s.drain(timeout)
 
 
 # -- one-shot helpers (the "rides the same plane" entries) -------------------

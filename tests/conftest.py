@@ -35,6 +35,12 @@ def pytest_configure(config):
         "markers",
         "chaos: seeded fault-injection tests (smoke subset runs in "
         "tier-1; the full soak matrix is also marked slow)")
+    config.addinivalue_line(
+        "markers",
+        "crash: kill-9 durability tests driving real server "
+        "subprocesses through MTPU_CRASH points (a one-point smoke "
+        "runs in tier-1; the full matrix is also marked slow — "
+        "select with -m 'crash and slow')")
 
 
 @pytest.fixture(params=["1", "0"], ids=["fastpath", "oracle"])

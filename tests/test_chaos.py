@@ -17,6 +17,9 @@ and asserts what no single-fault test can:
 A one-seed smoke runs in tier-1; the full seed matrix is `slow`.
 """
 
+import os
+import random
+
 import numpy as np
 import pytest
 
@@ -173,6 +176,100 @@ class TestChaosSmoke:
         drives[2].error_rate = 1.0              # m + 1
         with pytest.raises(StorageError):
             es.get_object("cb", "q")
+
+
+class TestTornRename:
+    """Torn rename_data: the fault lands BETWEEN the two halves of
+    publish — data dir moved into place, xl.meta never updated — the
+    exact on-disk state crash point rename.pre_meta leaves behind."""
+
+    def _set_with_torn_rename(self, tmp, seed=13):
+        """Drive 0 tears every rename_data (and ONLY rename_data — the
+        methods filter keeps the other write paths clean)."""
+        drives = [ChaosDrive(f"{tmp}/trd{i}", seed=seed * 101 + i,
+                             **({"methods": ("rename_data",),
+                                 "torn_rate": 1.0} if i == 0 else {}))
+                  for i in range(4)]
+        es = ErasureSet(drives, default_parity=2)
+        es.make_bucket("cb")
+        return es, drives
+
+    def test_orphan_data_dir_stays_invisible(self, tmp_path):
+        es, drives = self._set_with_torn_rename(str(tmp_path))
+        data = payload(300_000, seed=131)
+        es.put_object("cb", "t", data)          # quorums on drives 1-3
+        assert drives[0].injected["torn"] == 1
+        # Drive 0 on disk: the data dir arrived, xl.meta never did —
+        # an unreferenced orphan that must not serve.
+        obj_dir = os.path.join(drives[0].root, "cb", "t")
+        entries = os.listdir(obj_dir)
+        assert "xl.meta" not in entries and entries, entries
+        _, got = es.get_object("cb", "t")
+        assert bytes(got) == data
+        # Heal republishes the SAME data_dir and reclaims the orphan.
+        drives[0].chaos_off()
+        for _ in range(4):
+            rs = heal_mod.heal_object(es, "cb", "t", deep=True)
+            if all(not r.healed for r in rs):
+                break
+        r = heal_mod.heal_object(es, "cb", "t", deep=True)[0]
+        assert not r.healed and r.after == [heal_mod.DRIVE_OK] * 4
+        assert "xl.meta" in os.listdir(obj_dir)
+        _, got = es.get_object("cb", "t")
+        assert bytes(got) == data
+
+    def test_draw_sequence_is_seed_oracle(self, tmp_path):
+        """Determinism pin: the injected fault schedule is EXACTLY the
+        one a bare random.Random(seed) predicts — three unconditional
+        draws (slow, torn, err) per intercepted call.  This is what
+        makes a failing seed a reproducer, and it's the invariant that
+        adding rename_data to TORN_METHODS must not shift."""
+        seed, rate = 77, 0.25
+        d = ChaosDrive(f"{tmp_path}/oracle", seed=seed,
+                       error_rate=rate, torn_rate=rate,
+                       methods=("write_all",))
+        d.make_volume("v")
+        got = []
+        for i in range(50):
+            try:
+                d.write_all("v", f"f{i}", b"y" * 32)
+                got.append("ok")
+            except ErrChaosInjected as e:
+                got.append("torn" if "torn" in str(e) else "err")
+            except StorageError:
+                got.append("err")
+        oracle_rng = random.Random(seed)
+        want = []
+        for _ in range(50):
+            oracle_rng.random()                  # r_slow (rate 0)
+            r_torn = oracle_rng.random()
+            r_err = oracle_rng.random()
+            want.append("torn" if r_torn < rate
+                        else ("err" if r_err < rate else "ok"))
+        assert got == want
+        assert "torn" in got and "err" in got    # schedule non-trivial
+
+    def test_torn_rename_with_scripted_overwrite(self, tmp_path):
+        """Chaos + naughty compose: tear the publish of an OVERWRITE.
+        The previous version must keep serving byte-exact (the torn
+        republish displaced the old data dir on drive 0 only — below
+        read quorum, so the committed version still wins)."""
+        es, drives = self._set_with_torn_rename(str(tmp_path), seed=17)
+        drives[0].chaos_off()
+        v1 = payload(200_000, seed=171)
+        es.put_object("cb", "ow", v1)            # clean commit
+        drives[0].torn_rate = 1.0
+        v2 = payload(200_000, seed=172)
+        es.put_object("cb", "ow", v2)            # drive 0 tears; quorums
+        _, got = es.get_object("cb", "ow")
+        assert bytes(got) == v2                  # latest committed wins
+        drives[0].chaos_off()
+        for _ in range(4):
+            rs = heal_mod.heal_object(es, "cb", "ow", deep=True)
+            if all(not r.healed for r in rs):
+                break
+        _, got = es.get_object("cb", "ow")
+        assert bytes(got) == v2
 
 
 @pytest.mark.slow

@@ -18,6 +18,15 @@ Every fault is a pure function of (seed, call order) — a seed that
 prints a data-loss line is a deterministic reproducer, re-runnable
 under a debugger.  Exit status is non-zero if any invariant (exact
 bytes, heal convergence, rejected-stays-invisible) is violated.
+
+`--crash-matrix` switches to the kill-9 durability matrix instead:
+real server subprocesses are booted, SIGKILLed inside MTPU_CRASH
+points, and rebooted, and the per-scenario durability verdicts are
+rendered as a table (the same scenarios tests/test_crash.py runs):
+
+    $ python tools/chaos_report.py --crash-matrix
+    $ python tools/chaos_report.py --crash-matrix \\
+          --crash-points rename.pre_meta,mp.complete.publish
 """
 
 import argparse
@@ -147,6 +156,51 @@ def run_seed(seed: int, args, root: str) -> bool:
     return ok
 
 
+def run_crash_matrix(args) -> int:
+    """Kill-9 durability matrix: boot/kill/reboot real server
+    subprocesses through every armed crash point and render the
+    per-scenario verdicts."""
+    from minio_tpu.tools import crash_matrix as cm
+
+    scenarios = cm.SCENARIOS
+    if args.crash_points:
+        wanted = {p.strip() for p in args.crash_points.split(",")
+                  if p.strip()}
+        unknown = wanted - {s["point"] for s in cm.SCENARIOS}
+        if unknown:
+            print(f"unknown crash point(s): {', '.join(sorted(unknown))}")
+            return 2
+        scenarios = tuple(s for s in cm.SCENARIOS
+                          if s["point"] in wanted)
+    print(f"== kill-9 crash matrix :: seed {args.crash_seed}, "
+          f"{len(scenarios)} scenario(s) " + "=" * 24)
+    results = cm.run_matrix(scenarios, seed=args.crash_seed,
+                            progress=print)
+    print()
+    print(f'{"point":<26} {"nth":>3}  {"op":<10} {"expect":<8} '
+          f'{"victim":<10} result')
+    bad = 0
+    for r in results:
+        if r.get("ok"):
+            victim = ("visible" if r.get("victim_visible")
+                      else "invisible")
+            verdict = "ok"
+        else:
+            victim, verdict = "-", f"FAIL ({r.get('error', '?')})"
+            bad += 1
+        print(f'{r["point"]:<26} {r["nth"]:>3}  {r["op"]:<10} '
+              f'{r["expect"]:<8} {victim:<10} {verdict}')
+    print()
+    if bad:
+        print(f"{bad}/{len(results)} scenario(s) violated the "
+              f"durability contract")
+        return 1
+    print(f"all {len(results)} scenario(s) clean: acked writes "
+          f"survived every kill, no torn object ever served, tmp "
+          f"swept on every recovery boot")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="seeded chaos scenario report for minio_tpu")
@@ -160,7 +214,19 @@ def main(argv=None) -> int:
     ap.add_argument("--slow-rate", type=float, default=0.05)
     ap.add_argument("--torn-rate", type=float, default=0.04)
     ap.add_argument("--slow-s", type=float, default=0.002)
+    ap.add_argument("--crash-matrix", action="store_true",
+                    help="run the kill-9 durability matrix (real "
+                         "server subprocesses) instead of the "
+                         "in-process chaos storm")
+    ap.add_argument("--crash-seed", type=int, default=0,
+                    help="payload seed for --crash-matrix scenarios")
+    ap.add_argument("--crash-points", default="",
+                    help="comma-separated subset of crash points to "
+                         "run (default: the full matrix)")
     args = ap.parse_args(argv)
+
+    if args.crash_matrix:
+        return run_crash_matrix(args)
 
     seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
     failures = 0
