@@ -32,14 +32,28 @@ class LockLost(StorageError):
 class DRWMutex:
     def __init__(self, resource: str, lockers: list, *,
                  refresh_interval: float = 10.0,
+                 lease_duration: float | None = None,
                  loss_callback=None):
         self.resource = resource
         self.lockers = lockers
         self.refresh_interval = refresh_interval
+        # Lease contract (cf. drwmutex.go refresh + local-locker stale
+        # sweep): a holder that cannot REACH refresh quorum within
+        # lease_duration must consider the lock lost — by then a
+        # partitioned majority may have stale-swept its entry and
+        # granted the lock to someone else, so acking work under the
+        # old grant could conflict.  The default (2.5 intervals) keeps
+        # the lease safely under LocalLocker's 30s stale_after at the
+        # default 10s refresh: the holder gives up BEFORE the survivors
+        # hand out the resource.
+        self.lease_duration = (lease_duration
+                               if lease_duration is not None
+                               else refresh_interval * 2.5)
         self.loss_callback = loss_callback
         self.uid = uuid.uuid4().hex
         self._held: str | None = None          # "w" | "r" | None
         self._mode: str | None = None          # sticky: what we acquired
+        self._lease_ok_at = 0.0    # monotonic time of last quorum ack
         self._stop_refresh = threading.Event()
         self._refresh_thread: threading.Thread | None = None
 
@@ -91,6 +105,7 @@ class DRWMutex:
     def get_lock(self, timeout: float = 10.0) -> bool:
         if self._acquire("lock", "unlock", self.write_quorum, timeout):
             self._held = self._mode = "w"
+            self._lease_ok_at = time.monotonic()
             self._start_refresh()
             return True
         return False
@@ -98,9 +113,28 @@ class DRWMutex:
     def get_rlock(self, timeout: float = 10.0) -> bool:
         if self._acquire("rlock", "runlock", self.read_quorum, timeout):
             self._held = self._mode = "r"
+            self._lease_ok_at = time.monotonic()
             self._start_refresh()
             return True
         return False
+
+    # -- lease validity ------------------------------------------------------
+
+    def lease_expired(self) -> bool:
+        """Whether the holder's lease has run out: no refresh quorum ack
+        within lease_duration.  A partitioned holder whose refresh
+        rounds hang (black-holed lockers stall each round for the full
+        transport timeout) trips this even before the refresh loop
+        counts a failed round — the ack gate the operation checks
+        BEFORE acknowledging its result."""
+        return (self._held is not None
+                and time.monotonic() - self._lease_ok_at
+                > self.lease_duration)
+
+    def is_held(self) -> bool:
+        """Held AND lease-valid — the only state in which an operation
+        may ack work done under this lock."""
+        return self._held is not None and not self.lease_expired()
 
     # -- release -------------------------------------------------------------
 
@@ -130,11 +164,17 @@ class DRWMutex:
                             votes += 1
                     except Exception:  # noqa: BLE001
                         continue
-                if votes < quorum:
-                    self._held = None
-                    if self.loss_callback is not None:
-                        self.loss_callback(self.resource)
-                    return
+                if votes >= quorum and not self.lease_expired():
+                    # Quorum ack within the lease window: renew.  A
+                    # quorum that only arrived AFTER the lease ran out
+                    # does NOT resurrect it — the survivors may already
+                    # have stale-swept us and granted the lock onward.
+                    self._lease_ok_at = time.monotonic()
+                    continue
+                self._held = None
+                if self.loss_callback is not None:
+                    self.loss_callback(self.resource)
+                return
 
         self._refresh_thread = threading.Thread(target=loop, daemon=True)
         self._refresh_thread.start()

@@ -138,12 +138,19 @@ class NSLockMap:
         try:
             yield
         finally:
+            # Lease validity is sampled BEFORE unlock clears the held
+            # state: a partitioned holder whose refresh never reached
+            # quorum within the lease window must not ack, even if the
+            # loss callback hasn't fired yet (a black-holed refresh
+            # round can stall past the whole operation).
+            expired = dm.lease_expired()
             dm.unlock()
-        # The refresh loop lost quorum while the operation ran: another
-        # node may have acquired the lock mid-mutation, so the caller
-        # must treat the result as suspect (the reference cancels the op
-        # context via lockLossCallback, drwmutex.go:221).
-        if lost.is_set():
+        # The refresh loop lost quorum (or the lease ran out) while the
+        # operation ran: another node may have acquired the lock
+        # mid-mutation, so the caller must treat the result as suspect
+        # (the reference cancels the op context via lockLossCallback,
+        # drwmutex.go:221).
+        if lost.is_set() or expired:
             raise LockLost(f"{resource}: lock lost during operation")
 
     def write_locked(self, bucket: str, obj: str,

@@ -245,6 +245,16 @@ class DataPathStats:
             self.drains = 0
             self.drain_leftover = 0
             self.drain_s = 0.0
+            # Network plane (rpc/rest.py): peer online/offline flips by
+            # direction, idempotent-call retries, per-request deadline
+            # budget exhaustions, and chaos-injected transport faults by
+            # kind (MTPU_NETCHAOS).
+            self.peer_transitions = {"online": 0, "offline": 0}
+            self.rpc_retries = 0
+            self.rpc_deadline_exceeded = 0
+            self.netchaos_injected = {"slow": 0, "reset": 0,
+                                      "blackhole": 0, "truncate": 0,
+                                      "oneway": 0}
 
     def record_heal_batch(self, blocks: int, capacity: int,
                           source_bytes: int, out_bytes: int,
@@ -359,6 +369,23 @@ class DataPathStats:
             self.drain_leftover += leftover
             self.drain_s += seconds
 
+    def record_peer_transition(self, online: bool) -> None:
+        with self._mu:
+            self.peer_transitions["online" if online else "offline"] += 1
+
+    def record_rpc_retry(self) -> None:
+        with self._mu:
+            self.rpc_retries += 1
+
+    def record_rpc_deadline_exceeded(self) -> None:
+        with self._mu:
+            self.rpc_deadline_exceeded += 1
+
+    def record_netchaos(self, kind: str) -> None:
+        with self._mu:
+            if kind in self.netchaos_injected:
+                self.netchaos_injected[kind] += 1
+
     def snapshot(self) -> dict:
         with self._mu:
             return {
@@ -415,6 +442,10 @@ class DataPathStats:
                 "drains": self.drains,
                 "drain_leftover": self.drain_leftover,
                 "drain_seconds": self.drain_s,
+                "peer_transitions": dict(self.peer_transitions),
+                "rpc_retries": self.rpc_retries,
+                "rpc_deadline_exceeded": self.rpc_deadline_exceeded,
+                "netchaos_injected": dict(self.netchaos_injected),
             }
 
 
@@ -624,6 +655,39 @@ class MetricsRegistry:
                                   "Online drives")
         self.drive_offline = Gauge("mtpu_cluster_drives_offline",
                                    "Offline drives")
+        # Peer-liveness families (rpc/rest.py RPCClient accounting,
+        # cf. the reference's internode health checker): per-endpoint
+        # state/flap-count/staleness plus fleet-wide flip, retry,
+        # deadline-exhaustion and chaos-injection counters.
+        self.peer_state = Gauge(
+            "mtpu_peer_state",
+            "Peer RPC endpoint state: 1 online, 0 offline",
+            ("endpoint",))
+        self.peer_transitions = Gauge(
+            "mtpu_peer_transitions_total",
+            "Peer online/offline transitions", ("endpoint",))
+        self.peer_last_seen = Gauge(
+            "mtpu_peer_last_seen_seconds",
+            "Seconds since the peer last answered an RPC "
+            "(-1: never)", ("endpoint",))
+        self.peer_rpc_timeout = Gauge(
+            "mtpu_peer_rpc_timeout_seconds",
+            "Adaptive per-call RPC deadline for the peer",
+            ("endpoint",))
+        self.peer_flaps = Gauge(
+            "mtpu_peer_flaps_total",
+            "Peer state flips across all endpoints by direction",
+            ("state",))
+        self.rpc_retries = Gauge(
+            "mtpu_rpc_retries_total",
+            "Idempotent RPC retries after retryable transport faults")
+        self.rpc_deadline_exceeded = Gauge(
+            "mtpu_rpc_deadline_exceeded_total",
+            "RPCs aborted because the request deadline budget ran out")
+        self.netchaos_injected = Gauge(
+            "mtpu_netchaos_injected_total",
+            "Chaos-injected transport faults by kind (MTPU_NETCHAOS)",
+            ("kind",))
         # Disk-cache gauges (cf. getCacheMetrics, cmd/metrics-v2.go)
         self.cache_hits = Gauge("mtpu_cache_hits_total",
                                 "Disk cache hits")
@@ -704,6 +768,17 @@ class MetricsRegistry:
                     self.bucket_usage.set(u.bytes, bucket=bucket)
                     self.bucket_objects.set(u.objects, bucket=bucket)
 
+    def update_peers(self, clients) -> None:
+        """Refresh per-endpoint peer gauges from RPCClient liveness
+        (called on scrape with the cluster node's peer clients)."""
+        for cli in clients:
+            info = cli.peer_info()
+            ep = info["endpoint"]
+            self.peer_state.set(1 if info["online"] else 0, endpoint=ep)
+            self.peer_transitions.set(info["transitions"], endpoint=ep)
+            self.peer_last_seen.set(info["last_seen_ago_s"], endpoint=ep)
+            self.peer_rpc_timeout.set(info["timeout_s"], endpoint=ep)
+
     def _sync_datapath(self) -> None:
         snap = DATA_PATH.snapshot()
         self.heal_bytes.set(snap["heal_bytes"])
@@ -752,6 +827,12 @@ class MetricsRegistry:
         self.drains.set(snap["drains"])
         self.drain_leftover.set(snap["drain_leftover"])
         self.drain_seconds.set(snap["drain_seconds"])
+        for state, n in snap["peer_transitions"].items():
+            self.peer_flaps.set(n, state=state)
+        self.rpc_retries.set(snap["rpc_retries"])
+        self.rpc_deadline_exceeded.set(snap["rpc_deadline_exceeded"])
+        for kind, n in snap["netchaos_injected"].items():
+            self.netchaos_injected.set(n, kind=kind)
 
     def _sync_spans(self) -> None:
         # Imported lazily: span.py is the one observe module allowed to
@@ -805,7 +886,11 @@ class MetricsRegistry:
                   self.mrf_retries, self.recovery_sweeps,
                   self.recovery_tmp, self.recovery_mp_stage,
                   self.mrf_replayed, self.drains, self.drain_leftover,
-                  self.drain_seconds,
+                  self.drain_seconds, self.peer_state,
+                  self.peer_transitions, self.peer_last_seen,
+                  self.peer_rpc_timeout, self.peer_flaps,
+                  self.rpc_retries, self.rpc_deadline_exceeded,
+                  self.netchaos_injected,
                   self.trace_api_count, self.trace_api_errors,
                   self.trace_api_latency, self.trace_stage_ms,
                   self.trace_stage_count, self.trace_stage_hist,

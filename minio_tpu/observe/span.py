@@ -40,6 +40,20 @@ from .trace import PubSub
 
 _current: ContextVar = ContextVar("mtpu_span", default=None)
 
+#: Request-scoped vars other layers register (rpc.rest's deadline
+#: budget) so wrap_ctx carries them across pool hops alongside the span
+#: — fan-out workers run in their own contextvars context and would
+#: otherwise silently drop the caller's request scope.
+_CARRIED: list[ContextVar] = []
+
+
+def carry_var(var: ContextVar) -> None:
+    """Register a contextvar for cross-thread carry in wrap_ctx.  The
+    var's default must be None (None values are not re-set in the
+    worker, keeping the all-defaults path zero-cost)."""
+    if var not in _CARRIED:
+        _CARRIED.append(var)
+
 #: Counts every Span.__init__ — the tests' allocation sentinel proving
 #: the disabled path never materialises span objects.
 SPAN_ALLOCS = 0
@@ -377,22 +391,29 @@ def active() -> bool:
 
 
 def wrap_ctx(fn):
-    """Carry the current span across a thread-pool hop: returns fn
-    bound to the calling context's span, or fn unchanged when untraced
-    (the zero-cost default).  The span VALUE is re-set in the worker's
-    own context rather than via contextvars.copy_context().run — a
-    single Context object cannot be entered concurrently from the
-    many pool threads a fan-out uses."""
+    """Carry the current span — plus every carry_var-registered
+    request-scoped var (deadline budgets) — across a thread-pool hop:
+    returns fn bound to the calling context's values, or fn unchanged
+    when nothing is set (the zero-cost default).  Values are re-set in
+    the worker's own context rather than via
+    contextvars.copy_context().run — a single Context object cannot be
+    entered concurrently from the many pool threads a fan-out uses."""
     cur = _current.get()
-    if cur is None:
+    extras = [(v, v.get()) for v in _CARRIED]
+    if cur is None and all(val is None for _, val in extras):
         return fn
 
     def run(*a, **kw):
-        token = _current.set(cur)
+        tokens = [(v, v.set(val)) for v, val in extras
+                  if val is not None]
+        token = _current.set(cur) if cur is not None else None
         try:
             return fn(*a, **kw)
         finally:
-            _current.reset(token)
+            if token is not None:
+                _current.reset(token)
+            for v, tk in reversed(tokens):
+                v.reset(tk)
     return run
 
 

@@ -31,13 +31,17 @@ serves all inter-node planes on the main server port, routed by path.
 
 from __future__ import annotations
 
+import contextvars
 import errno
 import http.client
+import os
 import random
 import threading
 import time
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..cluster.dynamic_timeout import DynamicTimeout
 from ..storage import errors as se
 from ..utils import msgpackx
 
@@ -89,6 +93,171 @@ class NetworkError(Exception):
     def __init__(self, msg: str, *, retryable: bool = False):
         super().__init__(msg)
         self.retryable = retryable
+
+
+class DeadlineExceeded(NetworkError):
+    """The caller's request deadline budget ran out before (or while)
+    dialing the peer.  NOT a peer-health event: the peer may be fine —
+    the REQUEST is out of time — so the client never marks the endpoint
+    offline for it, and it is never retried."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, retryable=False)
+
+
+#: Absolute monotonic deadline for the current request, or None.  Set at
+#: the S3 front door from MTPU_RPC_DEADLINE_MS and consulted by every
+#: RPC the request fans out to: each hop gets min(per-call timeout,
+#: remaining budget), so one wedged peer can never eat more than the
+#: request's whole budget (the context-deadline propagation of the
+#: reference's storage REST calls).  Registered with observe.span's
+#: pool-hop carrier so erasure fan-out threads inherit it.
+_DEADLINE: contextvars.ContextVar[float | None] = contextvars.ContextVar(
+    "mtpu_rpc_deadline", default=None)
+
+
+def set_deadline(seconds: float):
+    """Arm a deadline `seconds` from now; returns the reset token."""
+    return _DEADLINE.set(time.monotonic() + seconds)
+
+
+def clear_deadline(token) -> None:
+    _DEADLINE.reset(token)
+
+
+def deadline_remaining() -> float | None:
+    """Seconds left in the current request's budget (may be <= 0), or
+    None when no deadline is armed."""
+    dl = _DEADLINE.get()
+    if dl is None:
+        return None
+    return dl - time.monotonic()
+
+
+def request_deadline_ms() -> float:
+    """The configured per-request RPC budget (MTPU_RPC_DEADLINE_MS), or
+    0 when unset/disabled."""
+    try:
+        return float(os.environ.get("MTPU_RPC_DEADLINE_MS", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+# Pool-hop propagation: erasure fan-outs run on worker threads, which
+# have their own contextvars context; span.wrap_ctx re-sets registered
+# vars in the worker so the deadline budget survives the hop.
+from ..observe.span import carry_var as _carry_var  # noqa: E402
+
+_carry_var(_DEADLINE)
+
+
+class ChaosTransport:
+    """Deterministic seeded RPC fault injector — ChaosDrive's network
+    sibling.  Wraps RPCClient._raw_call; every intercepted call draws
+    THREE uniforms from the seeded stream under a lock regardless of
+    which (if any) faults fire, so the fault schedule is a pure function
+    of (seed, call order) — changing a rate re-weights outcomes without
+    shifting any later call's draw.
+
+    Fault kinds (cf. the failure taxonomy of internal/rest's health
+    checker and the reference's network-partition testing):
+
+      slow       latency spike: the call proceeds after `slow_s`
+      reset      connection reset before the request is sent (the peer's
+                 kernel answered RST) — retryable, never executed
+      blackhole  SYN accepted, bytes never answered: holds for
+                 min(timeout, hold_s) then times out — retryable
+      truncate   mid-response truncation: the call EXECUTES on the peer,
+                 the response is lost — retryable transport error
+      oneway     one-way partition: request delivered (side effect
+                 happens), response dropped — the lost-ack case writes
+                 must survive
+
+    Enabled per-client via MTPU_NETCHAOS=<seed> (unset/0 = off, zero
+    overhead).  The per-client stream is seed ^ crc32(endpoint) so each
+    peer link gets an independent but reproducible schedule."""
+
+    KINDS = ("slow", "reset", "blackhole", "truncate", "oneway")
+
+    def __init__(self, seed: int, endpoint: str = "", *,
+                 slow_rate: float | None = None,
+                 reset_rate: float | None = None,
+                 blackhole_rate: float | None = None,
+                 truncate_rate: float | None = None,
+                 oneway_rate: float | None = None,
+                 slow_s: float | None = None,
+                 hold_s: float | None = None):
+        def env(name: str, val, default: float) -> float:
+            if val is not None:
+                return float(val)
+            try:
+                return float(os.environ.get(name, "") or default)
+            except ValueError:
+                return default
+        self.seed = seed
+        self.endpoint = endpoint
+        self.slow_rate = env("MTPU_NETCHAOS_SLOW_RATE", slow_rate, 0.05)
+        self.reset_rate = env("MTPU_NETCHAOS_RESET_RATE", reset_rate, 0.04)
+        self.blackhole_rate = env("MTPU_NETCHAOS_BLACKHOLE_RATE",
+                                  blackhole_rate, 0.02)
+        self.truncate_rate = env("MTPU_NETCHAOS_TRUNCATE_RATE",
+                                 truncate_rate, 0.03)
+        self.oneway_rate = env("MTPU_NETCHAOS_ONEWAY_RATE",
+                               oneway_rate, 0.03)
+        self.slow_s = env("MTPU_NETCHAOS_SLOW_S", slow_s, 0.02)
+        self.hold_s = env("MTPU_NETCHAOS_HOLD_S", hold_s, 0.4)
+        self._rng = random.Random(seed ^ zlib.crc32(endpoint.encode()))
+        self._mu = threading.Lock()
+        self.calls = 0
+        self.injected = {k: 0 for k in self.KINDS}
+        #: (call index, kind) for every injected fault — the
+        #: byte-reproducible schedule tests pin against the seed.
+        self.schedule: list[tuple[int, str]] = []
+
+    def draw(self) -> str | None:
+        """One intercepted call -> fault kind or None.  The three draws
+        happen unconditionally, in a fixed order, under the lock."""
+        with self._mu:
+            idx = self.calls
+            self.calls += 1
+            r_slow = self._rng.random()
+            r_err = self._rng.random()
+            r_kind = self._rng.random()
+            kind = None
+            total = (self.reset_rate + self.blackhole_rate
+                     + self.truncate_rate + self.oneway_rate)
+            if total > 0 and r_err < total:
+                # r_kind picks within the error band so the kind mix
+                # follows the configured rates.
+                pick = r_kind * total
+                for k, rate in (("reset", self.reset_rate),
+                                ("blackhole", self.blackhole_rate),
+                                ("truncate", self.truncate_rate),
+                                ("oneway", self.oneway_rate)):
+                    if pick < rate:
+                        kind = k
+                        break
+                    pick -= rate
+                else:
+                    kind = "oneway"
+            elif r_slow < self.slow_rate:
+                kind = "slow"
+            if kind is not None:
+                self.injected[kind] += 1
+                self.schedule.append((idx, kind))
+            return kind
+
+    def chaos_off(self) -> None:
+        self.slow_rate = self.reset_rate = 0.0
+        self.blackhole_rate = self.truncate_rate = self.oneway_rate = 0.0
+
+
+def chaos_seed() -> int:
+    """The active MTPU_NETCHAOS seed, or 0 when network chaos is off."""
+    try:
+        return int(os.environ.get("MTPU_NETCHAOS", "0") or 0)
+    except ValueError:
+        return 0
 
 
 class RPCVersionMismatch(Exception):
@@ -264,6 +433,24 @@ class RPCClient:
         self._checker_running = False
         self._lock = threading.Lock()
         self._closed = False
+        # Measured per-peer latency feeds an adaptive per-call deadline
+        # (cluster/dynamic_timeout.py): a consistently fast peer shrinks
+        # the budget so a wedged socket fails in ~2x its real latency,
+        # a slow WAN link grows it instead of flapping.  Bounded to
+        # [min(1, timeout), 4*timeout] around the configured default.
+        self.dyn_timeout = DynamicTimeout(
+            default_s=timeout, minimum_s=min(1.0, timeout),
+            maximum_s=timeout * 4)
+        # Peer-liveness accounting exported via mtpu_peer_* gauges and
+        # admin-info: online/offline flips, monotonic last-answer stamp,
+        # and consecutive failed reconnect probes.
+        self.transitions = 0
+        self.last_seen = 0.0
+        self.offline_since = 0.0
+        self.probe_failures = 0
+        seed = chaos_seed()
+        self.chaos: ChaosTransport | None = (
+            ChaosTransport(seed, endpoint) if seed else None)
 
     # -- health --------------------------------------------------------------
 
@@ -271,29 +458,85 @@ class RPCClient:
         return self._online
 
     def _mark_offline(self) -> None:
+        flipped = False
         with self._lock:
             if self._online:
                 self._online = False
+                self.transitions += 1
+                self.offline_since = time.monotonic()
+                flipped = True
             if not self._checker_running and not self._closed:
                 self._checker_running = True
                 threading.Thread(target=self._health_loop,
                                  daemon=True).start()
+        if flipped:
+            from ..observe.metrics import DATA_PATH
+            DATA_PATH.record_peer_transition(False)
+
+    def _mark_online(self) -> None:
+        with self._lock:
+            if self._online:
+                return
+            self._online = True
+            self._checker_running = False
+            self.transitions += 1
+            self.offline_since = 0.0
+        self.probe_failures = 0
+        from ..observe.metrics import DATA_PATH
+        DATA_PATH.record_peer_transition(True)
 
     def _health_loop(self) -> None:
-        # Jittered probe interval: when a node dies, every peer's client
-        # marks it offline within one quorum round — un-jittered probes
-        # would then hit the rebooting node in lockstep forever.
+        # Capped exponential backoff with jitter: a freshly dead peer is
+        # probed quickly (first retry ~check_interval), a long-dead one
+        # at most every MTPU_PEER_PROBE_MAX_S — and never in lockstep
+        # with the other survivors' probes (when a node dies, every
+        # peer's client marks it offline within one quorum round; a
+        # constant un-jittered interval would produce a reconnect storm
+        # against the rebooting node forever).
+        try:
+            max_s = float(os.environ.get("MTPU_PEER_PROBE_MAX_S",
+                                         "15") or 15)
+        except ValueError:
+            max_s = 15.0
+        attempt = 0
         while not self._closed:
-            time.sleep(self.check_interval *
-                       (0.5 + random.random()))
+            delay = min(self.check_interval * (2 ** attempt), max_s)
+            time.sleep(delay * (0.5 + random.random()))
             try:
                 self._raw_call(HEALTH_METHOD, {}, timeout=2.0)
-                with self._lock:
-                    self._online = True
-                    self._checker_running = False
-                return
             except (NetworkError, se.StorageError):
+                attempt += 1
+                self.probe_failures = attempt
                 continue
+            self._mark_online()
+            return
+        with self._lock:
+            self._checker_running = False
+
+    def probe_now(self) -> bool:
+        """Synchronous health probe (tests/admin/harness): flips the
+        endpoint online when the peer answers.  Returns whether it did."""
+        try:
+            self._raw_call(HEALTH_METHOD, {}, timeout=2.0)
+        except (NetworkError, se.StorageError):
+            return False
+        self._mark_online()
+        return True
+
+    def peer_info(self) -> dict:
+        """Liveness row for admin-info and the mtpu_peer_* gauges."""
+        now = time.monotonic()
+        return {
+            "endpoint": f"{self.host}:{self.port}",
+            "online": self._online,
+            "transitions": self.transitions,
+            "last_seen_ago_s": (round(now - self.last_seen, 3)
+                                if self.last_seen else -1.0),
+            "offline_for_s": (round(now - self.offline_since, 3)
+                              if self.offline_since else 0.0),
+            "probe_failures": self.probe_failures,
+            "timeout_s": round(self.dyn_timeout.timeout(), 3),
+        }
 
     def close(self) -> None:
         self._closed = True
@@ -308,13 +551,44 @@ class RPCClient:
     def _raw_call(self, method: str, payload: dict,
                   timeout: float | None = None) -> object:
         body = msgpackx.packb(payload)
+        me = f"{self.host}:{self.port} {method}"
+        # Chaos draw FIRST (before the deadline gate) so the fault
+        # schedule stays a pure function of (seed, call order) even when
+        # deadline budgets vary between runs.
+        fault = self.chaos.draw() if self.chaos is not None else None
+        if fault is not None:
+            from ..observe.metrics import DATA_PATH
+            DATA_PATH.record_netchaos(fault)
+        if fault == "slow":
+            time.sleep(self.chaos.slow_s)
+        elif fault == "reset":
+            raise NetworkError(f"{me}: connection reset (chaos)",
+                               retryable=True)
+        # Effective per-call timeout: explicit (health probes) wins,
+        # else the peer's measured adaptive deadline — both clamped to
+        # the request's remaining deadline budget.
+        eff = timeout if timeout is not None else self.dyn_timeout.timeout()
+        rem = deadline_remaining()
+        if rem is not None:
+            if rem <= 0:
+                from ..observe.metrics import DATA_PATH
+                DATA_PATH.record_rpc_deadline_exceeded()
+                raise DeadlineExceeded(f"{me}: request deadline exhausted")
+            eff = min(eff, rem)
+        if fault == "blackhole":
+            # SYN accepted, bytes never answered: hold until the caller's
+            # timeout would fire (bounded by hold_s for test speed).
+            time.sleep(min(eff, self.chaos.hold_s))
+            raise NetworkError(f"{me}: timed out (chaos black-hole)",
+                               retryable=True)
         if self.tls_context is not None:
             conn = http.client.HTTPSConnection(
-                self.host, self.port, timeout=timeout or self.timeout,
+                self.host, self.port, timeout=eff,
                 context=self.tls_context)
         else:
             conn = http.client.HTTPConnection(
-                self.host, self.port, timeout=timeout or self.timeout)
+                self.host, self.port, timeout=eff)
+        t0 = time.monotonic()
         try:
             conn.request("POST", self._path_for(method), body=body,
                          headers={"Authorization": f"Bearer {self.token}",
@@ -322,10 +596,27 @@ class RPCClient:
             resp = conn.getresponse()
             data = resp.read()
         except (OSError, http.client.HTTPException) as e:
-            raise NetworkError(f"{self.host}:{self.port} {method}: {e}",
+            if isinstance(e, TimeoutError):
+                # Only true timeouts grow the adaptive deadline —
+                # refused/reset connections fail fast and say nothing
+                # about how long a healthy call takes.
+                self.dyn_timeout.log_timeout()
+            raise NetworkError(f"{me}: {e}",
                                retryable=_is_retryable(e)) from None
         finally:
             conn.close()
+        self.dyn_timeout.log_success(time.monotonic() - t0)
+        self.last_seen = time.monotonic()
+        if fault == "truncate":
+            raise NetworkError(f"{me}: response truncated mid-body "
+                               f"(chaos)", retryable=True)
+        if fault == "oneway":
+            # The request REACHED the peer (its side effect happened);
+            # only the response is lost — the caller cannot tell this
+            # from a lost request, which is exactly why writes never
+            # retry at this layer.
+            raise NetworkError(f"{me}: response dropped (chaos one-way "
+                               f"partition)", retryable=True)
         if resp.status != 200:
             raise unpack_error(data)
         return msgpackx.unpackb(data) if data else None
@@ -353,8 +644,14 @@ class RPCClient:
         for i in range(attempts):
             try:
                 return self._raw_call(method, payload or {})
+            except DeadlineExceeded:
+                # Out of REQUEST budget, not a peer fault: never retried
+                # (there is no time left) and never a health event.
+                raise
             except NetworkError as e:
                 if e.retryable and i + 1 < attempts:
+                    from ..observe.metrics import DATA_PATH
+                    DATA_PATH.record_rpc_retry()
                     time.sleep(0.05 * (2 ** i) *
                                (1.0 + 0.5 * random.random()))
                     continue

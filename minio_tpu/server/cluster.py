@@ -175,7 +175,18 @@ class ClusterNode:
 
     def build_drives(self) -> list:
         """The global drive list: LocalDrive for mine, RemoteDrive for
-        every other node's, in endpoint order."""
+        every other node's, in endpoint order.
+
+        Remote drives get their own client-side HealthWrappedDrive
+        breaker (the reference health-checks its storage REST clients
+        the same way, cmd/storage-rest-client.go): a partitioned peer
+        trips OK->SUSPECT->OFFLINE HERE, so reads fan out to parity
+        spares and writes feed the MRF queue without every request
+        first eating a transport timeout.  The wrapper's __class__
+        spoof keeps isinstance gates honest — a wrapped RemoteDrive
+        still reports as RemoteDrive, so local-only fast paths (serial
+        fan-out, mmap views) stay off."""
+        from ..storage.health_wrap import HealthWrappedDrive
         out = []
         local_iter = iter(self.local_drives)
         for ep in self.endpoints:
@@ -184,8 +195,17 @@ class ClusterNode:
             else:
                 cli = self.peer_clients[ep.node]
                 idx = self.node_locals[ep.node].index(ep)
-                out.append(RemoteDrive(cli, idx, path=repr(ep)))
+                out.append(HealthWrappedDrive(
+                    RemoteDrive(cli, idx, path=repr(ep))))
         return out
+
+    # -- liveness ------------------------------------------------------------
+
+    def peer_info(self) -> list[dict]:
+        """Per-peer liveness rows (admin-info "peers" section and the
+        mtpu_peer_* gauges): endpoint, online/offline, transition count,
+        last-answer staleness, adaptive RPC deadline."""
+        return [cli.peer_info() for cli in self.peer_clients.values()]
 
     # -- format phase --------------------------------------------------------
 
@@ -339,6 +359,9 @@ def boot_cluster_node(endpoint_args: list[str], my_host: str,
     node = ClusterNode(endpoint_args, my_host, my_port, creds,
                        set_drive_count, certs_dir=certs_dir)
     server = server_factory(node)
+    # Admin-info and /metrics surface peer liveness through this back
+    # reference (peers aren't reachable from the pools object).
+    server.cluster_node = node
     try:
         drives = node.build_drives()
         fmt = node.wait_format(drives, timeout=timeout)

@@ -124,6 +124,9 @@ class S3Server:
         # S3 requests get 503 ServerNotInitialized until
         # bind_object_layer() installs the engine.
         self.rpc_router = rpc_router
+        # Cluster back-reference (set by boot_cluster_node): admin-info
+        # and /metrics read per-peer liveness through it.
+        self.cluster_node = None
         self._handler_opts = dict(notify=notify, replication=replication,
                                   scanner=scanner, kms=kms,
                                   compress_enabled=compress_enabled,
@@ -308,6 +311,15 @@ class S3Server:
                     return
                 t0 = _time.perf_counter()
                 outer.metrics.inflight.inc(1)
+                # Per-request deadline budget (MTPU_RPC_DEADLINE_MS):
+                # armed here, consumed by every storage/lock RPC this
+                # request fans out to (rest.py clamps each hop's
+                # timeout to the remaining budget; span.wrap_ctx
+                # carries it across pool threads).
+                from ..rpc import rest as _rest
+                _dl_ms = _rest.request_deadline_ms()
+                _dl_token = (_rest.set_deadline(_dl_ms / 1000.0)
+                             if _dl_ms > 0 else None)
                 # Root span: one per request, open through dispatch AND
                 # the response write (a streamed GET does its engine
                 # reads inside _respond). NOOP unless someone is
@@ -362,6 +374,8 @@ class S3Server:
                         path, self.request_id)
                     self.close_connection = True
                 finally:
+                    if _dl_token is not None:
+                        _rest.clear_deadline(_dl_token)
                     outer.metrics.inflight.inc(-1)
                 # Site replication: successful BUCKET-level mutations
                 # (create/delete/config) fan out like IAM ones —
@@ -1120,8 +1134,14 @@ class S3Server:
                                 "transitions": hi.get("transitions", []),
                             }
                         drives.append(row)
+            # Per-peer liveness (cluster deployments): online/offline,
+            # flap count, last-answer staleness, adaptive RPC deadline
+            # — the madmin per-server state rows' analogue.
+            peers = (self.cluster_node.peer_info()
+                     if self.cluster_node is not None else [])
             return j({
                 "mode": "online" if ok else "degraded",
+                "peers": peers,
                 "deploymentID": self.pools.deployment_id,
                 "buckets": {"count": n_buckets},
                 "objects": {"count": n_objects},
@@ -1764,6 +1784,9 @@ class S3Server:
                             {"Content-Type": "application/json"})
         if path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node"):
             self.metrics.update_cluster(self.pools, self.scanner)
+            if self.cluster_node is not None:
+                self.metrics.update_peers(
+                    self.cluster_node.peer_clients.values())
             return Response(200, self.metrics.render().encode(),
                             {"Content-Type": "text/plain; version=0.0.4"})
         raise S3Error("MethodNotAllowed")

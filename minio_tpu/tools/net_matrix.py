@@ -1,0 +1,402 @@
+"""Partition/node-kill matrix: a real multi-node cluster under the
+chaos proxy, scenario by scenario.
+
+The network sibling of tools/crash_matrix.py: where that harness proves
+acked writes survive kill -9 of the PROCESS, this one proves they
+survive the NETWORK — node kill, one-way and two-way partitions,
+black-holes, reset storms and slow peers, each injected mid-PUT,
+mid-GET and mid-heal.
+
+Topology: N nodes x M drives (default 3x2) booted IN PROCESS on
+loopback — every node a full ClusterNode + S3Server serving its RPC
+planes, exactly the production boot path (format quorum, bootstrap
+verify, dsync lockers, MRF queues).  After boot, every peer link is
+rewired through a per-(src,dst) ChaosTCPProxy, so faults are injected
+per DIRECTED edge: a one-way partition is one edge black-holed, a node
+kill is every edge toward the victim refusing connections — the
+network-level truth of a dead host, without the minutes-long cost of
+real subprocess boots (tools/crash_matrix.py owns real process death).
+
+Default EC layout for 3x2: set size 6, parity n//2 = 3, so write quorum
+is 4 (k==m adds one) and reads need k=3 shards — one dead node (2
+drives) leaves exactly 4: writes still ack (the 2 missing shards feed
+the MRF journal) and reads stay available; two dead nodes cleanly
+reject.
+
+Invariants asserted per scenario (the acceptance bar of the ISSUE):
+  - zero acked-write loss: every acknowledged PUT reads back byte-exact
+    after the partition heals
+  - no torn reads: a GET under a single-node fault returns exact bytes
+  - rejected writes stay invisible
+  - heal converges in bounded passes after calm weather returns
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from ..cluster.dynamic_timeout import DynamicTimeout
+from ..engine import heal as heal_mod
+from ..storage.errors import StorageError
+from .netchaos import ChaosTCPProxy
+
+FAULT_KINDS = ("kill", "blackhole", "twoway", "oneway", "reset", "slow")
+PHASES = ("put", "get", "heal")
+
+#: kind -> victim node (never 0: node 0 is the driving coordinator).
+_TARGETS = {"kill": 1, "blackhole": 2, "twoway": 1,
+            "oneway": 1, "reset": 2, "slow": 2}
+
+SCENARIOS = tuple(
+    {"name": f"{kind}-mid-{phase}", "fault": kind,
+     "target": _TARGETS[kind], "phase": phase}
+    for kind in FAULT_KINDS for phase in PHASES)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def payload(size: int, seed: int) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+class NetCluster:
+    """A booted in-process cluster with every peer edge proxied."""
+
+    def __init__(self, nodes, servers, pools, ports, proxies):
+        self.nodes = nodes
+        self.servers = servers
+        self.pools = pools              # per-node ServerPools
+        self.ports = ports
+        self.proxies = proxies          # (src, dst) -> ChaosTCPProxy
+        self.n = len(nodes)
+
+    # -- fault controls (all by DIRECTED edge) -------------------------------
+
+    def edges_to(self, dst: int):
+        return [self.proxies[(s, dst)] for s in range(self.n) if s != dst]
+
+    def kill_node(self, i: int) -> None:
+        """Every edge toward i refuses connections — the victim's host
+        looks dead (RST on SYN), though its process still runs."""
+        for p in self.edges_to(i):
+            p.set_down(True)
+
+    def isolate_node(self, i: int, mode: str = "blackhole") -> None:
+        """Full isolation: every edge to AND from i black-holes."""
+        for s in range(self.n):
+            if s == i:
+                continue
+            self.proxies[(s, i)].set_mode(mode)
+            self.proxies[(i, s)].set_mode(mode)
+
+    def partition(self, a: int, b: int, oneway: bool = False) -> None:
+        """Cut the a<->b pair (or just a->b responses with oneway)."""
+        if oneway:
+            # requests still EXECUTE on b; only responses die — the
+            # lost-ack shape (proxy relays the request upstream and
+            # drops the answer).
+            self.proxies[(a, b)].oneway_rate = 1.0
+        else:
+            self.proxies[(a, b)].set_mode("blackhole")
+            self.proxies[(b, a)].set_mode("blackhole")
+
+    def reset_storm(self, i: int, rate: float = 0.6) -> None:
+        for p in self.edges_to(i):
+            p.reset_rate = rate
+
+    def slow_peer(self, i: int, slow_s: float = 0.25) -> None:
+        for p in self.edges_to(i):
+            p.slow_rate = 1.0
+            p.slow_s = slow_s
+
+    def heal_network(self) -> None:
+        for p in self.proxies.values():
+            p.heal()
+
+    # -- recovery ------------------------------------------------------------
+
+    def recover(self, timeout: float = 20.0) -> None:
+        """Calm-weather convergence: flip RPC clients back online and
+        close every remote-drive breaker circuit (the background
+        probers would do both on their own jittered schedules; tests
+        want it bounded)."""
+        deadline = time.monotonic() + timeout
+        for node in self.nodes:
+            for cli in node.peer_clients.values():
+                while not cli.is_online() and \
+                        time.monotonic() < deadline:
+                    if cli.probe_now():
+                        break
+                    time.sleep(0.1)
+        for pools in self.pools:
+            for pool in pools.pools:
+                for es in pool.sets:
+                    for d in es.drives:
+                        if d is None or not hasattr(d, "probe_now"):
+                            continue
+                        while d.health_state() != "ok" and \
+                                time.monotonic() < deadline:
+                            if d.probe_now():
+                                break
+                            time.sleep(0.1)
+
+    def close(self) -> None:
+        for srv, node in zip(self.servers, self.nodes):
+            try:
+                srv.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            if getattr(srv, "scanner", None) is not None:
+                srv.scanner.stop()
+            node.close()
+        for p in self.proxies.values():
+            p.stop()
+
+
+def boot_proxied_cluster(root: str, *, n_nodes: int = 3,
+                         drives_per_node: int = 2, seed: int = 0,
+                         timeout: float = 120.0,
+                         rpc_timeout: float = 2.0) -> NetCluster:
+    """Boot n_nodes in-process cluster nodes (threads), then rewire
+    every peer RPC client through a per-edge chaos proxy.  Boot runs on
+    the CLEAN network; proxies start in pass-through."""
+    from ..server.cluster import boot_cluster_node
+    from ..server.server import S3Server
+    from ..server.sigv4 import Credentials
+
+    creds = Credentials("minioadmin", "minioadmin")
+    ports = [free_port() for _ in range(n_nodes)]
+    args = [f"http://127.0.0.1:{ports[i]}{root}/n{i}d"
+            f"{{1...{drives_per_node}}}" for i in range(n_nodes)]
+    results: list = [None] * n_nodes
+    errs: list = [None] * n_nodes
+
+    def boot(i: int) -> None:
+        def factory(node):
+            return S3Server(None, creds, host="127.0.0.1",
+                            port=ports[i],
+                            rpc_router=node.router).start()
+        try:
+            results[i] = boot_cluster_node(
+                args, "127.0.0.1", ports[i], creds,
+                server_factory=factory, timeout=timeout)
+        except Exception as e:  # noqa: BLE001
+            errs[i] = e
+
+    threads = [threading.Thread(target=boot, args=(i,), daemon=True)
+               for i in range(n_nodes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout + 30)
+    if any(errs) or any(r is None for r in results):
+        for r in results:
+            if r is not None:
+                r[1].shutdown()
+                r[0].close()
+        raise RuntimeError(f"cluster boot failed: {errs}")
+    nodes = [r[0] for r in results]
+    servers = [r[1] for r in results]
+    pools = [r[2] for r in results]
+
+    proxies: dict[tuple[int, int], ChaosTCPProxy] = {}
+    for s in range(n_nodes):
+        for d in range(n_nodes):
+            if s == d:
+                continue
+            px = ChaosTCPProxy("127.0.0.1", ports[d],
+                               seed=seed * 1000 + s * 16 + d).start()
+            proxies[(s, d)] = px
+            cli = nodes[s].peer_clients[("127.0.0.1", ports[d])]
+            cli.host, cli.port = "127.0.0.1", px.port
+            # Matrix-friendly transport budget: a black-holed peer must
+            # cost seconds, not the production 10s default, per call.
+            cli.timeout = rpc_timeout
+            cli.dyn_timeout = DynamicTimeout(
+                default_s=rpc_timeout, minimum_s=0.5,
+                maximum_s=rpc_timeout * 4)
+    return NetCluster(nodes, servers, pools, ports, proxies)
+
+
+def _apply_fault(nc: NetCluster, kind: str, target: int) -> None:
+    if kind == "kill":
+        nc.kill_node(target)
+    elif kind == "blackhole":
+        nc.isolate_node(target, "blackhole")
+    elif kind == "twoway":
+        nc.partition(0, target)
+    elif kind == "oneway":
+        nc.partition(0, target, oneway=True)
+    elif kind == "reset":
+        nc.reset_storm(target)
+    elif kind == "slow":
+        nc.slow_peer(target)
+    else:
+        raise ValueError(f"unknown fault kind {kind!r}")
+
+
+def _converge_heal(es, bucket: str, names, errors: list,
+                   max_passes: int = 12) -> int:
+    worst = 0
+    for name in names:
+        for passes in range(1, max_passes + 1):
+            try:
+                rs = heal_mod.heal_object(es, bucket, name, deep=True)
+            except StorageError as e:
+                errors.append(f"heal {name} raised post-recovery: {e}")
+                break
+            if all(not r.healed for r in rs):
+                break
+        else:
+            errors.append(f"heal did not converge for {name}")
+            passes = max_passes
+        worst = max(worst, passes)
+    return worst
+
+
+def _run_scenario(nc: NetCluster, sc: dict, idx: int,
+                  seed: int) -> dict:
+    name, kind = sc["name"], sc["fault"]
+    target, phase = sc["target"], sc["phase"]
+    bucket = f"m{idx}"
+    p0 = nc.pools[0]
+    es = nc.pools[0].pools[0].sets[0]
+    errors: list[str] = []
+    t0 = time.monotonic()
+
+    p0.make_bucket(bucket)
+    rng = np.random.default_rng(seed * 7919 + idx)
+    baseline: dict[str, bytes] = {}
+    for i in range(3):
+        data = payload(int(rng.integers(40_000, 160_000)),
+                       seed * 1000 + idx * 10 + i)
+        p0.put_object(bucket, f"base{i}", data)
+        baseline[f"base{i}"] = data
+    acked = dict(baseline)
+    rejected: list[str] = []
+    gets_ok = 0
+
+    if phase == "put":
+        _apply_fault(nc, kind, target)
+        for i in range(4):
+            data = payload(int(rng.integers(40_000, 160_000)),
+                           seed * 1000 + idx * 10 + 5 + i)
+            try:
+                p0.put_object(bucket, f"w{i}", data)
+                acked[f"w{i}"] = data
+            except StorageError:
+                rejected.append(f"w{i}")
+        if not any(k.startswith("w") for k in acked):
+            # One faulted node of three leaves write quorum intact —
+            # every mid-fault PUT rejecting means availability is lost.
+            errors.append(f"no PUT acked under single-node {kind}")
+    elif phase == "get":
+        _apply_fault(nc, kind, target)
+        for obj, data in baseline.items():
+            got = None
+            for attempt in (0, 1):
+                # One retry: the first GET may BE the discovery call
+                # that trips the dead peer's breaker.
+                try:
+                    _, got = p0.get_object(bucket, obj)
+                    break
+                except StorageError as e:
+                    if attempt:
+                        errors.append(
+                            f"GET {obj} unavailable with k shards on "
+                            f"live nodes ({kind}): {e}")
+            if got is None:
+                continue
+            if bytes(got) != data:
+                errors.append(f"torn read {obj} under {kind}")
+            else:
+                gets_ok += 1
+    elif phase == "heal":
+        # Shard damage on the coordinator's own first drive, then the
+        # heal sweep runs INTO the network fault.
+        root0 = nc.nodes[0].local_drives[0].root
+        for obj in baseline:
+            shutil.rmtree(os.path.join(root0, bucket, obj),
+                          ignore_errors=True)
+        _apply_fault(nc, kind, target)
+        for obj in baseline:
+            try:
+                heal_mod.heal_object(es, bucket, obj, deep=True)
+            except StorageError:
+                pass     # heal under partition may fail; it must
+                         # CONVERGE after calm weather, asserted below
+    else:
+        raise ValueError(f"unknown phase {phase!r}")
+
+    # -- calm weather: everything must converge -------------------------
+    nc.heal_network()
+    nc.recover()
+    heal_passes = _converge_heal(es, bucket, sorted(acked), errors)
+    for obj, data in sorted(acked.items()):
+        try:
+            _, got = p0.get_object(bucket, obj)
+        except StorageError as e:
+            errors.append(f"ACKED WRITE LOST: {obj}: {e}")
+            continue
+        if bytes(got) != data:
+            errors.append(f"ACKED WRITE CORRUPT: {obj}")
+    for obj in rejected:
+        try:
+            p0.get_object(bucket, obj)
+            errors.append(f"rejected PUT {obj} became visible")
+        except StorageError:
+            pass
+    return {"name": name, "fault": kind, "target": target,
+            "phase": phase, "ok": not errors, "errors": errors,
+            "acked": len(acked), "rejected": len(rejected),
+            "gets_ok": gets_ok, "heal_passes": heal_passes,
+            "mrf_pending": es.mrf.pending() if es.mrf else 0,
+            "seconds": round(time.monotonic() - t0, 2)}
+
+
+def run_matrix(scenarios=None, seed: int = 0, root: str | None = None,
+               progress=None) -> list[dict]:
+    """Boot one proxied cluster and run every scenario against it.
+    Returns per-scenario result dicts (see _run_scenario)."""
+    scenarios = list(scenarios if scenarios is not None else SCENARIOS)
+    note = progress or (lambda *_: None)
+    saved_scanner = os.environ.get("MTPU_SCANNER")
+    os.environ["MTPU_SCANNER"] = "0"    # scan cycles would race faults
+    tmp = None
+    if root is None:
+        tmp = tempfile.mkdtemp(prefix="mtpu-netmatrix-")
+        root = tmp
+    try:
+        note(f"booting {3} nodes under the chaos proxy ...")
+        nc = boot_proxied_cluster(root, seed=seed)
+        try:
+            results = []
+            for idx, sc in enumerate(scenarios):
+                note(f"[{idx + 1}/{len(scenarios)}] {sc['name']} "
+                     f"(victim n{sc['target']})")
+                results.append(_run_scenario(nc, sc, idx, seed))
+            return results
+        finally:
+            nc.close()
+    finally:
+        if saved_scanner is None:
+            os.environ.pop("MTPU_SCANNER", None)
+        else:
+            os.environ["MTPU_SCANNER"] = saved_scanner
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)

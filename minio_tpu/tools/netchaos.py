@@ -1,0 +1,358 @@
+"""Deterministic seeded TCP chaos proxy — the wire-level half of the
+network-fault plane (rpc.rest.ChaosTransport is the in-process half).
+
+A ChaosTCPProxy sits between an RPC client and a real peer and injects
+transport faults per accepted connection.  Every connection draws THREE
+uniforms from the seeded stream under a lock regardless of which fault
+(if any) fires, so the fault schedule is a pure function of
+(seed, connection order) — ChaosDrive's determinism contract applied to
+the network (re-running a seed replays the exact same storm).
+
+Per-connection fault kinds:
+
+  slow       hold the connection `slow_s` before relaying (latency spike)
+  reset      RST the client after it starts sending (SO_LINGER 0 close)
+  blackhole  SYN accepted, bytes read and discarded, nothing ever
+             answered — the firewall-DROP partition shape
+  truncate   relay the request, forward only the first `truncate_bytes`
+             of the response, then RST mid-body
+  oneway     relay the request upstream (the peer EXECUTES it), read and
+             discard the response — the lost-ack one-way partition
+
+On top of the per-connection storm sit manual partition controls the
+matrix harness drives:
+
+  set_down(True)      every connection is REFUSED with an immediate RST
+                      (a dead host / killed node, as the network sees it)
+  set_mode("blackhole")  every new connection black-holes (two-way or —
+                      applied to one direction only — one-way partition)
+  heal()              back to pass-through
+
+The proxy is cluster-agnostic: it forwards raw bytes, so it fronts the
+msgpack RPC planes and the S3 front door alike.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+
+KINDS = ("slow", "reset", "blackhole", "truncate", "oneway")
+
+_BUF = 65536
+
+
+class ChaosTCPProxy:
+    def __init__(self, target_host: str, target_port: int, *,
+                 seed: int = 0, listen_host: str = "127.0.0.1",
+                 listen_port: int = 0,
+                 slow_rate: float = 0.0, reset_rate: float = 0.0,
+                 blackhole_rate: float = 0.0, truncate_rate: float = 0.0,
+                 oneway_rate: float = 0.0,
+                 slow_s: float = 0.05, hold_s: float = 30.0,
+                 truncate_bytes: int = 64):
+        self.target = (target_host, target_port)
+        self.seed = seed
+        self.slow_rate = slow_rate
+        self.reset_rate = reset_rate
+        self.blackhole_rate = blackhole_rate
+        self.truncate_rate = truncate_rate
+        self.oneway_rate = oneway_rate
+        self.slow_s = slow_s
+        self.hold_s = hold_s            # black-hole/oneway socket hold
+        self.truncate_bytes = truncate_bytes
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+        self.mode = "pass"              # "pass" | "blackhole" | "refuse"
+        self.down = False
+        self.conns = 0
+        self.injected = {k: 0 for k in KINDS}
+        #: (connection index, fault kind) — the reproducible schedule.
+        self.schedule: list[tuple[int, str]] = []
+        self._stopping = False
+        self._socks: set[socket.socket] = set()
+        self._threads: set[threading.Thread] = set()
+        self._host = listen_host
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._bind(listen_host, listen_port)
+        self.port = self._listener.getsockname()[1]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _bind(self, host: str, port: int) -> None:
+        ls = socket.socket()
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((host, port))
+        ls.listen(64)
+        self._listener = ls
+
+    def start(self) -> "ChaosTCPProxy":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="netchaos-accept")
+        self._accept_thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Clean shutdown: listener closed, every relay socket closed,
+        relay threads joined — nothing keeps a drained server's port or
+        threads alive."""
+        self._stopping = True
+        if self._listener is not None:
+            # shutdown() before close(): closing an fd another thread
+            # is blocked in accept() on does not wake it on Linux;
+            # shutting the listening socket down does.
+            for op in (lambda: self._listener.shutdown(socket.SHUT_RDWR),
+                       self._listener.close):
+                try:
+                    op()
+                except OSError:
+                    pass
+        with self._mu:
+            socks = list(self._socks)
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout
+        for t in list(self._threads):
+            t.join(max(0.0, deadline - time.monotonic()))
+        if self._accept_thread is not None:
+            self._accept_thread.join(max(0.0, deadline - time.monotonic()))
+
+    def alive_relays(self) -> int:
+        return sum(1 for t in self._threads if t.is_alive())
+
+    # -- partition controls --------------------------------------------------
+
+    def set_down(self, down: bool) -> None:
+        """down=True hard-refuses every connection (RST on the first
+        byte — the node looks killed); down=False brings it back.  The
+        listener stays bound throughout: closing and re-binding the
+        port would race outgoing relay sockets grabbing it as an
+        ephemeral source port."""
+        self.down = down
+
+    def set_mode(self, mode: str) -> None:
+        assert mode in ("pass", "blackhole", "refuse"), mode
+        self.mode = mode
+
+    def heal(self) -> None:
+        """Clear every manual partition AND the seeded per-connection
+        rates (the calm-weather phase of a scenario)."""
+        self.set_mode("pass")
+        self.set_down(False)
+        self.slow_rate = self.reset_rate = 0.0
+        self.blackhole_rate = self.truncate_rate = self.oneway_rate = 0.0
+
+    # -- data path -----------------------------------------------------------
+
+    def _draw(self) -> str | None:
+        with self._mu:
+            idx = self.conns
+            self.conns += 1
+            r_slow = self._rng.random()
+            r_err = self._rng.random()
+            r_kind = self._rng.random()
+            kind = None
+            total = (self.reset_rate + self.blackhole_rate
+                     + self.truncate_rate + self.oneway_rate)
+            if total > 0 and r_err < total:
+                pick = r_kind * total
+                for k, rate in (("reset", self.reset_rate),
+                                ("blackhole", self.blackhole_rate),
+                                ("truncate", self.truncate_rate),
+                                ("oneway", self.oneway_rate)):
+                    if pick < rate:
+                        kind = k
+                        break
+                    pick -= rate
+                else:
+                    kind = "oneway"
+            elif r_slow < self.slow_rate:
+                kind = "slow"
+            if kind is not None:
+                self.injected[kind] += 1
+                self.schedule.append((idx, kind))
+            return kind
+
+    def _track(self, sock: socket.socket) -> None:
+        with self._mu:
+            self._socks.add(sock)
+
+    def _untrack_close(self, *socks) -> None:
+        for s in socks:
+            if s is None:
+                continue
+            try:
+                s.close()
+            except OSError:
+                pass
+            with self._mu:
+                self._socks.discard(s)
+
+    @staticmethod
+    def _rst(sock: socket.socket) -> None:
+        """Close with RST (SO_LINGER 0): the client sees a hard
+        connection reset, not a graceful FIN."""
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        ls = self._listener
+        while not self._stopping:
+            try:
+                client, _ = ls.accept()
+            except OSError:
+                return                   # listener closed (stop)
+            self._track(client)
+            t = threading.Thread(target=self._serve, args=(client,),
+                                 daemon=True, name="netchaos-relay")
+            self._threads.add(t)
+            t.start()
+            # opportunistic reaping keeps the set bounded on long runs
+            self._threads -= {x for x in list(self._threads)
+                              if not x.is_alive()}
+
+    def _hold(self, sock: socket.socket) -> None:
+        """Read-and-discard until hold_s elapses or the peer gives up —
+        the socket looks connected but nothing ever comes back."""
+        try:
+            sock.settimeout(0.2)
+        except OSError:
+            return                       # peer already gone
+
+        deadline = time.monotonic() + self.hold_s
+        while not self._stopping and time.monotonic() < deadline:
+            try:
+                if sock.recv(_BUF) == b"":
+                    break
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+
+    def _serve(self, client: socket.socket) -> None:
+        upstream = None
+        try:
+            if self.down or self.mode == "refuse":
+                self._rst(client)
+                return
+            if self.mode == "blackhole":
+                self._hold(client)
+                return
+            fault = self._draw()
+            if fault == "slow":
+                time.sleep(self.slow_s)
+            elif fault == "reset":
+                # let the client get its request bytes in flight first
+                client.settimeout(0.5)
+                try:
+                    client.recv(_BUF)
+                except OSError:
+                    pass
+                self._rst(client)
+                return
+            elif fault == "blackhole":
+                self._hold(client)
+                return
+            try:
+                upstream = socket.create_connection(self.target,
+                                                    timeout=10.0)
+            except OSError:
+                self._rst(client)
+                return
+            self._track(upstream)
+            if fault == "truncate":
+                self._relay_truncated(client, upstream)
+                return
+            if fault == "oneway":
+                self._relay_oneway(client, upstream)
+                return
+            self._relay(client, upstream)
+        finally:
+            self._untrack_close(client, upstream)
+
+    # A fresh HTTPConnection per RPC means request->response is one
+    # half-duplex exchange per connection; the relays below still pump
+    # both directions concurrently so pipelined/keep-alive clients work.
+
+    def _pump(self, src: socket.socket, dst: socket.socket | None,
+              limit: int | None = None, rst_after: bool = False) -> None:
+        sent = 0
+        src.settimeout(0.2)
+        while not self._stopping:
+            try:
+                data = src.recv(_BUF)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            if dst is None:
+                continue                 # discard (oneway)
+            if limit is not None:
+                room = limit - sent
+                if room <= 0:
+                    break
+                data = data[:room]
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+            sent += len(data)
+            if limit is not None and sent >= limit:
+                break
+        if rst_after and dst is not None:
+            self._rst(dst)
+        else:
+            for s in (src, dst):
+                if s is not None:
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+    def _relay(self, client: socket.socket,
+               upstream: socket.socket) -> None:
+        t = threading.Thread(target=self._pump, args=(upstream, client),
+                             daemon=True)
+        self._threads.add(t)
+        t.start()
+        self._pump(client, upstream)
+        t.join(2.0)
+
+    def _relay_truncated(self, client: socket.socket,
+                         upstream: socket.socket) -> None:
+        """Request passes whole; the response dies after truncate_bytes
+        with an RST — the peer executed, the caller got a torn body."""
+        t = threading.Thread(
+            target=self._pump,
+            args=(upstream, client),
+            kwargs={"limit": self.truncate_bytes, "rst_after": True},
+            daemon=True)
+        self._threads.add(t)
+        t.start()
+        self._pump(client, upstream)
+        t.join(2.0)
+
+    def _relay_oneway(self, client: socket.socket,
+                      upstream: socket.socket) -> None:
+        """Request delivered and executed; the response is read off the
+        upstream and dropped on the floor (one-way partition: the ack
+        never comes home)."""
+        t = threading.Thread(target=self._pump, args=(upstream, None),
+                             daemon=True)
+        self._threads.add(t)
+        t.start()
+        self._pump(client, upstream)
+        self._hold(client)
+        t.join(2.0)

@@ -41,6 +41,12 @@ def pytest_configure(config):
         "subprocesses through MTPU_CRASH points (a one-point smoke "
         "runs in tier-1; the full matrix is also marked slow — "
         "select with -m 'crash and slow')")
+    config.addinivalue_line(
+        "markers",
+        "netchaos: partition-tolerance tests driving a multi-node "
+        "cluster under the seeded network-chaos proxy (a one-scenario "
+        "smoke runs in tier-1; the full partition/node-kill matrix is "
+        "also marked slow — select with -m 'netchaos and slow')")
 
 
 @pytest.fixture(params=["1", "0"], ids=["fastpath", "oracle"])

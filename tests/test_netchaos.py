@@ -1,0 +1,597 @@
+"""Network chaos plane: deterministic fault injection, deadline budgets,
+partition-tolerant peers, and the partition/node-kill matrix.
+
+Three layers under test, mirroring the ISSUE's tentpole:
+
+  1. the seeded injectors — ChaosTransport (RPC-level) and ChaosTCPProxy
+     (wire-level), both pure functions of (seed, call/connection order),
+  2. the partition-tolerance plumbing — per-request deadline budgets,
+     adaptive per-peer timeouts, capped-backoff reconnects, peer
+     liveness gauges, client-side breakers on remote drives, and dsync
+     lock leases that a partitioned holder cannot outlive,
+  3. the matrix harness (tools/net_matrix.py): a real 3-node cluster
+     under per-edge proxies, every fault kind mid-PUT/GET/heal.
+"""
+
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from minio_tpu.cluster import nslock as nslock_mod
+from minio_tpu.cluster.dsync import DRWMutex, LockLost
+from minio_tpu.cluster.nslock import NSLockMap
+from minio_tpu.observe.metrics import DATA_PATH, MetricsRegistry
+from minio_tpu.observe.span import wrap_ctx
+from minio_tpu.rpc.rest import (ChaosTransport, DeadlineExceeded,
+                                NetworkError, RPCClient, RPCRouter,
+                                RPCServer, clear_deadline,
+                                deadline_remaining, request_deadline_ms,
+                                set_deadline)
+from minio_tpu.rpc.storage_rpc import RemoteDrive, register_storage_rpc
+from minio_tpu.storage.drive import LocalDrive
+from minio_tpu.storage.health_wrap import (HealthWrappedDrive,
+                                           drive_available)
+from minio_tpu.tools.netchaos import ChaosTCPProxy
+
+TOKEN = "netchaos-token"
+
+RATES = dict(slow_rate=0.2, reset_rate=0.15, blackhole_rate=0.1,
+             truncate_rate=0.1, oneway_rate=0.1)
+
+
+# ---------------------------------------------------------------------------
+# ChaosTransport determinism
+# ---------------------------------------------------------------------------
+
+class TestChaosTransportDeterminism:
+    def test_same_seed_same_schedule(self):
+        a = ChaosTransport(7, "h:1", **RATES)
+        b = ChaosTransport(7, "h:1", **RATES)
+        for _ in range(300):
+            a.draw()
+            b.draw()
+        assert a.schedule and a.schedule == b.schedule
+        assert a.injected == b.injected
+        assert set(k for _, k in a.schedule) >= {"slow", "reset"}
+
+    def test_endpoint_decorrelates_streams(self):
+        a = ChaosTransport(7, "h:1", **RATES)
+        b = ChaosTransport(7, "h:2", **RATES)
+        for _ in range(300):
+            a.draw()
+            b.draw()
+        assert a.schedule != b.schedule
+
+    def test_rate_change_does_not_shift_later_draws(self):
+        """The three-unconditional-draws contract: zeroing the rates for
+        a prefix of calls must leave every LATER call's fault unchanged
+        (same (seed, call order) -> same draw, whatever fired before)."""
+        ref = ChaosTransport(11, "h:1", **RATES)
+        for _ in range(200):
+            ref.draw()
+        quiet = ChaosTransport(11, "h:1", **RATES)
+        quiet.chaos_off()
+        for _ in range(100):
+            quiet.draw()
+        assert quiet.schedule == []
+        for k, v in RATES.items():
+            setattr(quiet, k, v)
+        for _ in range(100):
+            quiet.draw()
+        assert quiet.schedule == [e for e in ref.schedule if e[0] >= 100]
+
+    def test_chaos_off_draws_nothing(self):
+        t = ChaosTransport(3, "h:1", **RATES)
+        t.chaos_off()
+        for _ in range(100):
+            assert t.draw() is None
+        assert t.calls == 100 and t.schedule == []
+
+
+# ---------------------------------------------------------------------------
+# ChaosTransport wired into a live RPC client
+# ---------------------------------------------------------------------------
+
+class TestChaosRPC:
+    def test_seeded_client_injects_reproducibly(self, monkeypatch):
+        monkeypatch.setenv("MTPU_NETCHAOS", "1234")
+        monkeypatch.setenv("MTPU_NETCHAOS_RESET_RATE", "0.3")
+        for k in ("SLOW", "BLACKHOLE", "TRUNCATE", "ONEWAY"):
+            monkeypatch.setenv(f"MTPU_NETCHAOS_{k}_RATE", "0")
+        srv = RPCServer(TOKEN).start()
+        srv.register("t.echo", lambda p: {"got": p.get("x")})
+        try:
+            cli = RPCClient(srv.endpoint, TOKEN)
+            assert cli.chaos is not None
+            ok = 0
+            for i in range(40):
+                try:
+                    assert cli.call("t.echo", {"x": i},
+                                    idempotent=True) == {"got": i}
+                    ok += 1
+                except NetworkError:
+                    cli._online = True      # keep driving the schedule
+            assert ok > 0
+            assert cli.chaos.injected["reset"] > 0
+            # the injected schedule replays from (seed, endpoint) alone
+            replay = ChaosTransport(1234, srv.endpoint, reset_rate=0.3,
+                                    slow_rate=0, blackhole_rate=0,
+                                    truncate_rate=0, oneway_rate=0)
+            for _ in range(cli.chaos.calls):
+                replay.draw()
+            assert replay.schedule == cli.chaos.schedule
+        finally:
+            srv.shutdown()
+
+    def test_netchaos_off_is_byte_identical_oracle(self, monkeypatch):
+        """MTPU_NETCHAOS=0 -> no ChaosTransport at all; responses are
+        byte-identical to what the handler returned."""
+        monkeypatch.setenv("MTPU_NETCHAOS", "0")
+        blob = np.random.default_rng(5).integers(
+            0, 256, 4096, dtype=np.uint8).tobytes()
+        srv = RPCServer(TOKEN).start()
+        srv.register("t.blob", lambda p: {"data": blob})
+        try:
+            cli = RPCClient(srv.endpoint, TOKEN)
+            assert cli.chaos is None
+            got = cli.call("t.blob")
+            assert got["data"] == blob
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Per-request deadline budgets
+# ---------------------------------------------------------------------------
+
+class TestDeadlineBudget:
+    def test_exhausted_budget_fails_typed_and_not_offline(self):
+        srv = RPCServer(TOKEN).start()
+        srv.register("t.echo", lambda p: {"ok": True})
+        tok = set_deadline(0.01)
+        try:
+            cli = RPCClient(srv.endpoint, TOKEN)
+            before = DATA_PATH.snapshot()["rpc_deadline_exceeded"]
+            time.sleep(0.03)                 # budget runs out
+            with pytest.raises(DeadlineExceeded):
+                cli.call("t.echo", idempotent=True)
+            # out of budget is a REQUEST property, not a peer fault
+            assert cli.is_online()
+            after = DATA_PATH.snapshot()["rpc_deadline_exceeded"]
+            assert after == before + 1
+        finally:
+            clear_deadline(tok)
+            srv.shutdown()
+
+    def test_budget_clamps_transport_timeout(self):
+        def stall(p):
+            time.sleep(3.0)
+            return {}
+        srv = RPCServer(TOKEN).start()
+        srv.register("t.stall", stall)
+        tok = set_deadline(0.3)
+        try:
+            cli = RPCClient(srv.endpoint, TOKEN, timeout=10.0)
+            t0 = time.monotonic()
+            with pytest.raises(NetworkError):
+                cli.call("t.stall")
+            # failed in ~the budget, nowhere near the 10s default
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            clear_deadline(tok)
+            srv.shutdown()
+
+    def test_deadline_carried_across_pool_threads(self):
+        """The erasure fan-out runs on a thread pool through
+        span.wrap_ctx; the budget must ride along."""
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            assert ex.submit(wrap_ctx(lambda _: deadline_remaining()),
+                             None).result() is None
+            tok = set_deadline(5.0)
+            try:
+                rem = ex.submit(wrap_ctx(lambda _: deadline_remaining()),
+                                None).result()
+            finally:
+                clear_deadline(tok)
+            assert rem is not None and 0 < rem <= 5.0
+
+    def test_request_deadline_ms_env(self, monkeypatch):
+        monkeypatch.setenv("MTPU_RPC_DEADLINE_MS", "2500")
+        assert request_deadline_ms() == 2500.0
+        monkeypatch.setenv("MTPU_RPC_DEADLINE_MS", "junk")
+        assert request_deadline_ms() == 0
+        monkeypatch.delenv("MTPU_RPC_DEADLINE_MS")
+        assert request_deadline_ms() == 0
+
+
+# ---------------------------------------------------------------------------
+# Adaptive per-peer timeouts (satellite: dynamic_timeout live wiring)
+# ---------------------------------------------------------------------------
+
+class TestDynamicTimeoutWiring:
+    def test_measured_latency_shrinks_peer_timeout(self):
+        srv = RPCServer(TOKEN).start()
+        srv.register("t.echo", lambda p: {"ok": True})
+        try:
+            cli = RPCClient(srv.endpoint, TOKEN, timeout=8.0)
+            base = cli.dyn_timeout.timeout()
+            assert base == 8.0
+            for _ in range(70):              # > one WINDOW of successes
+                cli.call("t.echo")
+            assert cli.dyn_timeout.timeout() < base
+            assert cli.peer_info()["timeout_s"] < base
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Peer liveness: transitions, backoff reconnect, metrics gauges
+# ---------------------------------------------------------------------------
+
+class TestPeerLiveness:
+    def test_transitions_counted_and_gauges_rendered(self):
+        srv = RPCServer(TOKEN).start()
+        port = srv.port
+        cli = RPCClient(srv.endpoint, TOKEN, check_interval=0.05)
+        before = dict(DATA_PATH.snapshot()["peer_transitions"])
+        try:
+            cli.call("health.health")
+            info = cli.peer_info()
+            assert info["online"] and info["transitions"] == 0
+            assert info["last_seen_ago_s"] >= 0
+            srv.shutdown()
+            with pytest.raises(NetworkError):
+                cli.call("health.health")
+            info = cli.peer_info()
+            assert not info["online"] and info["transitions"] == 1
+            assert info["offline_for_s"] >= 0
+            # capped-backoff reconnect flips it back once a server
+            # reappears on the same port
+            srv2 = RPCServer(TOKEN, port=port).start()
+            try:
+                deadline = time.monotonic() + 5
+                while not cli.is_online() and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                assert cli.is_online()
+                assert cli.peer_info()["transitions"] == 2
+            finally:
+                srv2.shutdown()
+            after = DATA_PATH.snapshot()["peer_transitions"]
+            assert after["offline"] >= before["offline"] + 1
+            assert after["online"] >= before["online"] + 1
+
+            reg = MetricsRegistry()
+            reg.update_peers([cli])
+            out = reg.render()
+            ep = f'endpoint="127.0.0.1:{port}"'
+            assert f"mtpu_peer_state{{{ep}}} 1" in out
+            assert f"mtpu_peer_transitions_total{{{ep}}} 2" in out
+            assert "mtpu_peer_rpc_timeout_seconds" in out
+            assert "mtpu_peer_last_seen_seconds" in out
+        finally:
+            cli.close()
+
+
+# ---------------------------------------------------------------------------
+# ChaosTCPProxy (wire level)
+# ---------------------------------------------------------------------------
+
+def _echo_server():
+    """One-shot echo upstream: answers b'ok:' + request per connection.
+    Returns (port, received list, stop)."""
+    ls = socket.socket()
+    ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(16)
+    received = []
+    stopping = threading.Event()
+
+    def serve():
+        while not stopping.is_set():
+            try:
+                c, _ = ls.accept()
+            except OSError:
+                return
+            try:
+                c.settimeout(2.0)
+                data = c.recv(65536)
+                if data:
+                    received.append(data)
+                    c.sendall(b"ok:" + data)
+            except OSError:
+                pass
+            finally:
+                c.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    def stop():
+        stopping.set()
+        ls.close()
+
+    return ls.getsockname()[1], received, stop
+
+
+def _exchange(port, msg=b"hello", timeout=1.0):
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(msg)
+        chunks = []
+        while True:
+            try:
+                d = s.recv(65536)
+            except OSError:
+                break
+            if not d:
+                break
+            chunks.append(d)
+        return b"".join(chunks)
+
+
+class TestChaosTCPProxy:
+    def test_pass_relays_bytes(self):
+        port, _, stop = _echo_server()
+        px = ChaosTCPProxy("127.0.0.1", port, seed=0).start()
+        try:
+            assert _exchange(px.port) == b"ok:hello"
+        finally:
+            px.stop()
+            stop()
+
+    def test_set_down_refuses_and_revives(self):
+        port, _, stop = _echo_server()
+        px = ChaosTCPProxy("127.0.0.1", port, seed=0).start()
+        try:
+            px.set_down(True)
+            assert _exchange(px.port) == b""    # RST / nothing back
+            px.set_down(False)
+            assert _exchange(px.port) == b"ok:hello"
+        finally:
+            px.stop()
+            stop()
+
+    def test_blackhole_mode_swallows_and_heals(self):
+        port, received, stop = _echo_server()
+        px = ChaosTCPProxy("127.0.0.1", port, seed=0, hold_s=0.4).start()
+        try:
+            px.set_mode("blackhole")
+            n = len(received)
+            t0 = time.monotonic()
+            assert _exchange(px.port, timeout=0.5) == b""
+            assert time.monotonic() - t0 >= 0.3   # held, not refused
+            assert len(received) == n             # never reached upstream
+            px.heal()
+            assert _exchange(px.port) == b"ok:hello"
+        finally:
+            px.stop()
+            stop()
+
+    def test_truncate_cuts_response_midbody(self):
+        port, received, stop = _echo_server()
+        px = ChaosTCPProxy("127.0.0.1", port, seed=0, truncate_rate=1.0,
+                           truncate_bytes=2).start()
+        try:
+            got = _exchange(px.port)
+            assert got == b"ok"                   # 2 of 8 bytes, then RST
+            assert received                       # request DID execute
+        finally:
+            px.stop()
+            stop()
+
+    def test_oneway_executes_but_drops_response(self):
+        port, received, stop = _echo_server()
+        px = ChaosTCPProxy("127.0.0.1", port, seed=0, oneway_rate=1.0,
+                           hold_s=0.3).start()
+        try:
+            assert _exchange(px.port, timeout=0.6) == b""
+            deadline = time.monotonic() + 2
+            while not received and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert received == [b"hello"]         # the lost-ack shape
+        finally:
+            px.stop()
+            stop()
+
+    def test_schedule_deterministic_across_runs(self):
+        port, _, stop = _echo_server()
+        schedules = []
+        for _ in range(2):
+            px = ChaosTCPProxy("127.0.0.1", port, seed=42,
+                               reset_rate=0.3, slow_rate=0.3,
+                               slow_s=0.01).start()
+            try:
+                for _ in range(25):
+                    _exchange(px.port, timeout=0.5)
+                schedules.append(list(px.schedule))
+            finally:
+                px.stop()
+        stop()
+        assert schedules[0] and schedules[0] == schedules[1]
+
+    def test_proxy_clean_shutdown_under_graceful_drain(self):
+        """The proxy must come down cleanly alongside a draining server
+        (PR 7 path): drain -> shutdown -> proxy.stop() leaves no live
+        relays and a dead listen port."""
+        from minio_tpu.server.server import S3Server
+        from minio_tpu.server.sigv4 import Credentials
+        router = RPCRouter(TOKEN)
+        srv = S3Server(None, Credentials("ak", "sk"), host="127.0.0.1",
+                       port=0, rpc_router=router).start()
+        px = ChaosTCPProxy("127.0.0.1", srv.port, seed=0).start()
+        try:
+            cli = RPCClient(f"127.0.0.1:{px.port}", TOKEN)
+            assert cli.call("health.health")["ok"]
+            rep = srv.drain(timeout=2.0)
+            assert rep["draining"] and rep["leftover"] == 0
+        finally:
+            srv.shutdown()
+            px.stop(timeout=5.0)
+        assert px.alive_relays() == 0
+        assert not px._accept_thread.is_alive()
+        assert px._listener.fileno() == -1    # listen socket released
+
+
+# ---------------------------------------------------------------------------
+# Remote drives behind the circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestRemoteDriveBreaker:
+    def test_breaker_trips_on_dead_peer_and_recovers(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("MTPU_BREAKER_ERRS", "2")
+        monkeypatch.setenv("MTPU_BREAKER_OFFLINE_ERRS", "3")
+        srv = RPCServer(TOKEN).start()
+        port = srv.port
+        local = LocalDrive(str(tmp_path / "d1"))
+        register_storage_rpc(srv, [local])
+        cli = RPCClient(srv.endpoint, TOKEN, check_interval=0.05)
+        wrapped = HealthWrappedDrive(RemoteDrive(cli, 0, path="r0"))
+        try:
+            # isinstance-transparency: engine gates must see RemoteDrive
+            assert isinstance(wrapped, RemoteDrive)
+            assert not isinstance(wrapped, LocalDrive)
+            wrapped.make_volume("b")
+            assert "b" in wrapped.list_volumes()
+            assert wrapped.health_state() == "ok"
+
+            srv.shutdown()
+            for _ in range(4):
+                try:
+                    wrapped.list_volumes()
+                except Exception:  # noqa: BLE001
+                    pass
+            assert wrapped.health_state() == "offline"
+            assert not drive_available(wrapped)
+            # circuit open: fails fast without touching the network
+            t0 = time.monotonic()
+            with pytest.raises(Exception):  # noqa: B017
+                wrapped.list_volumes()
+            assert time.monotonic() - t0 < 0.1
+
+            srv2 = RPCServer(TOKEN, port=port).start()
+            try:
+                register_storage_rpc(srv2, [local])
+                deadline = time.monotonic() + 5
+                while not cli.is_online() and \
+                        time.monotonic() < deadline:
+                    cli.probe_now()
+                    time.sleep(0.05)
+                assert wrapped.probe_now()
+                assert wrapped.health_state() == "ok"
+                assert "b" in wrapped.list_volumes()
+            finally:
+                srv2.shutdown()
+        finally:
+            cli.close()
+
+
+# ---------------------------------------------------------------------------
+# dsync lease expiry: a partitioned holder can never ack
+# ---------------------------------------------------------------------------
+
+class _StubLocker:
+    def __init__(self):
+        self.partitioned = False
+        self.refreshes = 0
+
+    def lock(self, res, uid):
+        return True
+
+    def unlock(self, res, uid):
+        return True
+
+    rlock = lock
+    runlock = unlock
+
+    def refresh(self, res, uid):
+        self.refreshes += 1
+        if self.partitioned:
+            raise OSError("partitioned")
+        return True
+
+
+class TestDsyncLease:
+    def test_partitioned_holder_lease_expires(self):
+        stubs = [_StubLocker() for _ in range(3)]
+        lost = threading.Event()
+        dm = DRWMutex("res", stubs, refresh_interval=0.05,
+                      lease_duration=0.12,
+                      loss_callback=lambda r: lost.set())
+        assert dm.get_lock(timeout=1.0)
+        assert dm.is_held() and not dm.lease_expired()
+        for s in stubs:
+            s.partitioned = True
+        deadline = time.monotonic() + 2
+        while dm.is_held() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not dm.is_held()
+        assert lost.wait(2.0)
+        dm.unlock()
+
+    def test_late_quorum_does_not_resurrect_lease(self):
+        """A refresh quorum that lands AFTER the lease ran out must not
+        renew it — survivors may have stale-swept and re-granted."""
+        stubs = [_StubLocker() for _ in range(3)]
+        lost = threading.Event()
+        dm = DRWMutex("res", stubs, refresh_interval=0.3,
+                      lease_duration=0.1,
+                      loss_callback=lambda r: lost.set())
+        assert dm.get_lock(timeout=1.0)
+        time.sleep(0.15)                  # expired before ANY refresh
+        assert dm.lease_expired() and not dm.is_held()
+        # first refresh round (t=0.3) gets full quorum — too late
+        assert lost.wait(2.0)
+        assert dm._held is None
+        dm.unlock()
+
+    def test_nslock_raises_locklost_on_expired_lease(self, monkeypatch):
+        def short_lease(resource, lockers, loss_callback=None):
+            return DRWMutex(resource, lockers,
+                            refresh_interval=0.05, lease_duration=0.12,
+                            loss_callback=loss_callback)
+        monkeypatch.setattr(nslock_mod, "DRWMutex", short_lease)
+        stubs = [_StubLocker() for _ in range(3)]
+        ns = NSLockMap(lockers=stubs)
+        with pytest.raises(LockLost):
+            with ns.write_locked("b", "o", timeout=1.0):
+                for s in stubs:
+                    s.partitioned = True
+                time.sleep(0.4)           # lease dies mid-operation
+
+
+# ---------------------------------------------------------------------------
+# The partition/node-kill matrix
+# ---------------------------------------------------------------------------
+
+class TestNetMatrix:
+    @pytest.mark.netchaos
+    def test_matrix_smoke_kill_mid_put(self, tmp_path):
+        """One-seed tier-1 smoke: a node dies mid-PUT; writes keep
+        acking at quorum, nothing acked is lost, heal converges."""
+        from minio_tpu.tools import net_matrix as nm
+        res = nm.run_matrix([nm.SCENARIOS[0]], seed=5,
+                            root=str(tmp_path))
+        assert len(res) == 1
+        r = res[0]
+        assert r["ok"], r["errors"]
+        assert r["acked"] > 3                 # PUTs acked under the kill
+        assert r["rejected"] == 0
+
+    @pytest.mark.netchaos
+    @pytest.mark.slow
+    def test_matrix_full_sweep(self, tmp_path):
+        """Every fault kind x every phase (>= 10 scenarios): zero
+        acked-write loss, no torn reads, heal convergence."""
+        from minio_tpu.tools import net_matrix as nm
+        res = nm.run_matrix(seed=11, root=str(tmp_path))
+        assert len(res) >= 10
+        bad = [r for r in res if not r["ok"]]
+        assert not bad, [(r["name"], r["errors"]) for r in bad]
+        assert {r["fault"] for r in res} == set(nm.FAULT_KINDS)
+        assert {r["phase"] for r in res} == set(nm.PHASES)

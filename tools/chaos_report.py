@@ -27,6 +27,16 @@ rendered as a table (the same scenarios tests/test_crash.py runs):
     $ python tools/chaos_report.py --crash-matrix
     $ python tools/chaos_report.py --crash-matrix \\
           --crash-points rename.pre_meta,mp.complete.publish
+
+`--net-matrix` runs the partition/node-kill matrix instead: a real
+3-node cluster boots under per-edge chaos TCP proxies, and every fault
+kind (node kill, one-way/two-way partition, black-hole, reset storm,
+slow peer) is injected mid-PUT/GET/heal (the same scenarios
+tests/test_netchaos.py runs under -m 'netchaos and slow'):
+
+    $ python tools/chaos_report.py --net-matrix
+    $ python tools/chaos_report.py --net-matrix \\
+          --net-scenarios kill-mid-put,oneway-mid-get
 """
 
 import argparse
@@ -201,6 +211,46 @@ def run_crash_matrix(args) -> int:
     return 0
 
 
+def run_net_matrix(args) -> int:
+    """Partition/node-kill matrix: a proxied 3-node cluster, every
+    network-fault kind mid-PUT/GET/heal, per-scenario verdict table."""
+    from minio_tpu.tools import net_matrix as nm
+
+    scenarios = list(nm.SCENARIOS)
+    if args.net_scenarios:
+        wanted = {s.strip() for s in args.net_scenarios.split(",")
+                  if s.strip()}
+        unknown = wanted - {s["name"] for s in nm.SCENARIOS}
+        if unknown:
+            print(f"unknown scenario(s): {', '.join(sorted(unknown))}")
+            return 2
+        scenarios = [s for s in nm.SCENARIOS if s["name"] in wanted]
+    print(f"== partition/node-kill matrix :: seed {args.net_seed}, "
+          f"{len(scenarios)} scenario(s) " + "=" * 20)
+    results = nm.run_matrix(scenarios, seed=args.net_seed,
+                            progress=print)
+    print()
+    print(f'{"scenario":<22} {"victim":>6}  {"acked":>5} {"rej":>3} '
+          f'{"gets":>4} {"heal":>4} {"mrf":>3} {"secs":>6}  result')
+    bad = 0
+    for r in results:
+        verdict = "ok" if r["ok"] else f'FAIL ({"; ".join(r["errors"][:2])})'
+        bad += 0 if r["ok"] else 1
+        print(f'{r["name"]:<22} {"n" + str(r["target"]):>6}  '
+              f'{r["acked"]:>5} {r["rejected"]:>3} {r["gets_ok"]:>4} '
+              f'{r["heal_passes"]:>4} {r["mrf_pending"]:>3} '
+              f'{r["seconds"]:>6}  {verdict}')
+    print()
+    if bad:
+        print(f"{bad}/{len(results)} scenario(s) violated the "
+              f"partition-tolerance contract")
+        return 1
+    print(f"all {len(results)} scenario(s) clean: zero acked-write "
+          f"loss, no torn reads, rejected writes invisible, heal "
+          f"converged after every partition healed")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="seeded chaos scenario report for minio_tpu")
@@ -223,10 +273,21 @@ def main(argv=None) -> int:
     ap.add_argument("--crash-points", default="",
                     help="comma-separated subset of crash points to "
                          "run (default: the full matrix)")
+    ap.add_argument("--net-matrix", action="store_true",
+                    help="run the partition/node-kill matrix (a real "
+                         "multi-node cluster under the chaos TCP "
+                         "proxy) instead of the in-process storm")
+    ap.add_argument("--net-seed", type=int, default=0,
+                    help="fault/payload seed for --net-matrix")
+    ap.add_argument("--net-scenarios", default="",
+                    help="comma-separated subset of net-matrix "
+                         "scenario names (default: the full matrix)")
     args = ap.parse_args(argv)
 
     if args.crash_matrix:
         return run_crash_matrix(args)
+    if args.net_matrix:
+        return run_net_matrix(args)
 
     seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
     failures = 0

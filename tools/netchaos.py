@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Standalone seeded network-chaos TCP proxy.
+
+Fronts any minio_tpu port (RPC plane or S3 front door) with the
+deterministic fault injector from minio_tpu.tools.netchaos: latency
+spikes, connection resets, black-holes, mid-response truncation and
+one-way partitions, each a pure function of (seed, connection order).
+
+    # a flaky link in front of a node on :9001
+    $ python tools/netchaos.py --listen 19001 --target 127.0.0.1:9001 \\
+          --seed 7 --reset-rate 0.05 --blackhole-rate 0.02
+
+    # a hard two-way partition (SYN accepted, nothing answered)
+    $ python tools/netchaos.py --listen 19001 --target 127.0.0.1:9001 \\
+          --mode blackhole
+
+Point the cluster's endpoint list (or a single peer) at the listen port
+and drive traffic; ^C prints the injected-fault schedule so a failing
+run is replayable from its seed.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from minio_tpu.tools.netchaos import ChaosTCPProxy  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic seeded TCP chaos proxy")
+    ap.add_argument("--listen", type=int, required=True,
+                    help="local port to listen on")
+    ap.add_argument("--target", required=True, help="host:port to front")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slow-rate", type=float, default=0.0)
+    ap.add_argument("--reset-rate", type=float, default=0.0)
+    ap.add_argument("--blackhole-rate", type=float, default=0.0)
+    ap.add_argument("--truncate-rate", type=float, default=0.0)
+    ap.add_argument("--oneway-rate", type=float, default=0.0)
+    ap.add_argument("--slow-s", type=float, default=0.05)
+    ap.add_argument("--hold-s", type=float, default=30.0)
+    ap.add_argument("--truncate-bytes", type=int, default=64)
+    ap.add_argument("--mode", choices=("pass", "blackhole", "refuse"),
+                    default="pass",
+                    help="manual partition mode for every connection")
+    args = ap.parse_args(argv)
+
+    host, _, port = args.target.partition(":")
+    proxy = ChaosTCPProxy(
+        host, int(port), seed=args.seed, listen_port=args.listen,
+        slow_rate=args.slow_rate, reset_rate=args.reset_rate,
+        blackhole_rate=args.blackhole_rate,
+        truncate_rate=args.truncate_rate, oneway_rate=args.oneway_rate,
+        slow_s=args.slow_s, hold_s=args.hold_s,
+        truncate_bytes=args.truncate_bytes).start()
+    proxy.set_mode(args.mode)
+    print(f"netchaos: 127.0.0.1:{proxy.port} -> {args.target} "
+          f"seed={args.seed} mode={args.mode}")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        proxy.stop()
+        print(f"\nconnections={proxy.conns} injected={proxy.injected}")
+        if proxy.schedule:
+            print("schedule:", ", ".join(f"{i}:{k}"
+                                         for i, k in proxy.schedule))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
