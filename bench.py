@@ -338,6 +338,90 @@ def concurrent_bench(duration_s: float = 4.0,
     return out
 
 
+def workers_bench(duration_s: float = 3.0, object_mib: int = 1,
+                  nworkers: int | None = None) -> dict:
+    """Pre-fork pool suite (server/workers.py): the same closed-loop
+    HTTP mix against one server booted MTPU_WORKERS=0 (single-process
+    oracle) and one booted MTPU_WORKERS=N, at 1/4/16 clients over the
+    wire.  The pool's acceptance shape: 16-client aggregate above its
+    own 1-client, and above the oracle at 16 clients with p99 no worse.
+    That needs a multi-core host — on 1 core the pool can only tie the
+    oracle (the GIL was never the limit when there is one CPU), so the
+    ratios are reported, not asserted."""
+    import os
+    import shutil
+    import socket as _socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from tools.loadgen import run_load_http
+
+    if nworkers is None:
+        nworkers = min(4, max(2, os.cpu_count() or 2))
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {"workers_n": nworkers}
+    for label, nw in (("w0", 0), ("wN", nworkers)):
+        root = tempfile.mkdtemp(prefix=f"mtpu-wb-{label}-")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MTPU_SCANNER"] = "0"
+        env["MTPU_WORKERS"] = str(nw)
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--drives", f"{root}/d{{1...4}}", "--port", str(port)],
+            env=env, cwd=here, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT)
+        try:
+            deadline = time.monotonic() + 180
+            up = False
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    break
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/minio/health/ready",
+                            timeout=2) as r:
+                        if r.status == 200:
+                            up = True
+                            break
+                except Exception:  # noqa: BLE001 — keep polling
+                    pass
+                time.sleep(0.2)
+            if not up:
+                raise RuntimeError(f"workers_bench {label} never ready")
+            for n in (1, 4, 16):
+                r = run_load_http(
+                    f"http://127.0.0.1:{port}", clients=n,
+                    object_size=object_mib << 20, put_frac=0.5,
+                    duration_s=duration_s, seed=n,
+                    # multi-process CLIENT side for the pool runs so the
+                    # load generator's own GIL can't cap the measurement
+                    procs=min(4, n) if nw else 1)
+                out[f"{label}_conc{n}_gbps"] = r["gbps"]
+                out[f"{label}_conc{n}_p50_ms"] = r["p50_ms"]
+                out[f"{label}_conc{n}_p99_ms"] = r["p99_ms"]
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+            shutil.rmtree(root, ignore_errors=True)
+    if out.get("wN_conc1_gbps"):
+        out["pool_16c_vs_1c_speedup"] = round(
+            out["wN_conc16_gbps"] / out["wN_conc1_gbps"], 2)
+    if out.get("w0_conc16_gbps"):
+        out["pool_vs_oracle_16c"] = round(
+            out["wN_conc16_gbps"] / out["w0_conc16_gbps"], 2)
+    return out
+
+
 def digest_bench(duration_s: float = 3.0) -> dict:
     """Native multi-buffer digest plane suite (MTPU_NATIVE_DIGEST):
 
@@ -1005,11 +1089,12 @@ def main() -> None:
             [sys.executable, "-c",
              "import json, sys; sys.path.insert(0, sys.argv[1]); "
              "from bench import (e2e_bench, concurrent_bench, "
-             "hedge_bench, digest_bench); "
+             "hedge_bench, digest_bench, workers_bench); "
              "r = e2e_bench(); r.update(concurrent_bench()); "
              "r.update(hedge_bench()); r.update(digest_bench()); "
+             "r.update(workers_bench()); "
              "print(json.dumps(r))", here],
-            env=env, capture_output=True, text=True, timeout=600)
+            env=env, capture_output=True, text=True, timeout=900)
         if res.returncode != 0:
             raise RuntimeError(res.stderr[-300:])
         results.update(json.loads(res.stdout.strip().splitlines()[-1]))

@@ -236,6 +236,18 @@ class MRFQueue:
                     self._jf = None
 
 
+def _journal_name() -> str:
+    """Journal filename for THIS process.  The pre-fork worker pool
+    (server/workers.py) runs N servers over the same drives; a JSONL
+    journal is single-writer (interleaved appends tear records), so
+    each worker owns `mrf-journal.w<ID>.jsonl`.  Single-process mode
+    keeps the legacy name."""
+    wid = os.environ.get("MTPU_WORKER_ID", "")
+    if wid:
+        return f"mrf-journal.w{wid}.jsonl"
+    return "mrf-journal.jsonl"
+
+
 def _pool_journal_path(pool) -> str | None:
     """Journal home: the first local drive of the pool's first set —
     under its reserved system namespace, next to tmp/ and multipart/."""
@@ -244,8 +256,87 @@ def _pool_journal_path(pool) -> str | None:
         for d in getattr(es, "drives", []):
             root = getattr(d, "root", None)
             if d is not None and root:
-                return os.path.join(root, SYS_VOL, "mrf-journal.jsonl")
+                return os.path.join(root, SYS_VOL, _journal_name())
     return None
+
+
+def adopt_orphan_journals(journal_path: str) -> int:
+    """Fold sibling journals whose writer is gone into `journal_path`
+    so their pending heals are not stranded.  Called by the recovery
+    owner (worker 0, or single-process mode) BEFORE its MRFQueue
+    replays.  A journal is an orphan when it belongs to a worker id
+    beyond the current pool width (pool shrank), or when this process
+    is the legacy single writer and per-worker journals remain from a
+    previous MTPU_WORKERS>0 run (and vice versa).  Each orphan is
+    reduced to its NET pending set first (its own ckpt/enq/done/drop
+    algebra), then appended as plain enq records — raw concatenation
+    would let an orphan's ckpt record wipe the adopter's entries at
+    replay."""
+    home = os.path.dirname(journal_path)
+    me = os.path.basename(journal_path)
+    try:
+        names = sorted(os.listdir(home))
+    except OSError:
+        return 0
+    adopted = 0
+    width = int(os.environ.get("MTPU_WORKERS_TOTAL", "0") or 0)
+    for name in names:
+        if name == me or not name.startswith("mrf-journal"):
+            continue
+        if not name.endswith(".jsonl"):
+            continue
+        if width:
+            # Pool mode: live siblings are w0..w{width-1}; adopt the
+            # legacy journal and out-of-range worker journals only.
+            m = name.removeprefix("mrf-journal.").removesuffix(".jsonl")
+            if m.startswith("w"):
+                try:
+                    if int(m[1:]) < width:
+                        continue            # a live sibling owns it
+                except ValueError:
+                    pass
+        path = os.path.join(home, name)
+        try:
+            with open(path, "r", encoding="utf-8") as src:
+                pending = _net_pending(src.read())
+            with open(journal_path, "a", encoding="utf-8") as dst:
+                for it in pending.values():
+                    dst.write(json.dumps(
+                        {"op": "enq", "b": it["bucket"], "o": it["obj"],
+                         "vid": it["vid"]},
+                        separators=(",", ":")) + "\n")
+                dst.flush()
+                os.fsync(dst.fileno())
+            os.unlink(path)
+            adopted += 1
+        except OSError:
+            continue
+    return adopted
+
+
+def _net_pending(raw: str) -> "OrderedDict[str, dict]":
+    """The enq/done/drop/ckpt algebra of _replay_journal, standalone —
+    what a journal's writer still owed when it last wrote."""
+    pending: OrderedDict[str, dict] = OrderedDict()
+    for line in raw.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        op = rec.get("op")
+        if op == "ckpt":
+            pending = OrderedDict()
+            for e in rec.get("pending", ()):
+                key = f"{e['b']}/{e['o']}@{e['vid']}"
+                pending[key] = {"bucket": e["b"], "obj": e["o"],
+                                "vid": e["vid"]}
+        elif op == "enq":
+            key = f"{rec['b']}/{rec['o']}@{rec['vid']}"
+            pending[key] = {"bucket": rec["b"], "obj": rec["o"],
+                            "vid": rec["vid"]}
+        elif op in ("done", "drop"):
+            pending.pop(rec.get("k"), None)
+    return pending
 
 
 def attach_mrf(pools, journal: bool = True, **kw) -> list[MRFQueue]:
@@ -262,6 +353,11 @@ def attach_mrf(pools, journal: bool = True, **kw) -> list[MRFQueue]:
         def heal(bucket, obj, vid, _p=pool):
             _p.heal_object(bucket, obj, vid)
         jp = _pool_journal_path(pool) if journal else None
+        if jp and os.environ.get("MTPU_WORKER_ID", "0") in ("", "0"):
+            # The recovery owner folds journals stranded by a previous
+            # run's (different) process topology into its own before
+            # replay — pending heals never orphan across mode changes.
+            adopt_orphan_journals(jp)
         q = MRFQueue(heal, journal_path=jp, **kw).start()
         if q.replayed:
             from ..observe.metrics import DATA_PATH

@@ -212,6 +212,15 @@ class DataPathStats:
             self.co_batch_faults = 0
             self.co_member_retries = 0
             self.co_fallbacks = 0
+            # Cross-process dispatch (ops/ipc_dispatch.py, worker pool):
+            # items shipped to the device owner, results received,
+            # fallbacks (arena/ring full -> computed locally), and
+            # owner-death events observed by this worker.
+            self.ipc_submits = 0
+            self.ipc_rows = 0
+            self.ipc_results = 0
+            self.ipc_fallbacks = 0
+            self.ipc_owner_deaths = 0
             # Hedged shard reads (Tail-at-Scale first-k-wins): fired =
             # hedge timers that expired, spares = speculative parity
             # reads launched, wins = spare rows used in the final k.
@@ -324,6 +333,23 @@ class DataPathStats:
         with self._mu:
             self.co_fallbacks += 1
 
+    def record_ipc_submit(self, rows: int = 0) -> None:
+        with self._mu:
+            self.ipc_submits += 1
+            self.ipc_rows += rows
+
+    def record_ipc_result(self) -> None:
+        with self._mu:
+            self.ipc_results += 1
+
+    def record_ipc_fallback(self) -> None:
+        with self._mu:
+            self.ipc_fallbacks += 1
+
+    def record_ipc_owner_death(self) -> None:
+        with self._mu:
+            self.ipc_owner_deaths += 1
+
     def record_hedge(self, fired: bool, spares: int, wins: int) -> None:
         with self._mu:
             self.hedged_reads += 1
@@ -421,6 +447,11 @@ class DataPathStats:
                 "co_batch_faults": self.co_batch_faults,
                 "co_member_retries": self.co_member_retries,
                 "co_fallbacks": self.co_fallbacks,
+                "ipc_submits": self.ipc_submits,
+                "ipc_rows": self.ipc_rows,
+                "ipc_results": self.ipc_results,
+                "ipc_fallbacks": self.ipc_fallbacks,
+                "ipc_owner_deaths": self.ipc_owner_deaths,
                 "hedged_reads": self.hedged_reads,
                 "hedge_fired": self.hedge_fired,
                 "hedge_spares": self.hedge_spares,
@@ -553,6 +584,20 @@ class MetricsRegistry:
             "mtpu_coalesce_fallbacks_total",
             "Call sites that recomputed a span through the direct "
             "path after a failed coalesced handle")
+        # Cross-process dispatch families (worker pool, PR 9).
+        self.ipc_submits = Gauge(
+            "mtpu_ipc_dispatch_submits_total",
+            "Work items shipped to the device-owner process")
+        self.ipc_results = Gauge(
+            "mtpu_ipc_dispatch_results_total",
+            "Remote dispatch results received back")
+        self.ipc_fallbacks = Gauge(
+            "mtpu_ipc_dispatch_fallbacks_total",
+            "Remote submits that degraded to worker-local compute "
+            "(arena/ring backpressure or owner loss)")
+        self.ipc_owner_deaths = Gauge(
+            "mtpu_ipc_owner_deaths_total",
+            "Device-owner heartbeat losses observed by this worker")
         # Hedged shard-read families (MTPU_HEDGE).
         self.hedged_reads = Gauge(
             "mtpu_hedged_reads_total",
@@ -807,6 +852,10 @@ class MetricsRegistry:
         self.co_batch_faults.set(snap["co_batch_faults"])
         self.co_member_retries.set(snap["co_member_retries"])
         self.co_fallbacks.set(snap["co_fallbacks"])
+        self.ipc_submits.set(snap["ipc_submits"])
+        self.ipc_results.set(snap["ipc_results"])
+        self.ipc_fallbacks.set(snap["ipc_fallbacks"])
+        self.ipc_owner_deaths.set(snap["ipc_owner_deaths"])
         self.hedged_reads.set(snap["hedged_reads"])
         self.hedge_fired.set(snap["hedge_fired"])
         self.hedge_spares.set(snap["hedge_spares"])
@@ -876,7 +925,9 @@ class MetricsRegistry:
                   self.co_dispatches, self.co_items, self.co_blocks,
                   self.co_occupancy, self.co_wait_seconds,
                   self.co_batch_faults, self.co_member_retries,
-                  self.co_fallbacks, self.hedged_reads,
+                  self.co_fallbacks, self.ipc_submits,
+                  self.ipc_results, self.ipc_fallbacks,
+                  self.ipc_owner_deaths, self.hedged_reads,
                   self.hedge_fired, self.hedge_spares, self.hedge_wins,
                   self.dg_md5_calls, self.dg_md5_streams,
                   self.dg_md5_bytes, self.dg_md5_occupancy,

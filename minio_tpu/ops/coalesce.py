@@ -499,8 +499,18 @@ def make_digest_kernel(algo: str, pad_rows: int = 0):
 _CO: DispatchCoalescer | None = None
 _CO_MU = threading.Lock()
 
+#: Remote-submit front end (ops/ipc_dispatch.RemoteCoalescer), attached
+#: by server/workers.py inside a forked HTTP worker.  When set, every
+#: engine call site that does `coalesce.get()` transparently routes
+#: remote-eligible keys to the device-owner process and keeps the rest
+#: on the worker's own in-process scheduler.
+_REMOTE = None
 
-def get() -> DispatchCoalescer:
+
+def get():
+    r = _REMOTE
+    if r is not None:
+        return r
     global _CO
     co = _CO
     if co is None:
@@ -511,6 +521,20 @@ def get() -> DispatchCoalescer:
     return co
 
 
+def attach_remote(remote) -> None:
+    """Install a cross-process front end as THE coalescer for this
+    (worker) process.  detach_remote() restores in-process dispatch."""
+    global _REMOTE
+    _REMOTE = remote
+
+
+def detach_remote() -> None:
+    global _REMOTE
+    r, _REMOTE = _REMOTE, None
+    if r is not None:
+        r.close()
+
+
 def reset() -> None:
     """Tests: retire the singleton (its daemon thread exits) so flag
     changes start from a cold scheduler."""
@@ -519,3 +543,16 @@ def reset() -> None:
         if _CO is not None:
             _CO.close()
         _CO = None
+
+
+def _reset_after_fork() -> None:
+    # A forked child inherits the parent's singleton OBJECT but not its
+    # dispatcher thread — submits would queue forever.  Drop both the
+    # scheduler and any remote front end (its listener thread is gone
+    # too); the child lazily builds fresh ones.
+    global _CO, _REMOTE
+    _CO = None
+    _REMOTE = None
+
+
+os.register_at_fork(after_in_child=_reset_after_fork)

@@ -1,0 +1,171 @@
+"""Pre-fork shared-memory arenas for the multi-process dispatch plane.
+
+The worker pool (server/workers.py) moves shard batches between HTTP
+worker processes and the device-owner process.  Pickling a 16 MiB
+payload through a multiprocessing queue would copy it at least twice
+and serialize both ends on the pickler; instead the supervisor
+preallocates ONE anonymous shared mapping before forking (``mmap(-1)``
+is ``MAP_SHARED | MAP_ANONYMOUS`` — inherited by every child, no
+files, no resource-tracker bookkeeping) and the processes exchange
+only tiny ``(offset, nbytes)`` descriptors over the IPC ring
+(ops/ipc_ring.py).  Workers write shard bytes straight into an arena
+slot; the owner maps the same bytes as a numpy view and hands them to
+the coalescer zero-copy; results come back through the arena the same
+way.
+
+Allocation is a first-fit run of fixed-size slots under one
+cross-process lock — the arena sees a few thousand allocations per
+second at most (one per shard *batch*, not per byte), so a bitmap scan
+is entirely off the hot path.  When the arena is full, ``alloc``
+BLOCKS (bounded) — that is the backpressure contract the worker tests
+pin: a flood of writers slows down instead of corrupting or
+deadlocking, and a caller that cannot get a slot within its budget
+falls back to computing locally.
+
+Stats (occupancy, high-water, waits, timeouts) live in the shared
+header so ANY process — each worker's /metrics endpoint — can export
+them without an RPC.
+"""
+
+from __future__ import annotations
+
+import mmap
+import multiprocessing
+import os
+import time
+
+import numpy as np
+
+#: shared header: i64[8] = in_use_bytes, high_water_bytes, allocs,
+#: frees, waits, timeouts, slot_bytes, nslots
+_HDR_SLOTS = 8
+_HDR_BYTES = _HDR_SLOTS * 8
+
+
+def default_arena_bytes() -> int:
+    try:
+        mb = int(os.environ.get("MTPU_SHM_ARENA_MB", "256"))
+    except ValueError:
+        mb = 256
+    return max(8, mb) << 20
+
+
+class ArenaFull(RuntimeError):
+    """alloc() exhausted its wait budget — the caller should degrade
+    to local/inline work, not die."""
+
+
+class ShmArena:
+    """Slot arena over one anonymous shared mapping.
+
+    Create BEFORE fork; every inheriting process calls alloc/free/view
+    on its inherited copy — all state that matters (header, bitmap,
+    slot bytes) lives inside the mapping, and the allocator lock is a
+    fork-inherited ``multiprocessing`` primitive.
+    """
+
+    def __init__(self, total_bytes: int | None = None,
+                 slot_bytes: int = 1 << 20):
+        if total_bytes is None:
+            total_bytes = default_arena_bytes()
+        self.slot_bytes = int(slot_bytes)
+        self.nslots = max(1, int(total_bytes) // self.slot_bytes)
+        # layout: [header][bitmap nslots bytes][slots]
+        self._data_off = _HDR_BYTES + self.nslots
+        self._mm = mmap.mmap(-1, self._data_off
+                             + self.nslots * self.slot_bytes)
+        self._hdr = np.frombuffer(self._mm, dtype=np.int64,
+                                  count=_HDR_SLOTS)
+        self._bitmap = np.frombuffer(self._mm, dtype=np.uint8,
+                                     count=self.nslots, offset=_HDR_BYTES)
+        self._hdr[6] = self.slot_bytes
+        self._hdr[7] = self.nslots
+        ctx = multiprocessing.get_context("fork")
+        self._cv = ctx.Condition(ctx.Lock())
+
+    # -- allocation ----------------------------------------------------------
+
+    def _find_run_locked(self, want: int) -> int:
+        """First run of `want` free slots, or -1."""
+        bm = self._bitmap
+        run = 0
+        for i in range(self.nslots):
+            if bm[i]:
+                run = 0
+            else:
+                run += 1
+                if run == want:
+                    return i - want + 1
+        return -1
+
+    def alloc(self, nbytes: int, timeout: float | None = 5.0) -> int:
+        """Reserve `nbytes` of contiguous arena space; returns the byte
+        offset (pass it to view()/free()).  Blocks while the arena is
+        full, up to `timeout` — then raises ArenaFull (backpressure,
+        then degrade; never deadlock)."""
+        want = max(1, -(-int(nbytes) // self.slot_bytes))
+        if want > self.nslots:
+            raise ArenaFull(
+                f"request {nbytes}B exceeds arena "
+                f"({self.nslots * self.slot_bytes}B)")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cv:
+            first = self._find_run_locked(want)
+            waited = False
+            while first < 0:
+                waited = True
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    self._hdr[5] += 1       # timeouts
+                    raise ArenaFull(
+                        f"arena full ({want} slot(s) wanted)")
+                self._cv.wait(timeout=(0.25 if left is None
+                                       else min(left, 0.25)))
+                first = self._find_run_locked(want)
+            self._bitmap[first:first + want] = 1
+            self._hdr[0] += want * self.slot_bytes
+            if self._hdr[0] > self._hdr[1]:
+                self._hdr[1] = self._hdr[0]
+            self._hdr[2] += 1
+            if waited:
+                self._hdr[4] += 1
+        return self._data_off + first * self.slot_bytes
+
+    def free(self, offset: int, nbytes: int) -> None:
+        first = (int(offset) - self._data_off) // self.slot_bytes
+        want = max(1, -(-int(nbytes) // self.slot_bytes))
+        with self._cv:
+            self._bitmap[first:first + want] = 0
+            self._hdr[0] -= want * self.slot_bytes
+            self._hdr[3] += 1
+            self._cv.notify_all()
+
+    def view(self, offset: int, nbytes: int) -> np.ndarray:
+        """uint8 view of an allocated range — zero-copy in every
+        process that inherited the mapping."""
+        return np.frombuffer(self._mm, dtype=np.uint8,
+                             count=int(nbytes), offset=int(offset))
+
+    def reset(self) -> None:
+        """Drop every allocation (supervisor-only: called between
+        owner generations when no worker holds a live slot)."""
+        with self._cv:
+            self._bitmap[:] = 0
+            self._hdr[0] = 0
+            self._cv.notify_all()
+
+    # -- stats ---------------------------------------------------------------
+
+    def stats(self) -> dict:
+        h = self._hdr
+        return {
+            "arena_bytes": self.nslots * self.slot_bytes,
+            "in_use_bytes": int(h[0]),
+            "high_water_bytes": int(h[1]),
+            "allocs": int(h[2]),
+            "frees": int(h[3]),
+            "alloc_waits": int(h[4]),
+            "alloc_timeouts": int(h[5]),
+        }

@@ -45,6 +45,35 @@ def bucket_dns_from_env(host: str, port: int):
         raise SystemExit(2) from None
 
 
+def parse_pool_paths(drive_groups: list[list[str]]) -> list[list[str]] | None:
+    """Expand --drives groups into per-pool path lists; None on a
+    mixed ellipsis/plain group (caller exits 2).
+
+    Each --drives flag is one pool, and within a flag each
+    space-separated ellipsis group is ALSO one pool — `--drives
+    '/data{1...4} /newdata{1...4}'` is a two-pool deployment exactly
+    like the reference's capacity-expansion syntax
+    (cmd/endpoint-ellipses.go:341: one zone/pool per arg). Plain paths
+    with no ellipses keep the legacy meaning: one pool over all."""
+    from ..topology.endpoints import has_ellipses
+    pool_paths: list[list[str]] = []
+    for group in drive_groups:
+        if len(group) > 1 and any(has_ellipses(a) for a in group):
+            if not all(has_ellipses(a) for a in group):
+                # The reference rejects mixed args too — a plain path
+                # next to ellipsis pools would become a nonsensical
+                # 1-drive pool.
+                print("--drives: cannot mix ellipsis pool patterns "
+                      f"with plain paths in one group: {group}",
+                      file=sys.stderr)
+                return None
+            pool_paths.extend(expand_ellipses(a) for a in group)
+        else:
+            pool_paths.append(
+                [p for a in group for p in expand_ellipses(a)])
+    return pool_paths
+
+
 def install_signal_handlers(stop) -> None:
     """SIGTERM and SIGINT both start a graceful drain (cmd/signals.go:
     the reference treats them identically); a SECOND signal of either
@@ -75,12 +104,6 @@ def main(argv: list[str] | None = None) -> int:
                     help="dir with public.crt/private.key -> serve HTTPS")
     args = ap.parse_args(argv)
 
-    # Startup self-test guards (hard-fail like cmd/erasure-coding.go:158,
-    # cmd/bitrot.go:214).
-    from ..ops.selftest import run_startup_self_tests
-    run_startup_self_tests()
-
-    from .server import S3Server
     from .sigv4 import Credentials
 
     creds = Credentials(os.environ.get("MTPU_ROOT_USER", "minioadmin"),
@@ -101,6 +124,31 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
         certs = (cert, key)
+
+    # Pre-fork worker pool (server/workers.py): MTPU_WORKERS=N forks N
+    # SO_REUSEPORT HTTP workers plus one device-owner process.  The
+    # branch sits BEFORE any engine/jax import — forking after XLA
+    # spins up its thread pools is undefined behavior, so the
+    # supervisor must stay light and each child builds its own stack.
+    from .workers import nworkers_env
+    nworkers = nworkers_env()
+    if nworkers and cluster_mode:
+        print("minio_tpu: MTPU_WORKERS ignored in cluster mode "
+              "(one process per node)", file=sys.stderr, flush=True)
+    elif nworkers:
+        pool_paths = parse_pool_paths(drive_groups)
+        if pool_paths is None:
+            return 2
+        from .workers import run_pool
+        return run_pool(nworkers, pool_paths, creds, args.host,
+                        args.port, args.set_drive_count, certs)
+
+    # Startup self-test guards (hard-fail like cmd/erasure-coding.go:158,
+    # cmd/bitrot.go:214).
+    from ..ops.selftest import run_startup_self_tests
+    run_startup_self_tests()
+
+    from .server import S3Server
 
     if cluster_mode:
         # Distributed boot: URL endpoints, every node launched with the
@@ -188,30 +236,10 @@ def main(argv: list[str] | None = None) -> int:
     from ..engine.pools import ServerPools
     from ..engine.sets import ErasureSets
     from ..storage.drive import LocalDrive
-    from ..topology.endpoints import has_ellipses
 
-    # Pools: each --drives flag is one pool, and within a flag each
-    # space-separated ellipsis group is ALSO one pool — `--drives
-    # '/data{1...4} /newdata{1...4}'` is a two-pool deployment exactly
-    # like the reference's `minio server /data{1...4} /newdata{1...4}`
-    # capacity-expansion syntax (cmd/endpoint-ellipses.go:341: one
-    # zone/pool per arg). Plain paths with no ellipses keep the legacy
-    # meaning: one pool over all of them.
-    pool_paths: list[list[str]] = []
-    for group in drive_groups:
-        if len(group) > 1 and any(has_ellipses(a) for a in group):
-            if not all(has_ellipses(a) for a in group):
-                # The reference rejects mixed args too — a plain path
-                # next to ellipsis pools would become a nonsensical
-                # 1-drive pool.
-                print("--drives: cannot mix ellipsis pool patterns "
-                      f"with plain paths in one group: {group}",
-                      file=sys.stderr)
-                return 2
-            pool_paths.extend(expand_ellipses(a) for a in group)
-        else:
-            pool_paths.append(
-                [p for a in group for p in expand_ellipses(a)])
+    pool_paths = parse_pool_paths(drive_groups)
+    if pool_paths is None:
+        return 2
     from ..background.mrf import attach_mrf
     from ..storage.health_wrap import wrap_drives
 

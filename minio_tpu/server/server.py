@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os as _os
 import secrets
+import socket
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -108,7 +109,8 @@ class S3Server:
                  oidc=None, certs: tuple[str, str] | None = None,
                  rpc_router=None, site_replicator=None,
                  ldap=None, client_ca: str | None = None,
-                 bucket_dns=None):
+                 bucket_dns=None, reuse_port: bool = False,
+                 worker_plane=None, worker_id: int | None = None):
         self.oidc = oidc                   # iam.oidc.OpenIDConfig | None
         self.ldap = ldap                   # iam.ldap.LDAPConfig | None
         self.client_ca = client_ca         # CA bundle for mTLS STS
@@ -168,6 +170,11 @@ class S3Server:
         self.draining = False
         self._inflight = 0
         self._drain_cv = threading.Condition()
+        # Pre-fork pool wiring (server/workers.py): every worker binds
+        # the same port via SO_REUSEPORT; the plane carries the shared
+        # control block whose slabs feed /metrics and admin-info.
+        self.worker_plane = worker_plane
+        self.worker_id = worker_id
         # Site-hook single-flight state is created EAGERLY: the lazy
         # `if getattr(...) is None: self._site_hook_mu = Lock()` dance
         # raced — two first-ever mutations on different handler threads
@@ -268,6 +275,9 @@ class S3Server:
                     return
                 with outer._drain_cv:
                     outer._inflight += 1
+                if outer.worker_plane is not None:
+                    outer.worker_plane.state.note_request(
+                        outer.worker_id)
                 try:
                     self._handle_inner()
                 finally:
@@ -455,6 +465,16 @@ class S3Server:
             whole endpoint."""
             ssl_context = None
 
+            def server_bind(self):
+                if reuse_port:
+                    # Pre-fork pool: every worker binds the SAME
+                    # (host, port) and the kernel spreads connections
+                    # across them.  Must be set before bind();
+                    # socketserver on 3.10 has no allow_reuse_port.
+                    self.socket.setsockopt(socket.SOL_SOCKET,
+                                           socket.SO_REUSEPORT, 1)
+                super().server_bind()
+
             def finish_request(self, request, client_address):
                 if self.ssl_context is not None:
                     import ssl as _ssl
@@ -558,6 +578,10 @@ class S3Server:
                                             "10") or 10)
         t0 = _time.monotonic()
         deadline = t0 + timeout
+        if self.worker_plane is not None and self.worker_id is not None:
+            # pool mode: flip the shared slab so any worker's /metrics
+            # and admin-info show this one leaving rotation
+            self.worker_plane.state.set_draining(self.worker_id)
         with self._drain_cv:
             first = not self.draining
             self.draining = True
@@ -1139,9 +1163,14 @@ class S3Server:
             # — the madmin per-server state rows' analogue.
             peers = (self.cluster_node.peer_info()
                      if self.cluster_node is not None else [])
+            # Pre-fork pool view (server/workers.py): per-worker
+            # liveness/respawn rows + the owner/arena/ring plane.
+            pool_proc = (self.worker_plane.workers_info()
+                         if self.worker_plane is not None else None)
             return j({
                 "mode": "online" if ok else "degraded",
                 "peers": peers,
+                "pool": pool_proc,
                 "deploymentID": self.pools.deployment_id,
                 "buckets": {"count": n_buckets},
                 "objects": {"count": n_objects},
@@ -1787,7 +1816,13 @@ class S3Server:
             if self.cluster_node is not None:
                 self.metrics.update_peers(
                     self.cluster_node.peer_clients.values())
-            return Response(200, self.metrics.render().encode(),
+            text = self.metrics.render()
+            if self.worker_plane is not None:
+                # Pool aggregates live in shared slabs, so WHICHEVER
+                # worker the kernel picked exports the same pool-wide
+                # view (worker liveness, arena, rings, owner).
+                text += self.worker_plane.render_prom()
+            return Response(200, text.encode(),
                             {"Content-Type": "text/plain; version=0.0.4"})
         raise S3Error("MethodNotAllowed")
 

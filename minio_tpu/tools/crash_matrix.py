@@ -208,9 +208,12 @@ def tmp_residue(base_dir: str) -> list[str]:
     return left
 
 
-def run_scenario(sc: dict, base_dir: str, seed: int = 0) -> dict:
+def run_scenario(sc: dict, base_dir: str, seed: int = 0,
+                 extra_env: dict | None = None) -> dict:
     """Run one scenario over a FRESH base_dir; returns a result dict
-    (raises ScenarioError on contract violation)."""
+    (raises ScenarioError on contract violation).  extra_env reaches
+    every boot — MTPU_WORKERS=N runs the whole matrix against the
+    pre-fork pool (the supervisor propagates a worker's 137)."""
     os.makedirs(base_dir, exist_ok=True)
     point, nth, op = sc["point"], sc["nth"], sc["op"]
     expect = sc["expect"]
@@ -224,7 +227,7 @@ def run_scenario(sc: dict, base_dir: str, seed: int = 0) -> dict:
 
     # -- boot A: acked baseline, then kill -9 -------------------------------
     port = free_port()
-    proc = boot_server(base_dir, port)
+    proc = boot_server(base_dir, port, extra_env=extra_env)
     try:
         if not wait_ready(port, proc):
             raise ScenarioError(f"{point}: boot A never became ready")
@@ -238,7 +241,8 @@ def run_scenario(sc: dict, base_dir: str, seed: int = 0) -> dict:
 
     # -- boot B: armed crash point, victim op dies with the server ----------
     port = free_port()
-    proc = boot_server(base_dir, port, crash=f"{point}:{nth}")
+    proc = boot_server(base_dir, port, crash=f"{point}:{nth}",
+                       extra_env=extra_env)
     try:
         if not wait_ready(port, proc):
             raise ScenarioError(
@@ -263,7 +267,7 @@ def run_scenario(sc: dict, base_dir: str, seed: int = 0) -> dict:
 
     # -- boot C: recovery boot + assertions ---------------------------------
     port = free_port()
-    proc = boot_server(base_dir, port)
+    proc = boot_server(base_dir, port, extra_env=extra_env)
     try:
         if not wait_ready(port, proc):
             raise ScenarioError(f"{point}: recovery boot never ready")

@@ -315,6 +315,19 @@ def drain(timeout: float = 1.0) -> bool:
     return s.drain(timeout)
 
 
+def _reset_after_fork() -> None:
+    # A forked worker inherits the scheduler object but NOT its ticker
+    # thread — any stream enqueued in the child would hang, and the
+    # inherited lock may be held by a parent thread that doesn't exist
+    # here.  Drop the singleton; the child lazily builds its own lanes.
+    global _SCHED, _sched_mu
+    _SCHED = None
+    _sched_mu = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reset_after_fork)
+
+
 # -- one-shot helpers (the "rides the same plane" entries) -------------------
 
 def md5_digest(data) -> bytes:

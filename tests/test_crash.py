@@ -54,6 +54,30 @@ class TestCrashSmoke:
         assert res["victim_visible"] is True
 
 
+class TestPoolCrash:
+    """The same kill-9 contract holds when the server is the pre-fork
+    worker pool (MTPU_WORKERS=2): crash points arm inside workers via
+    the inherited environment, the supervisor propagates the child's
+    137 (boot B), and SIGTERM drains the whole pool to exit 0 (boot C)."""
+
+    def test_kill_mid_fanout_put_in_pool(self, tmp_path):
+        res = cm.run_scenario(
+            {"point": "rename.pre_meta", "nth": 1, "op": "put",
+             "expect": "absent"},
+            str(tmp_path / "site"), seed=7,
+            extra_env={"MTPU_WORKERS": "2"})
+        assert res["ok"] and res["victim_visible"] is False
+
+    @pytest.mark.slow
+    def test_kill_after_quorum_publish_in_pool(self, tmp_path):
+        res = cm.run_scenario(
+            {"point": "put.post_publish", "nth": 1, "op": "put",
+             "expect": "durable"},
+            str(tmp_path / "site"), seed=7,
+            extra_env={"MTPU_WORKERS": "2"})
+        assert res["ok"] and res["victim_visible"] is True
+
+
 class TestCrashMatrix:
     """The full seeded matrix: every instrumented crash point, each in
     its own fresh drive tree, three boots per scenario."""

@@ -13,6 +13,17 @@ Usable as a library (bench.py's concurrent suite) or a CLI:
 
     python tools/loadgen.py --clients 16 --size-kib 1024 \
         --mix 0.5 --duration 10 --root /tmp/lg
+
+Two drive modes:
+
+  * engine mode (default): clients call the ErasureSet directly — no
+    HTTP, isolates the data plane.
+  * HTTP mode (--endpoint http://...): clients speak SigV4 over the
+    wire against a RUNNING server — the mode that can actually observe
+    the pre-fork worker pool, since SO_REUSEPORT balancing happens at
+    accept time.  --procs forks the CLIENT side into multiple
+    processes too, so a GIL-bound load generator can't become the
+    bottleneck while measuring a multi-process server.
 """
 
 from __future__ import annotations
@@ -130,6 +141,122 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     }
 
 
+def _http_clients_loop(endpoint: str, creds: tuple[str, str],
+                       bucket: str, warm: list[str], body: bytes,
+                       clients: int, put_frac: float,
+                       duration_s: float, seed: int) -> dict:
+    """One load PROCESS: `clients` closed-loop threads, each with its
+    own S3Client (own connections).  Returns picklable lat/byte tallies
+    so --procs can merge across forks."""
+    from minio_tpu.server.client import S3Client
+    stop = threading.Event()
+    lat_put: list[list[float]] = [[] for _ in range(clients)]
+    lat_get: list[list[float]] = [[] for _ in range(clients)]
+    nbytes = [0] * clients
+    errors: list[str] = []
+
+    def client(ci: int) -> None:
+        cli = S3Client(endpoint, creds[0], creds[1])
+        crng = np.random.default_rng(seed * 1000 + ci)
+        j = 0
+        try:
+            while not stop.is_set():
+                is_put = crng.random() < put_frac
+                t0 = time.monotonic()
+                if is_put:
+                    cli.put_object(bucket, f"p{seed}-c{ci}-{j}", body)
+                    j += 1
+                else:
+                    name = warm[int(crng.integers(0, len(warm)))]
+                    got = cli.get_object(bucket, name)
+                    if len(got) != len(body):
+                        raise AssertionError("short read")
+                dt = time.monotonic() - t0
+                (lat_put if is_put else lat_get)[ci].append(dt)
+                nbytes[ci] += len(body)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(f"{type(e).__name__}: {e}")
+            stop.set()
+
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(60.0)
+    return {"lat_put": [x for per in lat_put for x in per],
+            "lat_get": [x for per in lat_get for x in per],
+            "nbytes": sum(nbytes), "errors": errors}
+
+
+def run_load_http(endpoint: str, *, clients: int = 4,
+                  object_size: int = 1 << 20, put_frac: float = 0.5,
+                  duration_s: float = 5.0, bucket: str = "loadgen",
+                  warm_objects: int = 8, seed: int = 0, procs: int = 1,
+                  access_key: str = "minioadmin",
+                  secret_key: str = "minioadmin") -> dict:
+    """HTTP closed loop against a running endpoint; with procs>1 the
+    `clients` are spread over that many forked client processes."""
+    import multiprocessing as mp
+    from minio_tpu.server.client import S3Client
+
+    cli = S3Client(endpoint, access_key, secret_key)
+    if not cli.bucket_exists(bucket):
+        cli.make_bucket(bucket)
+    rng = np.random.default_rng(seed)
+    body = rng.integers(0, 256, object_size, dtype=np.uint8).tobytes()
+    warm = [f"warm-{i}" for i in range(max(1, warm_objects))]
+    for name in warm:
+        cli.put_object(bucket, name, body)
+
+    procs = max(1, min(procs, clients))
+    # spread clients over processes; earlier procs take the remainder
+    per = [clients // procs + (1 if i < clients % procs else 0)
+           for i in range(procs)]
+    creds = (access_key, secret_key)
+    t_start = time.monotonic()
+    if procs == 1:
+        parts = [_http_clients_loop(endpoint, creds, bucket, warm, body,
+                                    clients, put_frac, duration_s,
+                                    seed)]
+    else:
+        ctx = mp.get_context("fork")
+        q: mp.Queue = ctx.Queue()
+
+        def entry(i: int, n: int) -> None:
+            q.put(_http_clients_loop(endpoint, creds, bucket, warm,
+                                     body, n, put_frac, duration_s,
+                                     seed + i))
+
+        ps = [ctx.Process(target=entry, args=(i, n), daemon=True)
+              for i, n in enumerate(per) if n]
+        for p in ps:
+            p.start()
+        parts = [q.get(timeout=duration_s + 120) for _ in ps]
+        for p in ps:
+            p.join(30.0)
+    wall = time.monotonic() - t_start
+    errs = [e for part in parts for e in part["errors"]]
+    if errs:
+        raise RuntimeError(f"loadgen client error: {errs[0]}")
+    puts = [x for part in parts for x in part["lat_put"]]
+    gets = [x for part in parts for x in part["lat_get"]]
+    alls = puts + gets
+    return {
+        "endpoint": endpoint, "clients": clients, "procs": procs,
+        "object_size": object_size,
+        "ops": len(alls), "puts": len(puts), "gets": len(gets),
+        "wall_s": round(wall, 3),
+        "gbps": round(sum(p["nbytes"] for p in parts) / wall / 1e9, 3),
+        "p50_ms": round(_quantile(alls, 0.50) * 1e3, 3),
+        "p99_ms": round(_quantile(alls, 0.99) * 1e3, 3),
+        "put_p50_ms": round(_quantile(puts, 0.50) * 1e3, 3),
+        "get_p50_ms": round(_quantile(gets, 0.50) * 1e3, 3),
+    }
+
+
 def make_set(root: str, n: int = 4, parity: int | None = None):
     from minio_tpu.engine.erasure_set import ErasureSet
     drives = [LocalDrive(os.path.join(root, f"d{i}")) for i in range(n)]
@@ -146,6 +273,18 @@ def main(argv=None) -> int:
     ap.add_argument("--drives", type=int, default=4)
     ap.add_argument("--parity", type=int, default=None)
     ap.add_argument("--root", default="/tmp/mtpu-loadgen")
+    ap.add_argument("--endpoint", default="",
+                    help="http(s)://host:port — drive a RUNNING server "
+                    "over the wire instead of an in-process engine")
+    ap.add_argument("--procs", type=int, default=1,
+                    help="HTTP mode: fork the client side into N "
+                    "processes (clients are spread across them)")
+    ap.add_argument("--access-key",
+                    default=os.environ.get("MTPU_ROOT_USER",
+                                           "minioadmin"))
+    ap.add_argument("--secret-key",
+                    default=os.environ.get("MTPU_ROOT_PASSWORD",
+                                           "minioadmin"))
     ap.add_argument("--profile", choices=("mixed", "put-digest"),
                     default="mixed",
                     help="put-digest: PUT-only 4 MiB objects — the "
@@ -158,10 +297,19 @@ def main(argv=None) -> int:
         if args.size_kib == 1024:          # only override the default
             args.size_kib = 4096
 
-    es = make_set(args.root, n=args.drives, parity=args.parity)
-    res = run_load(es, clients=args.clients,
-                   object_size=args.size_kib << 10,
-                   put_frac=args.mix, duration_s=args.duration)
+    if args.endpoint:
+        res = run_load_http(args.endpoint, clients=args.clients,
+                            object_size=args.size_kib << 10,
+                            put_frac=args.mix,
+                            duration_s=args.duration,
+                            procs=args.procs,
+                            access_key=args.access_key,
+                            secret_key=args.secret_key)
+    else:
+        es = make_set(args.root, n=args.drives, parity=args.parity)
+        res = run_load(es, clients=args.clients,
+                       object_size=args.size_kib << 10,
+                       put_frac=args.mix, duration_s=args.duration)
     w = max(len(k) for k in res)
     for k, v in res.items():
         print(f"{k:<{w}}  {v}")
