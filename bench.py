@@ -422,6 +422,153 @@ def workers_bench(duration_s: float = 3.0, object_mib: int = 1,
     return out
 
 
+def multichip_bench(duration_s: float = 2.5,
+                    object_mib: int = 1) -> dict:
+    """Device-sharding suite (PR 10, per-device coalescer lanes): the
+    same spread-keyspace closed loop over a 8-set hash ring at
+    MTPU_DEVICES 1/2/8, reporting aggregate GB/s, p99, and how many
+    lanes actually dispatched (with their mean batch occupancy) — plus
+    the device-parallel vs serial heal-sweep wall times over two
+    identically damaged rings, with an end-state equality check.  On a
+    host without 8 visible devices (one TPU chip, or a plain CPU) the
+    whole suite re-execs itself in a forced 8-virtual-CPU-device child,
+    same trick as __graft_entry__.dryrun_multichip.  On a 1-core host
+    the lane counts still prove the sharding; the GB/s ratios only
+    separate on real parallel hardware."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    import jax
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if (len(jax.devices()) < 8
+            and not os.environ.get("_MTPU_MULTICHIP_BENCH_CHILD")):
+        env = dict(os.environ)
+        env["_MTPU_MULTICHIP_BENCH_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = \
+            f"{flags} --xla_force_host_platform_device_count=8".strip()
+        code = (
+            "import json, sys; sys.path.insert(0, sys.argv[1]); "
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "from bench import multichip_bench; "
+            f"print(json.dumps(multichip_bench({duration_s}, "
+            f"{object_mib})))")
+        res = subprocess.run(
+            [sys.executable, "-c", code, here], env=env, cwd=here,
+            capture_output=True, text=True, timeout=900)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"multichip_bench child failed rc={res.returncode}: "
+                f"{res.stderr[-500:]}")
+        return json.loads(res.stdout.strip().splitlines()[-1])
+
+    from minio_tpu.engine import heal as heal_mod
+    from minio_tpu.ops import coalesce
+    from tools.loadgen import make_sets, run_load
+
+    out = {"mc_visible_devices": len(jax.devices())}
+    saved = {k: os.environ.get(k)
+             for k in ("MTPU_DEVICES", "MTPU_HEAL_DEVICE_PARALLEL")}
+
+    def restore():
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        coalesce.reset()
+
+    try:
+        # -- serving loop at 1/2/8 lanes --------------------------------
+        for nd in (1, 2, 8):
+            os.environ["MTPU_DEVICES"] = str(nd)
+            coalesce.reset()
+            root = tempfile.mkdtemp(prefix=f"mtpu-mc{nd}-")
+            try:
+                ring = make_sets(root, nsets=8, set_drives=2, parity=1)
+                r = run_load(ring, clients=8,
+                             object_size=object_mib << 20,
+                             put_frac=0.5, duration_s=duration_s,
+                             bucket="bench", seed=nd,
+                             keyspace="spread")
+                out[f"mc_dev{nd}_gbps"] = r["gbps"]
+                out[f"mc_dev{nd}_p99_ms"] = r["p99_ms"]
+                out[f"mc_dev{nd}_lanes_active"] = \
+                    len(r["lane_dispatches"])
+                out[f"mc_dev{nd}_lane_dispatches"] = \
+                    sum(r["lane_dispatches"].values())
+                occ = list(r["lane_occupancy"].values())
+                out[f"mc_dev{nd}_lane_occupancy"] = \
+                    round(sum(occ) / len(occ), 3) if occ else 0.0
+                out[f"mc_dev{nd}_set_spread"] = len(r["set_hits"])
+            finally:
+                shutil.rmtree(root, ignore_errors=True)
+                coalesce.reset()
+
+        # -- heal sweep: device-parallel vs serial ----------------------
+        os.environ["MTPU_DEVICES"] = "8"
+        coalesce.reset()
+        rng = np.random.default_rng(7)
+        objs = {f"heal-{i}": rng.integers(
+            0, 256, 256 * 1024, dtype=np.uint8).tobytes()
+            for i in range(16)}
+        root_a = tempfile.mkdtemp(prefix="mtpu-mch-a-")
+        root_b = None
+        try:
+            ring = make_sets(root_a, nsets=8, set_drives=2, parity=1)
+            ring.make_bucket("heal")
+            for name, body in objs.items():
+                ring.put_object("heal", name, body)
+            # clone the tree (same format/deployment id), then damage
+            # drive 0 of every set in BOTH rings identically
+            root_b = tempfile.mkdtemp(prefix="mtpu-mch-b-")
+            shutil.rmtree(root_b)
+            shutil.copytree(root_a, root_b)
+            rings, times, healed = {}, {}, {}
+            for label, root in (("serial", root_a),
+                                ("parallel", root_b)):
+                for si in range(8):
+                    d = os.path.join(root, f"d{si * 2}", "heal")
+                    shutil.rmtree(d, ignore_errors=True)
+                rings[label] = make_sets(root, nsets=8, set_drives=2,
+                                         parity=1)
+                os.environ["MTPU_HEAL_DEVICE_PARALLEL"] = \
+                    "0" if label == "serial" else "1"
+                t0 = time.monotonic()
+                rings[label].heal_bucket("heal")
+
+                def job(es):
+                    return heal_mod.heal_bucket_objects(es, "heal")
+                heal_mod.sweep_sets_device_parallel(
+                    rings[label].sets, job)
+                times[label] = time.monotonic() - t0
+                healed[label] = {
+                    name: rings[label].get_object("heal", name)[1]
+                    for name in objs}
+            out["mc_heal_serial_s"] = round(times["serial"], 3)
+            out["mc_heal_parallel_s"] = round(times["parallel"], 3)
+            out["mc_heal_parallel_vs_serial"] = round(
+                times["serial"] / times["parallel"], 2) \
+                if times["parallel"] else 0.0
+            out["mc_heal_equal"] = all(
+                bytes(healed["serial"][n]) == objs[n]
+                and bytes(healed["parallel"][n]) == objs[n]
+                for n in objs)
+        finally:
+            shutil.rmtree(root_a, ignore_errors=True)
+            if root_b:
+                shutil.rmtree(root_b, ignore_errors=True)
+    finally:
+        restore()
+    return out
+
+
 def digest_bench(duration_s: float = 3.0) -> dict:
     """Native multi-buffer digest plane suite (MTPU_NATIVE_DIGEST):
 
@@ -1089,10 +1236,11 @@ def main() -> None:
             [sys.executable, "-c",
              "import json, sys; sys.path.insert(0, sys.argv[1]); "
              "from bench import (e2e_bench, concurrent_bench, "
-             "hedge_bench, digest_bench, workers_bench); "
+             "hedge_bench, digest_bench, workers_bench, "
+             "multichip_bench); "
              "r = e2e_bench(); r.update(concurrent_bench()); "
              "r.update(hedge_bench()); r.update(digest_bench()); "
-             "r.update(workers_bench()); "
+             "r.update(workers_bench()); r.update(multichip_bench()); "
              "print(json.dumps(r))", here],
             env=env, capture_output=True, text=True, timeout=900)
         if res.returncode != 0:
@@ -1166,7 +1314,7 @@ def main() -> None:
     for k, v in results.items():
         if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup",
                         "_ms_tmpfs", "_pct", "_pct_tmpfs", "_occupancy"))
-                or k.startswith(("tunnel_", "digest_"))
+                or k.startswith(("tunnel_", "digest_", "mc_"))
                 or k == "host_cores"):
             extras.setdefault(k, v)
     if "put_stage_md5_ms_tmpfs" in extras:
@@ -1189,5 +1337,41 @@ def main() -> None:
           f"data={data_bytes/2**20:.0f}MiB x{N_ITER}", file=sys.stderr)
 
 
+def _multichip_main() -> None:
+    """`python bench.py multichip_bench`: run the device-sharding suite
+    alone and drop MULTICHIP_r06.json next to the other round files."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    doc = {"n_devices": 8, "rc": 0, "ok": False, "skipped": False}
+    try:
+        extras = multichip_bench()
+        doc["ok"] = bool(extras.get("mc_heal_equal")) and all(
+            extras.get(f"mc_dev{nd}_lanes_active", 0) >= 1
+            for nd in (1, 2, 8))
+        doc["extras"] = extras
+        doc["tail"] = (
+            f"multichip_bench OK on {extras.get('mc_visible_devices')} "
+            f"devices: lanes active 1/2/8 -> "
+            f"{extras.get('mc_dev1_lanes_active')}/"
+            f"{extras.get('mc_dev2_lanes_active')}/"
+            f"{extras.get('mc_dev8_lanes_active')}, heal "
+            f"parallel/serial = "
+            f"{extras.get('mc_heal_parallel_vs_serial')}x, "
+            f"end-state equal = {extras.get('mc_heal_equal')}")
+    except Exception as e:  # noqa: BLE001 — the round file records it
+        doc["rc"] = 1
+        doc["tail"] = f"{type(e).__name__}: {e}"
+    with open(os.path.join(here, "MULTICHIP_r06.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc))
+    if doc["rc"]:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if sys.argv[1:2] == ["multichip_bench"]:
+        _multichip_main()
+    else:
+        main()

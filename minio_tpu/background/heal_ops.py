@@ -67,9 +67,15 @@ class HealSequence:
             for bucket in buckets:
                 for pool in self.pools.pools:
                     sets = getattr(pool, "sets", [pool])
-                    for es in sets:
+
+                    # Device-parallel sweep (PR 10): each set's heal job
+                    # dispatches on the set's affine device lane; sets
+                    # sharing a lane stay serial within their group.
+                    # The observer already locks, so per-object outcomes
+                    # stream back live from every group at once.
+                    def job(es, _bucket=bucket):
                         try:
-                            H.heal_bucket(es, bucket)
+                            H.heal_bucket(es, _bucket)
                         except StorageError:
                             pass
                         # Bounded worker pool feeding the reconstruct
@@ -77,16 +83,19 @@ class HealSequence:
                         # the observer so status() stays live mid-walk.
                         try:
                             H.heal_bucket_objects(
-                                es, bucket, prefix=self.prefix,
+                                es, _bucket, prefix=self.prefix,
                                 deep=self.deep,
                                 remove_dangling=self.remove_dangling,
                                 stop=self._stop,
-                                on_object=self._on_object(bucket))
+                                on_object=self._on_object(_bucket))
                         except StorageError:
-                            continue
-                        if self._stop.is_set():
-                            self.state = "stopped"
-                            return self
+                            pass
+
+                    H.sweep_sets_device_parallel(sets, job,
+                                                 stop=self._stop)
+                    if self._stop.is_set():
+                        self.state = "stopped"
+                        return self
             self.state = "done"
         except Exception as e:  # noqa: BLE001
             self.state = "failed"

@@ -32,6 +32,7 @@ from ..cluster.dynamic_timeout import DynamicTimeout
 from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
 from ..ops import coalesce, fused
+from ..ops import devices as devices_mod
 from ..ops.erasure_cpu import ReedSolomonCPU
 from ..ops.erasure_jax import ReedSolomonTPU
 from ..parallel import pipeline as pl
@@ -247,6 +248,15 @@ class ErasureSet:
         self.metacache.bump(bucket)
 
     # -- codec helpers -------------------------------------------------------
+
+    @property
+    def device_idx(self) -> int:
+        """The coalescer-lane device this set's kernel traffic rides
+        (PR 10): `set_index % n_devices` — the same deterministic index
+        as the set's sipHashMod placement, one layer down, so affinity
+        is stable across boots and identical in every process.
+        Resolved per call: tests flip MTPU_DEVICES at runtime."""
+        return devices_mod.device_for_set(self.set_index)
 
     @property
     def _use_device(self) -> bool:
@@ -933,18 +943,21 @@ class ErasureSet:
 
         return kernel
 
-    def _enc_kernel(self, k: int, m: int, algo: str, fused_dev: bool):
+    def _enc_kernel(self, k: int, m: int, algo: str, fused_dev: bool,
+                    device: int | None = None):
         """Device/native encode over the stacked blocks; device shapes
         are padded to BATCH_BLOCKS buckets so coalesced batch sizes
         don't multiply jit compiles.  Returns (parity, digests) per
         span — the same pair the direct dispatch produces, so the
-        framing path downstream is shared."""
+        framing path downstream is shared.  `device` is the lane the
+        batch is placed on (the submitting set's affinity)."""
 
         def kernel(stacked, spans, ctx):
             if fused_dev:
                 x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
                 parity, digests = fused.encode_and_hash(x, k, m,
-                                                        algo=algo)
+                                                        algo=algo,
+                                                        device=device)
                 parity = np.asarray(parity)[:n]
                 digests = np.asarray(digests)[:, :n]
                 return [(parity[lo:hi], digests[:, lo:hi])
@@ -952,7 +965,8 @@ class ErasureSet:
             if self._use_device:
                 x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
                 parity = np.asarray(
-                    self._codec(k, m).encode_blocks(x))[:n]
+                    self._codec(k, m).encode_blocks(
+                        devices_mod.put(x, device)))[:n]
             else:
                 parity = np.asarray(
                     self._native(k, m).encode_blocks(stacked))
@@ -968,22 +982,25 @@ class ErasureSet:
         fused_dev = (algo in fused.DEVICE_ALGOS and self._use_device
                      and bitrot_io.device_preferred(algo))
         if fused_dev:
-            return fused.encode_and_hash(blocks, k, m, algo=algo)
+            return fused.encode_and_hash(blocks, k, m, algo=algo,
+                                         device=self.device_idx)
         if self._use_device:
-            return self._codec(k, m).encode_blocks(blocks), None
+            return self._codec(k, m).encode_blocks(
+                devices_mod.put(blocks, self.device_idx)), None
         return self._native(k, m).encode_blocks(blocks), None
 
     def _vt_kernel(self, k: int, m: int, sources: tuple, targets: tuple,
-                   algo: str):
+                   algo: str, device: int | None = None):
         """Fused device verify(+reconstruct) over stacked (B, K, S)
         gathers — the healthy-verify / degraded-decode / heal work
         item.  Digest layout is (B, K, hs): axis 0 is the concat axis
-        for both outputs."""
+        for both outputs.  `device` places the dispatch on the
+        submitting set's affine lane."""
 
         def kernel(stacked, spans, ctx):
             x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
             digests, out = fused.verify_and_transform(
-                x, k, m, sources, targets, algo=algo)
+                x, k, m, sources, targets, algo=algo, device=device)
             digests = np.asarray(digests)[:n]
             out = np.asarray(out)[:n] if targets else None
             return [(digests[lo:hi],
@@ -1104,7 +1121,8 @@ class ErasureSet:
                     if co is not None:
                         h = co.submit(
                             ("pf", k, m, shard_size), blocks,
-                            self._pf_kernel(k, m, shard_size), weight=nb)
+                            self._pf_kernel(k, m, shard_size), weight=nb,
+                            device=self.device_idx)
                         if pending is not None:
                             yield flush(pending)
                         pending = ("pf", h, blocks)
@@ -1143,8 +1161,9 @@ class ErasureSet:
                            else "dev" if self._use_device else "nat")
                     h = co.submit(
                         ("enc", tag, k, m, algo, shard_size), blocks,
-                        self._enc_kernel(k, m, algo, fused_dev),
-                        weight=nb)
+                        self._enc_kernel(k, m, algo, fused_dev,
+                                         device=self.device_idx),
+                        weight=nb, device=self.device_idx)
                     if pending is not None:
                         yield flush(pending)
                     pending = ("co", blocks, h)
@@ -1667,7 +1686,8 @@ class ErasureSet:
             # handoff on the single-client latency path.  Byte-exact
             # either way (same digests, same comparisons).
             use_co = (co is not None and nb > 0
-                      and (self._use_device or co.hot()))
+                      and (self._use_device
+                           or co.hot(self.device_idx)))
             if nb and fused_host is not None and not use_co:
                 # mxh256 host: ONE C pass verifies every frame AND
                 # gathers the systematic rows straight into the final
@@ -1707,7 +1727,7 @@ class ErasureSet:
                         coalesce.make_digest_kernel(
                             algo, BATCH_BLOCKS * k if self._use_device
                             else 0),
-                        weight=nb)
+                        weight=nb, device=self.device_idx)
                     try:
                         digests = h.result().reshape(nb, k, hs)
                         h.release()
@@ -1721,7 +1741,8 @@ class ErasureSet:
                         and bitrot_io.device_preferred(algo) \
                         and not _mesh_mode():
                     digests = np.asarray(fused.verify_and_transform(
-                        y, k, m, tuple(range(k)), (), algo=algo)[0])
+                        y, k, m, tuple(range(k)), (), algo=algo,
+                        device=self.device_idx)[0])
                     got = [digests[:, s] for s in range(k)]
                 else:
                     got = self._hash_shard_frames(
@@ -1776,14 +1797,14 @@ class ErasureSet:
             # work, so concurrency is only visible to hot() through
             # this counter.
             if co is not None:
-                co.note_read(1)
+                co.note_read(1, device=self.device_idx)
             try:
                 got = fast_path()
             except (StorageError, OSError):
                 got = None
             finally:
                 if co is not None:
-                    co.note_read(-1)
+                    co.note_read(-1, device=self.device_idx)
             if got is not None:
                 return got[0]
             DATA_PATH.record_fastpath_fallback()
@@ -1875,8 +1896,9 @@ class ErasureSet:
                             ("vt", k, m, tuple(sel), tuple(missing),
                              algo, shard_size), x,
                             self._vt_kernel(k, m, tuple(sel),
-                                            tuple(missing), algo),
-                            weight=nb)
+                                            tuple(missing), algo,
+                                            device=self.device_idx),
+                            weight=nb, device=self.device_idx)
                         try:
                             digests, dev_out = h.result()
                             h.release()
@@ -1884,12 +1906,12 @@ class ErasureSet:
                             DATA_PATH.record_co_fallback()
                             digests, dev_out = fused.verify_and_transform(
                                 x, k, m, tuple(sel), tuple(missing),
-                                algo=algo)
+                                algo=algo, device=self.device_idx)
                             digests = np.asarray(digests)
                     else:
                         digests, dev_out = fused.verify_and_transform(
                             x, k, m, tuple(sel), tuple(missing),
-                            algo=algo)
+                            algo=algo, device=self.device_idx)
                         digests = np.asarray(digests)
                 else:
                     # Host path (host-hashed algorithm, no TPU, or an
@@ -1898,11 +1920,11 @@ class ErasureSet:
                     # host, reconstruct via the backend picker only if
                     # rows are missing.
                     flat = x.reshape(nb * k, shard_size)
-                    if co is not None and co.hot():
+                    if co is not None and co.hot(self.device_idx):
                         h = co.submit(
                             ("digest", algo, shard_size), flat,
                             coalesce.make_digest_kernel(algo),
-                            weight=nb)
+                            weight=nb, device=self.device_idx)
                         try:
                             digests = h.result().reshape(nb, k, hs)
                             h.release()

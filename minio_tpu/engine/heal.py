@@ -668,29 +668,32 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
                     h = co.submit(
                         ("vt", k, m, tuple(cur), tuple(need), algo, S),
                         x, es._vt_kernel(k, m, tuple(cur), tuple(need),
-                                         algo), weight=nb)
+                                         algo, device=es.device_idx),
+                        weight=nb, device=es.device_idx)
                     try:
                         digests, rebuilt = h.result()
                         h.release()
                     except Exception:  # noqa: BLE001 — direct fallback
                         DATA_PATH.record_co_fallback()
                         digests, rebuilt = fused.verify_and_transform(
-                            x, k, m, tuple(cur), tuple(need), algo=algo)
+                            x, k, m, tuple(cur), tuple(need), algo=algo,
+                            device=es.device_idx)
                         digests = np.asarray(digests)
                         rebuilt = np.asarray(rebuilt) if need else None
                     if not need:
                         rebuilt = None
                 else:
                     digests, rebuilt = fused.verify_and_transform(
-                        x, k, m, tuple(cur), tuple(need), algo=algo)
+                        x, k, m, tuple(cur), tuple(need), algo=algo,
+                        device=es.device_idx)
                     digests = np.asarray(digests)
                     rebuilt = np.asarray(rebuilt) if need else None
             else:
-                if co is not None and co.hot():
+                if co is not None and co.hot(es.device_idx):
                     h = co.submit(("digest", algo, S),
                                   x.reshape(nb * k, S),
                                   coalesce.make_digest_kernel(algo),
-                                  weight=nb)
+                                  weight=nb, device=es.device_idx)
                     try:
                         digests = h.result().reshape(nb, k, hs)
                         h.release()
@@ -1021,4 +1024,62 @@ def heal_bucket_objects(es: ErasureSet, bucket: str, prefix: str = "",
     finally:
         if pool is not None:
             pool.shutdown(wait=True)
+    return results
+
+
+def device_parallel_enabled() -> bool:
+    """MTPU_HEAL_DEVICE_PARALLEL=0 is the serial-sweep oracle the
+    equivalence tests diff against (read per call)."""
+    return os.environ.get("MTPU_HEAL_DEVICE_PARALLEL", "1") != "0"
+
+
+def sweep_sets_device_parallel(sets, job, stop: threading.Event | None = None):
+    """Run `job(es)` over every erasure set with device-parallelism
+    (PR 10): sets are grouped by their lane affinity (`es.device_idx`)
+    and one worker thread per device runs its group's sets in order —
+    per-set heal jobs against DIFFERENT devices dispatch concurrently
+    while one device's own jobs stay serial (no oversubscribing a
+    single accelerator queue, and within-device ordering matches the
+    serial sweep).  With one group, a stop request, or the serial
+    oracle flag, this degrades to the plain in-order loop.
+
+    Returns {set_index: job result}.  The first exception any group
+    raised is re-raised after every group finished — same containment
+    the serial loop gets from its caller, but no set is silently
+    skipped because a sibling on another device failed."""
+    groups: dict[int, list] = {}
+    for es in sets:
+        groups.setdefault(getattr(es, "device_idx", 0), []).append(es)
+    results: dict[int, object] = {}
+    if not device_parallel_enabled() or len(groups) <= 1:
+        for es in sets:
+            if stop is not None and stop.is_set():
+                break
+            results[es.set_index] = job(es)
+        return results
+    mu = threading.Lock()
+    errors: list[BaseException] = []
+
+    def run_group(group):
+        for es in group:
+            if stop is not None and stop.is_set():
+                return
+            try:
+                r = job(es)
+            except BaseException as e:  # noqa: BLE001 — collect, re-raise
+                with mu:
+                    errors.append(e)
+                return
+            with mu:
+                results[es.set_index] = r
+
+    threads = [threading.Thread(target=run_group, args=(g,),
+                                name=f"mtpu-heal-d{d}", daemon=True)
+               for d, g in sorted(groups.items())]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
     return results

@@ -179,11 +179,19 @@ class ErasureSets:
                                     version_id, **kw)
 
     def heal_bucket(self, bucket: str) -> dict[int, list[int]]:
-        out = {}
+        # Device-parallel sweep (PR 10): sets on different device lanes
+        # heal concurrently; MTPU_HEAL_DEVICE_PARALLEL=0 restores the
+        # serial in-order loop.
+        res = heal_mod.sweep_sets_device_parallel(
+            self.sets, lambda s: heal_mod.heal_bucket(s, bucket))
+        return {i: healed for i, s in enumerate(self.sets)
+                if (healed := res.get(s.set_index))}
+
+    def device_map(self) -> dict[int, list[int]]:
+        """device index -> set indices affine to it (admin-info)."""
+        out: dict[int, list[int]] = {}
         for i, s in enumerate(self.sets):
-            healed = heal_mod.heal_bucket(s, bucket)
-            if healed:
-                out[i] = healed
+            out.setdefault(s.device_idx, []).append(i)
         return out
 
     # -- capacity ------------------------------------------------------------

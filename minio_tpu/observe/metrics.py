@@ -212,6 +212,11 @@ class DataPathStats:
             self.co_batch_faults = 0
             self.co_member_retries = 0
             self.co_fallbacks = 0
+            # Per-device coalescer lanes (PR 10): device index ->
+            # {dispatches, items, weight, wait_s}.  Aggregates above
+            # stay the cross-lane totals; this map is what the
+            # mtpu_device_lane_* gauge families render from.
+            self.lanes = {}
             # Cross-process dispatch (ops/ipc_dispatch.py, worker pool):
             # items shipped to the device owner, results received,
             # fallbacks (arena/ring full -> computed locally), and
@@ -321,6 +326,20 @@ class DataPathStats:
             self.co_items += items
             self.co_weight += weight
             self.co_wait_s += wait_s
+
+    def record_lane_dispatch(self, device: int, items: int, weight: int,
+                             wait_s: float) -> None:
+        """One coalesced launch on device lane `device`."""
+        with self._mu:
+            row = self.lanes.get(device)
+            if row is None:
+                row = self.lanes[device] = {
+                    "dispatches": 0, "items": 0, "weight": 0,
+                    "wait_s": 0.0}
+            row["dispatches"] += 1
+            row["items"] += items
+            row["weight"] += weight
+            row["wait_s"] += wait_s
 
     def record_co_fault(self, members: int) -> None:
         """A coalesced dispatch raised; `members` spans were retried
@@ -447,6 +466,8 @@ class DataPathStats:
                 "co_batch_faults": self.co_batch_faults,
                 "co_member_retries": self.co_member_retries,
                 "co_fallbacks": self.co_fallbacks,
+                "lanes": {d: dict(row)
+                          for d, row in sorted(self.lanes.items())},
                 "ipc_submits": self.ipc_submits,
                 "ipc_rows": self.ipc_rows,
                 "ipc_results": self.ipc_results,
@@ -584,6 +605,20 @@ class MetricsRegistry:
             "mtpu_coalesce_fallbacks_total",
             "Call sites that recomputed a span through the direct "
             "path after a failed coalesced handle")
+        # Per-device coalescer-lane families (PR 10): one series per
+        # device lane, so skew between lanes is visible (a pinned
+        # keyspace lights one device; spread lights them all).
+        self.device_lane_dispatches = Gauge(
+            "mtpu_device_lane_dispatches_total",
+            "Coalesced kernel launches per device lane", ("device",))
+        self.device_lane_occupancy = Gauge(
+            "mtpu_device_lane_occupancy",
+            "Mean work items per dispatch on this device lane",
+            ("device",))
+        self.device_lane_queue_wait = Gauge(
+            "mtpu_device_lane_queue_wait_seconds_total",
+            "Summed per-item queue wait before dispatch on this "
+            "device lane", ("device",))
         # Cross-process dispatch families (worker pool, PR 9).
         self.ipc_submits = Gauge(
             "mtpu_ipc_dispatch_submits_total",
@@ -852,6 +887,14 @@ class MetricsRegistry:
         self.co_batch_faults.set(snap["co_batch_faults"])
         self.co_member_retries.set(snap["co_member_retries"])
         self.co_fallbacks.set(snap["co_fallbacks"])
+        for dev, row in snap["lanes"].items():
+            self.device_lane_dispatches.set(row["dispatches"],
+                                            device=str(dev))
+            self.device_lane_occupancy.set(
+                row["items"] / row["dispatches"]
+                if row["dispatches"] else 0.0, device=str(dev))
+            self.device_lane_queue_wait.set(row["wait_s"],
+                                            device=str(dev))
         self.ipc_submits.set(snap["ipc_submits"])
         self.ipc_results.set(snap["ipc_results"])
         self.ipc_fallbacks.set(snap["ipc_fallbacks"])
@@ -925,7 +968,9 @@ class MetricsRegistry:
                   self.co_dispatches, self.co_items, self.co_blocks,
                   self.co_occupancy, self.co_wait_seconds,
                   self.co_batch_faults, self.co_member_retries,
-                  self.co_fallbacks, self.ipc_submits,
+                  self.co_fallbacks, self.device_lane_dispatches,
+                  self.device_lane_occupancy,
+                  self.device_lane_queue_wait, self.ipc_submits,
                   self.ipc_results, self.ipc_fallbacks,
                   self.ipc_owner_deaths, self.hedged_reads,
                   self.hedge_fired, self.hedge_spares, self.hedge_wins,

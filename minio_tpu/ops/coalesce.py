@@ -6,12 +6,25 @@ PUTs cost N small `encode_and_hash` launches instead of one large one,
 and dispatch overhead dominates exactly where the accelerator should
 shine.  This module applies the insight behind continuous batching in
 inference serving (Orca-style iteration-level scheduling) to object
-storage: a single dispatcher thread drains per-kernel queues that all
+storage: a dispatcher thread drains per-kernel queues that all
 in-flight requests submit to, packs compatible work items into ONE
 batched kernel call, and scatters the per-item slices back through
 futures.
 
-Scheduling contract:
+Since PR 10 the scheduler is sharded per device: `DispatchCoalescer`
+is a facade over one `DispatchLane` per visible device (lane count =
+`ops/devices.n_devices()`), and every submit carries the device index
+its erasure set is affine to (`set_index % n_devices` — the sipHashMod
+placement scheme one layer down).  Each lane owns one device, runs its
+own dispatcher thread, packs cross-set batches that map to ITS device,
+and keeps its own stats block — per-lane occupancy EMAs never pollute
+another lane's adaptive-window decision, and concurrent PUTs against
+sets on different devices launch kernels concurrently instead of
+serializing behind one queue.  The default single-lane configuration
+(CPU hosts, MTPU_DEVICES=1) is byte-for-byte the pre-sharding
+scheduler.
+
+Scheduling contract (per lane):
 
 - items are compatible when they share a key `(kind, k, m, algo,
   shard_size, ...)` — same kernel, same geometry, so their block axes
@@ -37,7 +50,8 @@ Env (read per call so tests flip them without re-importing):
 - MTPU_COALESCE_WINDOW_US: max time the oldest queued item waits for
   company once the window engages (default 250);
 - MTPU_COALESCE_MAX_BATCH: batch budget in 1 MiB-block weight units
-  (default 64 — two full per-request encode batches per dispatch).
+  (default 64 — two full per-request encode batches per dispatch);
+- MTPU_DEVICES: lane count (see ops/devices.py).
 """
 
 from __future__ import annotations
@@ -178,15 +192,19 @@ class Handle:
             ctx._deref()
 
 
-class DispatchCoalescer:
-    """The shared scheduler: per-key FIFO queues + one daemon dispatcher
-    thread (started lazily on first submit)."""
+class DispatchLane:
+    """One device's scheduler: per-key FIFO queues + one daemon
+    dispatcher thread (started lazily on first queued submit).  All
+    state — queues, occupancy EMA, buffer pool, lifetime stats — is
+    lane-private, so one device's traffic never skews another lane's
+    adaptive-window decision."""
 
     #: queued-weight cap as a multiple of the batch budget — beyond
     #: this, submit() blocks (backpressure) instead of buffering.
     QUEUE_FACTOR = 4
 
-    def __init__(self):
+    def __init__(self, device: int = 0):
+        self.device = int(device)
         self._mu = threading.Lock()
         self._work = threading.Condition(self._mu)
         self._space = threading.Condition(self._mu)
@@ -250,7 +268,8 @@ class DispatchCoalescer:
             else:
                 if self._thread is None:
                     self._thread = threading.Thread(
-                        target=self._loop, name="mtpu-coalesce",
+                        target=self._loop,
+                        name=f"mtpu-coalesce-d{self.device}",
                         daemon=True)
                     self._thread.start()
                 # Backpressure: an item never waits on its OWN weight
@@ -278,7 +297,7 @@ class DispatchCoalescer:
     # -- routing signals -----------------------------------------------------
 
     def hot(self) -> bool:
-        """Whether routing MORE work through the coalescer is likely to
+        """Whether routing MORE work through this lane is likely to
         batch (vs. adding a thread handoff to a lone request): work is
         queued or dispatching right now, recent dispatches packed >1
         item, or >1 read is concurrently in flight."""
@@ -434,6 +453,7 @@ class DispatchCoalescer:
             self.max_items = max(self.max_items, len(items))
             self._ema = 0.75 * self._ema + 0.25 * len(items)
         DATA_PATH.record_coalesce_dispatch(len(items), w, wait_sum)
+        DATA_PATH.record_lane_dispatch(self.device, len(items), w, wait_sum)
 
     # -- lifecycle / introspection ------------------------------------------
 
@@ -460,6 +480,7 @@ class DispatchCoalescer:
     def stats(self) -> dict:
         with self._mu:
             return {
+                "device": self.device,
                 "dispatches": self.dispatches,
                 "items": self.items,
                 "weight": self.weight,
@@ -473,6 +494,114 @@ class DispatchCoalescer:
                 "member_retries": self.member_retries,
                 "broken": self._broken is not None,
             }
+
+
+class DispatchCoalescer:
+    """Per-device lane facade: routes each submit to the lane owning
+    the target device (`device % n_lanes`, so a lane index is always
+    valid even when the topology shrank) and aggregates lane stats.
+    Lane count is resolved lazily from `ops/devices.n_devices()` on
+    first use and then frozen for the instance — tests flip
+    MTPU_DEVICES and call `coalesce.reset()` for a fresh topology.
+
+    With one lane (the host/oracle default) the facade is a thin
+    pass-through around the exact pre-sharding scheduler."""
+
+    def __init__(self, nlanes: int | None = None):
+        self._lanes_mu = threading.Lock()
+        self._want_lanes = nlanes
+        self._lanes: dict[int, DispatchLane] = {}
+        self._closed = False
+
+    def nlanes(self) -> int:
+        n = self._want_lanes
+        if n is None:
+            from . import devices
+
+            n = self._want_lanes = devices.n_devices()
+        return n
+
+    def lane(self, device: int = 0) -> DispatchLane:
+        d = int(device) % self.nlanes()
+        lane = self._lanes.get(d)
+        if lane is None:
+            with self._lanes_mu:
+                lane = self._lanes.get(d)
+                if lane is None:
+                    lane = DispatchLane(device=d)
+                    if self._closed:
+                        # Post-close stragglers (a late note_read in a
+                        # request's finally) get a lane that refuses
+                        # submits but never hangs or raises elsewhere.
+                        lane._stopped = True
+                    self._lanes[d] = lane
+        return lane
+
+    # -- pass-throughs keyed by device --------------------------------------
+
+    def submit(self, key: tuple, payload: np.ndarray, fn,
+               weight: int | None = None, device: int = 0) -> Handle:
+        return self.lane(device).submit(key, payload, fn, weight)
+
+    def hot(self, device: int | None = None) -> bool:
+        if device is not None:
+            return self.lane(device).hot()
+        return any(ln.hot() for ln in list(self._lanes.values()))
+
+    def note_read(self, delta: int, device: int = 0) -> None:
+        self.lane(device).note_read(delta)
+
+    # -- single-lane compatibility surface ----------------------------------
+    # The scheduler unit tests (and the idle fast-path contract) poke
+    # lane internals through the facade; with lanes these map to lane 0.
+
+    @property
+    def _ema(self) -> float:
+        return self.lane(0)._ema
+
+    @_ema.setter
+    def _ema(self, v: float) -> None:
+        self.lane(0)._ema = v
+
+    @property
+    def _thread(self):
+        ln = self._lanes.get(0)
+        return None if ln is None else ln._thread
+
+    # -- lifecycle / introspection ------------------------------------------
+
+    def close(self) -> None:
+        with self._lanes_mu:
+            self._closed = True
+            lanes = list(self._lanes.values())
+        for ln in lanes:
+            ln.close()
+
+    def lane_stats(self) -> dict[int, dict]:
+        """Per-lane stats for lanes that have actually been touched."""
+        return {d: ln.stats() for d, ln in sorted(self._lanes.items())}
+
+    def stats(self) -> dict:
+        per = self.lane_stats()
+        out = {
+            "dispatches": 0, "items": 0, "weight": 0, "wait_s": 0.0,
+            "max_items": 0, "pending_items": 0, "pending_weight": 0,
+            "batch_faults": 0, "member_retries": 0,
+        }
+        broken = False
+        for st in per.values():
+            for k in ("dispatches", "items", "weight", "wait_s",
+                      "pending_items", "pending_weight", "batch_faults",
+                      "member_retries"):
+                out[k] += st[k]
+            out["max_items"] = max(out["max_items"], st["max_items"])
+            broken = broken or st["broken"]
+        out["occupancy"] = (out["items"] / out["dispatches"]
+                            if out["dispatches"] else 0.0)
+        out["broken"] = broken
+        out["n_lanes"] = self.nlanes()
+        out["lanes"] = per
+        return out
 
 
 # -- shared kernels ----------------------------------------------------------
@@ -536,7 +665,7 @@ def detach_remote() -> None:
 
 
 def reset() -> None:
-    """Tests: retire the singleton (its daemon thread exits) so flag
+    """Tests: retire the singleton (its daemon threads exit) so flag
     changes start from a cold scheduler."""
     global _CO
     with _CO_MU:
@@ -547,7 +676,7 @@ def reset() -> None:
 
 def _reset_after_fork() -> None:
     # A forked child inherits the parent's singleton OBJECT but not its
-    # dispatcher thread — submits would queue forever.  Drop both the
+    # dispatcher threads — submits would queue forever.  Drop both the
     # scheduler and any remote front end (its listener thread is gone
     # too); the child lazily builds fresh ones.
     global _CO, _REMOTE
@@ -556,3 +685,4 @@ def _reset_after_fork() -> None:
 
 
 os.register_at_fork(after_in_child=_reset_after_fork)
+

@@ -1,0 +1,110 @@
+"""Device discovery + deterministic erasure-set → device affinity.
+
+The reference spreads objects across erasure sets with sipHashMod
+(cmd/erasure-server-pool.go, mirrored by `engine/sets.py:set_for`).
+This module pushes the SAME deterministic index one layer down, to the
+accelerator plane:
+
+    device = set_index % n_devices()
+
+so kernel-lane placement needs no coordination protocol: it is stable
+across boots, identical in every process of the pre-fork pool (all of
+them derive it from the deployment-id-keyed sipHashMod), and trivially
+rebalances when the device count changes — exactly the properties the
+set placement already has.
+
+Env:
+
+- MTPU_DEVICES=N — lane count override, clamped to the visible device
+  topology.  `=1` is the byte-identical single-lane oracle the
+  differential tests diff against.  Unset, the count defaults to every
+  visible device on a real TPU mesh and 1 on host backends, so CPU CI
+  opts into multi-lane explicitly (simulated mesh via
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 + MTPU_DEVICES=8).
+
+The env var is read per call so tests can flip lane counts without
+re-importing; only the (static per-process) jax device topology is
+cached.
+"""
+
+from __future__ import annotations
+
+import os
+
+_VISIBLE: tuple[list, str] | None = None
+
+
+def _visible() -> tuple[list, str]:
+    """(devices, backend) — cached; device topology is fixed per
+    process.  Import of jax is deferred to first use so import-light
+    processes (the pre-fork supervisor) never pay for it."""
+    global _VISIBLE
+    if _VISIBLE is None:
+        try:
+            import jax
+
+            _VISIBLE = (list(jax.devices()), jax.default_backend())
+        except Exception:  # noqa: BLE001 — no jax → single host lane
+            _VISIBLE = ([], "none")
+    return _VISIBLE
+
+
+def visible_count() -> int:
+    return max(1, len(_visible()[0]))
+
+
+def n_devices() -> int:
+    """Number of kernel lanes (= devices) the coalescer shards over."""
+    v = os.environ.get("MTPU_DEVICES", "").strip()
+    if v:
+        try:
+            n = int(v)
+        except ValueError:
+            n = 1
+        return max(1, min(n, visible_count()))
+    devs, backend = _visible()
+    if backend == "tpu" and len(devs) > 1:
+        return len(devs)
+    return 1
+
+
+def device_for_set(set_index: int) -> int:
+    """Lane affinity of an erasure set: same modulo-of-deterministic-
+    index scheme as its sipHashMod placement, one layer down."""
+    return int(set_index) % n_devices()
+
+
+def jax_device(idx: int):
+    """The jax Device lane `idx` dispatches on (None when jax is
+    unavailable).  Indices wrap over the visible topology so a lane
+    index is always placeable."""
+    devs, _ = _visible()
+    if not devs:
+        return None
+    return devs[int(idx) % len(devs)]
+
+
+def put(x, device_idx: int | None):
+    """Commit `x` onto lane `device_idx`'s device via jax.device_put;
+    identity when placement is unavailable or unrequested.  A committed
+    input makes every downstream jit execution follow it to that
+    device — the whole of 'explicit device placement' for the fused
+    kernels."""
+    if device_idx is None:
+        return x
+    dev = jax_device(device_idx)
+    if dev is None:
+        return x
+    import jax
+
+    return jax.device_put(x, dev)
+
+
+def _reset_after_fork() -> None:
+    # A forked child may land on a different backend (workers re-import
+    # jax post-fork); drop the cached topology.
+    global _VISIBLE
+    _VISIBLE = None
+
+
+os.register_at_fork(after_in_child=_reset_after_fork)

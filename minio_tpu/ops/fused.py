@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ..observe import span as ospan
+from . import devices as devices_mod
 from . import erasure_jax, erasure_pallas
 from .highwayhash import MAGIC_KEY
 from .highwayhash_jax import _hh256_impl
@@ -40,17 +41,33 @@ from .mxhash_jax import mxh256_rows
 DEVICE_ALGOS = ("mxh256", "highwayhash256S", "highwayhash256")
 
 
-def _traced_dispatch(name: str, fn, *args):
+def _traced_dispatch(name: str, fn, x, device: int | None = None):
     """Run a jitted kernel call; inside a traced request the span covers
     dispatch AND device completion (block_until_ready), so the trace
-    attributes real device time. Untraced calls stay fully async —
+    attributes real device time (tagged with the lane's device index
+    when the dispatch is placed). Untraced calls stay fully async —
     callers sync via np.asarray exactly as before."""
     if not ospan.active():
-        return fn(*args)
-    with ospan.span(name):
-        out = fn(*args)
+        return fn(x)
+    with ospan.span(name) as sp:
+        if device is not None:
+            sp.tag(device=int(device))
+        out = fn(x)
         jax.block_until_ready(out)
         return out
+
+
+def _placed(x, device: int | None):
+    """Commit the input batch to lane `device`'s jax device (PR 10
+    erasure-set affinity): jit executions follow a committed input, so
+    this one device_put is the whole placement story for every fused
+    kernel. `device=None` keeps the historical default-device path."""
+    if device is None:
+        return jnp.asarray(x, dtype=jnp.uint8)
+    dev = devices_mod.jax_device(device)
+    if dev is None:
+        return jnp.asarray(x, dtype=jnp.uint8)
+    return jax.device_put(jnp.asarray(x, dtype=jnp.uint8), dev)
 
 
 def _digest_rows(x2d: jax.Array, algo: str, key: bytes) -> jax.Array:
@@ -94,20 +111,25 @@ def _verify_transform_jit(k: int, m: int, sources: tuple[int, ...],
 def verify_and_transform(x, k: int, m: int, sources: tuple[int, ...],
                          targets: tuple[int, ...],
                          algo: str = "highwayhash256S",
-                         key: bytes = MAGIC_KEY):
+                         key: bytes = MAGIC_KEY,
+                         device: int | None = None):
     """((B, K, S) shard rows) -> ((B, K, 32) digests, (B, T, S) rebuilt rows).
 
     Digests are of the INPUT rows (callers compare them against the bitrot
     frame hashes); rebuilt rows are the GF transform sources->targets.
-    With no targets (nothing missing) only the hash runs.
+    With no targets (nothing missing) only the hash runs.  `device` is
+    the coalescer-lane index the dispatch is placed on (None = default
+    device, the pre-sharding behavior).
     """
-    x = jnp.asarray(x, dtype=jnp.uint8)
+    x = _placed(x, device)
     if not targets:
         return _traced_dispatch("device.verify",
-                                _hash_rows_jit(algo, key), x), None
+                                _hash_rows_jit(algo, key), x,
+                                device=device), None
     fn = _verify_transform_jit(k, m, tuple(sources), tuple(targets),
                                algo, key)
-    return _traced_dispatch("device.verify_transform", fn, x)
+    return _traced_dispatch("device.verify_transform", fn, x,
+                            device=device)
 
 
 @functools.lru_cache(maxsize=64)
@@ -129,14 +151,17 @@ def _encode_hash_jit(k: int, m: int, algo: str, key: bytes):
 
 
 def encode_and_hash(x, k: int, m: int, algo: str = "highwayhash256S",
-                    key: bytes = MAGIC_KEY):
+                    key: bytes = MAGIC_KEY,
+                    device: int | None = None):
     """((B, K, S) data) -> ((B, M, S) parity, (K+M, B, 32) digests).
 
     The PUT hot path: parity AND per-shard-block bitrot digests in one
     device dispatch; framing on the host is then pure byte interleaving.
     Digest layout is shard-major to match frame_shards_batch's
-    (n_shards, n_blocks) order.
+    (n_shards, n_blocks) order.  `device` places the dispatch on that
+    coalescer lane's device (None = default device).
     """
-    x = jnp.asarray(x, dtype=jnp.uint8)
+    x = _placed(x, device)
     return _traced_dispatch("device.encode_hash",
-                            _encode_hash_jit(k, m, algo, key), x)
+                            _encode_hash_jit(k, m, algo, key), x,
+                            device=device)

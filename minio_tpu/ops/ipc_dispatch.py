@@ -63,8 +63,12 @@ from . import coalesce
 from .shm_arena import ArenaFull
 
 #: descriptor wire format (one ipc_ring record):
-#: magic, worker_id, req_id, slot_off, total_len, hdr_len, status, gen
-_DESC = struct.Struct("<IIQQQIiI")
+#: magic, worker_id, req_id, slot_off, total_len, hdr_len, status, gen,
+#: device — the coalescer-lane index the submitting set is affine to
+#: (PR 10), so the owner routes the arena slot to the right device lane
+#: without parsing the JSON header.  48 bytes, still inside the 64-byte
+#: ring record.
+_DESC = struct.Struct("<IIQQQIiII")
 _MAGIC = 0x4D545055            # "MTPU"
 
 #: descriptor status codes
@@ -148,16 +152,20 @@ def _pf_kernel(k: int, m: int, shard_size: int):
     return kernel
 
 
-def _enc_kernel(tag: str, k: int, m: int, algo: str):
+def _enc_kernel(tag: str, k: int, m: int, algo: str,
+                device: int | None = None):
     """Owner-side mirror of ErasureSet._enc_kernel; the tag picks the
-    backend the submitting worker would have used."""
+    backend the submitting worker would have used, `device` the lane
+    the dispatch is placed on."""
     from ..engine.erasure_set import BATCH_BLOCKS
+    from . import devices as devices_mod
     from . import fused
 
     if tag == "fd":
         def kernel(stacked, spans, ctx):
             x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
-            parity, digests = fused.encode_and_hash(x, k, m, algo=algo)
+            parity, digests = fused.encode_and_hash(x, k, m, algo=algo,
+                                                    device=device)
             parity = np.asarray(parity)[:n]
             digests = np.asarray(digests)[:, :n]
             return [(parity[lo:hi], digests[:, lo:hi])
@@ -168,7 +176,8 @@ def _enc_kernel(tag: str, k: int, m: int, algo: str):
     if tag == "dev":
         def kernel(stacked, spans, ctx):
             x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
-            parity = np.asarray(codec.encode_blocks(x))[:n]
+            parity = np.asarray(codec.encode_blocks(
+                devices_mod.put(x, device)))[:n]
             return [(parity[lo:hi], None) for lo, hi in spans]
     else:
         def kernel(stacked, spans, ctx):
@@ -177,7 +186,8 @@ def _enc_kernel(tag: str, k: int, m: int, algo: str):
     return kernel
 
 
-def _vt_kernel(k: int, m: int, sources: tuple, targets: tuple, algo: str):
+def _vt_kernel(k: int, m: int, sources: tuple, targets: tuple, algo: str,
+               device: int | None = None):
     """Owner-side mirror of ErasureSet._vt_kernel (fused verify/
     reconstruct)."""
     from ..engine.erasure_set import BATCH_BLOCKS
@@ -186,7 +196,7 @@ def _vt_kernel(k: int, m: int, sources: tuple, targets: tuple, algo: str):
     def kernel(stacked, spans, ctx):
         x, n = coalesce.pad_batch(stacked, BATCH_BLOCKS)
         digests, out = fused.verify_and_transform(
-            x, k, m, sources, targets, algo=algo)
+            x, k, m, sources, targets, algo=algo, device=device)
         digests = np.asarray(digests)[:n]
         out = np.asarray(out)[:n] if targets else None
         return [(digests[lo:hi], out[lo:hi] if out is not None else None)
@@ -195,10 +205,10 @@ def _vt_kernel(k: int, m: int, sources: tuple, targets: tuple, algo: str):
     return kernel
 
 
-def kernel_from_key(key: tuple):
-    """Rebuild the dispatch kernel for a coalescer key.  Raises KeyError
-    for kinds this registry does not know (the worker then keeps them
-    local)."""
+def kernel_from_key(key: tuple, device: int | None = None):
+    """Rebuild the dispatch kernel for a coalescer key (placed on lane
+    `device` for device-backed kinds).  Raises KeyError for kinds this
+    registry does not know (the worker then keeps them local)."""
     kind = key[0]
     if kind == "digest":
         _, algo, _shard = key
@@ -208,11 +218,12 @@ def kernel_from_key(key: tuple):
         return _pf_kernel(int(k), int(m), int(shard))
     if kind == "enc":
         _, tag, k, m, algo, _shard = key
-        return _enc_kernel(str(tag), int(k), int(m), str(algo))
+        return _enc_kernel(str(tag), int(k), int(m), str(algo),
+                           device=device)
     if kind == "vt":
         _, k, m, sources, targets, algo, _shard = key
         return _vt_kernel(int(k), int(m), tuple(sources), tuple(targets),
-                          str(algo))
+                          str(algo), device=device)
     raise KeyError(f"no remote kernel for key kind {kind!r}")
 
 
@@ -345,26 +356,32 @@ class RemoteCoalescer:
 
     # engine-facing surface ---------------------------------------------------
 
-    def submit(self, key: tuple, payload, fn, weight: int | None = None):
+    def submit(self, key: tuple, payload, fn, weight: int | None = None,
+               device: int = 0):
         if not self._remote_eligible(key):
-            return self.local.submit(key, payload, fn, weight)
+            return self.local.submit(key, payload, fn, weight,
+                                     device=device)
         try:
-            return self._submit_remote(key, payload, weight)
+            return self._submit_remote(key, payload, weight, device)
         except Exception:  # noqa: BLE001 — arena/ring full, owner gone
             with self._mu:
                 self.fallbacks += 1
             DATA_PATH.record_ipc_fallback()
-            return self.local.submit(key, payload, fn, weight)
+            return self.local.submit(key, payload, fn, weight,
+                                     device=device)
 
-    def hot(self) -> bool:
+    def hot(self, device: int | None = None) -> bool:
         # Remote routing means digest piggybacking still batches (on the
         # owner) even when this worker's local queues are idle.
         if self._remote_active() and mode() == "all":
             return True
-        return self.local.hot()
+        return self.local.hot(device)
 
-    def note_read(self, delta: int) -> None:
-        self.local.note_read(delta)
+    def note_read(self, delta: int, device: int = 0) -> None:
+        self.local.note_read(delta, device=device)
+
+    def lane_stats(self) -> dict:
+        return self.local.lane_stats()
 
     def stats(self) -> dict:
         st = self.local.stats()
@@ -419,7 +436,8 @@ class RemoteCoalescer:
                 es._USE_DEVICE = False
         return bool(es._USE_DEVICE)
 
-    def _submit_remote(self, key: tuple, payload, weight) -> RemoteHandle:
+    def _submit_remote(self, key: tuple, payload, weight,
+                       device: int = 0) -> RemoteHandle:
         payload = np.ascontiguousarray(payload)
         nrows = int(payload.shape[0]) if payload.ndim else 1
         hdr = json.dumps({
@@ -446,7 +464,8 @@ class RemoteCoalescer:
                 self._pending[req] = h
                 self.remote_submits += 1
             rec = _DESC.pack(_MAGIC, self.wid, req, off, total, len(hdr),
-                             ST_REQ, self.plane.owner_gen() & 0xFFFFFFFF)
+                             ST_REQ, self.plane.owner_gen() & 0xFFFFFFFF,
+                             int(device) & 0xFFFFFFFF)
             if not self.plane.req_ring.put(rec, timeout=1.0):
                 with self._mu:
                     self._pending.pop(req, None)
@@ -476,7 +495,7 @@ class RemoteCoalescer:
                 continue
             try:
                 (_, _, req, off, total, hlen, status,
-                 _gen) = _DESC.unpack(rec[:_DESC.size])
+                 _gen, _dev) = _DESC.unpack(rec[:_DESC.size])
             except struct.error:
                 continue
             with self._mu:
@@ -577,7 +596,7 @@ def _owner_loop(plane, stop, co) -> None:
 def _serve_one(plane, co, rec: bytes) -> None:
     try:
         (magic, wid, req, off, total, hlen, _status,
-         _gen) = _DESC.unpack(rec[:_DESC.size])
+         _gen, dev) = _DESC.unpack(rec[:_DESC.size])
     except struct.error:
         return
     if magic != _MAGIC:
@@ -591,8 +610,11 @@ def _serve_one(plane, co, rec: bytes) -> None:
         shape = tuple(meta["shape"])
         dt = np.dtype(meta["dtype"])
         payload = view[hlen:].view(dt).reshape(shape)
-        fn = kernel_from_key(key)
-        h = co.submit(key, payload, fn, weight=meta.get("w"))
+        # Route to the lane the submitting set is affine to: the owner
+        # packs cross-WORKER traffic per DEVICE, not into one queue.
+        fn = kernel_from_key(key, device=dev)
+        h = co.submit(key, payload, fn, weight=meta.get("w"),
+                      device=dev)
         res = h.result(timeout=120.0)
         arrays = _flatten_result(kind, res)
         hdr, copies = _encode_arrays(arrays)
@@ -616,7 +638,7 @@ def _respond_ok(plane, wid, req, hdr: bytes, arrays: list[np.ndarray],
     except ArenaFull:
         plane.arena.free(*freeing)
         _push_resp(plane, wid,
-                   _DESC.pack(_MAGIC, wid, req, 0, 0, 0, ST_DROP, 0))
+                   _DESC.pack(_MAGIC, wid, req, 0, 0, 0, ST_DROP, 0, 0))
         return
     view = plane.arena.view(roff, rtotal)
     view[:len(hdr)] = np.frombuffer(hdr, dtype=np.uint8)
@@ -628,7 +650,8 @@ def _respond_ok(plane, wid, req, hdr: bytes, arrays: list[np.ndarray],
     # The request slot is only reusable once the result no longer
     # aliases pooled dispatch buffers — everything above was copied.
     plane.arena.free(*freeing)
-    rec = _DESC.pack(_MAGIC, wid, req, roff, rtotal, len(hdr), ST_OK, 0)
+    rec = _DESC.pack(_MAGIC, wid, req, roff, rtotal, len(hdr), ST_OK,
+                     0, 0)
     if not _push_resp(plane, wid, rec):
         plane.arena.free(roff, rtotal)
 
@@ -639,11 +662,12 @@ def _respond_error(plane, wid, req, exc: BaseException) -> None:
         roff = plane.arena.alloc(len(hdr), timeout=1.0)
     except ArenaFull:
         _push_resp(plane, wid,
-                   _DESC.pack(_MAGIC, wid, req, 0, 0, 0, ST_DROP, 0))
+                   _DESC.pack(_MAGIC, wid, req, 0, 0, 0, ST_DROP, 0, 0))
         return
     view = plane.arena.view(roff, len(hdr))
     view[:] = np.frombuffer(hdr, dtype=np.uint8)
-    rec = _DESC.pack(_MAGIC, wid, req, roff, len(hdr), len(hdr), ST_ERR, 0)
+    rec = _DESC.pack(_MAGIC, wid, req, roff, len(hdr), len(hdr), ST_ERR,
+                     0, 0)
     if not _push_resp(plane, wid, rec):
         plane.arena.free(roff, len(hdr))
 

@@ -106,11 +106,55 @@ def digest_self_test() -> None:
                 f"sha256 self-test mismatch on {dn.SHA_ISA_NAMES[isa]}")
 
 
+def device_lane_self_test() -> None:
+    """Encode+hash golden vectors on EVERY configured device lane before
+    serving (PR 10 device sharding): a device whose compiled kernels or
+    HBM produce wrong bytes must refuse to boot, named by index, rather
+    than corrupt the slice of erasure sets affine to it.  Single-lane
+    hosts run exactly one pass (the historical default-device check);
+    skips silently when jax is unavailable."""
+    import numpy as np
+
+    from . import devices as devices_mod
+    from .erasure_cpu import ReedSolomonCPU
+    from .mxhash import mxh256
+
+    if devices_mod.jax_device(0) is None:
+        return
+    from . import fused
+
+    k, m, s = 2, 2, 128
+    rng = np.random.default_rng(0xD0D)
+    x = rng.integers(0, 256, size=(1, k, s), dtype=np.uint8)
+    rs = ReedSolomonCPU(k, m)
+    want_parity = np.stack(
+        rs.encode([x[0, i] for i in range(k)])[k:], axis=0)
+    rows = np.concatenate([x[0], want_parity], axis=0)
+    want_digests = [mxh256(rows[i].tobytes()) for i in range(k + m)]
+    for dev in range(devices_mod.n_devices()):
+        try:
+            parity, digests = fused.encode_and_hash(
+                x, k, m, algo="mxh256", device=dev)
+            parity = np.asarray(parity)[0]
+            digests = np.asarray(digests)[:, 0]
+        except Exception as e:  # noqa: BLE001 — name the device
+            raise SelfTestError(
+                f"device lane self-test dispatch failed on device "
+                f"{dev}: {e}") from e
+        if not np.array_equal(parity, want_parity):
+            raise SelfTestError(
+                f"device lane self-test encode mismatch on device {dev}")
+        if [d.tobytes() for d in digests] != want_digests:
+            raise SelfTestError(
+                f"device lane self-test digest mismatch on device {dev}")
+
+
 def run_startup_self_tests() -> None:
     erasure_self_test()
     bitrot_self_test()
     mxhash_self_test()
     digest_self_test()
+    device_lane_self_test()
     # Fail boot on a misconfigured bitrot write algorithm (clear config
     # error now, not a confusing per-request failure later).
     from ..storage.bitrot_io import write_algo

@@ -1167,10 +1167,38 @@ class S3Server:
             # liveness/respawn rows + the owner/arena/ring plane.
             pool_proc = (self.worker_plane.workers_info()
                          if self.worker_plane is not None else None)
+            # Device lane plane (PR 10): one row per coalescer lane —
+            # which erasure sets are affine to it, how deep its queue
+            # is, and how much it has dispatched.
+            from ..ops import coalesce as _co
+            from ..ops import devices as _devices
+            lane_stats = {}
+            try:
+                lane_stats = _co.get().lane_stats()
+            except Exception:  # noqa: BLE001 — lanes are best-effort
+                pass
+            dev_sets: dict[int, list[str]] = {}
+            for pi, pool in enumerate(self.pools.pools):
+                if hasattr(pool, "device_map"):
+                    for dev, idxs in pool.device_map().items():
+                        dev_sets.setdefault(dev, []).extend(
+                            f"p{pi}s{i}" for i in idxs)
+            device_rows = []
+            for dev in range(_devices.n_devices()):
+                ls = lane_stats.get(dev, {})
+                device_rows.append({
+                    "device": dev,
+                    "lane_depth": ls.get("pending_items", 0),
+                    "dispatches": ls.get("dispatches", 0),
+                    "items": ls.get("items", 0),
+                    "occupancy": ls.get("occupancy", 0.0),
+                    "sets": dev_sets.get(dev, []),
+                })
             return j({
                 "mode": "online" if ok else "degraded",
                 "peers": peers,
                 "pool": pool_proc,
+                "devices": device_rows,
                 "deploymentID": self.pools.deployment_id,
                 "buckets": {"count": n_buckets},
                 "objects": {"count": n_objects},

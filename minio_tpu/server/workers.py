@@ -384,7 +384,7 @@ def _worker_main(plane: WorkerPlane, idx: int, cfg: dict) -> int:
     for rec in plane.resp_rings[idx].drain():
         try:
             (_, _, _, off, total, _, status,
-             _) = ipcmod._DESC.unpack(rec[:ipcmod._DESC.size])
+             _, _) = ipcmod._DESC.unpack(rec[:ipcmod._DESC.size])
             if total and status != ipcmod.ST_DROP:
                 plane.arena.free(off, total)
         except Exception:  # noqa: BLE001 — torn record

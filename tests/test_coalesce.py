@@ -250,7 +250,7 @@ class TestFaultContainment:
         co = coalesce.DispatchCoalescer()
         co._ema = 5.0                 # force the queued path
         monkeypatch.setattr(
-            co, "_pick_key",
+            co.lane(0), "_pick_key",
             lambda: (_ for _ in ()).throw(RuntimeError("scheduler bug")))
         h = co.submit(("dead",), np.ones(3, dtype=np.uint8),
                       sum_kernel())
@@ -291,13 +291,13 @@ class TestFaultContainment:
                 pass
 
         class BrokenCoalescer:
-            def submit(self, key, payload, fn, weight=None):
+            def submit(self, key, payload, fn, weight=None, device=0):
                 return FailHandle()
 
-            def hot(self):
+            def hot(self, device=None):
                 return True           # force the coalesced verify route
 
-            def note_read(self, delta):
+            def note_read(self, delta, device=0):
                 pass
 
         monkeypatch.setenv("MTPU_COALESCE", "1")

@@ -474,6 +474,19 @@ def pool_server(tmp_path_factory):
     dispatch plane."""
     root = str(tmp_path_factory.mktemp("pool"))
     proc, port = _boot_pool(root, 2, {"MTPU_IPC_DISPATCH": "all"})
+    # health/ready turns 200 as soon as ONE worker serves; the smoke
+    # asserts on BOTH slabs, so wait out the second worker's boot too.
+    cli = _cli(port)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        _, _, data = cli.request("GET", "/minio/admin/v1/info")
+        rows = json.loads(data)["pool"]["workers"]
+        if len(rows) == 2 and all(r["up"] and r["ready"] for r in rows):
+            break
+        time.sleep(0.1)
+    else:
+        proc.kill()
+        raise RuntimeError("second worker never became ready")
     yield port
     assert _stop(proc) == 0      # graceful drain is part of the smoke
 

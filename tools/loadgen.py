@@ -49,36 +49,89 @@ def _quantile(lat_s: list[float], q: float) -> float:
     return float(np.quantile(np.asarray(lat_s), q))
 
 
+def keyspace_names(es, mode: str, total: int = 32,
+                   prefix: str = "ks") -> list[str]:
+    """Object names with PROVEN set placement (PR 10 device sharding):
+    rejection-sample candidate names through the same sipHashMod the
+    engine routes with.  'spread' returns names fanning out evenly over
+    every erasure set (interleaved round-robin, so a client walking the
+    list touches all sets — and therefore all device lanes —
+    continuously); 'pinned' returns names that ALL land on set 0 (one
+    lane saturated, the others idle).  A single ErasureSet has no ring,
+    so both modes degrade to plain numbered names."""
+    nset = int(getattr(es, "set_count", 1))
+    key = getattr(es, "_dep_key", None)
+    if nset <= 1 or key is None or mode == "default":
+        return [f"{prefix}-{i}" for i in range(total)]
+    from minio_tpu.utils.siphash import sip_hash_mod
+    per: dict[int, list[str]] = {i: [] for i in range(nset)}
+    want = max(1, total // nset) if mode == "spread" else total
+    i = 0
+    while True:
+        if mode == "spread":
+            if all(len(v) >= want for v in per.values()):
+                break
+        elif len(per[0]) >= want:
+            break
+        if i > 1_000_000:
+            raise RuntimeError(f"keyspace sampling runaway ({mode})")
+        name = f"{prefix}-{i}"
+        i += 1
+        per[sip_hash_mod(name, nset, key)].append(name)
+    if mode == "pinned":
+        return per[0][:want]
+    if mode != "spread":
+        raise ValueError(f"unknown keyspace mode {mode!r}")
+    return [per[s][j] for j in range(want) for s in range(nset)]
+
+
 def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
              put_frac: float = 0.5, duration_s: float = 5.0,
              bucket: str = "loadgen", warm_objects: int = 8,
-             seed: int = 0) -> dict:
+             seed: int = 0, keyspace: str = "default") -> dict:
     """Drive `clients` closed-loop workers against `es` for
     `duration_s`; returns aggregate GB/s, p50/p99 latency, and mean
-    coalesced dispatch occupancy over the run."""
+    coalesced dispatch occupancy over the run.  `keyspace` picks the
+    set-placement shape of every key touched (see keyspace_names);
+    non-default modes add a per-set hit histogram and per-device lane
+    dispatch stats to the result."""
     if not es.bucket_exists(bucket):
         es.make_bucket(bucket)
     rng = np.random.default_rng(seed)
     body = rng.integers(0, 256, object_size, dtype=np.uint8).tobytes()
-    warm = [f"warm-{i}" for i in range(max(1, warm_objects))]
+    warm = keyspace_names(es, keyspace, total=max(1, warm_objects),
+                          prefix="warm")
     for name in warm:
         es.put_object(bucket, name, body)
+    # PUT pool: placement-proven names partitioned per client (closed
+    # loops overwrite within their own slice — no cross-client races).
+    put_pool = keyspace_names(es, keyspace, total=max(clients * 8, 16),
+                              prefix="put")
+    put_slices = [put_pool[ci::clients] for ci in range(clients)]
+    name_set: dict[str, int] = {}
+    if keyspace != "default" and hasattr(es, "set_for"):
+        name_set = {n: es.set_for(n).set_index
+                    for n in warm + put_pool}
 
     stop = threading.Event()
     lat_put: list[list[float]] = [[] for _ in range(clients)]
     lat_get: list[list[float]] = [[] for _ in range(clients)]
     nbytes = [0] * clients
+    set_hits = [dict() for _ in range(clients)]
     errors: list[BaseException] = []
 
     def client(ci: int) -> None:
         crng = np.random.default_rng(seed * 1000 + ci)
+        mine = put_slices[ci]
         j = 0
         try:
             while not stop.is_set():
                 is_put = crng.random() < put_frac
                 t0 = time.monotonic()
                 if is_put:
-                    es.put_object(bucket, f"c{ci}-{j}", body)
+                    name = (mine[j % len(mine)] if name_set
+                            else f"c{ci}-{j}")
+                    es.put_object(bucket, name, body)
                     j += 1
                 else:
                     name = warm[int(crng.integers(0, len(warm)))]
@@ -88,6 +141,9 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
                 dt = time.monotonic() - t0
                 (lat_put if is_put else lat_get)[ci].append(dt)
                 nbytes[ci] += object_size
+                if name_set:
+                    s = name_set.get(name, -1)
+                    set_hits[ci][s] = set_hits[ci].get(s, 0) + 1
         except BaseException as e:  # noqa: BLE001 — surfaced below
             errors.append(e)
             stop.set()
@@ -118,6 +174,22 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     d_dg_calls = snap1["dg_md5_calls"] - snap0["dg_md5_calls"]
     d_dg_streams = snap1["dg_md5_streams"] - snap0["dg_md5_streams"]
     d_dg_bytes = snap1["dg_md5_bytes"] - snap0["dg_md5_bytes"]
+    # per-device lane deltas (PR 10): which coalescer lanes dispatched,
+    # how much, and at what batch occupancy over this run
+    lanes0 = snap0.get("lanes", {})
+    lane_dispatches: dict[int, int] = {}
+    lane_occupancy: dict[int, float] = {}
+    for dev, row in snap1.get("lanes", {}).items():
+        prev = lanes0.get(dev, {})
+        dd = row["dispatches"] - prev.get("dispatches", 0)
+        di = row["items"] - prev.get("items", 0)
+        if dd:
+            lane_dispatches[dev] = dd
+            lane_occupancy[dev] = round(di / dd, 3)
+    merged_hits: dict[int, int] = {}
+    for per in set_hits:
+        for s, n in per.items():
+            merged_hits[s] = merged_hits.get(s, 0) + n
     return {
         "clients": clients,
         "object_size": object_size,
@@ -138,6 +210,12 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
         "dg_md5_occupancy": round(d_dg_streams / d_dg_calls, 3)
         if d_dg_calls else 0.0,
         "dg_md5_gbps": round(d_dg_bytes / wall / 1e9, 3),
+        "keyspace": keyspace,
+        "set_hits": {int(k): v for k, v in sorted(merged_hits.items())},
+        "lane_dispatches": {int(k): v for k, v
+                            in sorted(lane_dispatches.items())},
+        "lane_occupancy": {int(k): v for k, v
+                           in sorted(lane_occupancy.items())},
     }
 
 
@@ -263,6 +341,17 @@ def make_set(root: str, n: int = 4, parity: int | None = None):
     return ErasureSet(drives, default_parity=parity)
 
 
+def make_sets(root: str, nsets: int = 4, set_drives: int = 4,
+              parity: int | None = None):
+    """A full hash ring (nsets erasure sets of set_drives drives) —
+    the topology the --keyspace modes route across."""
+    from minio_tpu.engine.sets import ErasureSets
+    drives = [LocalDrive(os.path.join(root, f"d{i}"))
+              for i in range(nsets * set_drives)]
+    return ErasureSets(drives, set_drive_count=set_drives,
+                       default_parity=parity)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=4)
@@ -272,6 +361,17 @@ def main(argv=None) -> int:
     ap.add_argument("--duration", type=float, default=5.0)
     ap.add_argument("--drives", type=int, default=4)
     ap.add_argument("--parity", type=int, default=None)
+    ap.add_argument("--sets", type=int, default=1,
+                    help="engine mode: build a hash ring of N erasure "
+                    "sets (of --drives each) instead of one bare set — "
+                    "the topology --keyspace routes across")
+    ap.add_argument("--keyspace", choices=("default", "spread",
+                                           "pinned"),
+                    default="default",
+                    help="spread: keys provably fan out over every "
+                    "erasure set (all device lanes busy); pinned: all "
+                    "keys land on set 0 (one lane hot).  The output's "
+                    "set_hits histogram proves the placement")
     ap.add_argument("--root", default="/tmp/mtpu-loadgen")
     ap.add_argument("--endpoint", default="",
                     help="http(s)://host:port — drive a RUNNING server "
@@ -306,10 +406,15 @@ def main(argv=None) -> int:
                             access_key=args.access_key,
                             secret_key=args.secret_key)
     else:
-        es = make_set(args.root, n=args.drives, parity=args.parity)
+        es = (make_sets(args.root, nsets=args.sets,
+                        set_drives=args.drives, parity=args.parity)
+              if args.sets > 1
+              else make_set(args.root, n=args.drives,
+                            parity=args.parity))
         res = run_load(es, clients=args.clients,
                        object_size=args.size_kib << 10,
-                       put_frac=args.mix, duration_s=args.duration)
+                       put_frac=args.mix, duration_s=args.duration,
+                       keyspace=args.keyspace)
     w = max(len(k) for k in res)
     for k, v in res.items():
         print(f"{k:<{w}}  {v}")
