@@ -422,6 +422,66 @@ def workers_bench(duration_s: float = 3.0, object_mib: int = 1,
     return out
 
 
+def decom_bench(n_objects: int = 48, object_kib: int = 256) -> dict:
+    """Live-decommission suite (background/decom.py): a 2-pool engine,
+    pool 0 loaded then drained through the normal write path.  Reports
+    the drain throughput plus the placement-skew histogram — PUTs per
+    pool before the drain (tie-break pins them to pool 0) vs after
+    (the drained pool must take ZERO new writes)."""
+    import shutil
+    import tempfile
+
+    from minio_tpu.background.decom import Decommissioner
+    from minio_tpu.engine.pools import ServerPools
+    from minio_tpu.engine.sets import ErasureSets
+    from minio_tpu.storage.drive import LocalDrive
+
+    out = {}
+    root = tempfile.mkdtemp(prefix="mtpu-decom-")
+    try:
+        p0 = ErasureSets([LocalDrive(f"{root}/p0_d{i}")
+                          for i in range(4)], set_drive_count=4)
+        p1 = ErasureSets([LocalDrive(f"{root}/p1_d{i}")
+                          for i in range(4)], set_drive_count=4,
+                         deployment_id=p0.deployment_id)
+        pools = ServerPools([p0, p1])
+        pools.make_bucket("bench")
+        rng = np.random.default_rng(7)
+        body = rng.integers(0, 256, object_kib << 10,
+                            dtype=np.uint8).tobytes()
+        before: dict[int, int] = {}
+        for i in range(n_objects):
+            fi = pools.put_object("bench", f"o{i:03d}", body)
+            p = getattr(fi, "pool_idx", -1)
+            before[p] = before.get(p, 0) + 1
+        d = Decommissioner(pools, 0)
+        t0 = time.perf_counter()
+        d.run_sync()
+        wall = max(time.perf_counter() - t0, 1e-9)
+        st = d.status()
+        if st["state"] != "complete":
+            out["decom_error"] = (f"drain ended {st['state']}: "
+                                  f"{st['error']}")
+            return out
+        after: dict[int, int] = {}
+        for i in range(max(8, n_objects // 4)):
+            fi = pools.put_object("bench", f"post{i:03d}", body)
+            p = getattr(fi, "pool_idx", -1)
+            after[p] = after.get(p, 0) + 1
+        out["decom_drain_mbps"] = round(st["bytes_moved"] / wall / 1e6,
+                                        2)
+        out["decom_wall_s"] = round(wall, 3)
+        out["decom_objects_moved"] = st["objects_moved"]
+        out["decom_versions_moved"] = st["versions_moved"]
+        out["decom_pool_hits_before"] = {
+            str(k): v for k, v in sorted(before.items())}
+        out["decom_pool_hits_after"] = {
+            str(k): v for k, v in sorted(after.items())}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def multichip_bench(duration_s: float = 2.5,
                     object_mib: int = 1) -> dict:
     """Device-sharding suite (PR 10, per-device coalescer lanes): the
@@ -1237,10 +1297,11 @@ def main() -> None:
              "import json, sys; sys.path.insert(0, sys.argv[1]); "
              "from bench import (e2e_bench, concurrent_bench, "
              "hedge_bench, digest_bench, workers_bench, "
-             "multichip_bench); "
+             "multichip_bench, decom_bench); "
              "r = e2e_bench(); r.update(concurrent_bench()); "
              "r.update(hedge_bench()); r.update(digest_bench()); "
              "r.update(workers_bench()); r.update(multichip_bench()); "
+             "r.update(decom_bench()); "
              "print(json.dumps(r))", here],
             env=env, capture_output=True, text=True, timeout=900)
         if res.returncode != 0:
@@ -1314,7 +1375,7 @@ def main() -> None:
     for k, v in results.items():
         if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup",
                         "_ms_tmpfs", "_pct", "_pct_tmpfs", "_occupancy"))
-                or k.startswith(("tunnel_", "digest_", "mc_"))
+                or k.startswith(("tunnel_", "digest_", "mc_", "decom_"))
                 or k == "host_cores"):
             extras.setdefault(k, v)
     if "put_stage_md5_ms_tmpfs" in extras:

@@ -1,0 +1,615 @@
+"""Crash-resumable pool decommission: drain a pool into the rest.
+
+The admin flips a pool to *draining* (`ServerPools.set_draining`) — new
+writes are excluded from placement immediately, reads keep serving —
+and a background mover walks the pool's namespace re-PUTting every
+version and pending multipart upload through the normal write path into
+the remaining pools (cf. the reference's decommission,
+/root/reference/cmd/erasure-server-pool-decom.go).
+
+Exactly-once discipline (the PR 7 MRF journal's, applied to moves):
+
+  * per-version sequence: VERIFY the destination copy (byte-identical,
+    or provably superseded by a newer client write) BEFORE deleting the
+    source version, then append a durable `moved` record.  Every step
+    is idempotent, so replay after kill-9 at any of the four armed
+    crash points (`decom.pre_verify`, `decom.post_copy`,
+    `decom.pre_delete`, `decom.checkpoint`) converges: a version that
+    died mid-copy is re-copied (same preserved version id — no
+    duplicates), one that died between verify and delete is found
+    already byte-identical on the destination and just reaped, one that
+    died before the journal append is simply gone from the source on
+    the resume walk.
+  * resume does NOT trust the journal for correctness — it re-walks the
+    draining pool's namespace; the journal carries the drain *state*
+    (draining/paused/complete/cancelled), the progress counters, and
+    the multipart relocation map (old full upload id -> new), which
+    clients' in-flight upload ids depend on across restarts.
+
+Journal: fsynced JSONL at `<first non-draining pool's first local
+drive>/<SYS_VOL>/decom-journal.p<idx>.jsonl` — NOT on the draining pool,
+whose drives are about to be unplugged.  Records:
+
+    {"op": "state", "pool": i, "state": "draining"|...}
+    {"op": "moved", "k": "bucket/obj@vid", "bytes": n}
+    {"op": "mp", "old": "<i.uid>", "new": "<j.uid>", "b": ..., "o": ...}
+    {"op": "ckpt", ...}              # atomic compaction (tmp+fsync+replace)
+
+Env knobs:
+  MTPU_DECOM_FSYNC     1 (default) fsync each durable append, 0 flush only
+  MTPU_DECOM_WORKERS   parallel mover lanes (default 1)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..storage.errors import (ErrBucketNotFound, ErrObjectNotFound,
+                              ErrVersionNotFound, StorageError)
+from ..utils.crashpoints import crash_point
+
+_NOT_HERE = (ErrObjectNotFound, ErrVersionNotFound, ErrBucketNotFound)
+
+# Drain states.  `failed` is terminal-with-retry: the mover hit a hard
+# storage error and parked; an admin `resume` restarts the walk.
+ACTIVE_STATES = ("draining", "paused")
+
+
+def journal_name(pool_idx: int) -> str:
+    return f"decom-journal.p{pool_idx}.jsonl"
+
+
+def _pool_first_root(pool) -> str | None:
+    for es in getattr(pool, "sets", [pool]):
+        for d in getattr(es, "drives", []):
+            root = getattr(d, "root", None)
+            if d is not None and root:
+                return root
+    return None
+
+
+def default_journal_path(pools, pool_idx: int) -> str | None:
+    """Journal home: first local drive of the first pool that is NOT the
+    one being drained — the drained pool's drives get unplugged after
+    completion and must not hold the record of their own drain."""
+    from ..storage.drive import SYS_VOL
+    for i, p in enumerate(pools.pools):
+        if i == pool_idx:
+            continue
+        root = _pool_first_root(p)
+        if root:
+            return os.path.join(root, SYS_VOL, journal_name(pool_idx))
+    root = _pool_first_root(pools.pools[pool_idx])
+    return os.path.join(root, SYS_VOL, journal_name(pool_idx)) \
+        if root else None
+
+
+def replay_journal(path: str) -> dict:
+    """Fold a journal to its net state.  A torn trailing line (killed
+    mid-append) is skipped, like the MRF journal's replay."""
+    out = {"state": "draining", "moved": 0, "bytes": 0, "mp": {}}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except (ValueError, TypeError):
+                    continue                      # torn tail
+                op = rec.get("op")
+                if op == "ckpt":
+                    out["state"] = rec.get("state", out["state"])
+                    out["moved"] = int(rec.get("moved", 0))
+                    out["bytes"] = int(rec.get("bytes", 0))
+                    out["mp"] = dict(rec.get("mp", {}))
+                elif op == "state":
+                    out["state"] = rec.get("state", out["state"])
+                elif op == "moved":
+                    out["moved"] += 1
+                    out["bytes"] += int(rec.get("bytes", 0))
+                elif op == "mp":
+                    out["mp"][rec["old"]] = rec["new"]
+    except OSError:
+        pass
+    return out
+
+
+def find_journals(pools) -> dict[int, str]:
+    """pool idx -> journal path, discovered across every pool's first
+    drive (the journal home pool is 'first non-draining', which depends
+    on state we are trying to recover — so scan them all)."""
+    from ..storage.drive import SYS_VOL
+    found: dict[int, str] = {}
+    for p in pools.pools:
+        root = _pool_first_root(p)
+        if not root:
+            continue
+        sysdir = os.path.join(root, SYS_VOL)
+        try:
+            names = os.listdir(sysdir)
+        except OSError:
+            continue
+        for name in names:
+            if not (name.startswith("decom-journal.p")
+                    and name.endswith(".jsonl")):
+                continue
+            mid = name[len("decom-journal.p"):-len(".jsonl")]
+            try:
+                idx = int(mid)
+            except ValueError:
+                continue
+            found.setdefault(idx, os.path.join(sysdir, name))
+    return found
+
+
+class Decommissioner:
+    """One pool's drain: mover thread + journal + admin controls."""
+
+    def __init__(self, pools, pool_idx: int, *,
+                 journal_path: str | None = None,
+                 fsync: bool | None = None,
+                 workers: int | None = None):
+        if not 0 <= pool_idx < len(pools.pools):
+            raise ValueError(f"no pool {pool_idx}")
+        self.pools = pools
+        self.pool_idx = pool_idx
+        self.journal_path = (journal_path
+                             or default_journal_path(pools, pool_idx))
+        self._j_fsync = (os.environ.get("MTPU_DECOM_FSYNC", "1") != "0"
+                         if fsync is None else fsync)
+        if workers is None:
+            try:
+                workers = int(os.environ.get("MTPU_DECOM_WORKERS", "1"))
+            except ValueError:
+                workers = 1
+        self.workers = max(1, workers)
+
+        self._mu = threading.Lock()
+        self._jf = None
+        self.state = "draining"
+        self.error: str | None = None
+        self.versions_moved = 0
+        self.bytes_moved = 0
+        self.uploads_moved = 0
+        self.objects_total = 0
+        self.objects_done = 0
+        self._session_bytes = 0
+        self._session_t0: float | None = None
+        self.started_at = time.time()
+        self._unpaused = threading.Event()
+        self._unpaused.set()
+        self._cancel = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        if self.journal_path:
+            prior = replay_journal(self.journal_path)
+            self.state = prior["state"]
+            self.versions_moved = prior["moved"]
+            self.bytes_moved = prior["bytes"]
+            self.uploads_moved = len(prior["mp"])
+            # Relocated upload ids must keep resolving after restart.
+            self.pools.upload_relocations.update(prior["mp"])
+            if self.state == "paused":
+                self._unpaused.clear()
+
+    # -- journal -------------------------------------------------------------
+
+    def _append(self, rec: dict, durable: bool = True) -> None:
+        if not self.journal_path:
+            return
+        with self._mu:
+            try:
+                if self._jf is None:
+                    os.makedirs(os.path.dirname(self.journal_path),
+                                exist_ok=True)
+                    self._jf = open(self.journal_path, "a",
+                                    encoding="utf-8")
+                self._jf.write(json.dumps(rec, separators=(",", ":"))
+                               + "\n")
+                self._jf.flush()
+                if durable and self._j_fsync:
+                    os.fsync(self._jf.fileno())
+            except OSError:
+                # Journal loss degrades to memory-only progress: the
+                # resume walk re-derives correctness from the namespace.
+                self._jf = None
+
+    def checkpoint(self) -> None:
+        """Compact the journal to one ckpt record."""
+        if not self.journal_path:
+            return
+        with self._mu:
+            rec = {"op": "ckpt", "pool": self.pool_idx,
+                   "state": self.state, "moved": self.versions_moved,
+                   "bytes": self.bytes_moved,
+                   "mp": {k: v for k, v
+                          in self.pools.upload_relocations.items()
+                          if k.startswith(f"{self.pool_idx}.")}}
+            tmp = self.journal_path + ".tmp"
+            try:
+                if self._jf is not None:
+                    self._jf.close()
+                    self._jf = None
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.journal_path)
+            except OSError:
+                pass
+
+    # -- controls ------------------------------------------------------------
+
+    def start(self) -> "Decommissioner":
+        """Mark the pool draining and launch the mover."""
+        self.pools.set_draining(self.pool_idx, True)
+        self.pools.decommissions[self.pool_idx] = self
+        if self.state not in ACTIVE_STATES:
+            self.state = "draining"
+        self._append({"op": "state", "pool": self.pool_idx,
+                      "state": self.state})
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"decom-p{self.pool_idx}",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def run_sync(self) -> None:
+        """Synchronous drain (tests, harnesses): start + join."""
+        self.pools.set_draining(self.pool_idx, True)
+        self.pools.decommissions[self.pool_idx] = self
+        self._append({"op": "state", "pool": self.pool_idx,
+                      "state": self.state})
+        self._run()
+
+    def pause(self) -> None:
+        if self.state == "draining":
+            self.state = "paused"
+            self._unpaused.clear()
+            self._append({"op": "state", "pool": self.pool_idx,
+                          "state": "paused"})
+
+    def resume(self) -> None:
+        if self.state in ("paused", "failed"):
+            # A failed drain may have been registered without the
+            # draining flag (boot found a parked journal); re-assert it
+            # or the mover would copy objects back onto the source.
+            self.pools.set_draining(self.pool_idx, True)
+            self.pools.decommissions[self.pool_idx] = self
+            self.state = "draining"
+            self.error = None
+            self._append({"op": "state", "pool": self.pool_idx,
+                          "state": "draining"})
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=f"decom-p{self.pool_idx}",
+                    daemon=True)
+                self._thread.start()
+            self._unpaused.set()
+
+    def cancel(self) -> None:
+        """Stop the drain and make the pool placement-eligible again.
+        Versions already moved STAY moved (they are valid copies and the
+        source was deleted); relocated uploads keep their mapping."""
+        self._cancel.set()
+        self._unpaused.set()
+        self.join(timeout=30)
+        self.state = "cancelled"
+        self._append({"op": "state", "pool": self.pool_idx,
+                      "state": "cancelled"})
+        self.checkpoint()
+        self.pools.set_draining(self.pool_idx, False)
+
+    def join(self, timeout: float | None = None) -> None:
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    # -- status --------------------------------------------------------------
+
+    def status(self) -> dict:
+        with self._mu:
+            remaining = max(0, self.objects_total - self.objects_done)
+            elapsed = (time.monotonic() - self._session_t0) \
+                if self._session_t0 else 0.0
+            rate = self._session_bytes / elapsed if elapsed > 0.5 else 0.0
+            done_rate = self.objects_done / elapsed \
+                if elapsed > 0.5 and self.objects_done else 0.0
+            eta = remaining / done_rate if done_rate else None
+            return {
+                "pool": self.pool_idx,
+                "state": self.state,
+                "error": self.error,
+                "objects_total": self.objects_total,
+                "objects_moved": self.objects_done,
+                "objects_remaining": remaining,
+                "versions_moved": self.versions_moved,
+                "uploads_relocated": self.uploads_moved,
+                "bytes_moved": self.bytes_moved,
+                "bytes_per_sec": round(rate, 1),
+                "eta_seconds": round(eta, 1) if eta is not None else None,
+                "started_at": self.started_at,
+            }
+
+    # -- the mover -----------------------------------------------------------
+
+    def _src(self):
+        return self.pools.pools[self.pool_idx]
+
+    def _gate(self) -> bool:
+        """Block while paused; False when the drain should stop."""
+        while not self._unpaused.wait(0.2):
+            if self._cancel.is_set():
+                return False
+        return not self._cancel.is_set()
+
+    def _run(self) -> None:
+        try:
+            self._session_bytes = 0
+            self._session_t0 = time.monotonic()
+            # Pending multipart uploads first: their ids are client-held
+            # and pool-sticky, so new parts must start landing on the
+            # destination before the namespace walk churns.
+            self._relocate_uploads()
+            # Walk-move-rewalk until the source namespace is empty: a
+            # PUT that raced the draining flag can publish after the
+            # first pass walked past its name.
+            for _ in range(8):
+                if not self._gate():
+                    return
+                names = self._names()
+                with self._mu:
+                    self.objects_total = self.objects_done + len(names)
+                if not names:
+                    break
+                self._move_all(names)
+                if self._cancel.is_set():
+                    return
+            else:
+                raise StorageError(
+                    f"pool {self.pool_idx} namespace not converging")
+            if self._names():
+                raise StorageError(
+                    f"pool {self.pool_idx} not empty after drain")
+            self.state = "complete"
+            self._append({"op": "state", "pool": self.pool_idx,
+                          "state": "complete"})
+            self.checkpoint()
+        except Exception as e:          # noqa: BLE001 - park, don't die
+            if self._cancel.is_set():
+                return
+            self.state = "failed"
+            self.error = f"{type(e).__name__}: {e}"
+            self._append({"op": "state", "pool": self.pool_idx,
+                          "state": "failed", "error": self.error})
+
+    def _names(self) -> list[tuple[str, str]]:
+        src = self._src()
+        out: list[tuple[str, str]] = []
+        for b in src.list_buckets():
+            seen: set[str] = set()
+            for es in getattr(src, "sets", [src]):
+                try:
+                    seen.update(es.list_object_names(b))
+                except StorageError:
+                    continue
+            out.extend((b, o) for o in sorted(seen))
+        return out
+
+    def _move_all(self, names: list[tuple[str, str]]) -> None:
+        if self.workers > 1:
+            with ThreadPoolExecutor(
+                    max_workers=self.workers,
+                    thread_name_prefix=f"decom-p{self.pool_idx}") as ex:
+                list(ex.map(self._move_one, names))
+        else:
+            for bo in names:
+                self._move_one(bo)
+
+    def _move_one(self, bo: tuple[str, str]) -> None:
+        if not self._gate():
+            return
+        bucket, obj = bo
+        src = self._src()
+        try:
+            versions = src.list_object_versions(bucket, obj)
+        except _NOT_HERE:
+            versions = []
+        # Oldest first: each re-PUT preserves the source mod_time_ns and
+        # version id, so relative history order survives the move.
+        for fi in reversed(versions):
+            if not self._gate():
+                return
+            self._move_version(bucket, obj, fi)
+        with self._mu:
+            self.objects_done += 1
+
+    def _move_version(self, bucket: str, obj: str, fi) -> None:
+        src = self._src()
+        vid = fi.version_id
+        crash_point("decom.pre_verify")
+        if fi.deleted:
+            # Delete marker: replicate the tombstone only when it is the
+            # live tip (intermediate markers carry no data and would
+            # mint fresh ids); then reap the source marker.
+            if fi.is_latest and not self._dest_newer(bucket, obj,
+                                                     fi.mod_time_ns):
+                try:
+                    self.pools.delete_object(bucket, obj, versioned=True)
+                except _NOT_HERE:
+                    pass
+            crash_point("decom.post_copy")
+            crash_point("decom.pre_delete")
+            self._reap_source(bucket, obj, vid)
+            self._record_moved(bucket, obj, vid, 0)
+            return
+        try:
+            src_fi, data = src.get_object(bucket, obj, version_id=vid)
+        except _NOT_HERE:
+            return                      # raced away (client delete)
+        data = bytes(data)
+        meta = dict(src_fi.metadata)
+        if not self._dest_has(bucket, obj, src_fi, data):
+            # Normal write path: placement excludes the draining pool;
+            # version id + timestamp preserved so the copy IS the
+            # version, not a duplicate (the engine refuses to clobber
+            # a newer racing write on the same slot).
+            self.pools.put_object(bucket, obj, data, metadata=meta,
+                                  versioned=bool(vid),
+                                  version_id=vid if vid else None,
+                                  mod_time_ns=src_fi.mod_time_ns)
+        crash_point("decom.post_copy")
+        if not self._dest_has(bucket, obj, src_fi, data):
+            raise StorageError(
+                f"decom verify failed for {bucket}/{obj}@{vid!r}")
+        crash_point("decom.pre_delete")
+        self._reap_source(bucket, obj, vid)
+        self._record_moved(bucket, obj, vid, len(data))
+
+    def _reap_source(self, bucket: str, obj: str, vid: str) -> None:
+        src = self._src()
+        try:
+            src.delete_object(bucket, obj, version_id=vid,
+                              versioned=False)
+        except _NOT_HERE:
+            pass                        # already reaped (resume replay)
+
+    def _record_moved(self, bucket: str, obj: str, vid: str,
+                      nbytes: int) -> None:
+        crash_point("decom.checkpoint")
+        with self._mu:
+            self.versions_moved += 1
+            self.bytes_moved += nbytes
+            self._session_bytes += nbytes
+        self._append({"op": "moved", "k": f"{bucket}/{obj}@{vid}",
+                      "bytes": nbytes})
+
+    # -- destination verification -------------------------------------------
+
+    def _dest_versions(self, bucket: str, obj: str):
+        for i, p in enumerate(self.pools.pools):
+            if i == self.pool_idx:
+                continue
+            try:
+                yield from p.list_object_versions(bucket, obj)
+            except (StorageError, *_NOT_HERE):
+                continue
+
+    def _dest_newer(self, bucket: str, obj: str, mod_ns: int) -> bool:
+        return any(v.mod_time_ns > mod_ns
+                   for v in self._dest_versions(bucket, obj))
+
+    def _dest_has(self, bucket: str, obj: str, src_fi, data: bytes) -> bool:
+        """True when deleting the source version is safe: a byte-
+        identical destination copy of the SAME version id (and same
+        timestamp) exists, or — for the NULL version only, whose slot
+        is last-write-wins — a newer client write provably superseded
+        it mid-drain.  Versioned ids are never treated as superseded:
+        history must move intact even under concurrent overwrites."""
+        vid = src_fi.version_id
+        etag = src_fi.metadata.get("etag", "")
+        superseded = False
+        for i, p in enumerate(self.pools.pools):
+            if i == self.pool_idx:
+                continue
+            try:
+                vers = p.list_object_versions(bucket, obj)
+            except (StorageError, *_NOT_HERE):
+                continue
+            for v in vers:
+                if vid == "" and v.mod_time_ns > src_fi.mod_time_ns:
+                    superseded = True
+                if v.version_id != vid or v.deleted:
+                    continue
+                if v.mod_time_ns != src_fi.mod_time_ns:
+                    continue
+                if etag and v.metadata.get("etag", "") != etag:
+                    continue
+                if v.size != src_fi.size:
+                    continue
+                try:
+                    _, dbytes = p.get_object(bucket, obj,
+                                             version_id=vid)
+                except (StorageError, *_NOT_HERE):
+                    continue
+                if bytes(dbytes) == data:
+                    return True
+        return superseded
+
+    # -- pending multipart relocation ----------------------------------------
+
+    def _relocate_uploads(self) -> None:
+        src = self._src()
+        for bucket in src.list_buckets():
+            for u in src.list_multipart_uploads(bucket):
+                if not self._gate():
+                    return
+                self._relocate_upload(bucket, u["object"],
+                                      u["upload_id"])
+
+    def _relocate_upload(self, bucket: str, obj: str, uid: str) -> None:
+        old_full = f"{self.pool_idx}.{uid}"
+        src = self._src()
+        new_full = self.pools.upload_relocations.get(old_full)
+        if new_full is None:
+            meta = src.upload_metadata(bucket, obj, uid)
+            new_full = self.pools.new_multipart_upload(bucket, obj,
+                                                       metadata=meta)
+            # Record the mapping BEFORE copying parts: a crash between
+            # here and the abort resumes by re-copying into the SAME
+            # destination upload (part re-put is last-write-wins).
+            self.pools.upload_relocations[old_full] = new_full
+            self._append({"op": "mp", "old": old_full, "new": new_full,
+                          "b": bucket, "o": obj})
+            with self._mu:
+                self.uploads_moved += 1
+        didx, new_uid = self.pools._split_upload_id(new_full)
+        dest = self.pools.pools[didx]
+        for p in src.list_parts(bucket, obj, uid):
+            data = src.read_part_bytes(bucket, obj, uid, p.number)
+            dest.put_object_part(bucket, obj, new_uid, p.number, data)
+            with self._mu:
+                self._session_bytes += len(data)
+                self.bytes_moved += len(data)
+        try:
+            src.abort_multipart_upload(bucket, obj, uid)
+        except StorageError:
+            pass
+
+
+def resume_decommissions(pools, *, autostart: bool = True
+                         ) -> list[Decommissioner]:
+    """Boot-time recovery: rediscover drain journals, reload relocation
+    maps, re-mark draining pools, and relaunch interrupted movers —
+    the kill-9 resume path."""
+    out: list[Decommissioner] = []
+    for idx, path in sorted(find_journals(pools).items()):
+        if idx >= len(pools.pools):
+            continue
+        d = Decommissioner(pools, idx, journal_path=path)
+        pools.decommissions[idx] = d
+        if d.state in ACTIVE_STATES:
+            try:
+                pools.set_draining(idx, True)
+            except ValueError:
+                d.state = "failed"
+                d.error = "cannot resume: last placement-eligible pool"
+                out.append(d)
+                continue
+            if autostart:
+                if d.state == "draining":
+                    d.start()
+                else:                   # paused: thread parks on gate
+                    d.start()
+        elif d.state == "complete":
+            # Drained and empty: keep it excluded so nothing lands on a
+            # pool that is about to be unplugged.
+            pools.draining.add(idx)
+        out.append(d)
+    return out

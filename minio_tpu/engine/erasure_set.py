@@ -450,13 +450,21 @@ class ErasureSet:
     def put_object(self, bucket: str, obj: str, data, *,
                    metadata: dict | None = None,
                    versioned: bool = False,
-                   parity: int | None = None) -> FileInfo:
+                   parity: int | None = None,
+                   version_id: str | None = None,
+                   mod_time_ns: int | None = None) -> FileInfo:
         """Erasure-code and store one object (single part).
 
         `data` is bytes or a reader (.read(n)); a reader streams through
         encode in O(BATCH_BLOCKS x BLOCK_SIZE) memory — the role of the
         reference's blockwise streaming Encode
         (/root/reference/cmd/erasure-encode.go:73).
+
+        `version_id`/`mod_time_ns` override the generated identity —
+        the decommission mover re-PUTs a drained pool's versions through
+        this path and must preserve each version's id and timestamp or
+        the moved history would reorder (a moved OLD version would
+        eclipse a client write that raced the drain).
 
         cf. erasureObjects.putObject, /root/reference/cmd/erasure-object.go:748.
         """
@@ -467,7 +475,9 @@ class ErasureSet:
             fi = self._put_object_locked(bucket, obj, data,
                                          metadata=metadata,
                                          versioned=versioned,
-                                         parity=parity)
+                                         parity=parity,
+                                         version_id=version_id,
+                                         mod_time_ns=mod_time_ns)
         self._mark_dirty(bucket)
         return fi
 
@@ -481,7 +491,8 @@ class ErasureSet:
         return max(0, min(int(parity), self.n // 2))
 
     def _put_object_locked(self, bucket, obj, data, *, metadata,
-                           versioned, parity) -> FileInfo:
+                           versioned, parity, version_id=None,
+                           mod_time_ns=None) -> FileInfo:
         parity = self.clamp_parity(parity)
         # Parity upgrade: offline drives become parity so the write keeps
         # full reconstruction capability (cf. erasure-object.go:766-800).
@@ -527,8 +538,24 @@ class ErasureSet:
             etag_md5.feed(data)
         if upgraded:
             meta["x-mtpu-internal-erasure-upgraded"] = f"{offline}-offline"
-        version_id = new_uuid() if versioned else ""
-        mod_time = _now_ns()
+        if version_id is None:
+            version_id = new_uuid() if versioned else ""
+        mod_time = mod_time_ns if mod_time_ns is not None else _now_ns()
+        if mod_time_ns is not None:
+            # A preserved-timestamp write (the decommission mover) must
+            # never clobber a NEWER racing client write: the mover's
+            # copy of a drained version is stale the instant a client
+            # overwrites or deletes the object mid-drain, and last-
+            # write-wins on the xl.meta slot would silently resurrect
+            # the old bytes.  Under the namespace write lock the check
+            # is race-free.
+            try:
+                cur = self._read_metadata(bucket, obj, version_id)[0]
+                if cur.mod_time_ns >= mod_time:
+                    return cur
+            except StorageError:
+                pass
+
         algo = bitrot_io.write_algo()
         ec_base = ErasureInfo(
             data_blocks=k, parity_blocks=parity, block_size=BLOCK_SIZE,

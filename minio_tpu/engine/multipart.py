@@ -339,6 +339,51 @@ def _list_parts_with_algos(es: ErasureSet, bucket: str, obj: str,
     return parts, algos
 
 
+def upload_metadata(es: ErasureSet, bucket: str, obj: str,
+                    upload_id: str) -> dict:
+    """Client metadata an upload was created with (internal staging
+    keys stripped) — what a relocated upload must be re-created with."""
+    fi = _read_upload_fi(es, bucket, obj, upload_id)
+    return {k: v for k, v in fi.metadata.items()
+            if not k.startswith("x-mtpu-internal-mp-")}
+
+
+def read_part_bytes(es: ErasureSet, bucket: str, obj: str,
+                    upload_id: str, part_number: int) -> bytes:
+    """Decode one STAGED part back to plaintext — the decommission
+    mover's relocation read.  Staged parts are ordinary EC shard
+    streams under the system volume, so the object read path decodes
+    them once aimed at the staging layout: `_read_part` composes its
+    path as `{name}/{data_dir}/part.{n}`, and name=<upload root>,
+    data_dir=<upload id> lands exactly on `multipart/<hash>/<id>/part.n`."""
+    fi_up = _read_upload_fi(es, bucket, obj, upload_id)
+    ec = fi_up.erasure
+    parts, algos = _list_parts_with_algos(es, bucket, obj, upload_id)
+    info = next((p for p in parts if p.number == part_number), None)
+    if info is None:
+        raise ErrInvalidPart(f"part {part_number}")
+    if info.size == 0:
+        return b""
+    # Client part numbers may be sparse; parts[] is indexed part_number-1
+    # inside _read_part, so pad the synthetic list up to this part.
+    pad = [ObjectPartInfo(number=i + 1, size=0, actual_size=0, etag="")
+           for i in range(part_number - 1)]
+    ec_read = ErasureInfo(
+        data_blocks=ec.data_blocks, parity_blocks=ec.parity_blocks,
+        block_size=ec.block_size, index=0,
+        distribution=ec.distribution,
+        checksums=[{"part": part_number,
+                    "algo": algos.get(part_number, "highwayhash256S"),
+                    "hash": b""}])
+    fi = FileInfo(volume=SYS_VOL, name=_upload_root(bucket, obj),
+                  data_dir=upload_id, size=info.size,
+                  parts=pad + [info], erasure=ec_read)
+    buf = bytearray(info.size)
+    es._read_part(SYS_VOL, fi.name, fi, part_number, 0, info.size,
+                  dst=memoryview(buf), healthy=False)
+    return bytes(buf)
+
+
 def abort_multipart_upload(es: ErasureSet, bucket: str, obj: str,
                            upload_id: str) -> None:
     _read_upload_fi(es, bucket, obj, upload_id)  # 404 if unknown

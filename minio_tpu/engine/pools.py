@@ -24,8 +24,33 @@ class ServerPools:
             raise ValueError("need at least one pool")
         self.pools = pools
         self.deployment_id = pools[0].deployment_id
+        # Pool indices excluded from NEW placement (decommission drain):
+        # reads/deletes keep probing them, writes route elsewhere.
+        self.draining: set[int] = set()
+        # pool idx -> background.decom.Decommissioner (admin status).
+        self.decommissions: dict[int, object] = {}
+        # Pool-sticky multipart ids relocated off a drained pool:
+        # old full upload id -> new full upload id (see background/decom).
+        self.upload_relocations: dict[str, str] = {}
 
     # -- pool placement ------------------------------------------------------
+
+    def set_draining(self, idx: int, flag: bool = True) -> None:
+        if not 0 <= idx < len(self.pools):
+            raise ValueError(f"no pool {idx}")
+        if flag:
+            if len(self.placement_pools()) <= 1 \
+                    and idx not in self.draining:
+                raise ValueError(
+                    "cannot drain the last placement-eligible pool")
+            self.draining.add(idx)
+        else:
+            self.draining.discard(idx)
+
+    def placement_pools(self) -> list[int]:
+        """Pool indices new writes may land on (draining excluded)."""
+        out = [i for i in range(len(self.pools)) if i not in self.draining]
+        return out or list(range(len(self.pools)))
 
     def _pool_with_object(self, bucket: str, obj: str,
                           version_id: str = "") -> int | None:
@@ -42,39 +67,76 @@ class ServerPools:
         return None
 
     def get_pool_idx(self, bucket: str, obj: str) -> int:
-        """Existing pool wins; else most free space
-        (cf. getPoolIdx, erasure-server-pool.go:373).
+        """Existing pool wins; else most free space, ties broken by the
+        LOWEST pool index (cf. getPoolIdx, erasure-server-pool.go:373 —
+        the deterministic tie-break keeps placement stable across
+        restarts: equal-capacity pools must not flip-flop an object
+        between pools on re-PUT).
 
-        Single pool short-circuits BEFORE the existence probe (the
+        A sole candidate short-circuits BEFORE the existence probe (the
         reference's SinglePool() fast path): the probe needs read
         quorum, and a key whose last write died mid-publish (one drive
         holds the version — below quorum) would otherwise 503 every
-        overwrite PUT forever.  With one pool there is no placement
-        decision to protect, so the write must always proceed."""
-        if len(self.pools) == 1:
-            return 0
+        overwrite PUT forever.  With one eligible pool there is no
+        placement decision to protect, so the write must always
+        proceed.  Draining pools are excluded outright: an existing
+        copy there must NOT attract the write (the decommission mover
+        owns that copy), so the overwrite re-places by free space."""
+        cands = self.placement_pools()
+        if len(cands) == 1:
+            return cands[0]
         existing = self._pool_with_object(bucket, obj)
-        if existing is not None:
+        if existing is not None and existing not in self.draining:
             return existing
-        frees = [p.disk_usage()["free"] for p in self.pools]
-        return max(range(len(frees)), key=lambda i: frees[i])
+        frees = {i: self.pools[i].disk_usage()["free"] for i in cands}
+        best = max(frees.values())
+        return min(i for i in cands if frees[i] == best)
+
+    # -- pool lifecycle ------------------------------------------------------
+
+    def add_pool(self, es: ErasureSets) -> int:
+        """Attach a freshly-formatted pool to a RUNNING deployment
+        (cf. the reference's restart-time capacity expansion — here it
+        is live, via the admin pool/add API).  The bucket set is
+        replicated onto the new pool BEFORE it becomes placement-
+        eligible, so a write routed there the instant it appears can
+        never hit ErrBucketNotFound."""
+        if es.deployment_id != self.deployment_id:
+            raise ValueError(
+                f"pool deployment id {es.deployment_id} != "
+                f"{self.deployment_id}")
+        for b in self.list_buckets():
+            try:
+                es.make_bucket(b)
+            except ErrBucketExists:
+                pass
+        self.pools.append(es)
+        return len(self.pools) - 1
 
     # -- bucket ops ----------------------------------------------------------
 
     def make_bucket(self, bucket: str) -> None:
+        """Fan out to ALL pools atomically: a hard failure on any pool
+        rolls back the copies THIS call created (pre-existing copies
+        stay), so the bucket never half-exists across pools."""
+        created: list[int] = []
         errs = []
-        for p in self.pools:
+        for i, p in enumerate(self.pools):
             try:
                 p.make_bucket(bucket)
+                created.append(i)
                 errs.append(None)
-            except StorageError as e:
+            except ErrBucketExists as e:
                 errs.append(e)
+            except StorageError:
+                for j in created:
+                    try:
+                        self.pools[j].delete_bucket(bucket)
+                    except StorageError:
+                        pass        # best-effort unwind; state converges
+                raise
         if errs and all(isinstance(e, ErrBucketExists) for e in errs):
             raise ErrBucketExists(bucket)
-        real = [e for e in errs
-                if e is not None and not isinstance(e, ErrBucketExists)]
-        if real:
-            raise real[0]
 
     def bucket_exists(self, bucket: str, cached: bool = False) -> bool:
         # cached=True is the write hot path's pre-check (see
@@ -83,19 +145,30 @@ class ServerPools:
                    for p in self.pools)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        """Fan out to ALL pools atomically: a hard failure partway (the
+        classic case — force=False and one pool still holds objects)
+        re-creates the bucket on the pools already deleted from, so
+        existence state converges instead of diverging (the old code
+        deleted the empty pools' copies and then raised, leaving the
+        bucket visible on some pools and gone on others)."""
+        deleted: list[int] = []
         errs = []
-        for p in self.pools:
+        for i, p in enumerate(self.pools):
             try:
                 p.delete_bucket(bucket, force=force)
+                deleted.append(i)
                 errs.append(None)
-            except StorageError as e:
+            except ErrBucketNotFound as e:
                 errs.append(e)
+            except StorageError:
+                for j in deleted:
+                    try:
+                        self.pools[j].make_bucket(bucket)
+                    except StorageError:
+                        pass        # best-effort unwind; state converges
+                raise
         if errs and all(isinstance(e, ErrBucketNotFound) for e in errs):
             raise ErrBucketNotFound(bucket)
-        real = [e for e in errs
-                if e is not None and not isinstance(e, ErrBucketNotFound)]
-        if real:
-            raise real[0]
 
     def list_buckets(self) -> list[str]:
         names: set[str] = set()
@@ -109,17 +182,54 @@ class ServerPools:
                    **kw) -> FileInfo:
         if not self.bucket_exists(bucket, cached=True):
             raise ErrBucketNotFound(bucket)
-        return self.pools[self.get_pool_idx(bucket, obj)].put_object(
-            bucket, obj, data, **kw)
+        idx = self.get_pool_idx(bucket, obj)
+        fi = self.pools[idx].put_object(bucket, obj, data, **kw)
+        try:
+            # Placement tag for observability (the x-mtpu-pool response
+            # header + loadgen's placement-skew histogram); never stored.
+            fi.pool_idx = idx
+        except (AttributeError, TypeError):
+            pass
+        return fi
+
+    def _read_pool_idx(self, bucket: str, obj: str,
+                       version_id: str = "") -> int | None:
+        """Pool a read should serve from.  Normally first-hit probe
+        order (placement guarantees at most one copy); while a drain is
+        active the mover's copy-then-delete window can briefly hold the
+        SAME object on two pools — and an overwrite during the drain
+        lands on a non-draining pool while the stale source still
+        shadows it in probe order — so reads become latest-wins
+        (compare mod_time_ns across every pool that answers).  Named
+        versions stay first-hit: version ids are unique."""
+        if not self.draining or version_id:
+            return self._pool_with_object(bucket, obj, version_id)
+        best: tuple[int, int] | None = None    # (mod_time_ns, idx)
+        for i, p in enumerate(self.pools):
+            try:
+                fi = p.head_object(bucket, obj, version_id)
+            except (ErrObjectNotFound, ErrVersionNotFound,
+                    ErrBucketNotFound):
+                continue
+            if best is None or fi.mod_time_ns > best[0]:
+                best = (fi.mod_time_ns, i)
+        return None if best is None else best[1]
 
     def get_object(self, bucket: str, obj: str, offset: int = 0,
                    length: int = -1, version_id: str = ""):
         last: StorageError | None = None
-        for p in self.pools:
-            try:
-                return p.get_object(bucket, obj, offset, length, version_id)
-            except (ErrObjectNotFound, ErrVersionNotFound) as e:
-                last = e
+        if self.draining and not version_id:
+            idx = self._read_pool_idx(bucket, obj)
+            if idx is not None:
+                return self.pools[idx].get_object(bucket, obj, offset,
+                                                  length, version_id)
+        else:
+            for p in self.pools:
+                try:
+                    return p.get_object(bucket, obj, offset, length,
+                                        version_id)
+                except (ErrObjectNotFound, ErrVersionNotFound) as e:
+                    last = e
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
         raise last or ErrObjectNotFound(f"{bucket}/{obj}")
@@ -129,7 +239,11 @@ class ServerPools:
         """Streaming read: (fi, chunk iterator); falls back to a whole-
         object read on backends without a streaming path."""
         last: StorageError | None = None
-        for p in self.pools:
+        order = list(self.pools)
+        if self.draining and not version_id:
+            idx = self._read_pool_idx(bucket, obj)
+            order = [self.pools[idx]] if idx is not None else []
+        for p in order:
             try:
                 if hasattr(p, "get_object_iter"):
                     return p.get_object_iter(bucket, obj, offset, length,
@@ -146,17 +260,43 @@ class ServerPools:
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
         last: StorageError | None = None
-        for p in self.pools:
-            try:
-                return p.head_object(bucket, obj, version_id)
-            except (ErrObjectNotFound, ErrVersionNotFound) as e:
-                last = e
+        if self.draining and not version_id:
+            idx = self._read_pool_idx(bucket, obj)
+            if idx is not None:
+                return self.pools[idx].head_object(bucket, obj,
+                                                   version_id)
+        else:
+            for p in self.pools:
+                try:
+                    return p.head_object(bucket, obj, version_id)
+                except (ErrObjectNotFound, ErrVersionNotFound) as e:
+                    last = e
         if not self.bucket_exists(bucket):
             raise ErrBucketNotFound(bucket)
         raise last or ErrObjectNotFound(f"{bucket}/{obj}")
 
     def delete_object(self, bucket: str, obj: str, version_id: str = "",
                       versioned: bool = False):
+        if self.draining and not (versioned and version_id == ""):
+            # Mid-drain an object can transiently live on two pools
+            # (copied, source not yet reaped).  A hard delete must
+            # remove EVERY copy — deleting only the first probe hit
+            # would let the surviving duplicate resurrect the object.
+            hit = False
+            res = None
+            for p in self.pools:
+                try:
+                    res = p.delete_object(bucket, obj, version_id,
+                                          versioned)
+                    hit = True
+                except (ErrObjectNotFound, ErrVersionNotFound,
+                        ErrBucketNotFound):
+                    continue
+            if hit:
+                return res
+            if not self.bucket_exists(bucket):
+                raise ErrBucketNotFound(bucket)
+            raise ErrObjectNotFound(f"{bucket}/{obj}")
         idx = self._pool_with_object(bucket, obj, version_id)
         if idx is None:
             if not self.bucket_exists(bucket):
@@ -200,12 +340,29 @@ class ServerPools:
         return sorted(names)
 
     def list_object_versions(self, bucket: str, obj: str) -> list[FileInfo]:
+        """Version history merged across pools (an overwrite during a
+        drain legitimately splits an object's versions between the
+        draining source and the destination), deduped by version id,
+        newest first — the single-pool result is unchanged."""
+        merged: dict[str, FileInfo] = {}
+        found = False
         for p in self.pools:
             try:
-                return p.list_object_versions(bucket, obj)
+                vers = p.list_object_versions(bucket, obj)
             except (ErrObjectNotFound, StorageError):
                 continue
-        raise ErrObjectNotFound(f"{bucket}/{obj}")
+            found = True
+            for fi in vers:
+                prev = merged.get(fi.version_id)
+                if prev is None or fi.mod_time_ns > prev.mod_time_ns:
+                    merged[fi.version_id] = fi
+        if not found:
+            raise ErrObjectNotFound(f"{bucket}/{obj}")
+        out = sorted(merged.values(),
+                     key=lambda fi: (-fi.mod_time_ns, fi.version_id))
+        for i, fi in enumerate(out):
+            fi.is_latest = i == 0
+        return out
 
     # -- multipart -----------------------------------------------------------
 
@@ -217,14 +374,21 @@ class ServerPools:
         # Uploads are pool-sticky: encode the pool into the id.
         return f"{idx}.{uid}"
 
-    @staticmethod
-    def _split_upload_id(upload_id: str) -> tuple[int, str]:
+    def _split_upload_id(self, upload_id: str) -> tuple[int, str]:
+        # A drained pool's pending uploads were re-staged elsewhere; the
+        # client still holds the OLD id, so follow the relocation map
+        # (persisted in the decom journal, reloaded at boot).
+        upload_id = self.upload_relocations.get(upload_id, upload_id)
         idx, _, rest = upload_id.partition(".")
         try:
-            return int(idx), rest
+            idx = int(idx)
         except ValueError:
             from .multipart import ErrUploadNotFound
             raise ErrUploadNotFound(upload_id) from None
+        if not 0 <= idx < len(self.pools):
+            from .multipart import ErrUploadNotFound
+            raise ErrUploadNotFound(upload_id) from None
+        return idx, rest
 
     def put_object_part(self, bucket: str, obj: str, upload_id: str,
                         part_number: int, data: bytes):
@@ -292,4 +456,30 @@ class ServerPools:
             healed = p.heal_bucket(bucket)
             if healed:
                 out[i] = healed
+        return out
+
+    # -- capacity / status ---------------------------------------------------
+
+    def disk_usage(self) -> dict:
+        """Cluster capacity summed over every pool (admin info / usage
+        accounting see ONE namespace, not per-pool slices)."""
+        total = free = 0
+        for p in self.pools:
+            du = p.disk_usage()
+            total += du["total"]
+            free += du["free"]
+        return {"total": total, "free": free}
+
+    def pool_status(self) -> list[dict]:
+        """Per-pool capacity + drain state rows (admin `pools` listing
+        and the mtpu_pool_* metric families)."""
+        out = []
+        for i, p in enumerate(self.pools):
+            du = p.disk_usage()
+            row = {"pool": i, "total": du["total"], "free": du["free"],
+                   "draining": i in self.draining}
+            d = self.decommissions.get(i)
+            if d is not None:
+                row["decommission"] = d.status()
+            out.append(row)
         return out

@@ -779,6 +779,39 @@ class MetricsRegistry:
                                  "Disk cache bytes in use")
         self.cache_max = Gauge("mtpu_cache_total_bytes",
                                "Disk cache size budget")
+        # Multi-pool placement + decommission families (cf.
+        # getClusterHealthMetrics pool rows, cmd/metrics-v3-cluster.go).
+        self.pool_total_bytes = Gauge(
+            "mtpu_pool_total_bytes", "Pool raw capacity", ("pool",))
+        self.pool_free_bytes = Gauge(
+            "mtpu_pool_free_bytes", "Pool free capacity", ("pool",))
+        self.pool_draining = Gauge(
+            "mtpu_pool_draining",
+            "Pool is excluded from new placement (decommission)",
+            ("pool",))
+        self.decom_state = Gauge(
+            "mtpu_decom_state",
+            "Decommission state: 0 draining, 1 paused, 2 complete, "
+            "3 cancelled, 4 failed", ("pool",))
+        self.decom_objects_moved = Gauge(
+            "mtpu_decom_objects_moved_total",
+            "Objects fully drained off the pool", ("pool",))
+        self.decom_objects_remaining = Gauge(
+            "mtpu_decom_objects_remaining",
+            "Objects still to drain", ("pool",))
+        self.decom_versions_moved = Gauge(
+            "mtpu_decom_versions_moved_total",
+            "Versions re-PUT off the pool", ("pool",))
+        self.decom_bytes_moved = Gauge(
+            "mtpu_decom_bytes_moved_total",
+            "Bytes re-PUT off the pool", ("pool",))
+        self.decom_bytes_per_sec = Gauge(
+            "mtpu_decom_bytes_per_sec",
+            "Current drain throughput", ("pool",))
+        self.decom_uploads_relocated = Gauge(
+            "mtpu_decom_uploads_relocated_total",
+            "Pending multipart uploads re-staged off the pool",
+            ("pool",))
         self.bandwidth = BandwidthMonitor()
 
     def observe_request(self, api: str, status: int, duration_s: float,
@@ -837,6 +870,30 @@ class MetricsRegistry:
                     mrf_retries += getattr(mrf, "retries", 0)
         self.drive_online.set(online)
         self.drive_offline.set(offline)
+        if hasattr(pools, "pool_status"):
+            _DSTATE = {"draining": 0, "paused": 1, "complete": 2,
+                       "cancelled": 3, "failed": 4}
+            for row in pools.pool_status():
+                pl = str(row["pool"])
+                self.pool_total_bytes.set(row["total"], pool=pl)
+                self.pool_free_bytes.set(row["free"], pool=pl)
+                self.pool_draining.set(int(row["draining"]), pool=pl)
+                ds = row.get("decommission")
+                if ds:
+                    self.decom_state.set(
+                        _DSTATE.get(ds["state"], 4), pool=pl)
+                    self.decom_objects_moved.set(
+                        ds["objects_moved"], pool=pl)
+                    self.decom_objects_remaining.set(
+                        ds["objects_remaining"], pool=pl)
+                    self.decom_versions_moved.set(
+                        ds["versions_moved"], pool=pl)
+                    self.decom_bytes_moved.set(
+                        ds["bytes_moved"], pool=pl)
+                    self.decom_bytes_per_sec.set(
+                        ds["bytes_per_sec"], pool=pl)
+                    self.decom_uploads_relocated.set(
+                        ds["uploads_relocated"], pool=pl)
         self.mrf_pending.set(mrf_pending)
         self.mrf_healed.set(mrf_healed)
         self.mrf_dropped.set(mrf_dropped)
@@ -993,6 +1050,12 @@ class MetricsRegistry:
                   self.drive_online,
                   self.drive_offline, self.cache_hits, self.cache_misses,
                   self.cache_evictions, self.cache_usage,
-                  self.cache_max):
+                  self.cache_max, self.pool_total_bytes,
+                  self.pool_free_bytes, self.pool_draining,
+                  self.decom_state, self.decom_objects_moved,
+                  self.decom_objects_remaining,
+                  self.decom_versions_moved, self.decom_bytes_moved,
+                  self.decom_bytes_per_sec,
+                  self.decom_uploads_relocated):
             m.render(out)
         return "\n".join(out) + "\n"

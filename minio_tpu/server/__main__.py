@@ -92,7 +92,8 @@ def install_signal_handlers(stop) -> None:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="minio_tpu.server")
-    ap.add_argument("--drives", required=True, action="append",
+    ap.add_argument("--drives", required=False, action="append",
+                    default=None,
                     help="drive paths, ellipses ok: /tmp/d{1...4}; "
                          "repeat the flag to add a POOL (capacity "
                          "expansion) — each --drives is one pool")
@@ -110,8 +111,18 @@ def main(argv: list[str] | None = None) -> int:
                         os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin"))
     # Each --drives flag is one endpoint group; within a group, args
     # are space-separated (a node list in cluster mode, or ellipsis
-    # pool groups standalone).
-    drive_groups = [g.split() for g in args.drives]
+    # pool groups standalone).  MTPU_POOLS is the flag-free spelling
+    # (containers, harnesses): semicolon-separated pools, each a
+    # space-separated ellipsis group — appended after any --drives.
+    drive_flags = list(args.drives or [])
+    env_pools = os.environ.get("MTPU_POOLS", "")
+    if env_pools:
+        drive_flags.extend(p for p in env_pools.split(";") if p.strip())
+    if not drive_flags:
+        print("minio_tpu: --drives (or MTPU_POOLS) required",
+              file=sys.stderr)
+        return 2
+    drive_groups = [g.split() for g in drive_flags]
     endpoint_args = [a for g in drive_groups for a in g]
     cluster_mode = any("://" in a for a in endpoint_args)
 
@@ -278,6 +289,19 @@ def main(argv: list[str] | None = None) -> int:
     if replayed:
         print(f"minio_tpu: MRF journal: replayed {replayed} pending "
               f"heal(s)", flush=True)
+    # Live-added pools survive a restart with stale --drives flags:
+    # pool-topology.json (written by admin pool/add / decommission)
+    # wins over the boot flags, and interrupted drains resume from
+    # their journals — the kill-9 recovery path.
+    from ..background.decom import resume_decommissions
+    from .topology import adopt_topology
+    adopted = adopt_topology(pools)
+    if adopted:
+        print(f"minio_tpu: topology: attached {adopted} live-added "
+              f"pool(s)", flush=True)
+    for d in resume_decommissions(pools):
+        print(f"minio_tpu: resumed decommission of pool {d.pool_idx} "
+              f"({d.state})", flush=True)
 
     # Full subsystem stack, the newAllSubsystems role
     # (cmd/server-main.go:441): IAM, scanner, notifications.

@@ -993,6 +993,11 @@ class S3Handlers:
         resp_headers = {"ETag": f'"{etag}"'}
         if fi.version_id:
             resp_headers["x-amz-version-id"] = fi.version_id
+        pool_idx = getattr(fi, "pool_idx", None)
+        if pool_idx is not None:
+            # Placement tag (loadgen --during-decom reads this into the
+            # per-pool skew histogram; harmless to normal clients).
+            resp_headers["x-mtpu-pool"] = str(pool_idx)
         return Response(200, headers=resp_headers)
 
     def _copy_object(self, bucket: str, key: str,

@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..engine.pools import ServerPools
 from ..observe import span as ospan
+from ..storage.errors import StorageError
 from ..utils import streams
 from .api_errors import S3Error
 from .handlers import Response, S3Handlers, error_response
@@ -933,6 +934,10 @@ class S3Server:
         "listen": "admin:ListenNotification",
         "bandwidth": "admin:BandwidthMonitor",
         "pools": "admin:ServerInfo",
+        # pool lifecycle: add + decommission are WRITE actions (cf.
+        # DecommissionAdminAction, madmin-go); GET status refines to
+        # ServerInfo below.
+        "pool": "admin:Decommission",
         "site-replication": "admin:SiteReplicationInfo",
     }
 
@@ -971,6 +976,8 @@ class S3Server:
                     "POST": "admin:CreateServiceAccount",
                     "DELETE": "admin:RemoveServiceAccount"}.get(
                 method, "admin:CreateServiceAccount")
+        elif base == "admin:Decommission" and method == "GET":
+            base = "admin:ServerInfo"        # status is read-only
         elif base == "admin:SiteReplicationInfo" and method != "GET":
             # membership mutations are WRITE actions (cf.
             # SiteReplicationAddAction / SiteReplicationRemoveAction)
@@ -1102,6 +1109,62 @@ class S3Server:
                     self._site_hook_again = False
         threading.Thread(target=run, daemon=True,
                          name="site-repl-hook").start()
+
+    def _pool_self_test(self, es) -> None:
+        """Probe every lane of a candidate pool BEFORE it becomes
+        placement-eligible: one put/get/delete round-trip per erasure
+        set.  A pool with a dead drive path must fail the admin call,
+        not the first client write routed onto it."""
+        probe_bucket = ".mtpu.pool-selftest"
+        try:
+            es.make_bucket(probe_bucket)
+        except StorageError:
+            pass
+        try:
+            for i, s in enumerate(es.sets):
+                payload = secrets.token_bytes(1024)
+                key = f"probe-{i}"
+                s.put_object(probe_bucket, key, payload)
+                _, got = s.get_object(probe_bucket, key)
+                if bytes(got) != payload:
+                    raise ValueError(
+                        f"pool self-test: set {i} read mismatch")
+                s.delete_object(probe_bucket, key)
+        finally:
+            try:
+                es.delete_bucket(probe_bucket, force=True)
+            except StorageError:
+                pass
+
+    def _pool_add(self, spec: str,
+                  set_drive_count: int | None = None) -> int:
+        """Attach a new pool live: expand the drive spec, format +
+        recovery-sweep + health-wrap (the boot stack), self-test its
+        lanes, replicate the bucket set, attach an MRF queue, then
+        propagate the topology to sibling workers."""
+        from .__main__ import expand_ellipses
+        from .topology import build_pool
+        paths = []
+        for part in spec.split():
+            paths.extend(expand_ellipses(part))
+        if not paths:
+            raise ValueError("empty drives spec")
+        es = build_pool(paths, set_drive_count,
+                        self.pools.deployment_id, sweep=True)
+        self._pool_self_test(es)
+        idx = self.pools.add_pool(es)
+        from ..background.mrf import attach_mrf
+        attach_mrf(es)
+        self._propagate_topology()
+        return idx
+
+    def _propagate_topology(self) -> None:
+        """Persist pool-topology.json and wake sibling workers (shared
+        topology generation) — no-op extras in single-process mode."""
+        from .topology import save_topology
+        save_topology(self.pools)
+        if self.worker_plane is not None:
+            self.worker_plane.state.bump_topology_gen()
 
     def _dispatch_admin(self, access_key: str, method: str, path: str,
                         query: dict, body: bytes) -> Response:
@@ -1573,6 +1636,7 @@ class S3Server:
             # Pool status listing (cf. ListPools,
             # cmd/admin-handlers-pools.go).
             out = []
+            cap = {r["pool"]: r for r in self.pools.pool_status()}
             for pi, pool in enumerate(self.pools.pools):
                 sets = getattr(pool, "sets", [pool])
                 drives = online = 0
@@ -1582,13 +1646,82 @@ class S3Server:
                         if d is not None and (not hasattr(d, "is_online")
                                               or d.is_online()):
                             online += 1
-                out.append({"pool": pi, "sets": len(sets),
-                            "drivesPerSet": getattr(
-                                sets[0], "n", drives) if sets else 0,
-                            "drivesTotal": drives,
-                            "drivesOnline": online,
-                            "decommissioning": False})
-            return j({"pools": out})
+                row = {"pool": pi, "sets": len(sets),
+                       "drivesPerSet": getattr(
+                           sets[0], "n", drives) if sets else 0,
+                       "drivesTotal": drives,
+                       "drivesOnline": online,
+                       "decommissioning": pi in self.pools.draining}
+                crow = cap.get(pi, {})
+                row["totalBytes"] = crow.get("total", 0)
+                row["freeBytes"] = crow.get("free", 0)
+                if "decommission" in crow:
+                    row["decommission"] = crow["decommission"]
+                out.append(row)
+            return j({"pools": out,
+                      "placement": self.pools.placement_pools()})
+        if sub == "pool/add" and method == "POST":
+            # Runtime expansion (cf. the reference's restart-time pool
+            # add — here live): format + bootstrap the drives, lane
+            # self-test, replicate the bucket set, THEN placement sees
+            # it; no restart, new writes skew to the empty pool.
+            req_obj = _json.loads(body or b"{}")
+            spec = req_obj.get("drives", "")
+            if not spec:
+                raise S3Error("InvalidArgument",
+                              "drives spec required (ellipses ok)")
+            try:
+                new_idx = self._pool_add(
+                    spec, int(req_obj.get("setDriveCount", 0)) or None)
+            except (ValueError, StorageError) as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            return j({"pool": new_idx,
+                      "placement": self.pools.placement_pools()})
+        if sub == "pool/decommission":
+            # Drain lifecycle (cf. StartDecommission / Status /
+            # Cancel, cmd/admin-handlers-pools.go).
+            from ..background import decom as decom_mod
+            q_pool = query.get("pool", [""])[0]
+            if method == "GET":
+                if q_pool:
+                    d = self.pools.decommissions.get(int(q_pool))
+                    if d is None:
+                        return j({"error":
+                                  f"no decommission for pool {q_pool}"},
+                                 404)
+                    return j(d.status())
+                return j({"decommissions":
+                          [self.pools.decommissions[i].status()
+                           for i in sorted(self.pools.decommissions)]})
+            if method != "POST":
+                raise S3Error("MethodNotAllowed")
+            if not q_pool:
+                raise S3Error("InvalidArgument", "pool required")
+            idx = int(q_pool)
+            action = query.get("action", ["start"])[0]
+            d = self.pools.decommissions.get(idx)
+            if action == "start":
+                if d is not None and d.state in ("draining", "paused"):
+                    return j(d.status())         # idempotent start
+                try:
+                    d = decom_mod.Decommissioner(self.pools, idx)
+                    d.start()
+                except ValueError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+            elif d is None:
+                return j({"error": f"no decommission for pool {idx}"},
+                         404)
+            elif action == "pause":
+                d.pause()
+            elif action == "resume":
+                d.resume()
+            elif action == "cancel":
+                d.cancel()
+            else:
+                raise S3Error("InvalidArgument",
+                              f"unknown action {action!r}")
+            self._propagate_topology()
+            return j(d.status())
         if sub == "bucket-remote":
             # cmd/admin-bucket-targets handlers (SetRemoteTargetHandler
             # etc.): register the remote cluster/bucket a replication

@@ -1,0 +1,150 @@
+"""Pool-topology persistence + live propagation.
+
+Runtime expansion (admin `pool/add`) and decommission state changes
+must reach every process that holds a `ServerPools`: the pre-fork
+workers (server/workers.py) each build their OWN engine stack, and a
+worker respawned mid-life must come back with the CURRENT pool list,
+not the boot-time `--drives` flags.
+
+Mechanism: the mutating worker writes `pool-topology.json` to the
+first pool's first local drive (atomic tmp+fsync+replace, the journal
+discipline) and bumps the shared-memory topology generation
+(SharedState slot 9).  Every worker polls the generation in its idle
+loop and applies the delta live: attach pools it does not have yet,
+adopt the draining set, refresh the multipart relocation map from the
+decom journals.  Single-process boots read the same file so a restart
+with stale flags still comes up with every live-added pool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+TOPOLOGY_FILE = "pool-topology.json"
+
+
+def _first_root(pool) -> str | None:
+    for es in getattr(pool, "sets", [pool]):
+        for d in getattr(es, "drives", []):
+            root = getattr(d, "root", None)
+            if d is not None and root:
+                return root
+    return None
+
+
+def topology_path_from_root(root: str) -> str:
+    from ..storage.drive import SYS_VOL
+    return os.path.join(root, SYS_VOL, TOPOLOGY_FILE)
+
+
+def topology_path(pools) -> str | None:
+    root = _first_root(pools.pools[0])
+    return topology_path_from_root(root) if root else None
+
+
+def pool_paths_of(pool) -> list[str]:
+    out = []
+    for es in getattr(pool, "sets", [pool]):
+        for d in getattr(es, "drives", []):
+            root = getattr(d, "root", None)
+            if d is not None and root:
+                out.append(root)
+    return out
+
+
+def save_topology(pools) -> None:
+    """Persist the live pool list + drain set.  Best-effort: a failed
+    write degrades to boot-flag topology on the next restart."""
+    path = topology_path(pools)
+    if not path:
+        return
+    doc = {
+        "pools": [{"paths": pool_paths_of(p),
+                   "set_drive_count": getattr(p, "set_drive_count", 0)}
+                  for p in pools.pools],
+        "draining": sorted(pools.draining),
+    }
+    tmp = path + ".tmp"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def load_topology_from_root(root: str) -> dict | None:
+    try:
+        with open(topology_path_from_root(root), "r",
+                  encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or not doc.get("pools"):
+        return None
+    return doc
+
+
+def build_pool(paths: list[str], set_drive_count: int | None,
+               deployment_id: str | None, *, sweep: bool = False):
+    """One pool's engine stack the way boot builds it: recovery sweep
+    (optional — exactly one process may sweep), health wrap, format."""
+    from ..engine.sets import ErasureSets
+    from ..storage.drive import LocalDrive
+    from ..storage.health_wrap import wrap_drives
+    local = [LocalDrive(p) for p in paths]
+    if sweep:
+        from ..storage.recovery import boot_recovery_sweep
+        boot_recovery_sweep(local)
+    return ErasureSets(wrap_drives(local),
+                       set_drive_count=set_drive_count or len(local),
+                       deployment_id=deployment_id)
+
+
+def refresh_relocations(pools) -> None:
+    """Reload the multipart relocation maps from the decom journals —
+    a part PUT balanced onto a worker that did not run the mover must
+    still resolve the client's OLD upload id."""
+    from ..background import decom as decom_mod
+    for path in decom_mod.find_journals(pools).values():
+        pools.upload_relocations.update(
+            decom_mod.replay_journal(path)["mp"])
+
+
+def adopt_topology(pools, *, attach_pool=None) -> int:
+    """Fold the persisted topology into a live `ServerPools`: attach
+    pools beyond the current list, adopt the draining set, refresh
+    relocations.  Returns how many pools were attached.  `attach_pool`
+    (default: build + attach_mrf) lets workers hook their own wiring."""
+    root = _first_root(pools.pools[0])
+    if not root:
+        return 0
+    doc = load_topology_from_root(root)
+    if doc is None:
+        return 0
+    added = 0
+    for spec in doc["pools"][len(pools.pools):]:
+        if attach_pool is not None:
+            attach_pool(spec)
+        else:
+            from ..background.mrf import attach_mrf
+            es = build_pool(spec["paths"], spec.get("set_drive_count"),
+                            pools.deployment_id)
+            pools.add_pool(es)
+            attach_mrf(es)
+        added += 1
+    draining = {int(i) for i in doc.get("draining", [])
+                if 0 <= int(i) < len(pools.pools)}
+    # Never un-drain a pool the local mover is actively draining: the
+    # file is the cross-process floor, local state can be ahead.
+    pools.draining |= draining
+    for idx in list(pools.draining - draining):
+        d = pools.decommissions.get(idx)
+        if d is None or getattr(d, "state", "") in ("cancelled",):
+            pools.draining.discard(idx)
+    refresh_relocations(pools)
+    return added

@@ -64,7 +64,7 @@ _GHDR = 16                       # global slots
 _WSLOTS = 8                      # per-worker slab stride
 # global: 0 owner_gen, 1 owner_pid, 2 owner_beat_ns, 3 supervisor_pid,
 #         4 nworkers, 5 owner_co_dispatches, 6 owner_co_items,
-#         7 owner_co_pending, 8 owner_co_weight
+#         7 owner_co_pending, 8 owner_co_weight, 9 topology_gen
 # worker: 0 pid, 1 beat_ns, 2 ready, 3 draining, 4 respawns,
 #         5 requests_total, 6 inflight, 7 reserved
 
@@ -144,6 +144,20 @@ class SharedState:
         if not self._a[1]:
             return False
         return (_now_ns() - int(self._a[2])) < int(stale_s * 1e9)
+
+    # topology ---------------------------------------------------------------
+
+    def bump_topology_gen(self) -> int:
+        """Pool-topology epoch: bumped by whichever worker serves an
+        admin pool/add or pool/decommission call after it persisted
+        pool-topology.json; every worker polls it in the idle loop and
+        folds the delta into its own engine stack (see
+        server/topology.py)."""
+        self._a[9] += 1
+        return int(self._a[9])
+
+    def topology_gen(self) -> int:
+        return int(self._a[9])
 
     def owner_info(self) -> dict:
         d = int(self._a[5])
@@ -401,18 +415,40 @@ def _worker_main(plane: WorkerPlane, idx: int, cfg: dict) -> int:
     from ..storage.health_wrap import wrap_drives
     from ..storage.recovery import boot_recovery_sweep
 
+    # A respawned worker must come back with the LIVE topology (pools
+    # added via admin pool/add), not the boot-time flags: the persisted
+    # pool-topology.json wins when present.
+    from . import topology as topo_mod
+    topo = topo_mod.load_topology_from_root(cfg["pool_paths"][0][0])
+    pool_specs = ([(p["paths"], p.get("set_drive_count")
+                    or cfg["set_drive_count"]) for p in topo["pools"]]
+                  if topo else
+                  [(paths, cfg["set_drive_count"])
+                   for paths in cfg["pool_paths"]])
     pool_sets: list[ErasureSets] = []
-    for paths in cfg["pool_paths"]:
+    for paths, sdc in pool_specs:
         local = [LocalDrive(p) for p in paths]
         if idx == 0:
             boot_recovery_sweep(local)
         pool_sets.append(ErasureSets(
             wrap_drives(local),
-            set_drive_count=cfg["set_drive_count"] or len(local),
+            set_drive_count=sdc or len(local),
             deployment_id=(pool_sets[0].deployment_id
                            if pool_sets else None)))
     pools = ServerPools(pool_sets)
     mrf_queues = attach_mrf(pools)
+    if topo:
+        pools.draining |= {int(i) for i in topo.get("draining", [])
+                           if 0 <= int(i) < len(pools.pools)}
+        topo_mod.refresh_relocations(pools)
+    topo_seen = plane.state.topology_gen()
+    if idx == 0:
+        # Recovery owner: relaunch drains interrupted by the last death
+        # (the decom journal's state survives kill -9 at `draining`).
+        from ..background.decom import resume_decommissions
+        for d in resume_decommissions(pools):
+            print(f"minio_tpu: worker 0 resumed decommission of pool "
+                  f"{d.pool_idx} ({d.state})", flush=True)
 
     from ..background.scanner import DataScanner
     from ..bucket.notify import NotificationSystem
@@ -461,12 +497,33 @@ def _worker_main(plane: WorkerPlane, idx: int, cfg: dict) -> int:
     if idx == 0:
         print(f"minio_tpu worker pool serving on {srv.endpoint} "
               f"({plane.nworkers} workers, SO_REUSEPORT)", flush=True)
+    reloc_beat = 0
     while not stop.wait(timeout=0.5):
         if srv.service_event:
             # Admin restart/stop reaches ONE worker; exit and let the
             # supervisor respawn this slot fresh (restart) — pool-wide
             # stop is the supervisor's SIGTERM, not this path.
             break
+        gen = plane.state.topology_gen()
+        if gen != topo_seen:
+            # Another worker changed the pool topology (pool/add or a
+            # decommission state flip): fold the persisted delta in.
+            topo_seen = gen
+            try:
+                topo_mod.adopt_topology(pools)
+            except Exception as e:  # noqa: BLE001 — stay serving
+                print(f"minio_tpu: worker {idx} topology adopt "
+                      f"failed: {e}", file=sys.stderr, flush=True)
+        elif pools.draining:
+            # An active drain relocates multipart uploads continuously;
+            # a part PUT can land on ANY worker, so the relocation map
+            # must track the mover's journal, not just topology bumps.
+            reloc_beat += 1
+            if reloc_beat % 4 == 0:
+                try:
+                    topo_mod.refresh_relocations(pools)
+                except Exception:  # noqa: BLE001
+                    pass
     plane.state.set_draining(idx)
     srv.drain()
     srv.shutdown()

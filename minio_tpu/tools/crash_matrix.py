@@ -330,3 +330,235 @@ def run_matrix(scenarios=SCENARIOS, base_dir: str | None = None,
             progress(f"[{i + 1}/{len(scenarios)}] "
                      f"{sc['point']}:{sc['nth']} ({sc['op']}) {mark}")
     return results
+
+
+# ---------------------------------------------------------------------------
+# Decommission kill-9 matrix: one row per decom.* crash point.  Each
+# scenario proves the exactly-once mover discipline — kill -9 mid-drain,
+# reboot, auto-resume from the fsynced decom journal — ends with every
+# acked object byte-exact at its ORIGINAL ETag, no duplicate versions,
+# and the drained pool empty.
+# ---------------------------------------------------------------------------
+
+#: nth > 1 lands the kill mid-drain (some versions already moved and
+#: checkpointed, some not) — the resume must neither re-copy moved
+#: versions as duplicates nor skip unmoved ones.
+DECOM_SCENARIOS = (
+    {"point": "decom.pre_verify", "nth": 3},
+    {"point": "decom.post_copy", "nth": 2},
+    {"point": "decom.pre_delete", "nth": 2},
+    {"point": "decom.checkpoint", "nth": 4},
+)
+
+DECOM_KEYS = 10
+DECOM_DRAIN_DEADLINE_S = 180.0
+
+
+def boot_pool_server(base_dir: str, port: int, *, crash: str = "",
+                     extra_env: dict | None = None) -> subprocess.Popen:
+    """Two-pool server over base_dir/p{0,1}_d{1...N}."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MTPU_SCANNER"] = "0"
+    env.pop("MTPU_CRASH", None)
+    if crash:
+        env["MTPU_CRASH"] = crash
+    if extra_env:
+        env.update(extra_env)
+    log = open(os.path.join(base_dir, "server.log"), "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "minio_tpu.server",
+         "--drives", f"{base_dir}/p0_d{{1...{N_DRIVES}}}",
+         "--drives", f"{base_dir}/p1_d{{1...{N_DRIVES}}}",
+         "--port", str(port)],
+        stdout=log, stderr=subprocess.STDOUT, env=env)
+
+
+def _admin(cli, method: str, sub: str,
+           query: dict[str, str] | None = None) -> dict:
+    import json
+    status, _, body = cli.request(method, f"/minio/admin/v3/{sub}",
+                                  query=query)
+    if status != 200:
+        raise ScenarioError(
+            f"admin {method} {sub} -> {status}: {body[:200]!r}")
+    return json.loads(body) if body else {}
+
+
+def _wait_decom_complete(cli, pool: int,
+                         deadline_s: float = DECOM_DRAIN_DEADLINE_S) -> dict:
+    deadline = time.monotonic() + deadline_s
+    st = {}
+    while time.monotonic() < deadline:
+        st = _retry(lambda: _admin(cli, "GET", "pool/decommission",
+                                   {"pool": str(pool)}))
+        if st.get("state") == "complete":
+            return st
+        if st.get("state") in ("failed", "cancelled"):
+            raise ScenarioError(
+                f"decommission parked {st.get('state')}: "
+                f"{st.get('error')}")
+        time.sleep(0.25)
+    raise ScenarioError(f"drain never completed: last status {st}")
+
+
+def pool_object_residue(base_dir: str, pool: int) -> list[str]:
+    """Object entries still on a pool's drives (post-drain: none —
+    only the replicated bucket shell and the .mtpu.sys area remain)."""
+    left = []
+    for i in range(1, N_DRIVES + 1):
+        bdir = os.path.join(base_dir, f"p{pool}_d{i}", BUCKET)
+        try:
+            left += [f"p{pool}_d{i}/{n}" for n in os.listdir(bdir)]
+        except FileNotFoundError:
+            pass
+    return left
+
+
+def run_decom_scenario(sc: dict, base_dir: str, seed: int = 0,
+                       extra_env: dict | None = None) -> dict:
+    """Kill-9 an in-flight pool-0 drain at an armed decom.* point,
+    reboot, let the journal resume it, assert the zero-loss contract:
+
+      boot A  (unarmed)  load DECOM_KEYS objects + one pending
+              multipart upload onto pool 0, record ETags, SIGKILL;
+      boot B  (armed)    POST pool/decommission?pool=0&action=start;
+              the mover trips the crash point -> os._exit(137);
+      boot C  (unarmed)  resume_decommissions picks the journal up at
+              boot; await state=complete; every key byte-exact at its
+              ORIGINAL ETag, exactly one version each, the pending
+              upload completes under its OLD client-held id, pool 0
+              drives hold no objects, and new writes land on pool 1.
+    """
+    os.makedirs(base_dir, exist_ok=True)
+    point, nth = sc["point"], sc["nth"]
+    res = {"point": point, "nth": nth, "op": "decom", "seed": seed}
+    rng = random.Random(seed * 13 + 5)
+    objects = {f"obj{i:02d}": rng.randbytes(rng.choice(
+        (4 * 1024, 64 * 1024, 512 * 1024))) for i in range(DECOM_KEYS)}
+    part1 = _payload(seed * 13 + 7, PART_BIG)
+    part2 = _payload(seed * 13 + 8, 64 * 1024)
+    etags: dict[str, str] = {}
+
+    # -- boot A: load pool 0, then kill -9 ----------------------------------
+    port = free_port()
+    proc = boot_pool_server(base_dir, port, extra_env=extra_env)
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(f"{point}: boot A never became ready")
+        cli = make_client(port)
+        _retry(lambda: cli.make_bucket(BUCKET))
+        for key, val in objects.items():
+            h = _retry(lambda k=key, v=val: cli.put_object(BUCKET, k, v))
+            etags[key] = h.get("ETag") or h.get("etag") or ""
+        uid = _retry(lambda: cli.create_multipart(BUCKET, "mp-pending"))
+        petag = _retry(lambda: cli.upload_part(BUCKET, "mp-pending",
+                                               uid, 1, part1))
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+    # -- boot B: armed, start the drain, die inside the mover ---------------
+    port = free_port()
+    proc = boot_pool_server(base_dir, port, crash=f"{point}:{nth}",
+                            extra_env=extra_env)
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(f"{point}:{nth}: boot B never ready")
+        cli = make_client(port)
+        try:
+            _retry(lambda: _admin(cli, "POST", "pool/decommission",
+                                  {"pool": "0", "action": "start"}))
+        except Exception:  # noqa: BLE001 — server may die under the call
+            pass
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    if proc.returncode != 137:
+        raise ScenarioError(
+            f"{point}:{nth}: boot B exit {proc.returncode}, wanted 137 "
+            f"(crash point never fired?)")
+
+    # -- boot C: recovery boot resumes the drain from the journal -----------
+    port = free_port()
+    proc = boot_pool_server(base_dir, port, extra_env=extra_env)
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(f"{point}: recovery boot never ready")
+        cli = make_client(port)
+        st = _wait_decom_complete(cli, 0)
+        res["objects_moved"] = st.get("objects_moved")
+        # Zero acked-write loss, byte-identical at the ORIGINAL ETag.
+        for key, val in objects.items():
+            got = _retry(lambda k=key: cli.get_object(BUCKET, k))
+            if got != val:
+                raise ScenarioError(
+                    f"{point}: {key} lost/corrupt after resume "
+                    f"({len(got)} vs {len(val)} bytes)")
+            status, h, _ = cli.request("HEAD", f"/{BUCKET}/{key}")
+            etag = h.get("ETag") or h.get("etag") or ""
+            if status != 200 or etag != etags[key]:
+                raise ScenarioError(
+                    f"{point}: {key} ETag changed across drain "
+                    f"({etag!r} vs {etags[key]!r})")
+        # No duplicate versions: resume must not re-copy moved versions.
+        _, _, body = cli.request("GET", f"/{BUCKET}",
+                                 query={"versions": ""})
+        for key in objects:
+            n = body.count(f"<Key>{key}</Key>".encode())
+            if n != 1:
+                raise ScenarioError(
+                    f"{point}: {key} has {n} versions after resume "
+                    f"(duplicate copy)")
+        # The relocated pending upload completes under its OLD id.
+        p2 = _retry(lambda: cli.upload_part(BUCKET, "mp-pending", uid,
+                                            2, part2))
+        _retry(lambda: cli.complete_multipart(
+            BUCKET, "mp-pending", uid, [(1, petag), (2, p2)]))
+        got = cli.get_object(BUCKET, "mp-pending")
+        if got != part1 + part2:
+            raise ScenarioError(
+                f"{point}: relocated multipart readback mismatch")
+        # The drained pool is empty and excluded from new placement.
+        left = pool_object_residue(base_dir, 0)
+        if left:
+            raise ScenarioError(
+                f"{point}: drained pool not empty: {left[:8]}")
+        h = cli.put_object(BUCKET, "post-drain", b"x" * 1024)
+        landed = h.get("x-mtpu-pool") or h.get("X-Mtpu-Pool")
+        if landed is not None and landed != "1":
+            raise ScenarioError(
+                f"{point}: post-drain write landed on pool {landed}")
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        if proc.returncode != 0:
+            raise ScenarioError(
+                f"{point}: graceful exit returned {proc.returncode}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    res["ok"] = True
+    return res
+
+
+def run_decom_matrix(scenarios=DECOM_SCENARIOS,
+                     base_dir: str | None = None, seed: int = 0,
+                     progress=None) -> list[dict]:
+    import tempfile
+    root = base_dir or tempfile.mkdtemp(prefix="mtpu-decom-")
+    results = []
+    for i, sc in enumerate(scenarios):
+        d = os.path.join(root, f"dc{i}-{sc['point'].replace('.', '_')}")
+        try:
+            r = run_decom_scenario(sc, d, seed=seed)
+        except ScenarioError as e:
+            r = {**sc, "ok": False, "error": str(e)}
+        results.append(r)
+        if progress is not None:
+            mark = "ok" if r.get("ok") else f"FAIL: {r.get('error')}"
+            progress(f"[{i + 1}/{len(scenarios)}] "
+                     f"{sc['point']}:{sc['nth']} (decom) {mark}")
+    return results

@@ -47,6 +47,11 @@ POINTS = (
     "mp.part.post_publish",      # part PUT: part durable, before reply
     "mp.complete.publish",       # complete: per-drive publish (use :nth)
     "mp.complete.post_publish",  # complete: quorum met, before reply
+    # background/decom.py — the decommission mover's exactly-once window
+    "decom.pre_verify",          # mover: before the destination probe
+    "decom.post_copy",           # mover: copy published, source intact
+    "decom.pre_delete",          # mover: dest verified, source not deleted
+    "decom.checkpoint",          # mover: source gone, journal not appended
 )
 
 _mu = threading.Lock()

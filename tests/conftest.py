@@ -47,6 +47,12 @@ def pytest_configure(config):
         "cluster under the seeded network-chaos proxy (a one-scenario "
         "smoke runs in tier-1; the full partition/node-kill matrix is "
         "also marked slow — select with -m 'netchaos and slow')")
+    config.addinivalue_line(
+        "markers",
+        "decom: pool decommission tests (in-process drain smoke runs "
+        "in tier-1; the kill-9 mid-drain resume sweep over real "
+        "server subprocesses is also marked slow — select with "
+        "-m 'decom and slow')")
 
 
 @pytest.fixture(params=["1", "0"], ids=["fastpath", "oracle"])

@@ -1,0 +1,336 @@
+"""Live pool decommission (background/decom.py): drain, resume, admin.
+
+Tier-1 smoke: in-process 2-pool drains through `run_sync` — pool ends
+empty, bytes/ETags/version history survive intact, pending multipart
+uploads stay completable under their old client-held ids, the journal
+replays, pause/cancel behave, and a simulated mid-drain kill resumes
+with no loss and no duplicate versions.
+
+The full kill-9 sweep (real server subprocesses SIGKILLed inside every
+MTPU_CRASH=decom.* point, then journal-resumed across a reboot) is the
+slow tier: `-m 'decom and slow'` — the same scenarios
+tools/chaos_report.py --decom tables.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from minio_tpu.background import decom as decom_mod
+from minio_tpu.background.decom import (Decommissioner, find_journals,
+                                        replay_journal,
+                                        resume_decommissions)
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.storage.drive import LocalDrive
+from minio_tpu.storage.errors import ErrObjectNotFound, StorageError
+from minio_tpu.tools import crash_matrix
+
+pytestmark = pytest.mark.decom
+
+
+def two_pools(tmp, n=4):
+    p0 = ErasureSets([LocalDrive(f"{tmp}/p0-{i}") for i in range(n)],
+                     set_drive_count=n)
+    p1 = ErasureSets([LocalDrive(f"{tmp}/p1-{i}") for i in range(n)],
+                     set_drive_count=n,
+                     deployment_id=p0.deployment_id)
+    return ServerPools([p0, p1])
+
+
+def reopen_pools(tmp, n=4):
+    """Fresh object layer over the SAME drive trees — the restart.
+    Deployment ids are adopted from the on-disk formats."""
+    p0 = ErasureSets([LocalDrive(f"{tmp}/p0-{i}") for i in range(n)],
+                     set_drive_count=n)
+    p1 = ErasureSets([LocalDrive(f"{tmp}/p1-{i}") for i in range(n)],
+                     set_drive_count=n,
+                     deployment_id=p0.deployment_id)
+    return ServerPools([p0, p1])
+
+
+def force_free(pools, frees):
+    for p, free in zip(pools.pools, frees):
+        p.disk_usage = (lambda f: lambda: {"total": 1 << 40, "free": f})(
+            free)
+
+
+def pool_names(pool, bucket):
+    names = set()
+    for es in pool.sets:
+        try:
+            names.update(es.list_object_names(bucket))
+        except StorageError:
+            pass
+    return names
+
+
+def blob(seed, n):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture()
+def pools(tmp_path):
+    return two_pools(str(tmp_path))
+
+
+class TestDrain:
+    def test_drain_empties_pool_and_preserves_bytes(self, pools,
+                                                    tmp_path):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])        # everything lands on p0
+        data = {f"o{i}": blob(i, 40_000 + i * 111) for i in range(6)}
+        etags = {}
+        for name, val in data.items():
+            fi = pools.put_object("b", name, val)
+            etags[name] = fi.metadata.get("etag", "")
+        assert pool_names(pools.pools[0], "b") == set(data)
+
+        force_free(pools, [1000, 10 ** 9])   # room on the destination
+        d = Decommissioner(pools, 0)
+        d.run_sync()
+        st = d.status()
+        assert st["state"] == "complete", st["error"]
+        assert st["objects_moved"] == len(data)
+
+        # drained pool holds nothing; every byte + ETag intact on p1
+        assert pool_names(pools.pools[0], "b") == set()
+        assert pool_names(pools.pools[1], "b") == set(data)
+        for name, val in data.items():
+            fi, got = pools.get_object("b", name)
+            assert bytes(got) == val
+            assert fi.metadata.get("etag", "") == etags[name]
+
+    def test_drain_preserves_version_history(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        vals = [blob(10 + i, 20_000 + i) for i in range(3)]
+        for v in vals:
+            pools.put_object("b", "ver", v, versioned=True)
+        before = [(fi.version_id, fi.mod_time_ns)
+                  for fi in pools.list_object_versions("b", "ver")]
+        assert len(before) == 3
+
+        force_free(pools, [1000, 10 ** 9])
+        Decommissioner(pools, 0).run_sync()
+        after = [(fi.version_id, fi.mod_time_ns)
+                 for fi in pools.list_object_versions("b", "ver")]
+        # same ids, same timestamps, same order — the moved history IS
+        # the history, not a re-minted copy
+        assert after == before
+        for (vid, _), want in zip(reversed(before), vals):
+            _, got = pools.get_object("b", "ver", version_id=vid)
+            assert bytes(got) == want
+
+    def test_drain_relocates_pending_multipart(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        uid = pools.new_multipart_upload("b", "mp")
+        assert uid.startswith("0.")
+        part1 = blob(77, 5 << 20)            # min part size
+        pools.put_object_part("b", "mp", uid, 1, part1)
+
+        force_free(pools, [1000, 10 ** 9])
+        d = Decommissioner(pools, 0)
+        d.run_sync()
+        assert d.status()["state"] == "complete"
+        assert d.status()["uploads_relocated"] == 1
+
+        # The client still holds the OLD id: late part + complete must
+        # route through the relocation map onto the destination pool.
+        part2 = blob(78, 123_000)
+        pools.put_object_part("b", "mp", uid, 2, part2)
+        etags = {p.number: p.etag
+                 for p in pools.list_parts("b", "mp", uid)}
+        pools.complete_multipart_upload(
+            "b", "mp", uid, [(1, etags[1]), (2, etags[2])])
+        fi, got = pools.get_object("b", "mp")
+        assert bytes(got) == part1 + part2
+        with pytest.raises(ErrObjectNotFound):
+            pools.pools[0].head_object("b", "mp")
+
+    def test_journal_records_and_replays(self, pools, tmp_path):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        for i in range(3):
+            pools.put_object("b", f"j{i}", blob(i, 10_000))
+        force_free(pools, [1000, 10 ** 9])
+        d = Decommissioner(pools, 0)
+        d.run_sync()
+        journals = find_journals(pools)
+        assert set(journals) == {0}
+        # journal home is NOT the draining pool's tree
+        assert str(tmp_path / "p1-0") in journals[0]
+        prior = replay_journal(journals[0])
+        assert prior["state"] == "complete"
+        assert prior["moved"] == 3
+        assert prior["bytes"] == d.status()["bytes_moved"]
+
+
+class TestPlacementDuringDrain:
+    def test_new_writes_avoid_draining_pool(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [10 ** 9, 10])     # skew hard toward p0
+        d = Decommissioner(pools, 0)
+        d.pause()                            # gate the mover
+        d.start()                            # draining flag set, parked
+        try:
+            assert pools.get_pool_idx("b", "fresh") == 1
+            fi = pools.put_object("b", "fresh", b"x" * 2048)
+            assert getattr(fi, "pool_idx", None) == 1
+            pools.pools[1].head_object("b", "fresh")
+        finally:
+            d.cancel()
+
+    def test_cancel_restores_eligibility(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        pools.put_object("b", "stay", b"data-stays")
+        d = Decommissioner(pools, 0)
+        d.pause()
+        d.start()
+        assert d.status()["state"] == "paused"
+        assert 0 in pools.draining
+        d.cancel()
+        assert d.status()["state"] == "cancelled"
+        assert 0 not in pools.draining
+        # nothing moved while parked; the pool is placeable again
+        pools.pools[0].head_object("b", "stay")
+        assert pools.get_pool_idx("b", "stay") == 0
+
+    def test_pause_resume_completes(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        pools.put_object("b", "o", blob(3, 30_000))
+        force_free(pools, [1000, 10 ** 9])
+        d = Decommissioner(pools, 0)
+        d.pause()
+        assert d.status()["state"] == "paused"
+        d.resume()                           # relaunches the mover
+        d.join(timeout=60)
+        assert d.status()["state"] == "complete"
+        assert pool_names(pools.pools[0], "b") == set()
+
+    def test_cannot_drain_last_pool(self, pools):
+        pools.set_draining(0, True)
+        with pytest.raises(ValueError):
+            pools.set_draining(1, True)
+
+
+class TestCrashResume:
+    def test_kill_mid_drain_resumes_exactly_once(self, pools, tmp_path):
+        """Simulated kill-9 at decom.pre_delete (a BaseException, like
+        os._exit: no except-clause can park the state to `failed`),
+        then a fresh object layer over the same drives resumes from the
+        journal: zero loss, zero duplicate versions, pool empty."""
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        data = {f"o{i}": blob(50 + i, 25_000 + i) for i in range(6)}
+        for name, val in data.items():
+            pools.put_object("b", name, val)
+        force_free(pools, [1000, 10 ** 9])
+
+        class FakeKill(BaseException):
+            pass
+
+        hits = {"n": 0}
+        real = decom_mod.crash_point
+
+        def dying(point):
+            if point == "decom.pre_delete":
+                hits["n"] += 1
+                if hits["n"] == 4:           # mid-drain, one-shot
+                    raise FakeKill(point)
+
+        decom_mod.crash_point = dying
+        try:
+            with pytest.raises(FakeKill):
+                Decommissioner(pools, 0).run_sync()
+        finally:
+            decom_mod.crash_point = real
+
+        # -- the restart: fresh layer over the same trees -------------
+        pools2 = reopen_pools(str(tmp_path))
+        force_free(pools2, [1000, 10 ** 9])
+        resumed = resume_decommissions(pools2, autostart=False)
+        assert [d.pool_idx for d in resumed] == [0]
+        d = resumed[0]
+        assert d.state == "draining"
+        assert 0 in pools2.draining
+        d.run_sync()
+        assert d.status()["state"] == "complete", d.status()["error"]
+
+        assert pool_names(pools2.pools[0], "b") == set()
+        for name, val in data.items():
+            vers = pools2.list_object_versions("b", name)
+            assert len(vers) == 1, f"{name}: duplicate versions"
+            _, got = pools2.get_object("b", name)
+            assert bytes(got) == val
+
+    def test_completed_drain_stays_excluded_after_restart(
+            self, pools, tmp_path):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        pools.put_object("b", "o", b"y" * 4096)
+        force_free(pools, [1000, 10 ** 9])
+        Decommissioner(pools, 0).run_sync()
+
+        pools2 = reopen_pools(str(tmp_path))
+        force_free(pools2, [10 ** 9, 10])    # skew back toward p0
+        resumed = resume_decommissions(pools2, autostart=False)
+        assert resumed[0].state == "complete"
+        # the drained pool must NOT re-enter placement on restart
+        assert 0 in pools2.draining
+        assert pools2.get_pool_idx("b", "new") == 1
+        _, got = pools2.get_object("b", "o")
+        assert bytes(got) == b"y" * 4096
+
+
+class TestAtomicBucketOps:
+    def test_make_bucket_rolls_back_on_partial_failure(self, pools):
+        orig = pools.pools[1].make_bucket
+
+        def boom(bucket):
+            raise StorageError("pool 1 down")
+
+        pools.pools[1].make_bucket = boom
+        try:
+            with pytest.raises(StorageError):
+                pools.make_bucket("half")
+        finally:
+            pools.pools[1].make_bucket = orig
+        # no half-created bucket left on the pool that succeeded
+        assert not pools.pools[0].bucket_exists("half")
+        assert not pools.bucket_exists("half")
+        # and the name is reusable once every pool is healthy
+        pools.make_bucket("half")
+        assert all(p.bucket_exists("half") for p in pools.pools)
+
+
+class TestAddPool:
+    def test_add_pool_replicates_buckets_and_joins_placement(
+            self, pools, tmp_path):
+        pools.make_bucket("b")
+        p2 = ErasureSets(
+            [LocalDrive(f"{tmp_path}/p2-{i}") for i in range(4)],
+            set_drive_count=4,
+            deployment_id=pools.pools[0].deployment_id)
+        idx = pools.add_pool(p2)
+        assert idx == 2
+        assert p2.bucket_exists("b")
+        force_free(pools, [10, 10, 10 ** 9])
+        assert pools.get_pool_idx("b", "new-obj") == 2
+        fi = pools.put_object("b", "new-obj", b"expansion")
+        assert getattr(fi, "pool_idx", None) == 2
+
+
+# -- the kill-9 sweep over real server subprocesses (slow tier) ----------
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "sc", crash_matrix.DECOM_SCENARIOS,
+    ids=[f"{s['point']}:{s['nth']}" for s in crash_matrix.DECOM_SCENARIOS])
+def test_kill9_mid_drain_resume_sweep(sc, tmp_path):
+    crash_matrix.run_decom_scenario(sc, str(tmp_path), seed=1)

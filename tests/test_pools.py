@@ -109,6 +109,44 @@ class TestPlacement:
         assert data == part
 
 
+class TestPlacementDeterminism:
+    """getPoolIdx's tie-break + probe contracts (ISSUE 11 satellite):
+    equal-capacity pools must never flip-flop placement, and the
+    existing-object probe must beat any free-space skew."""
+
+    def test_tie_break_is_lowest_index(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [500, 500])
+        for key in (f"k{i}" for i in range(16)):
+            assert pools.get_pool_idx("b", key) == 0
+
+    def test_placement_stable_across_instances(self, pools, tmp_path):
+        """The same namespace rebuilt (a restart) answers the same
+        pool for every key — placement is a pure function of state,
+        not of construction order or dict iteration."""
+        pools.make_bucket("b")
+        force_free(pools, [500, 500])
+        keys = [f"obj-{i:02d}" for i in range(12)]
+        first = {k: pools.get_pool_idx("b", k) for k in keys}
+        rebuilt = ServerPools(pools.pools)
+        rebuilt_ans = {k: rebuilt.get_pool_idx("b", k) for k in keys}
+        assert rebuilt_ans == first
+
+    def test_probe_beats_skew(self, pools):
+        """An existing copy wins placement no matter how hard the
+        free-space skew points the other way — otherwise a re-PUT
+        strands a permanently stale duplicate on the old pool."""
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        pools.put_object("b", "sticky", b"v1")
+        pools.pools[0].head_object("b", "sticky")
+        force_free(pools, [1, 10 ** 9])        # extreme skew to pool 1
+        assert pools.get_pool_idx("b", "sticky") == 0
+        pools.put_object("b", "sticky", b"v2")
+        with pytest.raises(ErrObjectNotFound):
+            pools.pools[1].head_object("b", "sticky")
+
+
 class TestMerge:
     def test_listing_merges_across_pools(self, pools):
         pools.make_bucket("b")
@@ -126,6 +164,54 @@ class TestMerge:
         assert "everywhere" in pools.list_buckets()
         pools.delete_bucket("everywhere")
         assert not pools.bucket_exists("everywhere")
+
+    def test_listing_pagination_resumes_across_pools(self, pools):
+        """Marker-paged listing walks the MERGED namespace in order:
+        a page boundary falling between two pools must not skip or
+        duplicate names."""
+        pools.make_bucket("b")
+        want = []
+        for i in range(10):
+            force_free(pools, [1000, 10] if i % 2 == 0 else [10, 1000])
+            name = f"o{i:02d}"
+            pools.put_object("b", name, b"x")
+            want.append(name)
+        got, marker = [], ""
+        while True:
+            page = pools.list_objects("b", marker=marker, max_keys=3)
+            if not page:
+                break
+            assert len(page) <= 3
+            got += [fi.name for fi in page]
+            marker = page[-1].name
+        assert got == sorted(want)
+
+    def test_list_multipart_uploads_merges_pools(self, pools):
+        pools.make_bucket("b")
+        force_free(pools, [1000, 10])
+        u0 = pools.new_multipart_upload("b", "mp-a")
+        force_free(pools, [10, 1000])
+        u1 = pools.new_multipart_upload("b", "mp-b")
+        assert u0.startswith("0.") and u1.startswith("1.")
+        rows = pools.list_multipart_uploads("b")
+        assert [(r["object"], r["upload_id"]) for r in rows] \
+            == [("mp-a", u0), ("mp-b", u1)]
+
+    def test_usage_sums_pools(self, pools):
+        force_free(pools, [100, 250])
+        du = pools.disk_usage()
+        assert du["total"] == 2 << 40
+        assert du["free"] == 350
+
+    def test_heal_bucket_aggregates_pools(self, pools, tmp_path):
+        pools.make_bucket("hb")
+        # lose the bucket dir on one drive in EACH pool
+        os.rmdir(str(tmp_path / "p0-1" / "hb"))
+        os.rmdir(str(tmp_path / "p1-2" / "hb"))
+        healed = pools.heal_bucket("hb")
+        assert set(healed) == {0, 1}
+        assert os.path.isdir(str(tmp_path / "p0-1" / "hb"))
+        assert os.path.isdir(str(tmp_path / "p1-2" / "hb"))
 
 
 class TestHeal:

@@ -222,15 +222,19 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
 def _http_clients_loop(endpoint: str, creds: tuple[str, str],
                        bucket: str, warm: list[str], body: bytes,
                        clients: int, put_frac: float,
-                       duration_s: float, seed: int) -> dict:
+                       duration_s: float, seed: int,
+                       tag_pools: bool = False) -> dict:
     """One load PROCESS: `clients` closed-loop threads, each with its
     own S3Client (own connections).  Returns picklable lat/byte tallies
-    so --procs can merge across forks."""
+    so --procs can merge across forks.  tag_pools reads the
+    x-mtpu-pool response header off every PUT (multi-pool placement
+    histogram — --during-decom's skew evidence)."""
     from minio_tpu.server.client import S3Client
     stop = threading.Event()
     lat_put: list[list[float]] = [[] for _ in range(clients)]
     lat_get: list[list[float]] = [[] for _ in range(clients)]
     nbytes = [0] * clients
+    pool_hits: list[dict[str, int]] = [dict() for _ in range(clients)]
     errors: list[str] = []
 
     def client(ci: int) -> None:
@@ -242,8 +246,13 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
                 is_put = crng.random() < put_frac
                 t0 = time.monotonic()
                 if is_put:
-                    cli.put_object(bucket, f"p{seed}-c{ci}-{j}", body)
+                    h = cli.put_object(bucket, f"p{seed}-c{ci}-{j}",
+                                       body)
                     j += 1
+                    if tag_pools:
+                        p = (h.get("x-mtpu-pool")
+                             or h.get("X-Mtpu-Pool") or "?")
+                        pool_hits[ci][p] = pool_hits[ci].get(p, 0) + 1
                 else:
                     name = warm[int(crng.integers(0, len(warm)))]
                     got = cli.get_object(bucket, name)
@@ -264,9 +273,14 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
     stop.set()
     for t in threads:
         t.join(60.0)
+    merged: dict[str, int] = {}
+    for per in pool_hits:
+        for p, n in per.items():
+            merged[p] = merged.get(p, 0) + n
     return {"lat_put": [x for per in lat_put for x in per],
             "lat_get": [x for per in lat_get for x in per],
-            "nbytes": sum(nbytes), "errors": errors}
+            "nbytes": sum(nbytes), "errors": errors,
+            "pool_hits": merged}
 
 
 def run_load_http(endpoint: str, *, clients: int = 4,
@@ -274,9 +288,13 @@ def run_load_http(endpoint: str, *, clients: int = 4,
                   duration_s: float = 5.0, bucket: str = "loadgen",
                   warm_objects: int = 8, seed: int = 0, procs: int = 1,
                   access_key: str = "minioadmin",
-                  secret_key: str = "minioadmin") -> dict:
+                  secret_key: str = "minioadmin",
+                  tag_pools: bool = False) -> dict:
     """HTTP closed loop against a running endpoint; with procs>1 the
-    `clients` are spread over that many forked client processes."""
+    `clients` are spread over that many forked client processes.
+    tag_pools adds a pool_hits histogram (PUTs per placement pool,
+    from the x-mtpu-pool response header) — run it against a server
+    mid-decommission and the draining pool must show zero hits."""
     import multiprocessing as mp
     from minio_tpu.server.client import S3Client
 
@@ -298,7 +316,7 @@ def run_load_http(endpoint: str, *, clients: int = 4,
     if procs == 1:
         parts = [_http_clients_loop(endpoint, creds, bucket, warm, body,
                                     clients, put_frac, duration_s,
-                                    seed)]
+                                    seed, tag_pools)]
     else:
         ctx = mp.get_context("fork")
         q: mp.Queue = ctx.Queue()
@@ -306,7 +324,7 @@ def run_load_http(endpoint: str, *, clients: int = 4,
         def entry(i: int, n: int) -> None:
             q.put(_http_clients_loop(endpoint, creds, bucket, warm,
                                      body, n, put_frac, duration_s,
-                                     seed + i))
+                                     seed + i, tag_pools))
 
         ps = [ctx.Process(target=entry, args=(i, n), daemon=True)
               for i, n in enumerate(per) if n]
@@ -322,7 +340,7 @@ def run_load_http(endpoint: str, *, clients: int = 4,
     puts = [x for part in parts for x in part["lat_put"]]
     gets = [x for part in parts for x in part["lat_get"]]
     alls = puts + gets
-    return {
+    res = {
         "endpoint": endpoint, "clients": clients, "procs": procs,
         "object_size": object_size,
         "ops": len(alls), "puts": len(puts), "gets": len(gets),
@@ -333,6 +351,13 @@ def run_load_http(endpoint: str, *, clients: int = 4,
         "put_p50_ms": round(_quantile(puts, 0.50) * 1e3, 3),
         "get_p50_ms": round(_quantile(gets, 0.50) * 1e3, 3),
     }
+    if tag_pools:
+        merged: dict[str, int] = {}
+        for part in parts:
+            for p, n in part.get("pool_hits", {}).items():
+                merged[p] = merged.get(p, 0) + n
+        res["pool_hits"] = dict(sorted(merged.items()))
+    return res
 
 
 def make_set(root: str, n: int = 4, parity: int | None = None):
@@ -391,7 +416,17 @@ def main(argv=None) -> int:
                     "ETag-digest-bound shape the multi-buffer MD5 "
                     "lanes exist for (dg_md5_* in the output show "
                     "lane occupancy and aggregate hash rate)")
+    ap.add_argument("--during-decom", action="store_true",
+                    help="HTTP mode: tag every PUT with the pool it "
+                    "landed on (x-mtpu-pool response header) and "
+                    "report a pool_hits placement-skew histogram — "
+                    "run it against a server mid-decommission to "
+                    "prove new writes avoid the draining pool")
     args = ap.parse_args(argv)
+    if args.during_decom and not args.endpoint:
+        print("--during-decom requires --endpoint (the x-mtpu-pool "
+              "header is an HTTP response surface)", file=sys.stderr)
+        return 2
     if args.profile == "put-digest":
         args.mix = 1.0
         if args.size_kib == 1024:          # only override the default
@@ -404,7 +439,8 @@ def main(argv=None) -> int:
                             duration_s=args.duration,
                             procs=args.procs,
                             access_key=args.access_key,
-                            secret_key=args.secret_key)
+                            secret_key=args.secret_key,
+                            tag_pools=args.during_decom)
     else:
         es = (make_sets(args.root, nsets=args.sets,
                         set_drives=args.drives, parity=args.parity)
