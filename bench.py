@@ -482,6 +482,95 @@ def decom_bench(n_objects: int = 48, object_kib: int = 256) -> dict:
     return out
 
 
+def obs_bench(n_get: int = 300, object_kib: int = 64) -> dict:
+    """Observability-plane overhead: the same healthy-GET loop against
+    one server with the full plane on (structured audit to a file
+    target + the last-minute SLO window) and one with it off.  Reports
+    both p50s and the delta pct — the plane's contract is <3% on the
+    hot path.  One /minio/v2/metrics/node render is timed on the
+    audited server afterwards (the scrape must stay copy-free), and
+    the audit sink must shed nothing during the run: a drop here means
+    the bench measured back-pressure, not the handler."""
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.engine.pools import ServerPools
+    from minio_tpu.engine.sets import ErasureSets
+    from minio_tpu.iam.iam import IAMSys
+    from minio_tpu.server.client import S3Client
+    from minio_tpu.server.server import S3Server
+    from minio_tpu.server.sigv4 import Credentials
+    from minio_tpu.storage.drive import LocalDrive
+
+    rng = np.random.default_rng(11)
+    body = rng.integers(0, 256, object_kib << 10,
+                        dtype=np.uint8).tobytes()
+
+    def boot(enabled: bool, root: str):
+        old = {k: os.environ.get(k) for k in ("MTPU_AUDIT", "MTPU_SLO")}
+        os.environ["MTPU_AUDIT"] = (f"file:{root}/audit.jsonl"
+                                    if enabled else "")
+        os.environ["MTPU_SLO"] = "1" if enabled else "0"
+        try:
+            drives = [LocalDrive(f"{root}/d{i}") for i in range(4)]
+            pools = ServerPools([ErasureSets(drives,
+                                             set_drive_count=4)])
+            srv = S3Server(pools, Credentials("bench", "bench-secret"),
+                           iam=IAMSys(pools)).start()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        cli = S3Client(srv.endpoint, "bench", "bench-secret")
+        cli.make_bucket("obs")
+        cli.put_object("obs", "o", body)
+        cli.get_object("obs", "o")              # warm
+        return srv, cli
+
+    out = {}
+    root = tempfile.mkdtemp(prefix="mtpu-obs-")
+    srvs = []
+    try:
+        srv_off, cli_off = boot(False, f"{root}/off")
+        srvs.append(srv_off)
+        srv_on, cli_on = boot(True, f"{root}/on")
+        srvs.append(srv_on)
+        # Interleave the two loops in small batches so page-cache
+        # state, GC pauses and host jitter hit both sides equally —
+        # at ~1.5 ms per GET a 50 us drift is 3% on its own.
+        lat_on: list[float] = []
+        lat_off: list[float] = []
+        batch = 10
+        for _ in range(max(1, n_get // batch)):
+            for lat, cli in ((lat_off, cli_off), (lat_on, cli_on)):
+                for _ in range(batch):
+                    t0 = time.perf_counter()
+                    cli.get_object("obs", "o")
+                    lat.append(time.perf_counter() - t0)
+        lat_on.sort()
+        lat_off.sort()
+        p50_on = lat_on[len(lat_on) // 2]
+        p50_off = lat_off[len(lat_off) // 2]
+        t0 = time.perf_counter()
+        cli_on.request("GET", "/minio/v2/metrics/node")
+        out["obs_scrape_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        out["obs_get_p50_off_ms"] = round(p50_off * 1e3, 3)
+        out["obs_get_p50_on_ms"] = round(p50_on * 1e3, 3)
+        out["obs_overhead_pct"] = round(
+            (p50_on - p50_off) / p50_off * 100, 2)
+        out["obs_audit_dropped_total"] = sum(
+            t.dropped for t in srv_on.audit_targets)
+    finally:
+        for s in srvs:
+            s.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def multichip_bench(duration_s: float = 2.5,
                     object_mib: int = 1) -> dict:
     """Device-sharding suite (PR 10, per-device coalescer lanes): the
@@ -1297,11 +1386,11 @@ def main() -> None:
              "import json, sys; sys.path.insert(0, sys.argv[1]); "
              "from bench import (e2e_bench, concurrent_bench, "
              "hedge_bench, digest_bench, workers_bench, "
-             "multichip_bench, decom_bench); "
+             "multichip_bench, decom_bench, obs_bench); "
              "r = e2e_bench(); r.update(concurrent_bench()); "
              "r.update(hedge_bench()); r.update(digest_bench()); "
              "r.update(workers_bench()); r.update(multichip_bench()); "
-             "r.update(decom_bench()); "
+             "r.update(decom_bench()); r.update(obs_bench()); "
              "print(json.dumps(r))", here],
             env=env, capture_output=True, text=True, timeout=900)
         if res.returncode != 0:
@@ -1375,7 +1464,8 @@ def main() -> None:
     for k, v in results.items():
         if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup",
                         "_ms_tmpfs", "_pct", "_pct_tmpfs", "_occupancy"))
-                or k.startswith(("tunnel_", "digest_", "mc_", "decom_"))
+                or k.startswith(("tunnel_", "digest_", "mc_", "decom_",
+                                 "obs_"))
                 or k == "host_cores"):
             extras.setdefault(k, v)
     if "put_stage_md5_ms_tmpfs" in extras:

@@ -179,6 +179,14 @@ class MRFQueue:
         with self._mu:
             return len(self._q)
 
+    def stats(self) -> dict:
+        """Backlog depth + lifetime counters — the healthinfo MRF row
+        (and already what /metrics exports per queue)."""
+        with self._mu:
+            return {"pending": len(self._q), "healed": self.healed,
+                    "dropped": self.dropped, "retries": self.retries,
+                    "replayed": self.replayed}
+
     def drain_once(self) -> int:
         """Try every due entry once; returns how many healed."""
         now = time.monotonic()

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import threading
 
+from .lastminute import ApiWindow
+
 
 class Counter:
     def __init__(self, name: str, help_: str, label_names=()):
@@ -812,7 +814,52 @@ class MetricsRegistry:
             "mtpu_decom_uploads_relocated_total",
             "Pending multipart uploads re-staged off the pool",
             ("pool",))
+        # Sliding last-minute SLO families (observe/lastminute.py):
+        # merged from the per-worker ring at scrape time.
+        self.api_lm_count = Gauge(
+            "mtpu_api_last_minute_count",
+            "Requests in the sliding SLO window by API", ("api",))
+        self.api_lm_errors = Gauge(
+            "mtpu_api_last_minute_errors",
+            "Error responses in the sliding SLO window by API",
+            ("api",))
+        self.api_lm_p50 = Gauge(
+            "mtpu_api_last_minute_p50",
+            "Sliding-window p50 latency in ms by API", ("api",))
+        self.api_lm_p99 = Gauge(
+            "mtpu_api_last_minute_p99",
+            "Sliding-window p99 latency in ms by API", ("api",))
+        # Audit-plane delivery families (observe/audit.py): per-target
+        # delivered/shed/retried entry counts.
+        self.audit_emitted = Gauge(
+            "mtpu_audit_emitted_total",
+            "Audit entries delivered to the sink", ("target",))
+        self.audit_dropped = Gauge(
+            "mtpu_audit_dropped_total",
+            "Audit entries shed (bounded queue full or sink dead "
+            "after retries)", ("target",))
+        self.audit_retries = Gauge(
+            "mtpu_audit_retries_total",
+            "Audit delivery re-attempts (webhook backoff)", ("target",))
         self.bandwidth = BandwidthMonitor()
+        self.last_minute = ApiWindow()
+
+    def observe_api(self, api: str, duration_s: float,
+                    error: bool = False, nbytes: int = 0) -> None:
+        """Feed the sliding SLO window — lock-free, called once per
+        request with the span-style API name (api.PutObject, ...)."""
+        self.last_minute.observe(api, duration_s, error, nbytes)
+
+    def update_audit(self, targets) -> None:
+        """Refresh per-target audit delivery gauges (scrape time)."""
+        for t in targets:
+            s = t.stats() if hasattr(t, "stats") else None
+            if s is None:
+                continue
+            name = s["target"]
+            self.audit_emitted.set(s["emitted"], target=name)
+            self.audit_dropped.set(s["dropped"], target=name)
+            self.audit_retries.set(s["retries"], target=name)
 
     def observe_request(self, api: str, status: int, duration_s: float,
                         rx: int, tx: int, bucket: str = "") -> None:
@@ -1007,55 +1054,80 @@ class MetricsRegistry:
                     self.trace_stage_hist.set(cum, api=api, stage=stage,
                                               le=le)
 
+    def _sync_last_minute(self) -> None:
+        for api, row in self.last_minute.snapshot().items():
+            self.api_lm_count.set(row["count"], api=api)
+            self.api_lm_errors.set(row["errors"], api=api)
+            self.api_lm_p50.set(row["p50_ms"], api=api)
+            self.api_lm_p99.set(row["p99_ms"], api=api)
+
+    def families(self) -> list:
+        """Every exported metric family, in definition order — the
+        enumerable registry the render loop and the boot self-test
+        (ops/selftest.metrics_registry_self_test) both walk, so a
+        family can never exist without being rendered and checked."""
+        return [m for m in self.__dict__.values()
+                if isinstance(m, (Counter, Histogram))]
+
     def render(self) -> str:
         self._sync_datapath()
         self._sync_spans()
+        self._sync_last_minute()
         out: list[str] = []
-        for m in (self.api_requests, self.api_errors, self.inflight,
-                  self.latency, self.bytes_rx, self.bytes_tx,
-                  self.bucket_usage, self.bucket_objects,
-                  self.heal_total, self.heal_bytes,
-                  self.heal_source_bytes, self.heal_stage_seconds,
-                  self.heal_batches, self.heal_batch_occupancy,
-                  self.degraded_reads, self.degraded_bytes,
-                  self.degraded_seconds, self.healthy_reads,
-                  self.healthy_bytes, self.healthy_stage_seconds,
-                  self.fastpath_fallbacks, self.mp_batches,
-                  self.mp_bytes, self.mp_stage_seconds,
-                  self.co_dispatches, self.co_items, self.co_blocks,
-                  self.co_occupancy, self.co_wait_seconds,
-                  self.co_batch_faults, self.co_member_retries,
-                  self.co_fallbacks, self.device_lane_dispatches,
-                  self.device_lane_occupancy,
-                  self.device_lane_queue_wait, self.ipc_submits,
-                  self.ipc_results, self.ipc_fallbacks,
-                  self.ipc_owner_deaths, self.hedged_reads,
-                  self.hedge_fired, self.hedge_spares, self.hedge_wins,
-                  self.dg_md5_calls, self.dg_md5_streams,
-                  self.dg_md5_bytes, self.dg_md5_occupancy,
-                  self.dg_sha_calls, self.dg_sha_bufs, self.dg_sha_bytes,
-                  self.drive_state, self.drive_transitions,
-                  self.mrf_pending, self.mrf_healed, self.mrf_dropped,
-                  self.mrf_retries, self.recovery_sweeps,
-                  self.recovery_tmp, self.recovery_mp_stage,
-                  self.mrf_replayed, self.drains, self.drain_leftover,
-                  self.drain_seconds, self.peer_state,
-                  self.peer_transitions, self.peer_last_seen,
-                  self.peer_rpc_timeout, self.peer_flaps,
-                  self.rpc_retries, self.rpc_deadline_exceeded,
-                  self.netchaos_injected,
-                  self.trace_api_count, self.trace_api_errors,
-                  self.trace_api_latency, self.trace_stage_ms,
-                  self.trace_stage_count, self.trace_stage_hist,
-                  self.drive_online,
-                  self.drive_offline, self.cache_hits, self.cache_misses,
-                  self.cache_evictions, self.cache_usage,
-                  self.cache_max, self.pool_total_bytes,
-                  self.pool_free_bytes, self.pool_draining,
-                  self.decom_state, self.decom_objects_moved,
-                  self.decom_objects_remaining,
-                  self.decom_versions_moved, self.decom_bytes_moved,
-                  self.decom_bytes_per_sec,
-                  self.decom_uploads_relocated):
+        for m in self.families():
             m.render(out)
         return "\n".join(out) + "\n"
+
+
+def label_sample(line: str, key: str, value: str) -> str:
+    """Inject one label into a Prometheus sample line
+    (`name{a="b"} v` or `name v`)."""
+    head, _, val = line.rpartition(" ")
+    if head.endswith("}"):
+        return f'{head[:-1]},{key}="{value}"}} {val}'
+    return f'{head}{{{key}="{value}"}} {val}'
+
+
+def merge_prom(sections: list[tuple[str, str]]) -> str:
+    """Merge per-node Prometheus renders into one valid exposition:
+    HELP/TYPE once per family (first seen wins), every sample line
+    relabeled with node="host:port", samples grouped under their
+    family.  Input sections are (node, text) pairs as produced by
+    S3Server.local_metrics_text on each node."""
+    meta: dict[str, list[str | None]] = {}    # family -> [help, type]
+    rows: dict[str, list[str]] = {}
+    order: list[str] = []
+    for node, text in sections:
+        current = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith(("# HELP ", "# TYPE ")):
+                fam = line.split(None, 3)[2]
+                if fam not in rows:
+                    rows[fam] = []
+                    meta[fam] = [None, None]
+                    order.append(fam)
+                slot = 0 if line.startswith("# HELP ") else 1
+                if meta[fam][slot] is None:
+                    meta[fam][slot] = line
+                current = fam
+                continue
+            if line.startswith("#"):
+                continue
+            if current is None:
+                # Bare sample with no preceding comment: group under
+                # its own metric name.
+                current = line.split("{", 1)[0].split()[0]
+                if current not in rows:
+                    rows[current] = []
+                    meta[current] = [None, None]
+                    order.append(current)
+            rows[current].append(label_sample(line, "node", node))
+    out: list[str] = []
+    for fam in order:
+        for comment in meta[fam]:
+            if comment is not None:
+                out.append(comment)
+        out.extend(rows[fam])
+    return "\n".join(out) + "\n"

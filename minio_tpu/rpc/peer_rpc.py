@@ -17,8 +17,9 @@ from concurrent.futures import ThreadPoolExecutor
 from .rest import DEFAULT_PLANE_VERSIONS, NetworkError, RPCClient, RPCServer
 
 #: Peer (control) plane wire version (cf. peerRESTVersion,
-#: cmd/peer-rest-common.go:21).
-PEER_RPC_VERSION = "v2"
+#: cmd/peer-rest-common.go:21).  v3: added the observability verbs
+#: (peer.metrics_text, peer.healthinfo) — bump-on-wire-change.
+PEER_RPC_VERSION = "v3"
 DEFAULT_PLANE_VERSIONS["peer"] = PEER_RPC_VERSION
 
 
@@ -81,6 +82,19 @@ def register_peer_rpc(server, registry: PeerRegistry) -> None:
                     lambda p: registry.profile_start())
     server.register("peer.profile_dump",
                     lambda p: {"text": registry.profile_dump()})
+
+
+def register_obs_rpc(server, s3_server) -> None:
+    """Observability verbs: whole-node metric/health snapshots the
+    admin aggregate endpoints fan out to (cf. the peer REST metrics
+    channel, cmd/peer-rest-server.go GetMetricsHandler + the HealthInfo
+    collection in cmd/admin-handlers.go).  Mounted separately from
+    register_peer_rpc because they need the S3Server back-reference —
+    only available after boot_cluster_node built it."""
+    server.register("peer.metrics_text",
+                    lambda p: {"text": s3_server.local_metrics_text()})
+    server.register("peer.healthinfo",
+                    lambda p: {"info": s3_server.local_healthinfo()})
 
 
 class NotificationSys:

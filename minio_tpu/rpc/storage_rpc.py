@@ -138,3 +138,32 @@ def _add_method(name: str):
 for _m in _DRIVE_METHODS:
     _add_method(_m)
 del _m
+
+
+#: How long a remote drive's capacity snapshot stays fresh.  Capacity
+#: moves slowly; the observability plane scrapes often — without this
+#: cache every /metrics render would pay one RPC per remote drive, and a
+#: blackholed peer would hang the scrape for its full timeout budget.
+_DISK_INFO_TTL_S = 5.0
+
+_disk_info_rpc = RemoteDrive.disk_info
+
+
+def _disk_info_cached(self):
+    import time
+    now = time.monotonic()
+    cached = getattr(self, "_di_cache", None)
+    if cached is not None and now - cached[1] < _DISK_INFO_TTL_S:
+        return cached[0]
+    try:
+        info = _disk_info_rpc(self)
+    except ErrDiskNotFound:
+        if cached is not None:
+            return cached[0]     # stale capacity beats a hung scrape
+        raise
+    self._di_cache = (info, now)
+    return info
+
+
+_disk_info_cached.__name__ = "disk_info"
+RemoteDrive.disk_info = _disk_info_cached

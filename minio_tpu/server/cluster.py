@@ -362,6 +362,10 @@ def boot_cluster_node(endpoint_args: list[str], my_host: str,
     # Admin-info and /metrics surface peer liveness through this back
     # reference (peers aren't reachable from the pools object).
     server.cluster_node = node
+    # Obs verbs need the server back-reference (they snapshot the whole
+    # node through it), so they mount here, not in ClusterNode.__init__.
+    from ..rpc.peer_rpc import register_obs_rpc
+    register_obs_rpc(node.router, server)
     try:
         drives = node.build_drives()
         fmt = node.wait_format(drives, timeout=timeout)

@@ -159,7 +159,15 @@ class S3Server:
             # never boot-fatal
             self._register_config_targets(notify)
         self._reload_replication()
-        self.audit_targets: list = []
+        # Structured audit plane (observe/audit.py): targets built from
+        # MTPU_AUDIT at boot.  A typo'd target spec raises and refuses
+        # to serve — a silent fallback would silently lose the trail.
+        from ..observe.audit import targets_from_env
+        self.audit_targets: list = targets_from_env()
+        # Sliding SLO window feed (observe/lastminute.py).  MTPU_SLO=0
+        # is the kill switch the <3% request-overhead guard compares
+        # against.
+        self.slo_enabled = _os.environ.get("MTPU_SLO", "1") != "0"
         self.scanner = scanner
         self.config = None                 # lazy ConfigSys (admin API)
         self.service_event = ""            # "" | "restart" | "stop"
@@ -268,6 +276,15 @@ class S3Server:
                         path, self.request_id)
                     resp.headers["Retry-After"] = "1"
                     self.close_connection = True
+                    # Drain bounces never reach _handle_inner's audit
+                    # point, but the trail must still show them.
+                    outer._emit_audit(
+                        api=_api_name(self.command, path, {},
+                                      self.headers),
+                        method=self.command, path=path, status=503,
+                        error_code="ServiceUnavailable",
+                        source_ip=self.client_address[0],
+                        request_id=self.request_id)
                     try:
                         self._respond(resp)
                     except (BrokenPipeError, ConnectionResetError,
@@ -335,11 +352,18 @@ class S3Server:
                 # the response write (a streamed GET does its engine
                 # reads inside _respond). NOOP unless someone is
                 # tracing (ring configured or live trace subscriber).
+                api_name = _api_name(self.command, path, query,
+                                     self.headers)
                 rspan = ospan.TRACER.root(
-                    _api_name(self.command, path, query, self.headers),
-                    method=self.command, path=path)
+                    api_name, method=self.command, path=path)
                 rspan.__enter__()
-                access_key = ""
+                # Audit identity/routing facts for THIS request.  Reset
+                # here because handler instances persist across
+                # keep-alive requests; _dispatch stamps them once auth
+                # succeeds and routing begins.
+                self.audit_access_key = ""
+                self.audit_dispatched = False
+                err_code = None
                 try:
                     if outer.handlers is None and \
                             not path.startswith("/minio/health/"):
@@ -352,6 +376,7 @@ class S3Server:
                     else:
                         resp = outer._dispatch(self, path, query)
                 except S3Error as e:
+                    err_code = e.api.code
                     resp = error_response(e, path, self.request_id)
                     # A failed request may leave unread body bytes on
                     # the socket (streaming PUTs); don't reuse it.
@@ -359,6 +384,7 @@ class S3Server:
                 except streams.StreamError as e:
                     # Malformed/truncated request body: 400-class, not
                     # a handler crash.
+                    err_code = "IncompleteBody"
                     resp = error_response(
                         S3Error("IncompleteBody", str(e)), path,
                         self.request_id)
@@ -367,6 +393,7 @@ class S3Server:
                     # Client stalled mid-body past the socket timeout:
                     # a clean RequestTimeout + connection close, not an
                     # unhandled socket.timeout traceback.
+                    err_code = "RequestTimeout"
                     resp = error_response(
                         S3Error("RequestTimeout",
                                 "client read timed out mid-request"),
@@ -374,11 +401,13 @@ class S3Server:
                     self.close_connection = True
                 except (BrokenPipeError, ConnectionResetError):
                     # Client went away mid-body: nothing to tell them.
+                    err_code = "ClientDisconnected"
                     resp = Response(499, b"")
                     self.close_connection = True
                 except Exception as e:  # noqa: BLE001
                     outer.log.error(f"handler crash: {e}",
                                     path=path, request_id=self.request_id)
+                    err_code = "InternalError"
                     resp = error_response(
                         S3Error("InternalError",
                                 f"{type(e).__name__}: {e}"),
@@ -407,7 +436,6 @@ class S3Server:
                     except Exception:  # noqa: BLE001
                         pass
                 dur = (_time.perf_counter() - t0)
-                api = f"{self.command} {path.split('/')[1] if '/' in path else ''}"
                 resp_size = (int(resp.headers.get("Content-Length", 0) or 0)
                              if resp.body_iter is not None
                              else len(resp.body or b""))
@@ -429,18 +457,10 @@ class S3Server:
                                                       0) or 0),
                     response_size=resp_size,
                     source_ip=self.client_address[0])
-                if outer.audit_targets:
-                    from ..observe.logger import audit_entry
-                    entry = audit_entry(
-                        method=self.command, path=path,
-                        status=resp.status, duration_ms=dur * 1e3,
-                        source_ip=self.client_address[0],
-                        request_id=self.request_id)
-                    for t in outer.audit_targets:
-                        try:
-                            t.send(entry)
-                        except Exception:  # noqa: BLE001
-                            continue
+                if outer.slo_enabled:
+                    outer.metrics.observe_api(api_name, dur,
+                                              error=resp.status >= 400,
+                                              nbytes=resp_size)
                 sb = ("" if path.startswith("/minio/")
                       else path.lstrip("/"))
                 rspan.tag(status=resp.status, bytes=resp_size,
@@ -455,7 +475,40 @@ class S3Server:
                         TimeoutError):
                     self.close_connection = True
                 finally:
+                    # Close the root span BEFORE building the audit
+                    # entry so its per-stage timings (flatten of the
+                    # child spans) cover the response write too.
                     rspan.__exit__(None, None, None)
+                    if outer.audit_targets:
+                        stages = None
+                        if rspan is not ospan.NOOP:
+                            try:
+                                stages = ospan.flatten(rspan.to_dict())
+                            except Exception:  # noqa: BLE001
+                                stages = None
+                        obj = (sb.split("/", 1)[1]
+                               if "/" in sb else "") or None
+                        if (not getattr(self, "audit_dispatched", False)
+                                or err_code == "IncompleteBody"):
+                            # Rejected before (or during) routing —
+                            # auth failure, malformed framing: the
+                            # object was never resolved, so the entry
+                            # carries a null object.
+                            obj = None
+                        outer._emit_audit(
+                            api=api_name, method=self.command,
+                            path=path, status=resp.status,
+                            error_code=err_code,
+                            bucket=sb.split("/", 1)[0] or None,
+                            object_name=obj,
+                            access_key=getattr(self,
+                                               "audit_access_key", ""),
+                            source_ip=self.client_address[0],
+                            request_id=self.request_id,
+                            rx=int(self.headers.get("Content-Length",
+                                                    0) or 0),
+                            tx=resp_size, duration_ms=dur * 1e3,
+                            stages=stages)
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
 
@@ -560,6 +613,13 @@ class S3Server:
         # healing for the life of the process.
         self._httpd.shutdown()
         self._httpd.server_close()
+        # Flush + stop the audit drain threads (file targets flush
+        # their tail; queued entries drain before the sentinel).
+        for t in self.audit_targets:
+            try:
+                t.close(timeout=2.0)
+            except Exception:  # noqa: BLE001
+                pass
 
     def drain(self, timeout: float | None = None) -> dict:
         """Graceful drain (the cmd/signals.go handleSignals role).
@@ -939,6 +999,10 @@ class S3Server:
         # ServerInfo below.
         "pool": "admin:Decommission",
         "site-replication": "admin:SiteReplicationInfo",
+        # Fleet observability (cf. PrometheusAdminAction /
+        # HealthInfoAdminAction, madmin-go).
+        "metrics": "admin:Prometheus",
+        "healthinfo": "admin:OBDInfo",
     }
 
     def _admin_authorize(self, access_key: str, sub: str,
@@ -1277,6 +1341,30 @@ class S3Server:
                 "deploymentId": self.pools.deployment_id,
                 "sets": detail["sets"],
             })
+        if sub == "metrics/cluster" and method == "GET":
+            # Fleet scrape (cmd/metrics-v2.go cluster collection over
+            # peer REST clients): render locally, fan the metrics_text
+            # verb to every peer under the deadline budget, and merge
+            # into one exposition where every sample carries a `node`
+            # label.  mtpu_node_up marks which peers answered — a dead
+            # peer is 0, never a hung scrape.
+            from ..observe.metrics import merge_prom
+            results, node_up = self._obs_fanout("metrics_text")
+            text = merge_prom(sorted(results.items()))
+            up = ["# HELP mtpu_node_up Node answered the cluster "
+                  "scrape within the deadline budget",
+                  "# TYPE mtpu_node_up gauge"]
+            up += [f'mtpu_node_up{{node="{n}"}} {v}'
+                   for n, v in sorted(node_up.items())]
+            text += "\n".join(up) + "\n"
+            return Response(200, text.encode(),
+                            {"Content-Type":
+                             "text/plain; version=0.0.4"})
+        if sub == "healthinfo" and method == "GET":
+            # Fleet health document (cmd/admin-handlers.go HealthInfo):
+            # same peer fan-out, JSON merge keyed by node endpoint.
+            results, node_up = self._obs_fanout("healthinfo")
+            return j({"nodes": results, "node_up": node_up})
         if sub == "datausage" and method == "GET":
             if self.scanner is None:
                 return j({"error": "scanner not running"}, 503)
@@ -1973,19 +2061,215 @@ class S3Server:
                             _json.dumps(detail).encode(),
                             {"Content-Type": "application/json"})
         if path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node"):
-            self.metrics.update_cluster(self.pools, self.scanner)
+            return Response(200, self.local_metrics_text().encode(),
+                            {"Content-Type": "text/plain; version=0.0.4"})
+        raise S3Error("MethodNotAllowed")
+
+    # -- observability plane (audit fan-out, node snapshots, fleet merge) ----
+
+    def _emit_audit(self, **kw) -> None:
+        """Build one structured audit entry and fan it to every
+        configured target.  Never blocks and never raises into the
+        request path: targets shed to their drop counters."""
+        if not self.audit_targets:
+            return
+        from ..observe.audit import build_entry
+        entry = build_entry(node=f"{self.host}:{self.port}",
+                            worker=self.worker_id, **kw)
+        for t in self.audit_targets:
+            try:
+                t.send(entry)
+            except Exception:  # noqa: BLE001 — a sink bug can't 500 a request
+                pass
+        if (self.worker_plane is not None
+                and self.worker_id is not None):
+            # Mirror this worker's shed count into the shared slab so
+            # the pool owner's scrape aggregates drops across workers.
+            self.worker_plane.state.set_audit_dropped(
+                self.worker_id,
+                sum(t.dropped for t in self.audit_targets))
+
+    def local_metrics_text(self) -> str:
+        """THIS node's full Prometheus render — the single-node body of
+        /minio/v2/metrics/node and the peer.metrics_text RPC verb the
+        cluster aggregate fans out to.  Scrape discipline: everything
+        here is a copy-free read of counters other planes already
+        maintain — no device state is touched, no dispatcher lock is
+        taken (the coalescer/digest numbers come from DATA_PATH's
+        monotonic tallies, not from live lane introspection)."""
+        from ..rpc import rest as _rest
+
+        # Belt and braces for the "never block" contract: remote-drive
+        # capacity reads are cached (storage_rpc._DISK_INFO_TTL_S), but a
+        # COLD cache against a blackholed peer would still pay one RPC
+        # timeout per drive.  A short ambient deadline turns that worst
+        # case into a bounded sub-second fail-fast.
+        left = _rest.deadline_remaining()
+        tok = _rest.set_deadline(1.0 if left is None else min(1.0, left))
+        try:
+            if self.pools is not None:
+                self.metrics.update_cluster(self.pools, self.scanner)
             if self.cluster_node is not None:
                 self.metrics.update_peers(
                     self.cluster_node.peer_clients.values())
-            text = self.metrics.render()
-            if self.worker_plane is not None:
-                # Pool aggregates live in shared slabs, so WHICHEVER
-                # worker the kernel picked exports the same pool-wide
-                # view (worker liveness, arena, rings, owner).
-                text += self.worker_plane.render_prom()
-            return Response(200, text.encode(),
-                            {"Content-Type": "text/plain; version=0.0.4"})
-        raise S3Error("MethodNotAllowed")
+        finally:
+            _rest.clear_deadline(tok)
+        self.metrics.update_audit(self.audit_targets)
+        text = self.metrics.render()
+        if self.worker_plane is not None:
+            # Pool aggregates live in shared slabs, so WHICHEVER
+            # worker the kernel picked exports the same pool-wide
+            # view (worker liveness, arena, rings, owner).
+            text += self.worker_plane.render_prom()
+        return text
+
+    def local_healthinfo(self) -> dict:
+        """One node's health document (the cmd/admin-handlers.go
+        HealthInfo role): drive/breaker states, peer liveness,
+        pool/decom status, MRF backlog, device-lane depths,
+        digest/coalescer occupancy, drain state, worker slab, audit
+        sink health — all composed from state other planes already
+        maintain, msgpack/JSON-safe for the peer fan-out."""
+        import time as _time
+
+        from ..observe.metrics import DATA_PATH
+        drives: list[dict] = []
+        pool_rows: list = []
+        mrf_rows: list[dict] = []
+        if self.pools is not None:
+            seen_mrf: set[int] = set()
+            for pi, pool in enumerate(self.pools.pools):
+                sets = getattr(pool, "sets", None) or [pool]
+                for si, es in enumerate(sets):
+                    for di, d in enumerate(getattr(es, "drives", [])):
+                        if d is None:
+                            state = "offline"
+                        elif hasattr(d, "health_state"):
+                            state = d.health_state()
+                        elif (hasattr(d, "is_online")
+                                and not d.is_online()):
+                            state = "offline"
+                        else:
+                            state = "ok"
+                        drives.append({"pool": pi, "set": si,
+                                       "drive": di, "state": state})
+                    mrf = getattr(es, "mrf", None)
+                    if (mrf is not None and id(mrf) not in seen_mrf
+                            and hasattr(mrf, "stats")):
+                        seen_mrf.add(id(mrf))
+                        mrf_rows.append({"pool": pi, "set": si,
+                                         **mrf.stats()})
+            if hasattr(self.pools, "pool_status"):
+                from ..rpc import rest as _rest
+                left = _rest.deadline_remaining()
+                tok = _rest.set_deadline(
+                    1.0 if left is None else min(1.0, left))
+                try:
+                    pool_rows = self.pools.pool_status()
+                except Exception:  # noqa: BLE001 — status is best-effort
+                    pool_rows = []
+                finally:
+                    _rest.clear_deadline(tok)
+        lanes: dict = {}
+        try:
+            from ..ops import coalesce as _co
+            lanes = {str(k): v
+                     for k, v in _co.get().lane_stats().items()}
+        except Exception:  # noqa: BLE001 — lanes are best-effort
+            lanes = {}
+        snap = DATA_PATH.snapshot()
+        digest = {k: snap[k] for k in snap
+                  if k.startswith("dg_") and not isinstance(snap[k],
+                                                            dict)}
+        coalescer = {k: snap[k] for k in snap
+                     if k.startswith("co_") and not isinstance(snap[k],
+                                                               dict)}
+        peers = (self.cluster_node.peer_info()
+                 if self.cluster_node is not None else [])
+        workers = (self.worker_plane.workers_info()
+                   if self.worker_plane is not None else None)
+        return {
+            "endpoint": f"{self.host}:{self.port}",
+            "time": round(_time.time(), 3),
+            "draining": bool(self.draining),
+            "inflight": int(self._inflight),
+            "drives": drives,
+            "pools": pool_rows,
+            "mrf": mrf_rows,
+            "peers": peers,
+            "device_lanes": lanes,
+            "digest": digest,
+            "coalescer": coalescer,
+            "workers": workers,
+            "audit": [t.stats() for t in self.audit_targets],
+            "slo": (self.metrics.last_minute.snapshot()
+                    if self.slo_enabled else {}),
+        }
+
+    def _obs_fanout(self, verb: str) -> tuple[dict, dict]:
+        """Run one obs RPC verb (peer.metrics_text / peer.healthinfo)
+        against every peer under a single wall-clock budget
+        (MTPU_OBS_DEADLINE_MS).  Breaker-aware: an offline peer is
+        node_up 0 immediately (no dial); a hung one costs at most the
+        remaining budget — the aggregate NEVER hangs the scrape.
+        Returns ({node: payload}, {node: 0|1}), this node included."""
+        import time as _time
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..rpc import rest as _rest
+        me = f"{self.host}:{self.port}"
+        local = (self.local_metrics_text() if verb == "metrics_text"
+                 else self.local_healthinfo())
+        results: dict = {me: local}
+        node_up: dict = {me: 1}
+        node = self.cluster_node
+        if node is None or not node.peer_clients:
+            return results, node_up
+        try:
+            budget_s = float(_os.environ.get("MTPU_OBS_DEADLINE_MS",
+                                             "8000") or 8000) / 1e3
+        except ValueError:
+            budget_s = 8.0
+        deadline = _time.monotonic() + budget_s
+        key = "text" if verb == "metrics_text" else "info"
+
+        def one(cli):
+            if not cli.is_online():
+                return None          # breaker open: fast-fail, no dial
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                return None
+            # Arm the RPC deadline contextvar in THIS worker thread so
+            # rest.py clamps the hop's timeout to the remaining budget.
+            tok = _rest.set_deadline(left)
+            try:
+                out = cli.call(f"peer.{verb}", {}, idempotent=True)
+                return out.get(key) if isinstance(out, dict) else None
+            except Exception:  # noqa: BLE001 — dead peer == node_up 0
+                return None
+            finally:
+                _rest.clear_deadline(tok)
+
+        peers = [(f"{h}:{p}", cli)
+                 for (h, p), cli in node.peer_clients.items()]
+        # No context manager: shutdown(wait=False) below — waiting for
+        # a hung future would defeat the deadline budget.
+        ex = ThreadPoolExecutor(max_workers=len(peers),
+                                thread_name_prefix="obs-fanout")
+        futs = [(name, ex.submit(one, cli)) for name, cli in peers]
+        for name, fut in futs:
+            try:
+                out = fut.result(
+                    timeout=max(0.0, deadline - _time.monotonic()))
+            except Exception:  # noqa: BLE001 — budget exhausted
+                out = None
+            if out is None:
+                node_up[name] = 0
+            else:
+                node_up[name] = 1
+                results[name] = out
+        ex.shutdown(wait=False)
+        return results, node_up
 
     def _dispatch(self, req, path: str, query: dict) -> Response:
         if self._stream_eligible(req.command, path, query):
@@ -1993,6 +2277,11 @@ class S3Server:
                                                             query)
         else:
             body, access_key = self._authenticate(req, path, query)
+        # Auth succeeded and routing begins: stamp the audit identity.
+        # A request that raised before this point audits with a null
+        # object and an empty accessKey (rejected pre-dispatch).
+        req.audit_access_key = access_key
+        req.audit_dispatched = True
         h = self.handlers
         method = req.command
         # Internal replication marker: only principals allowed to

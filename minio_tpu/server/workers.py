@@ -66,7 +66,7 @@ _WSLOTS = 8                      # per-worker slab stride
 #         4 nworkers, 5 owner_co_dispatches, 6 owner_co_items,
 #         7 owner_co_pending, 8 owner_co_weight, 9 topology_gen
 # worker: 0 pid, 1 beat_ns, 2 ready, 3 draining, 4 respawns,
-#         5 requests_total, 6 inflight, 7 reserved
+#         5 requests_total, 6 inflight, 7 audit_dropped
 
 
 def nworkers_env() -> int:
@@ -201,6 +201,12 @@ class SharedState:
     def note_request(self, idx: int) -> None:
         self._a[self._w(idx) + 5] += 1
 
+    def set_audit_dropped(self, idx: int, n: int) -> None:
+        """This worker's cumulative audit-entry shed count (the writer
+        is the worker itself — single-writer discipline like the rest
+        of the slab)."""
+        self._a[self._w(idx) + 7] = int(n)
+
     def worker_rows(self) -> list[dict]:
         stale = int(_stale_s() * 1e9)
         now = _now_ns()
@@ -217,6 +223,7 @@ class SharedState:
                 "respawns": int(self._a[w + 4]),
                 "requests": int(self._a[w + 5]),
                 "inflight": int(self._a[w + 6]),
+                "audit_dropped": int(self._a[w + 7]),
             })
         return rows
 
@@ -290,6 +297,10 @@ class WorkerPlane:
         fam("mtpu_worker_inflight_requests",
             "Requests currently inflight in this worker",
             [({"worker": r["worker"]}, r["inflight"]) for r in rows])
+        fam("mtpu_worker_audit_dropped_total",
+            "Audit entries shed by this worker's targets",
+            [({"worker": r["worker"]}, r["audit_dropped"])
+             for r in rows])
         oi = self.state.owner_info()
         fam("mtpu_owner_up", "Device-owner heartbeat is fresh",
             [({}, int(oi["up"]))])

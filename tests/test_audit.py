@@ -1,0 +1,386 @@
+"""Audit log target tests: async sink units + HTTP-level delivery.
+
+The internal/logger audit-plane contract: one structured JSON entry per
+S3 request — acked AND rejected (auth failure, drain 503, malformed
+chunked framing) — delivered through bounded async targets that shed
+under pressure instead of stalling the data plane, and an overhead
+guard proving audit+SLO on costs <3% on the healthy-GET p50.
+"""
+
+import datetime
+import http.server
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.observe.audit import (AuditTarget, FileAuditTarget,
+                                     WebhookAuditTarget, build_entry,
+                                     targets_from_env)
+from minio_tpu.server.client import S3Client
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import (Credentials, encode_streaming_body,
+                                    sign_request)
+from minio_tpu.storage.drive import LocalDrive
+
+ACCESS, SECRET = "auditadmin", "auditadmin-secret"
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def wait_for(pred, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# Target units
+# ---------------------------------------------------------------------------
+
+class TestTargets:
+    def test_file_target_delivers_jsonl(self, tmp_path):
+        path = str(tmp_path / "audit.jsonl")
+        tgt = FileAuditTarget(path, queue_size=64)
+        entries = [build_entry(api=f"api.Op{i}", method="GET",
+                               path=f"/b/o{i}", status=200)
+                   for i in range(5)]
+        for e in entries:
+            tgt.send(e)
+        tgt.close()
+        lines = [json.loads(line) for line in open(path)]
+        assert [e["api"]["name"] for e in lines] == \
+            [f"api.Op{i}" for i in range(5)]
+        assert tgt.emitted == 5 and tgt.dropped == 0
+        s = tgt.stats()
+        assert s["kind"] == "file" and s["queued"] == 0
+
+    def test_queue_full_sheds_never_blocks(self):
+        """A stalled sink backs up into the bounded queue, which sheds
+        (counted) — the sender never blocks."""
+        release = threading.Event()
+        delivered = []
+
+        class Stalled(AuditTarget):
+            kind = "stalled"
+
+            def _deliver(self, entry):
+                release.wait(10.0)
+                delivered.append(entry)
+                return True
+
+        tgt = Stalled("stall", queue_size=4)
+        tgt.send({"n": 0})                     # drain thread takes this
+        assert wait_for(lambda: len(tgt._q) == 0)
+        for i in range(1, 5):                  # fill the queue
+            tgt.send({"n": i})
+        t0 = time.perf_counter()
+        for i in range(5, 8):                  # overflow: shed, fast
+            tgt.send({"n": i})
+        assert time.perf_counter() - t0 < 0.1
+        assert tgt.dropped == 3
+        release.set()
+        tgt.close()
+        assert tgt.emitted == 5 and len(delivered) == 5
+
+    def test_webhook_retries_then_drops(self, tmp_path):
+        hits = []
+
+        class Refuse(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers["Content-Length"]))
+                hits.append(self.path)
+                self.send_response(500)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Refuse)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/sink"
+        tgt = WebhookAuditTarget(url, queue_size=8, timeout=1.0)
+        tgt.BACKOFF_BASE_S = 0.01              # keep the test quick
+        try:
+            tgt.send(build_entry(api="api.X", method="GET", path="/",
+                                 status=200))
+            assert wait_for(lambda: tgt.dropped == 1, timeout=10.0)
+            assert len(hits) == tgt.MAX_TRIES
+            assert tgt.retries == tgt.MAX_TRIES - 1
+            assert tgt.emitted == 0
+        finally:
+            tgt.close()
+            httpd.shutdown()
+
+    def test_webhook_delivers_on_2xx(self):
+        hits = []
+
+        class Accept(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers["Content-Length"]))
+                hits.append(json.loads(body))
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Accept)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/sink"
+        tgt = WebhookAuditTarget(url, queue_size=8, timeout=2.0)
+        try:
+            tgt.send(build_entry(api="api.PutObject", method="PUT",
+                                 path="/b/o", status=200, bucket="b",
+                                 object_name="o"))
+            assert wait_for(lambda: tgt.emitted == 1)
+            assert hits[0]["api"]["name"] == "api.PutObject"
+            assert tgt.dropped == 0 and tgt.retries == 0
+        finally:
+            tgt.close()
+            httpd.shutdown()
+
+    def test_targets_from_env_parsing(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "a.jsonl")
+        ts = targets_from_env(f"file:{p},webhook:http://127.0.0.1:9/x,"
+                              f"http://127.0.0.1:9/y")
+        try:
+            assert [t.kind for t in ts] == ["file", "webhook", "webhook"]
+        finally:
+            for t in ts:
+                t.close(timeout=1.0)
+        assert targets_from_env("") == []
+        assert targets_from_env("0") == []
+        monkeypatch.delenv("MTPU_AUDIT", raising=False)
+        assert targets_from_env() == []
+        with pytest.raises(ValueError):
+            targets_from_env("syslog:localhost")
+
+    def test_build_entry_shape(self):
+        e = build_entry(api="api.GetObject", method="GET", path="/b/o",
+                        status=206, error_code=None, bucket="b",
+                        object_name="o", access_key="ak",
+                        source_ip="10.0.0.1", request_id="rid",
+                        rx=11, tx=22, duration_ms=3.14159,
+                        stages={"read": 1.23456}, node="n:1", worker=2)
+        assert e["version"] == "2"
+        # ISO-8601 UTC, millisecond precision.
+        datetime.datetime.fromisoformat(e["time"])
+        assert e["api"] == {"name": "api.GetObject", "method": "GET",
+                            "statusCode": 206, "errorCode": None,
+                            "rx": 11, "tx": 22,
+                            "timeToResponseMs": 3.142}
+        assert e["bucket"] == "b" and e["object"] == "o"
+        assert e["stages"] == {"read": 1.235}
+        assert e["node"] == "n:1" and e["worker"] == 2
+        # No stages key when none were measured.
+        assert "stages" not in build_entry(api="a", method="GET",
+                                           path="/", status=200)
+
+
+# ---------------------------------------------------------------------------
+# HTTP-level delivery: every acked AND rejected request leaves a trail
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def audited(tmp_path, monkeypatch):
+    path = str(tmp_path / "audit.jsonl")
+    monkeypatch.setenv("MTPU_AUDIT", f"file:{path}")
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+    srv = S3Server(pools, Credentials(ACCESS, SECRET)).start()
+    cli = S3Client(srv.endpoint, ACCESS, SECRET)
+    yield srv, cli, path
+    srv.shutdown()
+
+
+def entries_for(srv, path, pred, n=1, timeout=5.0):
+    """Flush-tolerant read: the drain thread delivers on its own
+    clock, so poll the file until pred matches n entries."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            out = [e for e in (json.loads(line) for line in open(path))
+                   if pred(e)]
+        except (OSError, ValueError):
+            out = []
+        if len(out) >= n:
+            return out
+        time.sleep(0.02)
+    return out
+
+
+class TestHTTPAudit:
+    def test_acked_put_get_entries(self, audited):
+        srv, cli, path = audited
+        cli.make_bucket("bkt")
+        body = payload(4096, seed=3)
+        cli.put_object("bkt", "obj", body)
+        assert cli.get_object("bkt", "obj") == body
+        puts = entries_for(srv, path,
+                           lambda e: e["api"]["name"] == "api.PutObject")
+        gets = entries_for(srv, path,
+                           lambda e: e["api"]["name"] == "api.GetObject")
+        assert puts and gets
+        p, g = puts[0], gets[0]
+        assert p["bucket"] == "bkt" and p["object"] == "obj"
+        assert p["accessKey"] == ACCESS
+        assert p["api"]["statusCode"] == 200
+        assert p["api"]["errorCode"] is None
+        assert p["api"]["rx"] == 4096
+        assert p["api"]["timeToResponseMs"] > 0
+        assert p["node"] == f"{srv.host}:{srv.port}"
+        assert p["requestID"]
+        assert g["api"]["tx"] == 4096
+        assert g["object"] == "obj"
+
+    def test_auth_failure_entry(self, audited):
+        srv, cli, path = audited
+        cli.make_bucket("bkt")
+        bad = S3Client(srv.endpoint, ACCESS, "wrong-secret")
+        st, _, _ = bad.request("GET", "/bkt/secret-obj")
+        assert st == 403
+        es = entries_for(srv, path,
+                         lambda e: e["api"]["statusCode"] == 403)
+        assert es
+        e = es[0]
+        assert e["api"]["errorCode"] == "SignatureDoesNotMatch"
+        # Rejected pre-dispatch: no object touched, no identity proven.
+        assert e["object"] is None
+        assert e["accessKey"] == ""
+        assert e["remoteHost"]
+
+    def test_drain_503_entry(self, audited):
+        srv, cli, path = audited
+        srv.draining = True
+        try:
+            st, _, _ = cli.request("GET", "/bkt/o")
+            assert st == 503
+        finally:
+            srv.draining = False
+        es = entries_for(srv, path,
+                         lambda e: e["api"]["statusCode"] == 503)
+        assert es
+        e = es[0]
+        assert e["api"]["errorCode"] == "ServiceUnavailable"
+        assert e["object"] is None
+        assert e["requestID"]
+
+    def test_malformed_chunked_entry(self, audited):
+        srv, cli, path = audited
+        cli.make_bucket("bkt")
+        creds = cli.creds
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        scope = f"{amz_date[:8]}/{creds.region}/s3/aws4_request"
+        headers = {"Host": f"{srv.host}:{srv.port}"}
+        auth = sign_request(creds, "PUT", "/bkt/stream", {}, headers,
+                            payload="STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+                            now=now)
+        headers.update(auth)
+        seed_sig = auth["Authorization"].rpartition("Signature=")[2]
+        good = encode_streaming_body(creds, scope, amz_date, seed_sig,
+                                     payload(65536, seed=4))
+        # Truncate mid-chunk: framing dies before the payload does.
+        st, _, _ = cli.request("PUT", "/bkt/stream",
+                               body=good[:len(good) // 2],
+                               headers=headers, raw_query="")
+        assert st >= 400
+        es = entries_for(srv, path,
+                         lambda e: e["api"]["name"] == "api.PutObject"
+                         and e["api"]["statusCode"] >= 400)
+        assert es
+        e = es[0]
+        assert e["api"]["errorCode"] == "IncompleteBody"
+        # The body never landed — the trail must not claim an object.
+        assert e["object"] is None
+
+    def test_worker_slab_exports_drops(self, audited, monkeypatch):
+        """The per-worker audit_dropped slab slot mirrors target drops
+        (deliberate queue-full injection — the only sanctioned path to
+        a nonzero drop counter)."""
+        srv, cli, path = audited
+        tgt = srv.audit_targets[0]
+        monkeypatch.setattr(tgt, "maxsize", 0)   # every send sheds
+        cli.make_bucket("bkt")
+        assert wait_for(lambda: tgt.dropped > 0)
+        st, _, text = cli.request("GET", "/minio/v2/metrics/node")
+        assert st == 200
+        assert "mtpu_audit_dropped_total" in text.decode()
+
+
+# ---------------------------------------------------------------------------
+# Overhead guard (mirrors the PR 3 tracer guard)
+# ---------------------------------------------------------------------------
+
+class TestObsOverhead:
+    def test_healthy_get_p50_overhead_under_3pct(self, tmp_path,
+                                                 monkeypatch):
+        """Audit (file target) + SLO window ON must cost <3% on the
+        healthy-GET p50 vs both planes OFF.  min-of-N timing with
+        whole-measurement retries rides out CI noise."""
+        def boot(tag, enabled):
+            if enabled:
+                monkeypatch.setenv(
+                    "MTPU_AUDIT", f"file:{tmp_path}/{tag}.jsonl")
+                monkeypatch.setenv("MTPU_SLO", "1")
+            else:
+                monkeypatch.setenv("MTPU_AUDIT", "")
+                monkeypatch.setenv("MTPU_SLO", "0")
+            drives = [LocalDrive(str(tmp_path / f"{tag}-d{i}"))
+                      for i in range(4)]
+            pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+            srv = S3Server(pools, Credentials(ACCESS, SECRET)).start()
+            cli = S3Client(srv.endpoint, ACCESS, SECRET)
+            cli.make_bucket("bkt")
+            cli.put_object("bkt", "o", payload(1 << 16, seed=5))
+            for _ in range(5):
+                cli.get_object("bkt", "o")               # warm
+            return srv, cli
+
+        srv_on, cli_on = boot("on", True)
+        srv_off, cli_off = boot("off", False)
+        try:
+            def measure(rounds=8, batch=10):
+                # Interleave on/off batches so host-wide drift (GC,
+                # CPU frequency, noisy neighbours) cancels instead of
+                # landing entirely on one side.
+                on = off = float("inf")
+                for _ in range(rounds):
+                    for cli in (cli_on, cli_off):
+                        best = float("inf")
+                        for _ in range(batch):
+                            t0 = time.perf_counter()
+                            cli.get_object("bkt", "o")
+                            best = min(best, time.perf_counter() - t0)
+                        if cli is cli_on:
+                            on = min(on, best)
+                        else:
+                            off = min(off, best)
+                return on * 1e3, off * 1e3
+
+            for attempt in range(3):
+                with_obs, baseline = measure()
+                if with_obs <= baseline * 1.03:
+                    break
+            assert with_obs <= baseline * 1.03, \
+                f"audit+SLO on {with_obs:.3f}ms vs off {baseline:.3f}ms"
+            # The run must have shed nothing: drops would mean the
+            # guard measured back-pressure, not the hot path.
+            assert sum(t.dropped for t in srv_on.audit_targets) == 0
+        finally:
+            srv_on.shutdown()
+            srv_off.shutdown()

@@ -1,0 +1,151 @@
+"""Fleet observability under faults and load.
+
+1. A 3-node proxied cluster with one peer black-holed: the cluster
+   metrics aggregate and healthinfo merge must return within the
+   deadline budget with the dead node reported node_up 0 — and ONLY
+   the dead node; the live peers' families arrive complete.
+2. Scrape-under-load guard: /minio/v2/metrics/node stays fast (<50 ms)
+   with 16 clients hammering the data path — the render is copy-free
+   reads, never a dispatcher lock or a device call.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.server.client import S3Client
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+from minio_tpu.storage.drive import LocalDrive
+
+ACCESS, SECRET = "clusterobs", "clusterobs-secret"
+
+
+def node_up_rows(text: str) -> dict[str, int]:
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("mtpu_node_up{"):
+            node = line.split('node="')[1].split('"')[0]
+            out[node] = int(float(line.rsplit(" ", 1)[1]))
+    return out
+
+
+class TestClusterAggregation:
+    @pytest.mark.netchaos
+    def test_blackhole_peer_within_budget(self, tmp_path, monkeypatch):
+        from minio_tpu.tools.net_matrix import boot_proxied_cluster
+
+        monkeypatch.setenv("MTPU_OBS_DEADLINE_MS", "8000")
+        nc = boot_proxied_cluster(str(tmp_path), n_nodes=3,
+                                  drives_per_node=2, seed=7)
+        try:
+            cli = S3Client(f"http://127.0.0.1:{nc.ports[0]}",
+                           "minioadmin", "minioadmin")
+            # Healthy baseline: all three nodes up, one label per node.
+            st, _, body = cli.request(
+                "GET", "/minio/admin/v3/metrics/cluster")
+            assert st == 200
+            up = node_up_rows(body.decode())
+            assert len(up) == 3 and all(v == 1 for v in up.values())
+
+            nc.isolate_node(2, "blackhole")
+            dead = f"127.0.0.1:{nc.ports[2]}"
+            live_peer = f"127.0.0.1:{nc.ports[1]}"
+            t0 = time.monotonic()
+            st, _, body = cli.request(
+                "GET", "/minio/admin/v3/metrics/cluster")
+            elapsed = time.monotonic() - t0
+            assert st == 200
+            # Within the fan-out budget: the dead peer costs bounded
+            # retries, never a hung scrape.
+            assert elapsed < 9.0, f"aggregate took {elapsed:.1f}s"
+            text = body.decode()
+            up = node_up_rows(text)
+            assert up[dead] == 0
+            # ONLY the isolated node is down — the live peer's own
+            # scrape must not block on the dead node's drives.
+            assert up[live_peer] == 1
+            assert sum(v == 0 for v in up.values()) == 1
+            # Live families arrive complete, node-labelled.
+            assert f'mtpu_cluster_drives_online{{node="{live_peer}"}}' \
+                in text
+
+            # healthinfo merges through the same fan-out.
+            t0 = time.monotonic()
+            st, _, body = cli.request("GET",
+                                      "/minio/admin/v3/healthinfo")
+            assert time.monotonic() - t0 < 9.0
+            assert st == 200
+            hi = json.loads(body)
+            assert hi["node_up"][dead] == 0
+            assert dead not in hi["nodes"]
+            assert set(hi["nodes"]) == {f"127.0.0.1:{nc.ports[0]}",
+                                        live_peer}
+            doc = hi["nodes"][live_peer]
+            assert {"drives", "peers", "workers", "audit",
+                    "inflight"} <= set(doc)
+        finally:
+            nc.close()
+
+
+class TestScrapeUnderLoad:
+    def test_metrics_scrape_fast_with_16_clients(self, tmp_path):
+        drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+        pools = ServerPools([ErasureSets(drives, set_drive_count=4)])
+        srv = S3Server(pools, Credentials(ACCESS, SECRET)).start()
+        stop = threading.Event()
+        errors: list[str] = []
+        try:
+            boot = S3Client(srv.endpoint, ACCESS, SECRET)
+            boot.make_bucket("load")
+            body = np.random.default_rng(0).integers(
+                0, 256, 1 << 14, dtype=np.uint8).tobytes()
+            boot.put_object("load", "warm", body)
+
+            def hammer(ci):
+                cli = S3Client(srv.endpoint, ACCESS, SECRET)
+                i = 0
+                while not stop.is_set():
+                    try:
+                        if i % 3 == 0:
+                            cli.put_object("load", f"o{ci}-{i % 8}",
+                                           body)
+                        else:
+                            cli.get_object("load", "warm")
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"{type(e).__name__}: {e}")
+                        return
+                    i += 1
+
+            threads = [threading.Thread(target=hammer, args=(ci,),
+                                        daemon=True)
+                       for ci in range(16)]
+            for t in threads:
+                t.start()
+            time.sleep(0.5)                       # load is flowing
+            scraper = S3Client(srv.endpoint, ACCESS, SECRET)
+            best = float("inf")
+            for _ in range(10):
+                t0 = time.perf_counter()
+                st, _, text = scraper.request(
+                    "GET", "/minio/v2/metrics/node")
+                best = min(best, time.perf_counter() - t0)
+                assert st == 200
+            stop.set()
+            for t in threads:
+                t.join(10.0)
+            assert not errors, errors[0]
+            # Copy-free render: even under 16-client load the scrape
+            # must never queue behind the data plane.
+            assert best < 0.050, f"scrape best-of-10 {best * 1e3:.1f}ms"
+            txt = text.decode()
+            assert "mtpu_s3_requests_total" in txt
+            assert "mtpu_api_last_minute_p99" in txt
+        finally:
+            stop.set()
+            srv.shutdown()

@@ -431,3 +431,140 @@ class TestAdminBreadthR4:
             assert st == 200 and not json.loads(body)["enabled"]
         finally:
             srv2.shutdown()
+
+
+class TestLastMinute:
+    """Sliding-window SLO tracker units (observe/lastminute.py) with an
+    injected clock — no sleeps, fully deterministic."""
+
+    def test_window_slides(self):
+        from minio_tpu.observe.lastminute import ApiWindow
+        now = [1000.0]
+        w = ApiWindow(window_s=60, clock=lambda: now[0])
+        for _ in range(10):
+            w.observe("api.GetObject", 0.002)
+        snap = w.snapshot()["api.GetObject"]
+        assert snap["count"] == 10 and snap["errors"] == 0
+        now[0] += 30
+        w.observe("api.GetObject", 0.002, error=True)
+        snap = w.snapshot()["api.GetObject"]
+        assert snap["count"] == 11 and snap["errors"] == 1
+        now[0] += 45                    # first burst ages out
+        snap = w.snapshot()["api.GetObject"]
+        assert snap["count"] == 1 and snap["errors"] == 1
+        now[0] += 120                   # everything ages out
+        # The row survives at zero (so exported gauges fall to 0
+        # instead of freezing at their last value).
+        snap = w.snapshot()["api.GetObject"]
+        assert snap["count"] == 0 and snap["errors"] == 0
+
+    def test_percentiles_from_buckets(self):
+        from minio_tpu.observe.lastminute import ApiWindow
+        now = [0.0]
+        w = ApiWindow(window_s=60, clock=lambda: now[0])
+        for _ in range(95):
+            w.observe("api.X", 0.001)          # ~1 ms
+        for _ in range(5):
+            w.observe("api.X", 0.400)          # ~400 ms tail
+        snap = w.snapshot()["api.X"]
+        assert snap["p50_ms"] <= 2.5
+        assert snap["p99_ms"] >= 250
+        assert snap["count"] == 100
+
+    def test_bytes_and_avg(self):
+        from minio_tpu.observe.lastminute import ApiWindow
+        now = [0.0]
+        w = ApiWindow(window_s=60, clock=lambda: now[0])
+        w.observe("api.PutObject", 0.010, nbytes=1000)
+        w.observe("api.PutObject", 0.030, nbytes=3000)
+        snap = w.snapshot()["api.PutObject"]
+        assert snap["bytes"] == 4000
+        assert 15 <= snap["avg_ms"] <= 25
+
+    def test_registry_exports_window(self):
+        m = MetricsRegistry()
+        m.observe_api("api.GetObject", 0.005)
+        m.observe_api("api.GetObject", 0.005, error=True)
+        text = m.render()
+        assert 'mtpu_api_last_minute_count{api="api.GetObject"} 2' \
+            in text
+        assert 'mtpu_api_last_minute_errors{api="api.GetObject"} 1' \
+            in text
+        assert 'mtpu_api_last_minute_p99{api="api.GetObject"}' in text
+
+
+class TestPromMerge:
+    """merge_prom / label_sample units — the cluster-aggregate text
+    merge (cmd/metrics-v2.go peer merge role)."""
+
+    def test_label_sample(self):
+        from minio_tpu.observe.metrics import label_sample
+        assert label_sample("mtpu_x 1", "node", "n:1") == \
+            'mtpu_x{node="n:1"} 1'
+        assert label_sample('mtpu_x{api="GET"} 2', "node", "n:1") == \
+            'mtpu_x{api="GET",node="n:1"} 2'
+
+    def test_merge_adds_node_label_and_dedups_meta(self):
+        from minio_tpu.observe.metrics import merge_prom
+        a = ("# HELP mtpu_up help\n# TYPE mtpu_up gauge\n"
+             "mtpu_up 1\n")
+        b = ("# HELP mtpu_up help\n# TYPE mtpu_up gauge\n"
+             "mtpu_up 0\n")
+        text = merge_prom([("n1", a), ("n2", b)])
+        assert text.count("# HELP mtpu_up") == 1
+        assert 'mtpu_up{node="n1"} 1' in text
+        assert 'mtpu_up{node="n2"} 0' in text
+
+
+class TestMetricsSelfTest:
+    def test_registry_self_test_passes(self):
+        """Every exported family is helped, namespaced, and documented
+        in the README — the boot-time drift guard must hold on HEAD."""
+        from minio_tpu.ops.selftest import metrics_registry_self_test
+        metrics_registry_self_test()
+
+    def test_startup_self_tests_include_registry(self):
+        from minio_tpu.ops import selftest
+        import inspect
+        src = inspect.getsource(selftest.run_startup_self_tests)
+        assert "metrics_registry_self_test" in src
+
+
+class TestAdminObsEndpoints:
+    """Cluster metrics + healthinfo on a standalone server: the
+    fan-out degenerates to the local node."""
+
+    def test_metrics_cluster_single_node(self, stack):
+        srv, cli, _ = stack
+        cli.make_bucket("obsc")
+        cli.put_object("obsc", "o", b"x" * 512)
+        st, _, body = cli.request("GET",
+                                  "/minio/admin/v3/metrics/cluster")
+        assert st == 200
+        text = body.decode()
+        me = f"{srv.host}:{srv.port}"
+        assert f'mtpu_node_up{{node="{me}"}} 1' in text
+        assert f'node="{me}"' in text
+        assert "mtpu_s3_requests_total" in text
+
+    def test_healthinfo_single_node(self, stack):
+        srv, cli, _ = stack
+        st, _, body = cli.request("GET", "/minio/admin/v3/healthinfo")
+        assert st == 200
+        hi = json.loads(body)
+        me = f"{srv.host}:{srv.port}"
+        assert hi["node_up"] == {me: 1}
+        doc = hi["nodes"][me]
+        assert len(doc["drives"]) == 4
+        assert all(d["state"] == "ok" for d in doc["drives"])
+        assert doc["draining"] is False
+        assert doc["pools"] and doc["pools"][0]["total"] > 0
+
+    def test_obs_admin_requires_auth(self, stack):
+        srv, cli, _ = stack
+        bad = S3Client(srv.endpoint, ROOT, "not-the-secret")
+        st, _, _ = bad.request("GET",
+                               "/minio/admin/v3/metrics/cluster")
+        assert st == 403
+        st, _, _ = bad.request("GET", "/minio/admin/v3/healthinfo")
+        assert st == 403

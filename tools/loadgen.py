@@ -360,6 +360,31 @@ def run_load_http(endpoint: str, *, clients: int = 4,
     return res
 
 
+def slo_report(endpoint: str, access_key: str, secret_key: str) -> dict:
+    """Scrape the server's last-minute SLO window after a run: the
+    mtpu_api_last_minute_{count,errors,p50,p99} families from
+    /minio/v2/metrics/node, keyed by API.  Client-side latencies above
+    measure the wire; this is the server's own view of the same window
+    — the two disagreeing is itself a finding (queueing outside the
+    handler).  Empty when the server runs with MTPU_SLO=0."""
+    import re
+    from minio_tpu.server.client import S3Client
+
+    cli = S3Client(endpoint, access_key, secret_key)
+    st, _, body = cli.request("GET", "/minio/v2/metrics/node")
+    if st != 200:
+        return {}
+    out: dict[str, dict[str, float]] = {}
+    pat = re.compile(r'^mtpu_api_last_minute_(\w+)\{api="([^"]+)"\} '
+                     r'([0-9.eE+-]+)$')
+    for line in body.decode().splitlines():
+        m = pat.match(line)
+        if m:
+            out.setdefault(m.group(2), {})[m.group(1)] = \
+                float(m.group(3))
+    return out
+
+
 def make_set(root: str, n: int = 4, parity: int | None = None):
     from minio_tpu.engine.erasure_set import ErasureSet
     drives = [LocalDrive(os.path.join(root, f"d{i}")) for i in range(n)]
@@ -454,6 +479,23 @@ def main(argv=None) -> int:
     w = max(len(k) for k in res)
     for k, v in res.items():
         print(f"{k:<{w}}  {v}")
+    if args.endpoint:
+        try:
+            slo = slo_report(args.endpoint, args.access_key,
+                             args.secret_key)
+        except Exception as e:  # noqa: BLE001 — report is best-effort
+            print(f"\n(slo report unavailable: {e})", file=sys.stderr)
+            slo = {}
+        if slo:
+            print("\nserver last-minute SLO window "
+                  "(mtpu_api_last_minute_*):")
+            print(f"{'api':<24}{'count':>8}{'errors':>8}"
+                  f"{'p50_ms':>10}{'p99_ms':>10}")
+            for api, d in sorted(slo.items()):
+                print(f"{api:<24}{int(d.get('count', 0)):>8}"
+                      f"{int(d.get('errors', 0)):>8}"
+                      f"{d.get('p50', 0.0):>10.1f}"
+                      f"{d.get('p99', 0.0):>10.1f}")
     return 0
 
 

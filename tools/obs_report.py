@@ -1,0 +1,171 @@
+"""Fleet observability report: cluster metrics + healthinfo as tables.
+
+One signed scrape of the two admin aggregates this repo's observability
+plane exposes — `/minio/admin/v3/metrics/cluster` (merged Prometheus
+text, every sample labelled with its node) and
+`/minio/admin/v3/healthinfo` (per-node health document) — rendered as
+terminal tables: node liveness, per-node request/error counts, the
+last-minute SLO window per API, drive/breaker states, MRF backlog and
+audit sink health.
+
+    python tools/obs_report.py --endpoint http://127.0.0.1:9000 \
+        --access-key minioadmin --secret-key minioadmin
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from minio_tpu.server.client import S3Client  # noqa: E402
+
+
+def parse_prom(text: str) -> list[tuple[str, dict, float]]:
+    """Flatten a Prometheus exposition into (family, labels, value)
+    rows — enough structure for a terminal report, not a TSDB."""
+    rows = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?$", head)
+        if not m:
+            continue
+        labels = {}
+        if m.group(2):
+            for lm in re.finditer(r'(\w+)="((?:[^"\\]|\\.)*)"',
+                                  m.group(2)):
+                labels[lm.group(1)] = lm.group(2)
+        try:
+            rows.append((m.group(1), labels, float(val)))
+        except ValueError:
+            continue
+    return rows
+
+
+def table(title: str, headers: list[str],
+          rows: list[list], out=sys.stdout) -> None:
+    cells = [[str(c) for c in r] for r in rows]
+    widths = [max([len(h)] + [len(r[i]) for r in cells])
+              for i, h in enumerate(headers)]
+    out.write(f"\n== {title} ==\n")
+    out.write("  ".join(h.ljust(w) for h, w in zip(headers, widths))
+              + "\n")
+    out.write("  ".join("-" * w for w in widths) + "\n")
+    for r in cells:
+        out.write("  ".join(c.ljust(w) for c, w in zip(r, widths))
+                  + "\n")
+
+
+def fam_by_node(rows, fam: str, pick=None) -> dict[str, float]:
+    """Sum a family's samples per node label (optionally filtered)."""
+    out: dict[str, float] = {}
+    for name, labels, v in rows:
+        if name != fam:
+            continue
+        if pick is not None and not pick(labels):
+            continue
+        node = labels.get("node", "?")
+        out[node] = out.get(node, 0.0) + v
+    return out
+
+
+def report(endpoint: str, access_key: str, secret_key: str,
+           out=sys.stdout) -> int:
+    cli = S3Client(endpoint, access_key, secret_key)
+    st, _, body = cli.request("GET", "/minio/admin/v3/metrics/cluster")
+    if st != 200:
+        out.write(f"metrics/cluster -> HTTP {st}\n")
+        return 1
+    rows = parse_prom(body.decode())
+    st, _, body = cli.request("GET", "/minio/admin/v3/healthinfo")
+    if st != 200:
+        out.write(f"healthinfo -> HTTP {st}\n")
+        return 1
+    hi = json.loads(body)
+
+    # -- fleet liveness ------------------------------------------------------
+    up = {labels.get("node", "?"): v for name, labels, v in rows
+          if name == "mtpu_node_up"}
+    reqs = fam_by_node(rows, "mtpu_s3_requests_total")
+    errs = fam_by_node(rows, "mtpu_s3_errors_total")
+    drops = fam_by_node(rows, "mtpu_audit_dropped_total")
+    table("fleet", ["node", "up", "requests", "errors",
+                    "audit_dropped"],
+          [[n, int(up.get(n, 0)), int(reqs.get(n, 0)),
+            int(errs.get(n, 0)), int(drops.get(n, 0))]
+           for n in sorted(up)], out)
+
+    # -- last-minute SLO window (merged across nodes) ------------------------
+    slo: dict[str, dict[str, float]] = {}
+    for name, labels, v in rows:
+        if not name.startswith("mtpu_api_last_minute_"):
+            continue
+        key = name[len("mtpu_api_last_minute_"):]
+        api = labels.get("api", "?")
+        d = slo.setdefault(api, {})
+        if key in ("count", "errors"):
+            d[key] = d.get(key, 0.0) + v
+        else:                        # p50/p99: worst node wins
+            d[key] = max(d.get(key, 0.0), v)
+    table("last-minute SLO (per API, fleet)",
+          ["api", "count", "errors", "p50_ms", "p99_ms"],
+          [[api, int(d.get("count", 0)), int(d.get("errors", 0)),
+            d.get("p50", 0.0), d.get("p99", 0.0)]
+           for api, d in sorted(slo.items()) if d.get("count")], out)
+
+    # -- per-node health -----------------------------------------------------
+    health_rows = []
+    for node in sorted(hi.get("nodes", {})):
+        doc = hi["nodes"][node]
+        drives = doc.get("drives", [])
+        bad = sum(1 for d in drives if d.get("state") != "ok")
+        mrf = sum(r.get("pending", 0) for r in doc.get("mrf", []))
+        audit = doc.get("audit", [])
+        a_drop = sum(a.get("dropped", 0) for a in audit)
+        health_rows.append([
+            node, "drain" if doc.get("draining") else "serving",
+            doc.get("inflight", 0), f"{len(drives) - bad}/{len(drives)}",
+            mrf, len(audit), a_drop])
+    for node, v in sorted(hi.get("node_up", {}).items()):
+        if not v:
+            health_rows.append([node, "DOWN", "-", "-", "-", "-", "-"])
+    table("health", ["node", "state", "inflight", "drives_ok",
+                     "mrf_pending", "audit_targets", "audit_dropped"],
+          health_rows, out)
+
+    # -- drive detail for anything not ok ------------------------------------
+    bad_rows = []
+    for node in sorted(hi.get("nodes", {})):
+        for d in hi["nodes"][node].get("drives", []):
+            if d.get("state") != "ok":
+                bad_rows.append([node, d.get("pool"), d.get("set"),
+                                 d.get("drive"), d.get("state")])
+    if bad_rows:
+        table("degraded drives", ["node", "pool", "set", "drive",
+                                  "state"], bad_rows, out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--endpoint", required=True,
+                    help="http(s)://host:port of any cluster node")
+    ap.add_argument("--access-key",
+                    default=os.environ.get("MTPU_ROOT_USER",
+                                           "minioadmin"))
+    ap.add_argument("--secret-key",
+                    default=os.environ.get("MTPU_ROOT_PASSWORD",
+                                           "minioadmin"))
+    args = ap.parse_args(argv)
+    return report(args.endpoint, args.access_key, args.secret_key)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
