@@ -422,6 +422,146 @@ def workers_bench(duration_s: float = 3.0, object_mib: int = 1,
     return out
 
 
+def hotcache_bench(duration_s: float = 3.0, object_kib: int = 1024,
+                   clients: int = 8, nworkers: int = 2) -> dict:
+    """Hot-object-tier suite (engine/hotcache.py): a Zipf(1.1)
+    GET-dominated mix (5% PUTs, 20% ranged GETs) over 64 warm keys.
+
+    Leg 1 — engine, cache on vs the MTPU_HOTCACHE=0 oracle: hot-key
+    p50/p99 and aggregate GB/s, plus the tier's own hit ratio.  The
+    PUTs matter: every one bumps the bucket generation and flushes the
+    whole cached bucket, so the reported ratio already prices the
+    invalidation storm in.
+
+    Leg 2 — the pool: one server at MTPU_WORKERS=2 sharing ONE
+    pre-fork segment, same mix over HTTP, cache on vs off, with the
+    per-worker hit/miss split scraped from the
+    mtpu_worker_hotcache_* families — both workers hitting proves one
+    worker's fill serves the other."""
+    import os
+    import re
+    import shutil
+    import socket as _socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from tools.loadgen import make_set, run_load, run_load_http
+
+    out: dict = {}
+    size = object_kib << 10
+    mix = dict(clients=clients, object_size=size, put_frac=0.05,
+               duration_s=duration_s, warm_objects=64, seed=7,
+               zipf=1.1, range_frac=0.2)
+
+    # -- leg 1: engine, tier on vs oracle -----------------------------------
+    from minio_tpu.engine.hotcache import HotObjectCache, attach_sets
+    for label, cached in (("off", False), ("on", True)):
+        root = tempfile.mkdtemp(prefix=f"mtpu-hc-{label}-")
+        try:
+            es = make_set(root, n=4)
+            if cached:
+                attach_sets(es, HotObjectCache(total_bytes=256 << 20))
+            r = run_load(es, **mix)
+            out[f"hc_{label}_gbps"] = r["gbps"]
+            out[f"hc_{label}_hot_p50_ms"] = r["hot_p50_ms"]
+            out[f"hc_{label}_hot_p99_ms"] = r["hot_p99_ms"]
+            out[f"hc_{label}_cold_p50_ms"] = r["cold_p50_ms"]
+            out[f"hc_{label}_ranged_p50_ms"] = r["ranged_p50_ms"]
+            if cached:
+                out["hc_hit_ratio"] = r.get("hotcache_hit_ratio", 0.0)
+                out["hc_fills"] = r.get("hotcache_fills", 0)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    if out.get("hc_on_hot_p50_ms"):
+        out["hc_hot_p50_speedup"] = round(
+            out["hc_off_hot_p50_ms"] / out["hc_on_hot_p50_ms"], 2)
+        out["hc_hot_p99_speedup"] = round(
+            out["hc_off_hot_p99_ms"] / out["hc_on_hot_p99_ms"], 2)
+        out["hc_gbps_speedup"] = round(
+            out["hc_on_gbps"] / out["hc_off_gbps"], 2)
+
+    # -- leg 2: MTPU_WORKERS=2 pool sharing one segment ---------------------
+    here = os.path.dirname(os.path.abspath(__file__))
+    for label, hc in (("pool_off", "0"), ("pool_on", "1")):
+        root = tempfile.mkdtemp(prefix=f"mtpu-hc-{label}-")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MTPU_SCANNER"] = "0"
+        env["MTPU_WORKERS"] = str(nworkers)
+        env["MTPU_HOTCACHE"] = hc
+        # Size the segment to hold the whole warm set: the default
+        # 64 MiB against 64 x 1 MiB keys would churn CLOCK eviction on
+        # every fill and measure the thrash, not the tier.
+        env["MTPU_HOTCACHE_MB"] = "256"
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--drives", f"{root}/d{{1...4}}", "--port", str(port)],
+            env=env, cwd=here, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT)
+        try:
+            deadline = time.monotonic() + 180
+            up = False
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    break
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}"
+                            "/minio/health/ready", timeout=2) as r:
+                        if r.status == 200:
+                            up = True
+                            break
+                except Exception:  # noqa: BLE001 — keep polling
+                    pass
+                time.sleep(0.2)
+            if not up:
+                raise RuntimeError(f"hotcache_bench {label} never ready")
+            r = run_load_http(f"http://127.0.0.1:{port}", procs=2,
+                              **mix)
+            out[f"hc_{label}_gbps"] = r["gbps"]
+            out[f"hc_{label}_hot_p50_ms"] = r["hot_p50_ms"]
+            out[f"hc_{label}_hot_p99_ms"] = r["hot_p99_ms"]
+            if hc == "1":
+                # Per-worker hit/miss over the ONE shared segment —
+                # every worker hitting proves cross-worker fills.
+                from minio_tpu.server.client import S3Client
+                cli = S3Client(f"http://127.0.0.1:{port}",
+                               "minioadmin", "minioadmin")
+                st, _, body = cli.request(
+                    "GET", "/minio/v2/metrics/node")
+                text = body.decode() if st == 200 else ""
+                for kind in ("hits", "misses"):
+                    for w, v in re.findall(
+                            rf'mtpu_worker_hotcache_{kind}_total'
+                            rf'{{worker="(\d+)"}} (\d+)', text):
+                        out[f"hc_worker{w}_{kind}"] = int(v)
+                for w in range(nworkers):
+                    h = out.get(f"hc_worker{w}_hits", 0)
+                    m = out.get(f"hc_worker{w}_misses", 0)
+                    out[f"hc_worker{w}_hit_ratio"] = (
+                        round(h / (h + m), 4) if h + m else 0.0)
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+            shutil.rmtree(root, ignore_errors=True)
+    if out.get("hc_pool_on_hot_p50_ms") and out.get("hc_pool_off_hot_p50_ms"):
+        out["hc_pool_hot_p50_speedup"] = round(
+            out["hc_pool_off_hot_p50_ms"] / out["hc_pool_on_hot_p50_ms"],
+            2)
+        out["hc_pool_gbps_speedup"] = round(
+            out["hc_pool_on_gbps"] / out["hc_pool_off_gbps"], 2)
+    return out
+
+
 def decom_bench(n_objects: int = 48, object_kib: int = 256) -> dict:
     """Live-decommission suite (background/decom.py): a 2-pool engine,
     pool 0 loaded then drained through the normal write path.  Reports
@@ -1465,7 +1605,7 @@ def main() -> None:
         if (k.endswith(("_gbps", "_error", "_mbps", "_ms", "_speedup",
                         "_ms_tmpfs", "_pct", "_pct_tmpfs", "_occupancy"))
                 or k.startswith(("tunnel_", "digest_", "mc_", "decom_",
-                                 "obs_"))
+                                 "obs_", "hc_"))
                 or k == "host_cores"):
             extras.setdefault(k, v)
     if "put_stage_md5_ms_tmpfs" in extras:
@@ -1521,8 +1661,22 @@ def _multichip_main() -> None:
         raise SystemExit(1)
 
 
+def _hotcache_main() -> None:
+    """`python bench.py hotcache_bench` — hot-tier suite alone, JSON to
+    stdout and HOTCACHE_r14.json for the record."""
+    import os
+    r = hotcache_bench()
+    doc = json.dumps(r, indent=2, sort_keys=True)
+    print(doc)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "HOTCACHE_r14.json"), "w") as f:
+        f.write(doc + "\n")
+
+
 if __name__ == "__main__":
     if sys.argv[1:2] == ["multichip_bench"]:
         _multichip_main()
+    elif sys.argv[1:2] == ["hotcache_bench"]:
+        _hotcache_main()
     else:
         main()

@@ -223,6 +223,12 @@ class ErasureSet:
         # exactly like the bucket-existence cache above.
         self._fi_cache: dict[tuple, tuple] = {}
         self._fi_gen: dict[str, int] = {}
+        # Optional RAM hot-object tier (engine/hotcache.py): attached
+        # by attach_pools/attach_sets only when every drive is local.
+        # Invalidation piggybacks on _mark_dirty — same generation
+        # discipline as the FileInfo cache, but in shared memory so a
+        # pool sibling's PUT invalidates this process's hits too.
+        self.hot_tier = None
         # Hedged-read state: the hedge delay adapts like a lock deadline
         # (log_timeout when the timer fires, log_success when the
         # slowest needed shard beat it), and per-drive-position read
@@ -246,6 +252,8 @@ class ErasureSet:
             self._dirty_tracker.mark(bucket)
         self._fi_gen[bucket] = self._fi_gen.get(bucket, 0) + 1
         self.metacache.bump(bucket)
+        if self.hot_tier is not None:
+            self.hot_tier.note_mutation(bucket)
 
     # -- codec helpers -------------------------------------------------------
 
@@ -430,6 +438,9 @@ class ErasureSet:
         err = Q.reduce_write_quorum_errs(errs, self.n // 2 + 1)
         if err is not None:
             raise err
+        # Recreating the bucket must not resurrect pre-delete cache
+        # entries (FileInfo cache or hot tier).
+        self._mark_dirty(bucket)
 
     def list_buckets(self) -> list[str]:
         res = self._map_drives(lambda d: d.list_volumes())
@@ -1234,14 +1245,38 @@ class ErasureSet:
         """Read [offset, offset+length) of an object, verifying bitrot and
         reconstructing up to `parity` missing/corrupt shards.
 
-        Segment reads assemble straight into ONE preallocated bytearray
-        (each `_read_part` gathers into its slice of the final buffer),
-        so the object is never joined through an extra full-size copy;
-        the return is that memoryview-backed bytearray (bytes-compatible
-        for hashing/slicing/IO).
+        With a hot tier attached, the read is served from the shared
+        RAM cache when fresh, and cold reads of cacheable objects run
+        single-flight: one leader does the engine read (and fills the
+        cache if every segment passed the full-k fast-path verify),
+        concurrent followers slice the leader's result.
 
         cf. GetObjectNInfo → getObjectWithFileInfo,
         /root/reference/cmd/erasure-object.go:221.
+        """
+        if self.hot_tier is not None and self.hot_tier.enabled:
+            got = self._get_object_hot(bucket, obj, offset, length,
+                                       version_id)
+            if got is not None:
+                return got
+        return self._get_object_direct(bucket, obj, offset, length,
+                                       version_id)
+
+    def _get_object_direct(self, bucket: str, obj: str, offset: int = 0,
+                           length: int = -1, version_id: str = "",
+                           report: dict | None = None
+                           ) -> tuple[FileInfo, bytes]:
+        """The uncached engine read: segment reads assemble straight
+        into ONE preallocated bytearray (each `_read_part` gathers into
+        its slice of the final buffer), so the object is never joined
+        through an extra full-size copy; the return is that
+        memoryview-backed bytearray (bytes-compatible for
+        hashing/slicing/IO).
+
+        `report` (hot-tier fill eligibility) collects per-read
+        evidence: segs = segment count, fast = segments served by the
+        full-k verify-only fast path, taint = any decode/reconstruct
+        involvement.  Fill requires fast == segs and no taint.
         """
         fi, metas, offset, length = self._plan_read(bucket, obj, offset,
                                                     length, version_id)
@@ -1268,6 +1303,10 @@ class ErasureSet:
             o += ln
         degraded = (any(d is None for d in self.drives)
                     or any(m is None for m in metas))
+        if report is not None:
+            report["segs"] = len(segs)
+            if degraded:
+                report["taint"] = True
 
         def read_seg(i):
             pn, off, ln = segs[i]
@@ -1275,7 +1314,7 @@ class ErasureSet:
                 self._read_part(bucket, obj, fi, part_number=pn,
                                 offset=off, length=ln,
                                 dst=mv[offs[i]:offs[i] + ln],
-                                healthy=not degraded)
+                                healthy=not degraded, report=report)
         if self._serial_local() and not degraded:
             for i in range(len(segs)):
                 read_seg(i)
@@ -1285,6 +1324,86 @@ class ErasureSet:
                                      self._iter_pool, depth=1):
                 pass
         return fi, buf
+
+    # -- hot tier ------------------------------------------------------------
+
+    @staticmethod
+    def _hot_range(fi, body, offset: int, length: int):
+        """Slice a cached/leader whole-object body with _plan_read's
+        exact range-validation semantics, so a cache hit raises the
+        same errors a direct read would."""
+        size = fi.size
+        if offset < 0 or offset > size:
+            raise StorageError(
+                f"offset {offset} outside object of size {size}")
+        if length < 0:
+            length = size - offset
+        if offset + length > size:
+            raise StorageError(f"range [{offset}, {offset + length}) "
+                               f"outside object of size {size}")
+        if offset == 0 and length == len(body):
+            return body
+        return body[offset:offset + length]
+
+    def _hot_cacheable(self, fi) -> bool:
+        """Only healthy streaming-layout objects within the size gate
+        enter the cache: inline/v1 small objects are already a single
+        cheap read, and zero-byte bodies carry no payload to cache."""
+        from ..storage import xlmeta_v1
+        if fi.deleted or fi.size <= 0 \
+                or fi.size > self.hot_tier.max_obj:
+            return False
+        if fi.inline_data is not None or (fi.parts and not fi.data_dir):
+            return False
+        return not xlmeta_v1.is_v1(fi)
+
+    def _get_object_hot(self, bucket: str, obj: str, offset: int,
+                        length: int, version_id: str,
+                        skip_lookup: bool = False):
+        """Hot-tier GET: cache hit, else single-flight engine read with
+        a verified fill.  Returns (fi, body) or None — None means
+        \"bypass: caller must do the direct read\"."""
+        tier = self.hot_tier
+        if not skip_lookup:
+            got = tier.lookup(bucket, obj, version_id)
+            if got is not None:
+                fi, body = got
+                return fi, self._hot_range(fi, body, offset, length)
+        key = (id(self), bucket, obj, version_id)
+        flight, leader = tier.flights.begin(key)
+        if not leader:
+            res = flight.wait()
+            if res is None:
+                return None         # leader failed/bypassed: go direct
+            fi, body = res
+            return fi, self._hot_range(fi, body, offset, length)
+        ok = False
+        try:
+            # Capture the bucket generation BEFORE the read: a write
+            # landing mid-read bumps it and the fill is discarded.
+            gen0 = tier.generation(bucket)
+            fi, metas, _, _ = self._plan_read(bucket, obj, 0, -1,
+                                              version_id)
+            if not self._hot_cacheable(fi):
+                tier.note_bypass()
+                return None
+            report: dict = {}
+            fi, data = self._get_object_direct(bucket, obj, 0, -1,
+                                               version_id,
+                                               report=report)
+            body = bytes(data)
+            if report.get("segs") and not report.get("taint") \
+                    and report.get("fast", 0) == report["segs"]:
+                tier.fill(bucket, obj, version_id, fi, body, gen0)
+            else:
+                tier.note_bypass()
+            flight.resolve((fi, body))
+            ok = True
+            return fi, self._hot_range(fi, body, offset, length)
+        finally:
+            if not ok:
+                flight.resolve(None)
+            tier.flights.end(key)
 
     def _plan_read(self, bucket, obj, offset, length, version_id):
         """Shared GET front half: cached metadata election + range
@@ -1361,6 +1480,33 @@ class ErasureSet:
         chunks), each chunk one device batch (<= BATCH_BLOCKS blocks) of
         verified+decoded data — memory is O(batch), never O(object)
         (the GetObjectReader role, cmd/object-api-utils.go:392-528)."""
+        if self.hot_tier is not None and self.hot_tier.enabled:
+            tier = self.hot_tier
+            got = tier.lookup(bucket, obj, version_id)
+            if got is not None:
+                hfi, body = got
+                chunk = self._hot_range(hfi, memoryview(body), offset,
+                                        length)
+                return hfi, (iter(()) if len(chunk) == 0
+                             else iter((chunk,)))
+            # Cold cacheable object: delegate to the single-flight
+            # whole-read (fills the cache; O(max_obj) memory is the
+            # admission bound, so streaming degrades to nothing).
+            # skip_lookup — the miss was already counted above.
+            try:
+                peek, _, _, _ = self._plan_read(bucket, obj, 0, -1,
+                                                version_id)
+            except StorageError:
+                peek = None
+            if peek is not None and self._hot_cacheable(peek):
+                got = self._get_object_hot(bucket, obj, offset, length,
+                                           version_id, skip_lookup=True)
+                if got is not None:
+                    hfi, body = got
+                    return hfi, (iter(()) if len(body) == 0
+                                 else iter((body,)))
+            elif peek is not None:
+                tier.note_bypass()
         fi, metas, offset, length = self._plan_read(bucket, obj, offset,
                                                     length, version_id)
         if length == 0:
@@ -1555,7 +1701,7 @@ class ErasureSet:
         return self._decode_shard_files(shard_bytes, fi, fi.size)
 
     def _read_part(self, bucket, obj, fi, part_number, offset, length,
-                   dst=None, healthy=None):
+                   dst=None, healthy=None, report=None):
         """Ranged read of one part: fetch only the frames covering the
         block range, then run bitrot verify + reconstruction of missing
         rows as ONE fused device dispatch (north-star config #5; the
@@ -1813,6 +1959,11 @@ class ErasureSet:
             ospan.record("engine.read", t_read - t0)
             ospan.record("engine.verify", t_verify - t_read)
             ospan.record("engine.assemble", asm_s + (done - ta))
+            if report is not None:
+                # Hot-tier evidence: this segment was served purely by
+                # the full-k verify (dict ops are GIL-atomic enough for
+                # the prefetch pool's one-writer-per-segment pattern).
+                report["fast"] = report.get("fast", 0) + 1
             return (res,)
 
         # BLOCK_SIZE % k gate: the padded (non-dividing k) layout needs
@@ -1836,6 +1987,11 @@ class ErasureSet:
                 return got[0]
             DATA_PATH.record_fastpath_fallback()
 
+        if report is not None:
+            # Decode/reconstruct involvement (fallback, degraded, or
+            # fast path disabled): correct bytes, but not the full-k
+            # verify-only read the hot tier requires for a fill.
+            report["taint"] = True
         sel: list[int] = []
         missing: list[int] = []
         out = None
@@ -2199,9 +2355,19 @@ class ErasureSet:
             errs = [e for _, e in res if e is not None]
             raise errs[0] if errs else ErrObjectNotFound(
                 f"{bucket}/{obj}")
+        # The stamp changed the served metadata: cached FileInfos (and
+        # hot-tier entries, which carry the FileInfo) are now stale.
+        self._mark_dirty(bucket)
 
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
+        # Hot-tier metadata hit: a fresh-generation entry proves the
+        # version is current (every mutation bumps the bucket
+        # generation), so HEAD skips the drive stat fan-out.
+        if self.hot_tier is not None and self.hot_tier.enabled:
+            hfi = self.hot_tier.lookup_meta(bucket, obj, version_id)
+            if hfi is not None:
+                return hfi
         # HEAD always stats (a peer's write must be visible immediately)
         # but WRITES THROUGH the FileInfo cache: the common HEAD-then-GET
         # of one server request elects xl.meta once.

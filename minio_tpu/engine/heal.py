@@ -178,8 +178,16 @@ def heal_object(es: ErasureSet, bucket: str, obj: str, version_id: str = "",
     # Heal mutates shard files + metadata: same write lock as PUT/DELETE
     # (cf. NSLock in healObject, cmd/erasure-healing.go:276).
     with es.nslock.write_locked(bucket, obj, timeout=30.0):
-        return [_heal_version(es, bucket, obj, vid, deep, dry_run,
-                              remove_dangling) for vid in vids]
+        results = [_heal_version(es, bucket, obj, vid, deep, dry_run,
+                                 remove_dangling) for vid in vids]
+        # Heal is a mutation like any other: promoted spares / purged
+        # dangling versions change what a read elects, so the FileInfo
+        # cache and hot tier must be invalidated (a missed bump here
+        # would let the hot cache serve the pre-heal body forever).
+        if not dry_run and any(r.healed_drives or r.purged
+                               for r in results):
+            es._mark_dirty(bucket)
+        return results
 
 
 def _heal_version(es: ErasureSet, bucket: str, obj: str, version_id: str,

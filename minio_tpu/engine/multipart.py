@@ -386,6 +386,10 @@ def read_part_bytes(es: ErasureSet, bucket: str, obj: str,
 
 def abort_multipart_upload(es: ErasureSet, bucket: str, obj: str,
                            upload_id: str) -> None:
+    # No _mark_dirty here on purpose: abort only deletes SYS_VOL
+    # staging files — the object namespace never changed, so neither
+    # the FileInfo cache nor the hot tier can hold anything stale
+    # (complete_multipart_upload, which DOES publish, marks dirty).
     _read_upload_fi(es, bucket, obj, upload_id)  # 404 if unknown
     path = _upload_path(bucket, obj, upload_id)
 

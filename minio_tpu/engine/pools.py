@@ -111,6 +111,12 @@ class ServerPools:
             except ErrBucketExists:
                 pass
         self.pools.append(es)
+        # A pool adopted at runtime joins the shared hot tier the
+        # original pools attached at boot (all-local sets only).
+        tier = getattr(self, "hot_tier", None)
+        if tier is not None:
+            from .hotcache import attach_sets
+            attach_sets(es, tier)
         return len(self.pools) - 1
 
     # -- bucket ops ----------------------------------------------------------

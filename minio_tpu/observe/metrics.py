@@ -781,6 +781,38 @@ class MetricsRegistry:
                                  "Disk cache bytes in use")
         self.cache_max = Gauge("mtpu_cache_total_bytes",
                                "Disk cache size budget")
+        # RAM hot-object tier (engine/hotcache.py; cf. the reference's
+        # cmd/disk-cache*.go tier, here shared-memory + pool-shared).
+        self.hotcache_hits = Gauge("mtpu_hotcache_hits_total",
+                                   "Hot-object cache body hits")
+        self.hotcache_misses = Gauge("mtpu_hotcache_misses_total",
+                                     "Hot-object cache misses")
+        self.hotcache_meta_hits = Gauge(
+            "mtpu_hotcache_meta_hits_total",
+            "Hot-object cache metadata-only (HEAD/conditional) hits")
+        self.hotcache_ratio = Gauge("mtpu_hotcache_hit_ratio",
+                                    "Hot-object cache hit ratio")
+        self.hotcache_fills = Gauge("mtpu_hotcache_fills_total",
+                                    "Verified reads admitted to the "
+                                    "hot cache")
+        self.hotcache_evictions = Gauge(
+            "mtpu_hotcache_evictions_total",
+            "Hot-cache CLOCK evictions")
+        self.hotcache_bypassed = Gauge(
+            "mtpu_hotcache_bypassed_total",
+            "Reads that bypassed fill (degraded/oversize/ineligible)")
+        self.hotcache_stale = Gauge(
+            "mtpu_hotcache_stale_generation_total",
+            "Lookups/fills dropped on a stale bucket generation")
+        self.hotcache_invalidations = Gauge(
+            "mtpu_hotcache_invalidations_total",
+            "Bucket-generation bumps from mutation paths")
+        self.hotcache_entries = Gauge("mtpu_hotcache_entries",
+                                      "Live hot-cache entries")
+        self.hotcache_bytes = Gauge("mtpu_hotcache_usage_bytes",
+                                    "Hot-cache body bytes cached")
+        self.hotcache_segment = Gauge("mtpu_hotcache_total_bytes",
+                                      "Hot-cache shared-segment size")
         # Multi-pool placement + decommission families (cf.
         # getClusterHealthMetrics pool rows, cmd/metrics-v3-cluster.go).
         self.pool_total_bytes = Gauge(
@@ -881,6 +913,21 @@ class MetricsRegistry:
             self.cache_evictions.set(c["evictions"])
             self.cache_usage.set(c["usage_bytes"])
             self.cache_max.set(c["max_bytes"])
+        tier = getattr(pools, "hot_tier", None)
+        if tier is not None:
+            hs = tier.stats()
+            self.hotcache_hits.set(hs["hits"])
+            self.hotcache_misses.set(hs["misses"])
+            self.hotcache_meta_hits.set(hs["meta_hits"])
+            self.hotcache_ratio.set(round(hs["hit_ratio"], 6))
+            self.hotcache_fills.set(hs["fills"])
+            self.hotcache_evictions.set(hs["evictions"])
+            self.hotcache_bypassed.set(hs["bypassed"])
+            self.hotcache_stale.set(hs["stale_gen"])
+            self.hotcache_invalidations.set(hs["invalidations"])
+            self.hotcache_entries.set(hs["entries"])
+            self.hotcache_bytes.set(hs["cached_bytes"])
+            self.hotcache_segment.set(hs["segment_bytes"])
         online = offline = 0
         mrf_pending = mrf_healed = mrf_dropped = mrf_retries = 0
         mrf_seen: set[int] = set()

@@ -32,6 +32,7 @@ from __future__ import annotations
 import mmap
 import multiprocessing
 import os
+import threading
 import time
 
 import numpy as np
@@ -40,6 +41,13 @@ import numpy as np
 #: frees, waits, timeouts, slot_bytes, nslots
 _HDR_SLOTS = 8
 _HDR_BYTES = _HDR_SLOTS * 8
+
+#: process-local registry of named arenas (see ShmArena.named): the
+#: mapping itself is anonymous, so "named" reuse means "same instance
+#: within this process tree" — create before fork and every child
+#: inherits the one segment under the same name.
+_NAMED: dict[str, "ShmArena"] = {}
+_NAMED_MU = threading.Lock()
 
 
 def default_arena_bytes() -> int:
@@ -70,18 +78,46 @@ class ShmArena:
             total_bytes = default_arena_bytes()
         self.slot_bytes = int(slot_bytes)
         self.nslots = max(1, int(total_bytes) // self.slot_bytes)
-        # layout: [header][bitmap nslots bytes][slots]
-        self._data_off = _HDR_BYTES + self.nslots
+        # layout: [header][bitmap nslots bytes][refcounts int32]
+        #         [pending-free int32][slots]
+        # Refcounts/pending live per RUN HEAD: retain() pins an
+        # allocation against free() — an evicting writer (the hot
+        # cache) cannot reuse slots a reader is still copying out of;
+        # the free is deferred and performed by the last release().
+        self._ref_off = _HDR_BYTES + self.nslots
+        self._pend_off = self._ref_off + self.nslots * 4
+        self._data_off = self._pend_off + self.nslots * 4
         self._mm = mmap.mmap(-1, self._data_off
                              + self.nslots * self.slot_bytes)
         self._hdr = np.frombuffer(self._mm, dtype=np.int64,
                                   count=_HDR_SLOTS)
         self._bitmap = np.frombuffer(self._mm, dtype=np.uint8,
                                      count=self.nslots, offset=_HDR_BYTES)
+        self._refs = np.frombuffer(self._mm, dtype=np.int32,
+                                   count=self.nslots,
+                                   offset=self._ref_off)
+        self._pend = np.frombuffer(self._mm, dtype=np.int32,
+                                   count=self.nslots,
+                                   offset=self._pend_off)
         self._hdr[6] = self.slot_bytes
         self._hdr[7] = self.nslots
         ctx = multiprocessing.get_context("fork")
         self._cv = ctx.Condition(ctx.Lock())
+
+    @classmethod
+    def named(cls, name: str, total_bytes: int | None = None,
+              slot_bytes: int = 1 << 20) -> "ShmArena":
+        """One arena per name per process tree: the first caller
+        creates the segment, later callers (and, after fork, children
+        that inherited the module state) get the SAME instance — so
+        independent subsystems can agree on a shared segment without
+        passing the object through every constructor."""
+        with _NAMED_MU:
+            a = _NAMED.get(name)
+            if a is None:
+                a = cls(total_bytes, slot_bytes)
+                _NAMED[name] = a
+            return a
 
     # -- allocation ----------------------------------------------------------
 
@@ -133,14 +169,45 @@ class ShmArena:
                 self._hdr[4] += 1
         return self._data_off + first * self.slot_bytes
 
+    def _free_locked(self, first: int, want: int) -> None:
+        self._bitmap[first:first + want] = 0
+        self._hdr[0] -= want * self.slot_bytes
+        self._hdr[3] += 1
+        self._cv.notify_all()
+
     def free(self, offset: int, nbytes: int) -> None:
+        """Release an allocation.  If a reader still holds a retain()
+        on it, the free is DEFERRED: the slots stay marked in-use until
+        the last release() performs the actual bitmap clear (so the
+        reader's view never gets reused under it)."""
         first = (int(offset) - self._data_off) // self.slot_bytes
         want = max(1, -(-int(nbytes) // self.slot_bytes))
         with self._cv:
-            self._bitmap[first:first + want] = 0
-            self._hdr[0] -= want * self.slot_bytes
-            self._hdr[3] += 1
-            self._cv.notify_all()
+            if self._refs[first] > 0:
+                self._pend[first] = want
+                return
+            self._free_locked(first, want)
+
+    # -- per-entry refcounts (in-flight reader protection) -------------------
+
+    def retain(self, offset: int) -> None:
+        """Pin an allocation against free(): the caller may copy bytes
+        out of view() without holding any higher-level lock."""
+        first = (int(offset) - self._data_off) // self.slot_bytes
+        with self._cv:
+            self._refs[first] += 1
+
+    def release(self, offset: int) -> None:
+        """Drop a retain(); the last release performs any free() that
+        was deferred while the allocation was pinned."""
+        first = (int(offset) - self._data_off) // self.slot_bytes
+        with self._cv:
+            if self._refs[first] > 0:
+                self._refs[first] -= 1
+            if self._refs[first] == 0 and self._pend[first]:
+                want = int(self._pend[first])
+                self._pend[first] = 0
+                self._free_locked(first, want)
 
     def view(self, offset: int, nbytes: int) -> np.ndarray:
         """uint8 view of an allocated range — zero-copy in every
@@ -153,6 +220,8 @@ class ShmArena:
         owner generations when no worker holds a live slot)."""
         with self._cv:
             self._bitmap[:] = 0
+            self._refs[:] = 0
+            self._pend[:] = 0
             self._hdr[0] = 0
             self._cv.notify_all()
 

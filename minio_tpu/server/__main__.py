@@ -289,6 +289,13 @@ def main(argv: list[str] | None = None) -> int:
     if replayed:
         print(f"minio_tpu: MRF journal: replayed {replayed} pending "
               f"heal(s)", flush=True)
+    # RAM hot-object tier (single-process: one private segment; the
+    # pool path builds it pre-fork in WorkerPlane instead).
+    from ..engine.hotcache import attach_pools as attach_hotcache
+    if attach_hotcache(pools) is not None:
+        print("minio_tpu: hot-object cache: "
+              f"{pools.hot_tier.stats()['segment_bytes'] >> 20} MiB "
+              "segment attached", flush=True)
     # Live-added pools survive a restart with stale --drives flags:
     # pool-topology.json (written by admin pool/add / decommission)
     # wins over the boot flags, and interrupted drains resume from

@@ -187,7 +187,9 @@ class S3Handlers:
         # the source etag in its metadata.
         cond_fi = FileInfo(volume=bucket, name=key, size=len(data),
                            metadata=dict(meta))
-        self._check_conditions(headers, cond_fi)
+        cond = self._check_conditions(headers, cond_fi)
+        if cond is not None:
+            return cond
         h = {"Content-Length": str(len(data)),
              "Content-Type": meta.get("content-type",
                                       "application/octet-stream"),
@@ -638,31 +640,74 @@ class S3Handlers:
         return h
 
     @staticmethod
-    def _check_conditions(headers: dict[str, str], fi: FileInfo) -> None:
-        """If-Match / If-None-Match / If-(Un)modified-Since
-        (cf. checkPreconditions, cmd/object-handlers-common.go)."""
+    def _check_conditions(headers: dict[str, str],
+                          fi: FileInfo) -> Response | None:
+        """If-Match / If-None-Match / If-(Un)modified-Since with RFC
+        7232 §6 precedence (cf. checkPreconditions,
+        cmd/object-handlers-common.go): If-Match beats
+        If-Unmodified-Since, If-None-Match beats If-Modified-Since.
+
+        Returns a body-less 304 Response (carrying the §4.1-required
+        ETag/Last-Modified validators, NOT an XML error body — clients
+        revalidate their cache from these headers) when the client's
+        copy is fresh, or None to proceed; a failed writer-side
+        precondition raises S3Error("PreconditionFailed") → 412.
+
+        Runs BEFORE any range parse or shard IO: the cheapest possible
+        hot-key hit is the one that never touches a drive.
+        """
         etag = fi.metadata.get("etag", "")
         h = {k.lower(): v for k, v in headers.items()}
-        im = h.get("if-match")
-        if im is not None and im.strip('"') not in (etag, "*"):
-            raise S3Error("PreconditionFailed")
-        inm = h.get("if-none-match")
-        if inm is not None and (inm == "*" or inm.strip('"') == etag):
-            raise S3Error("NotModified")
+
+        def etag_match(spec: str) -> bool:
+            # Comma-separated entity-tag list; W/ weak tags compare by
+            # opaque value (weak comparison is fine for GET/HEAD).
+            if spec.strip() == "*":
+                return True
+            for cand in spec.split(","):
+                cand = cand.strip()
+                if cand.startswith("W/"):
+                    cand = cand[2:]
+                if cand.strip('"') == etag:
+                    return True
+            return False
 
         def parse_http_date(s):
             try:
-                return email.utils.parsedate_to_datetime(s)
+                d = email.utils.parsedate_to_datetime(s)
             except (TypeError, ValueError):
                 return None
+            if d is not None and d.tzinfo is None:
+                d = d.replace(tzinfo=datetime.timezone.utc)
+            return d
+
         mod = datetime.datetime.fromtimestamp(
             fi.mod_time_ns / 1e9, datetime.timezone.utc).replace(microsecond=0)
-        ims = parse_http_date(h.get("if-modified-since", ""))
-        if ims is not None and mod <= ims:
-            raise S3Error("NotModified")
-        ius = parse_http_date(h.get("if-unmodified-since", ""))
-        if ius is not None and mod > ius:
-            raise S3Error("PreconditionFailed")
+        im = h.get("if-match")
+        if im is not None:
+            if not etag_match(im):
+                raise S3Error("PreconditionFailed")
+        else:
+            ius = parse_http_date(h.get("if-unmodified-since", ""))
+            if ius is not None and mod > ius:
+                raise S3Error("PreconditionFailed")
+
+        def not_modified() -> Response:
+            nh = {"ETag": f'"{etag}"',
+                  "Last-Modified": _http_date(fi.mod_time_ns)}
+            if fi.version_id:
+                nh["x-amz-version-id"] = fi.version_id
+            return Response(304, b"", nh)
+
+        inm = h.get("if-none-match")
+        if inm is not None:
+            if etag_match(inm):
+                return not_modified()
+        else:
+            ims = parse_http_date(h.get("if-modified-since", ""))
+            if ims is not None and mod <= ims:
+                return not_modified()
+        return None
 
     @staticmethod
     def _parse_range(spec: str, size: int) -> tuple[int, int] | None:
@@ -720,7 +765,9 @@ class S3Handlers:
             return resp
         except StorageError as e:
             raise from_storage_error(e) from None
-        self._check_conditions(headers, fi)
+        cond = self._check_conditions(headers, fi)
+        if cond is not None:
+            return cond
 
         transformed = (sse.is_encrypted(fi.metadata)
                        or cz.is_compressed(fi.metadata)

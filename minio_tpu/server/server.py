@@ -2188,6 +2188,7 @@ class S3Server:
                  if self.cluster_node is not None else [])
         workers = (self.worker_plane.workers_info()
                    if self.worker_plane is not None else None)
+        tier = getattr(self.pools, "hot_tier", None)
         return {
             "endpoint": f"{self.host}:{self.port}",
             "time": round(_time.time(), 3),
@@ -2201,6 +2202,7 @@ class S3Server:
             "digest": digest,
             "coalescer": coalescer,
             "workers": workers,
+            "hotcache": tier.stats() if tier is not None else None,
             "audit": [t.stats() for t in self.audit_targets],
             "slo": (self.metrics.last_minute.snapshot()
                     if self.slo_enabled else {}),

@@ -61,12 +61,13 @@ from ..ops.shm_arena import ShmArena, default_arena_bytes
 
 #: shared control block layout (all int64, single-writer per field)
 _GHDR = 16                       # global slots
-_WSLOTS = 8                      # per-worker slab stride
+_WSLOTS = 10                     # per-worker slab stride
 # global: 0 owner_gen, 1 owner_pid, 2 owner_beat_ns, 3 supervisor_pid,
 #         4 nworkers, 5 owner_co_dispatches, 6 owner_co_items,
 #         7 owner_co_pending, 8 owner_co_weight, 9 topology_gen
 # worker: 0 pid, 1 beat_ns, 2 ready, 3 draining, 4 respawns,
-#         5 requests_total, 6 inflight, 7 audit_dropped
+#         5 requests_total, 6 inflight, 7 audit_dropped,
+#         8 hotcache_hits, 9 hotcache_misses
 
 
 def nworkers_env() -> int:
@@ -207,6 +208,12 @@ class SharedState:
         of the slab)."""
         self._a[self._w(idx) + 7] = int(n)
 
+    def note_hotcache(self, idx: int, hit: bool) -> None:
+        """Per-worker hot-tier hit/miss tally (the cache segment is
+        shared, so per-worker counters are the only way to see that
+        worker B is hitting on worker A's fills)."""
+        self._a[self._w(idx) + (8 if hit else 9)] += 1
+
     def worker_rows(self) -> list[dict]:
         stale = int(_stale_s() * 1e9)
         now = _now_ns()
@@ -224,6 +231,8 @@ class SharedState:
                 "requests": int(self._a[w + 5]),
                 "inflight": int(self._a[w + 6]),
                 "audit_dropped": int(self._a[w + 7]),
+                "hotcache_hits": int(self._a[w + 8]),
+                "hotcache_misses": int(self._a[w + 9]),
             })
         return rows
 
@@ -249,6 +258,12 @@ class WorkerPlane:
         self.req_ring = ShmRing(ring_capacity)
         self.resp_rings = [ShmRing(ring_capacity)
                            for _ in range(self.nworkers)]
+        # The pool-shared hot-object tier: the cache segment MUST exist
+        # before the first fork so every worker inherits the SAME
+        # mapping — worker A's fill is worker B's hit (engine/hotcache
+        # is import-light: stdlib + numpy + ops.shm_arena, no jax).
+        from ..engine.hotcache import maybe_tier
+        self.hotcache = maybe_tier()
 
     def owner_ok(self) -> bool:
         return self.state.owner_ok(_stale_s())
@@ -266,6 +281,8 @@ class WorkerPlane:
             "rings": {"request_depth": self.req_ring.depth(),
                       "response_depths": [r.depth()
                                           for r in self.resp_rings]},
+            "hotcache": (self.hotcache.stats()
+                         if self.hotcache is not None else None),
         }
 
     def render_prom(self) -> str:
@@ -300,6 +317,17 @@ class WorkerPlane:
         fam("mtpu_worker_audit_dropped_total",
             "Audit entries shed by this worker's targets",
             [({"worker": r["worker"]}, r["audit_dropped"])
+             for r in rows])
+        # Per-worker view of the SHARED hot tier (aggregate cache
+        # counters export via the registry's mtpu_hotcache_* families;
+        # distinct names avoid duplicate-family renders in pool mode).
+        fam("mtpu_worker_hotcache_hits_total",
+            "Hot-object cache hits served by this worker",
+            [({"worker": r["worker"]}, r["hotcache_hits"])
+             for r in rows])
+        fam("mtpu_worker_hotcache_misses_total",
+            "Hot-object cache misses seen by this worker",
+            [({"worker": r["worker"]}, r["hotcache_misses"])
              for r in rows])
         oi = self.state.owner_info()
         fam("mtpu_owner_up", "Device-owner heartbeat is fresh",
@@ -448,6 +476,14 @@ def _worker_main(plane: WorkerPlane, idx: int, cfg: dict) -> int:
                            if pool_sets else None)))
     pools = ServerPools(pool_sets)
     mrf_queues = attach_mrf(pools)
+    if plane.hotcache is not None:
+        # Attach the pre-fork cache segment this worker inherited;
+        # hits/misses also land in this worker's slab slots so the
+        # pool exposes per-worker ratios over the ONE shared cache.
+        from ..engine.hotcache import attach_pools as attach_hotcache
+        if attach_hotcache(pools, plane.hotcache) is not None:
+            plane.hotcache.on_lookup = (
+                lambda hit, _i=idx: plane.state.note_hotcache(_i, hit))
     if topo:
         pools.draining |= {int(i) for i in topo.get("draining", [])
                            if 0 <= int(i) < len(pools.pools)}

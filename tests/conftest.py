@@ -103,6 +103,17 @@ def digest_mode(request, monkeypatch):
     return request.param
 
 
+@pytest.fixture(params=["1", "0"], ids=["hotcache", "nocache"])
+def hotcache_mode(request, monkeypatch):
+    """Oracle guard for the RAM hot-object tier: tests using this
+    fixture run once with the verified shared-memory cache armed
+    (MTPU_HOTCACHE=1, the default) and once on the direct-read oracle
+    (=0) — GET/ranged-GET/HEAD results must be byte-identical; the
+    cache may only change latency."""
+    monkeypatch.setenv("MTPU_HOTCACHE", request.param)
+    return request.param
+
+
 @pytest.fixture(params=["1", "0"], ids=["breaker", "nobreaker"])
 def breaker_mode(request, monkeypatch):
     """Oracle guard for the drive circuit breaker: MTPU_BREAKER=0 pins

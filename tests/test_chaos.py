@@ -58,8 +58,13 @@ def storm(drives, error_rate=0.05, slow_rate=0.05, torn_rate=0.04,
 SIZES = [700, 64_000, 300_000, BLOCK_SIZE + 77]
 
 
-def run_scenario(tmp_path, seed, n=4, m=2, sizes=SIZES, rounds=1):
+def run_scenario(tmp_path, seed, n=4, m=2, sizes=SIZES, rounds=1,
+                 with_tier=False):
     es, drives = build_set(str(tmp_path), seed, n=n, m=m)
+    if with_tier:
+        from minio_tpu.engine.hotcache import (HotObjectCache,
+                                               attach_sets)
+        attach_sets(es, HotObjectCache(total_bytes=32 << 20))
     rng = np.random.default_rng(seed)
     storm(drives)
 
@@ -125,6 +130,36 @@ class TestChaosSmoke:
         es, drives, acked = run_scenario(tmp_path, seed=7)
         # the storm actually injected something, or this tested nothing
         assert sum(sum(d.injected.values()) for d in drives) > 0
+
+    def test_one_seed_matrix_hotcache(self, tmp_path, monkeypatch):
+        """The same matrix with the RAM hot tier armed: every byte
+        assertion in run_scenario now also polices reads SERVED FROM
+        CACHE under the storm — a tainted (reconstructed/errored) read
+        that slipped into the cache, or a stale entry surviving an
+        overwrite, would fail the byte-exactness checks.  rounds=3 so
+        repeat reads actually hit."""
+        monkeypatch.setenv("MTPU_HOTCACHE", "1")
+        es, drives, acked = run_scenario(tmp_path, seed=7, rounds=3,
+                                         with_tier=True)
+        st = es.hot_tier.stats()
+        # Under the storm, injected faults taint reads off the verified
+        # fast path — every tainted read must have BYPASSED the fill
+        # (this is the corruption-never-cached rule doing its job).
+        assert st["bypassed"] > 0
+        # Weather is off now (run_scenario healed to convergence):
+        # calm verified reads fill, then hit, still byte-exact.
+        big = max(acked, key=lambda k: len(acked[k]))
+        for _ in range(3):
+            _, got = es.get_object("cb", big)
+            assert bytes(got) == acked[big]
+        assert es.hot_tier.stats()["hits"] > 0
+        # zero stale reads: overwrite through the warm cache, the very
+        # next read must be the new bytes.
+        for j, name in enumerate(sorted(acked)[:2]):
+            new = payload(len(acked[name]) + 17, seed=7000 + j)
+            es.put_object("cb", name, new)
+            _, got = es.get_object("cb", name)
+            assert bytes(got) == new
 
     def test_determinism_same_seed_same_faults(self, tmp_path):
         """A failing seed is a reproducer: identical call sequences on

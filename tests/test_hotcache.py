@@ -1,0 +1,499 @@
+"""Hot-object RAM tier tests (engine/hotcache.py).
+
+Three layers of guarantees:
+  - the cache itself: two-hit ghost admission, CLOCK eviction,
+    generation invalidation, hash-collision demotion, size gates;
+  - the engine hot path: byte-identical with the MTPU_HOTCACHE=0
+    oracle over randomized GET/ranged-GET/HEAD (the `hotcache_mode`
+    fixture runs every differential twice), single-flight dedup of
+    concurrent cold GETs, and the verify-once fill rule — a corrupted
+    shard that forces the reconstruct fallback must NEVER be cached;
+  - zero stale reads: every mutation path (PUT overwrite, DELETE,
+    delete_bucket, metadata update, heal, multipart complete, decom
+    drain) must be visible through a warm cache immediately.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from minio_tpu.engine import heal as heal_mod
+from minio_tpu.engine import multipart as mp
+from minio_tpu.engine import quorum as Q
+from minio_tpu.engine.erasure_set import ErasureSet
+from minio_tpu.engine.hotcache import (HotObjectCache, SingleFlight,
+                                       attach_pools, attach_sets,
+                                       hot_bytes, hot_enabled,
+                                       hot_max_obj)
+from minio_tpu.storage.drive import LocalDrive
+from minio_tpu.storage.errors import ErrObjectNotFound, StorageError
+
+
+def make_set(tmp_path, n=4, name="hot", tier_bytes=32 << 20,
+             max_obj=None):
+    drives = [LocalDrive(str(tmp_path / name / f"d{i}"))
+              for i in range(n)]
+    es = ErasureSet(drives)
+    tier = HotObjectCache(total_bytes=tier_bytes, max_obj=max_obj)
+    attach_sets(es, tier)
+    return es, tier
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def warm(es, bucket, obj, version_id=""):
+    """Read until cached: miss-and-ghost, miss-and-fill, hit."""
+    for _ in range(3):
+        fi, got = es.get_object(bucket, obj, version_id=version_id)
+    return fi, bytes(got)
+
+
+class TestEnvKnobs:
+    def test_defaults_and_overrides(self, monkeypatch):
+        monkeypatch.delenv("MTPU_HOTCACHE", raising=False)
+        assert hot_enabled()
+        monkeypatch.setenv("MTPU_HOTCACHE", "0")
+        assert not hot_enabled()
+        monkeypatch.setenv("MTPU_HOTCACHE_MB", "128")
+        assert hot_bytes() == 128 << 20
+        monkeypatch.setenv("MTPU_HOTCACHE_MAX_OBJ", "1024")
+        assert hot_max_obj() == 1024
+
+
+class TestCacheUnit:
+    """HotObjectCache alone — no erasure engine behind it."""
+
+    def cache(self, **kw):
+        kw.setdefault("total_bytes", 8 << 20)
+        return HotObjectCache(**kw)
+
+    def test_two_hit_ghost_then_hit(self):
+        c = self.cache()
+        fi = {"etag": "e1", "size": 5}
+        g = c.generation("b")
+        assert c.fill("b", "o", "", fi, b"hello", g) is False  # ghost
+        assert c.lookup("b", "o", "") is None
+        assert c.fill("b", "o", "", fi, b"hello", g) is True
+        got = c.lookup("b", "o", "")
+        assert got is not None
+        gfi, body = got
+        assert gfi == fi and body == b"hello"
+        st = c.stats()
+        assert st["fills"] == 1 and st["ghost_defers"] == 1
+        assert st["hits"] == 1 and st["misses"] == 1
+        assert st["entries"] == 1 and st["cached_bytes"] == 5
+
+    def test_generation_bump_invalidates(self):
+        c = self.cache()
+        g = c.generation("b")
+        c.fill("b", "o", "", {}, b"v1", g)
+        c.fill("b", "o", "", {}, b"v1", g)
+        assert c.lookup("b", "o", "") is not None
+        c.note_mutation("b")
+        assert c.lookup("b", "o", "") is None
+        st = c.stats()
+        assert st["stale_gen"] >= 1 and st["invalidations"] == 1
+        # refill at the NEW generation serves again (ghost remembers)
+        g2 = c.generation("b")
+        assert g2 == g + 1
+        assert c.fill("b", "o", "", {}, b"v2", g2) is True
+        assert c.lookup("b", "o", "")[1] == b"v2"
+
+    def test_stale_gen_stamp_dropped(self):
+        """A fill stamped with a pre-mutation generation must bounce —
+        the bytes were read before the write landed."""
+        c = self.cache()
+        g = c.generation("b")
+        c.fill("b", "o", "", {}, b"old", g)       # ghost
+        c.note_mutation("b")
+        assert c.fill("b", "o", "", {}, b"old", g) is False
+        assert c.lookup("b", "o", "") is None
+
+    def test_size_gate(self):
+        c = self.cache(max_obj=100)
+        g = c.generation("b")
+        before = c.stats()["bypassed"]
+        assert c.fill("b", "big", "", {}, b"x" * 101, g) is False
+        assert c.fill("b", "empty", "", {}, b"", g) is False
+        assert c.stats()["bypassed"] == before + 2
+        assert c.stats()["fills"] == 0
+
+    def test_clock_eviction_bounded(self):
+        c = HotObjectCache(total_bytes=2 << 20, n_entries=16)
+        body = b"z" * (256 << 10)
+        for i in range(40):
+            g = c.generation("b")
+            c.fill("b", f"o{i}", "", {}, body, g)   # ghost
+            c.fill("b", f"o{i}", "", {}, body, g)   # admit
+        st = c.stats()
+        assert st["evictions"] > 0
+        assert st["entries"] <= 16
+        assert st["in_use_bytes"] <= st["segment_bytes"]
+        # the survivors still serve
+        served = sum(1 for i in range(40)
+                     if c.lookup("b", f"o{i}", "") is not None)
+        assert served >= 1
+
+    def test_version_keys_distinct(self):
+        c = self.cache()
+        g = c.generation("b")
+        for vid, body in (("v1", b"one"), ("v2", b"two")):
+            c.fill("b", "o", vid, {"v": vid}, body, g)
+            c.fill("b", "o", vid, {"v": vid}, body, g)
+        assert c.lookup("b", "o", "v1")[1] == b"one"
+        assert c.lookup("b", "o", "v2")[1] == b"two"
+        assert c.lookup("b", "o", "") is None
+
+    def test_lookup_meta_does_not_skew_body_ratio(self):
+        c = self.cache()
+        g = c.generation("b")
+        c.fill("b", "o", "", {"etag": "m"}, b"body", g)
+        c.fill("b", "o", "", {"etag": "m"}, b"body", g)
+        h0 = c.stats()["hits"]
+        assert c.lookup_meta("b", "o", "") == {"etag": "m"}
+        assert c.lookup_meta("b", "missing", "") is None
+        st = c.stats()
+        assert st["meta_hits"] == 1 and st["hits"] == h0
+
+
+class TestSingleFlight:
+    def test_leader_and_followers(self):
+        sf = SingleFlight()
+        fl, leader = sf.begin("k")
+        assert leader
+        f2, l2 = sf.begin("k")
+        assert not l2 and f2 is fl
+        out = []
+        t = threading.Thread(target=lambda: out.append(f2.wait()))
+        t.start()
+        fl.resolve("payload")
+        t.join(5)
+        assert out == ["payload"]
+        sf.end("k")
+        _, l3 = sf.begin("k")
+        assert l3          # fresh flight after end()
+        sf.end("k")
+
+    def test_failed_leader_resolves_none(self):
+        sf = SingleFlight()
+        fl, _ = sf.begin("k")
+        f2, _ = sf.begin("k")
+        sf.end("k")        # leader bailed without a result
+        assert f2.wait(timeout=1) is None
+
+
+@pytest.fixture()
+def hot_set(tmp_path):
+    es, tier = make_set(tmp_path)
+    es.make_bucket("b")
+    return es, tier
+
+
+class TestEngineDifferential:
+    SIZES = (777, 64 << 10, (1 << 20) + 123, 3 << 20)
+
+    def test_randomized_get_ranged_head_oracle(self, tmp_path,
+                                               hotcache_mode):
+        """The acceptance differential: the same seeded GET /
+        ranged-GET / HEAD stream under MTPU_HOTCACHE=1 and =0 must be
+        byte-identical to the in-memory truth (and so to each other)."""
+        es, tier = make_set(tmp_path)
+        es.make_bucket("b")
+        truth = {}
+        for i, size in enumerate(self.SIZES):
+            truth[f"o{i}"] = payload(size, seed=40 + i)
+            es.put_object("b", f"o{i}", truth[f"o{i}"])
+        rng = np.random.default_rng(7)
+        names = sorted(truth)
+        for _ in range(60):
+            name = names[int(rng.integers(len(names)))]
+            data = truth[name]
+            kind = int(rng.integers(3))
+            if kind == 0:
+                fi, got = es.get_object("b", name)
+                assert bytes(got) == data
+                assert fi.size == len(data)
+            elif kind == 1:
+                off = int(rng.integers(len(data)))
+                ln = int(rng.integers(1, len(data) - off + 1))
+                _, got = es.get_object("b", name, offset=off,
+                                       length=ln)
+                assert bytes(got) == data[off:off + ln]
+            else:
+                fi = es.head_object("b", name)
+                assert fi.size == len(data)
+
+    def test_hit_serves_and_counts(self, hot_set):
+        es, tier = hot_set
+        data = payload(200_000, seed=1)
+        es.put_object("b", "o", data)
+        _, got = warm(es, "b", "o")
+        assert got == data
+        st = tier.stats()
+        assert st["fills"] == 1 and st["hits"] >= 1
+
+    def test_ranged_hit_slices_cached_body(self, hot_set):
+        es, tier = hot_set
+        data = payload(500_000, seed=2)
+        es.put_object("b", "o", data)
+        warm(es, "b", "o")
+        h0 = tier.stats()["hits"]
+        _, got = es.get_object("b", "o", offset=1234, length=77)
+        assert bytes(got) == data[1234:1311]
+        _, got = es.get_object("b", "o", offset=len(data) - 5)
+        assert bytes(got) == data[-5:]
+        assert tier.stats()["hits"] == h0 + 2
+
+    def test_ranged_hit_error_parity(self, hot_set):
+        """Out-of-range requests on a CACHED object must raise the
+        same StorageError the planner raises on a cold one."""
+        es, tier = hot_set
+        data = payload(10_000, seed=3)
+        es.put_object("b", "o", data)
+        warm(es, "b", "o")
+        with pytest.raises(StorageError) as hot_err:
+            es.get_object("b", "o", offset=len(data) + 1)
+        monkey_env = dict(os.environ)
+        os.environ["MTPU_HOTCACHE"] = "0"
+        try:
+            with pytest.raises(StorageError) as cold_err:
+                es.get_object("b", "o", offset=len(data) + 1)
+        finally:
+            os.environ.clear()
+            os.environ.update(monkey_env)
+        assert str(hot_err.value) == str(cold_err.value)
+
+    def test_iter_path_serves_hits(self, hot_set):
+        es, tier = hot_set
+        data = payload(300_000, seed=4)
+        es.put_object("b", "o", data)
+        warm(es, "b", "o")
+        h0 = tier.stats()["hits"]
+        fi, it = es.get_object_iter("b", "o", offset=100, length=999)
+        assert b"".join(bytes(c) for c in it) == data[100:1099]
+        assert tier.stats()["hits"] == h0 + 1
+
+    def test_head_meta_hit(self, hot_set):
+        es, tier = hot_set
+        data = payload(300_000, seed=5)
+        put_fi = es.put_object("b", "o", data)
+        warm(es, "b", "o")
+        fi = es.head_object("b", "o")
+        assert fi.metadata.get("etag") == put_fi.metadata.get("etag")
+        assert fi.size == len(data)
+        assert tier.stats()["meta_hits"] >= 1
+
+    def test_single_flight_one_engine_read(self, hot_set):
+        es, tier = hot_set
+        data = payload(1 << 20, seed=6)
+        es.put_object("b", "cold", data)
+        reads = []
+        direct = es._get_object_direct
+
+        def counting(*a, **kw):
+            reads.append(1)
+            return direct(*a, **kw)
+
+        es._get_object_direct = counting
+        try:
+            results = [None] * 8
+            barrier = threading.Barrier(8)
+
+            def go(i):
+                barrier.wait()
+                _, got = es.get_object("b", "cold")
+                results[i] = bytes(got)
+
+            ts = [threading.Thread(target=go, args=(i,))
+                  for i in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+        finally:
+            es._get_object_direct = direct
+        assert all(r == data for r in results)
+        assert len(reads) == 1      # one leader; followers sliced it
+
+    def test_inline_object_bypasses(self, hot_set):
+        es, tier = hot_set
+        es.put_object("b", "tiny", b"inline-sized")
+        for _ in range(3):
+            _, got = es.get_object("b", "tiny")
+            assert bytes(got) == b"inline-sized"
+        assert tier.stats()["fills"] == 0
+
+    def test_oversize_object_bypasses(self, tmp_path):
+        es, tier = make_set(tmp_path, name="big", max_obj=100_000)
+        es.make_bucket("b")
+        data = payload(400_000, seed=7)
+        es.put_object("b", "big", data)
+        for _ in range(3):
+            _, got = es.get_object("b", "big")
+            assert bytes(got) == data
+        assert tier.stats()["fills"] == 0
+
+    def test_corruption_never_cached(self, hot_set, monkeypatch):
+        """The verify-once rule: a read that fell back from the
+        verified fast path (corrupted data shard -> reconstruct) is
+        TAINTED and must not fill — and the served bytes stay right."""
+        monkeypatch.setenv("MTPU_GET_FASTPATH", "1")
+        es, tier = hot_set
+        data = payload(2 << 20, seed=8)
+        es.put_object("b", "o", data)
+        fi, _, _ = es._read_metadata("b", "o")
+        order = Q.shuffle_by_distribution(list(range(es.n)),
+                                          fi.erasure.distribution)
+        d = es.drives[order[0]]         # the drive holding DATA shard 0
+        path = os.path.join(d.root, "b", "o", fi.data_dir, "part.1")
+        frame = 32 + fi.erasure.shard_size
+        pos = (os.path.getsize(path) // 2 // frame) * frame + 32 + 7
+        with open(path, "r+b") as f:
+            f.seek(pos)
+            byte = f.read(1)
+            f.seek(pos)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        for _ in range(3):
+            _, got = es.get_object("b", "o")
+            assert bytes(got) == data   # reconstructed, never bad bytes
+        st = tier.stats()
+        assert st["fills"] == 0
+        assert st["bypassed"] >= 3
+
+
+class TestStaleReads:
+    """Every mutation path through a WARM cache: the next read must
+    see the mutation (the _mark_dirty audit's regression net)."""
+
+    def test_put_overwrite_visible(self, hot_set):
+        es, tier = hot_set
+        v1, v2 = payload(250_000, seed=10), payload(260_000, seed=11)
+        es.put_object("b", "o", v1)
+        assert warm(es, "b", "o")[1] == v1
+        es.put_object("b", "o", v2)
+        _, got = es.get_object("b", "o")
+        assert bytes(got) == v2
+        assert tier.stats()["invalidations"] >= 2
+
+    def test_delete_visible(self, hot_set):
+        es, tier = hot_set
+        es.put_object("b", "o", payload(220_000, seed=12))
+        warm(es, "b", "o")
+        es.delete_object("b", "o")
+        with pytest.raises(ErrObjectNotFound):
+            es.get_object("b", "o")
+        with pytest.raises(ErrObjectNotFound):
+            es.head_object("b", "o")
+
+    def test_delete_bucket_visible(self, hot_set):
+        es, tier = hot_set
+        es.put_object("b", "o", payload(210_000, seed=13))
+        warm(es, "b", "o")
+        es.delete_bucket("b", force=True)
+        es.make_bucket("b")
+        with pytest.raises(ErrObjectNotFound):
+            es.get_object("b", "o")
+
+    def test_metadata_update_visible_via_head(self, hot_set):
+        es, tier = hot_set
+        fi = es.put_object("b", "o", payload(300_000, seed=14))
+        warm(es, "b", "o")
+        assert es.head_object("b", "o").metadata.get("x-new") is None
+        fi.metadata["x-new"] = "stamped"
+        es.update_object_metadata("b", "o", fi)
+        assert es.head_object("b", "o").metadata["x-new"] == "stamped"
+
+    def test_heal_marks_dirty(self, hot_set):
+        es, tier = hot_set
+        data = payload(200_000, seed=15)
+        es.put_object("b", "o", data)
+        warm(es, "b", "o")
+        # wipe one drive's copy, heal restores it — the on-disk layout
+        # changed, so the heal must bump the bucket generation.
+        fi, _, _ = es._read_metadata("b", "o")
+        import shutil
+        shutil.rmtree(os.path.join(es.drives[0].root, "b", "o"))
+        g0 = tier.generation("b")
+        res = heal_mod.heal_object(es, "b", "o")
+        assert any(r.healed_drives for r in res)
+        assert tier.generation("b") > g0
+        _, got = es.get_object("b", "o")
+        assert bytes(got) == data
+
+    def test_multipart_complete_visible(self, hot_set):
+        es, tier = hot_set
+        v1 = payload(230_000, seed=16)
+        es.put_object("b", "o", v1)
+        assert warm(es, "b", "o")[1] == v1
+        part = payload(5 << 20, seed=17)
+        uid = mp.new_multipart_upload(es, "b", "o")
+        info = mp.put_object_part(es, "b", "o", uid, 1, part)
+        mp.complete_multipart_upload(es, "b", "o", uid,
+                                     [(1, info.etag)])
+        _, got = es.get_object("b", "o")
+        assert bytes(got) == part
+
+    def test_versioned_delete_marker_visible(self, hot_set):
+        es, tier = hot_set
+        data = payload(240_000, seed=18)
+        es.put_object("b", "o", data, versioned=True)
+        warm(es, "b", "o")
+        es.delete_object("b", "o", versioned=True)   # delete marker
+        with pytest.raises(ErrObjectNotFound):
+            es.get_object("b", "o")
+
+
+@pytest.mark.decom
+class TestDecomStaleReads:
+    def two_pools(self, tmp):
+        from minio_tpu.engine.pools import ServerPools
+        from minio_tpu.engine.sets import ErasureSets
+        p0 = ErasureSets([LocalDrive(f"{tmp}/p0-{i}") for i in range(4)],
+                         set_drive_count=4)
+        p1 = ErasureSets([LocalDrive(f"{tmp}/p1-{i}") for i in range(4)],
+                         set_drive_count=4,
+                         deployment_id=p0.deployment_id)
+        return ServerPools([p0, p1])
+
+    def test_drain_with_warm_cache(self, tmp_path):
+        """Decom drain deletes through the source pool while the tier
+        is warm: reads during/after the drain must never serve the
+        drained copy's stale metadata, and an overwrite after the
+        drain must be visible immediately."""
+        from minio_tpu.background.decom import Decommissioner
+        pools = self.two_pools(str(tmp_path))
+        tier = attach_pools(pools, HotObjectCache(total_bytes=32 << 20))
+        assert tier is not None
+        pools.make_bucket("b")
+        for p, free in zip(pools.pools, [1000, 10]):
+            p.disk_usage = (lambda f: lambda: {"total": 1 << 40,
+                                               "free": f})(free)
+        data = {f"o{i}": payload(200_000 + i, seed=20 + i)
+                for i in range(4)}
+        for name, val in data.items():
+            pools.put_object("b", name, val)
+        for name, val in data.items():
+            for _ in range(3):
+                _, got = pools.get_object("b", name)
+            assert bytes(got) == val
+        assert tier.stats()["fills"] >= 1
+        g0 = tier.generation("b")
+        for p, free in zip(pools.pools, [1000, 10 ** 9]):
+            p.disk_usage = (lambda f: lambda: {"total": 1 << 40,
+                                               "free": f})(free)
+        d = Decommissioner(pools, 0)
+        d.run_sync()
+        assert d.status()["state"] == "complete"
+        assert tier.generation("b") > g0     # drain deletes marked dirty
+        for name, val in data.items():
+            _, got = pools.get_object("b", name)
+            assert bytes(got) == val
+        new = payload(205_000, seed=99)
+        pools.put_object("b", "o0", new)
+        _, got = pools.get_object("b", "o0")
+        assert bytes(got) == new

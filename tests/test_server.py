@@ -121,6 +121,79 @@ class TestObjects:
                                    headers={"If-Match": '"wrong"'})
         assert status == 412
 
+    def test_conditional_matrix(self, cli):
+        """RFC 7232 over the S3 front door: 304/412 short-circuit
+        BEFORE any shard IO, with the precedence S3 implements
+        (If-Match beats If-Unmodified-Since; If-None-Match beats
+        If-Modified-Since)."""
+        cli.make_bucket("bkt")
+        h = cli.put_object("bkt", "c", b"conditional body")
+        etag = h["ETag"]
+        head = cli.head_object("bkt", "c")
+        lastmod = head["Last-Modified"]
+        past = "Mon, 01 Jan 2001 00:00:00 GMT"
+        future = "Fri, 01 Jan 2038 00:00:00 GMT"
+
+        # If-None-Match: matching etag, list form, and star all 304
+        for val in (etag, f'"zzz", {etag}', "*"):
+            st, hdrs, body = cli.request(
+                "GET", "/bkt/c", headers={"If-None-Match": val})
+            assert (st, body) == (304, b""), val
+            assert hdrs.get("ETag") == etag     # 304 carries validators
+            assert hdrs.get("Last-Modified") == lastmod
+        # ... and a weak-prefixed validator still matches
+        st, _, _ = cli.request(
+            "GET", "/bkt/c", headers={"If-None-Match": f"W/{etag}"})
+        assert st == 304
+        st, _, body = cli.request(
+            "GET", "/bkt/c", headers={"If-None-Match": '"other"'})
+        assert st == 200 and body == b"conditional body"
+
+        # If-Match: wrong etag 412, right etag serves
+        st, _, _ = cli.request(
+            "GET", "/bkt/c", headers={"If-Match": '"wrong"'})
+        assert st == 412
+        st, _, body = cli.request(
+            "GET", "/bkt/c", headers={"If-Match": etag})
+        assert st == 200 and body == b"conditional body"
+
+        # date conditions
+        st, _, _ = cli.request(
+            "GET", "/bkt/c", headers={"If-Modified-Since": future})
+        assert st == 304
+        st, _, _ = cli.request(
+            "GET", "/bkt/c", headers={"If-Modified-Since": past})
+        assert st == 200
+        st, _, _ = cli.request(
+            "GET", "/bkt/c", headers={"If-Unmodified-Since": past})
+        assert st == 412
+        st, _, _ = cli.request(
+            "GET", "/bkt/c", headers={"If-Unmodified-Since": future})
+        assert st == 200
+
+        # precedence: an etag condition overrides its date counterpart
+        st, _, _ = cli.request(
+            "GET", "/bkt/c", headers={"If-None-Match": '"other"',
+                                      "If-Modified-Since": future})
+        assert st == 200        # etag mismatch wins over the 304 date
+        st, _, _ = cli.request(
+            "GET", "/bkt/c", headers={"If-Match": etag,
+                                      "If-Unmodified-Since": past})
+        assert st == 200        # etag match wins over the 412 date
+
+        # HEAD takes the same short-circuits
+        st, _, _ = cli.request(
+            "HEAD", "/bkt/c", headers={"If-None-Match": etag})
+        assert st == 304
+        st, _, _ = cli.request(
+            "HEAD", "/bkt/c", headers={"If-Match": '"wrong"'})
+        assert st == 412
+
+        # conditions never mask a missing key
+        st, _, _ = cli.request(
+            "GET", "/bkt/nope", headers={"If-Match": '"x"'})
+        assert st == 404
+
     def test_multi_delete(self, cli):
         cli.make_bucket("bkt")
         for i in range(3):

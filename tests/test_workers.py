@@ -537,6 +537,91 @@ class TestPoolSmoke:
         assert "mtpu_shm_arena_bytes" in text
         assert "mtpu_ipc_ring_depth" in text
 
+    def test_hot_tier_shared_across_pool(self, pool_server):
+        """One shared segment behind both SO_REUSEPORT workers: no
+        matter which worker each GET lands on, the first two lookups
+        miss (ghost, then fill) and every later one hits — visible in
+        the pool-wide hotcache stats block and the per-worker slab
+        counters."""
+        cli = _cli(pool_server)
+        cli.make_bucket("hotpool")
+        body = np.random.default_rng(23).integers(
+            0, 256, size=_MB + 7, dtype=np.uint8).tobytes()
+        cli.put_object("hotpool", "hot", body)
+        for _ in range(6):
+            assert cli.get_object("hotpool", "hot") == body
+        _, _, data = cli.request("GET", "/minio/admin/v1/info")
+        pool = json.loads(data)["pool"]
+        st = pool["hotcache"]
+        assert st["fills"] >= 1 and st["hits"] >= 1
+        rows = pool["workers"]
+        assert all("hotcache_hits" in r and "hotcache_misses" in r
+                   for r in rows)
+        assert sum(r["hotcache_hits"] + r["hotcache_misses"]
+                   for r in rows) >= 6
+
+
+class TestHotTierForkShare:
+    """The satellite acceptance shape, minus HTTP: two forked
+    processes over ONE pre-fork HotObjectCache segment and the same
+    drive roots.  A's fill serves B's hit; a PUT through A invalidates
+    B's cached copy via the shared generation table."""
+
+    def _run(self, fn):
+        import multiprocessing
+        ctx = multiprocessing.get_context("fork")
+        p = ctx.Process(target=fn)
+        p.start()
+        p.join(60)
+        assert p.exitcode == 0
+
+    def test_fill_hit_and_invalidation_across_fork(self, tmp_path):
+        from minio_tpu.engine.erasure_set import ErasureSet
+        from minio_tpu.engine.hotcache import (HotObjectCache,
+                                               attach_sets)
+        from minio_tpu.storage.drive import LocalDrive
+
+        es = ErasureSet([LocalDrive(str(tmp_path / f"d{i}"))
+                         for i in range(4)])
+        tier = HotObjectCache(total_bytes=16 * _MB)   # pre-fork
+        attach_sets(es, tier)
+        es.make_bucket("b")
+        rng = np.random.default_rng(29)
+        v1 = rng.integers(0, 256, size=300_000, dtype=np.uint8).tobytes()
+        v2 = rng.integers(0, 256, size=310_000, dtype=np.uint8).tobytes()
+
+        def a_put_and_warm():
+            es.put_object("b", "o", v1)
+            for _ in range(3):                        # ghost, fill, hit
+                _, got = es.get_object("b", "o")
+            assert bytes(got) == v1
+
+        self._run(a_put_and_warm)
+        st = tier.stats()                  # shared mapping: parent sees
+        assert st["fills"] == 1 and st["hits"] >= 1
+        hits0 = st["hits"]
+
+        def b_hits_a_fill():
+            _, got = es.get_object("b", "o")
+            assert bytes(got) == v1
+
+        self._run(b_hits_a_fill)
+        st = tier.stats()
+        assert st["hits"] == hits0 + 1     # B hit, and filled nothing
+        assert st["fills"] == 1
+
+        def a_overwrites():
+            es.put_object("b", "o", v2)    # _mark_dirty -> shared gen
+
+        self._run(a_overwrites)
+
+        def b_sees_v2():
+            _, got = es.get_object("b", "o")
+            assert bytes(got) == v2
+
+        self._run(b_sees_v2)
+        assert tier.stats()["stale_gen"] >= 1
+
 
 @pytest.mark.slow
 class TestPoolMatrix:

@@ -49,6 +49,42 @@ def _quantile(lat_s: list[float], q: float) -> float:
     return float(np.quantile(np.asarray(lat_s), q))
 
 
+def zipf_cdf(n: int, s: float) -> np.ndarray:
+    """CDF of a Zipf(s) distribution over ranks 1..n: P(i) ∝ 1/i^s.
+    Rank 0 is the hottest key.  Sampling = searchsorted(uniform) —
+    O(log n) per draw, no rejection (np.random.zipf is unbounded)."""
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64), s)
+    return np.cumsum(w / w.sum())
+
+
+def hot_rank_cut(n: int) -> int:
+    """Ranks [0, cut) are the 'hot' class for the SLO split: the top
+    decile (min 1 key) — under Zipf s≈1.1 it absorbs most GETs."""
+    return max(1, n // 10)
+
+
+def _zipf_pick(cdf: np.ndarray, crng) -> int:
+    return int(np.searchsorted(cdf, crng.random(), side="right"))
+
+
+def hot_cold_rows(lat_hot: list[float], lat_cold: list[float],
+                  lat_ranged: list[float]) -> dict:
+    """The SLO report rows the Zipfian runs compare: hot-key vs
+    cold-key (vs ranged) p50/p99 — the hot rows are where a RAM hot
+    tier must show up, the cold rows are where it must NOT regress."""
+    return {
+        "hot_gets": len(lat_hot),
+        "hot_p50_ms": round(_quantile(lat_hot, 0.50) * 1e3, 3),
+        "hot_p99_ms": round(_quantile(lat_hot, 0.99) * 1e3, 3),
+        "cold_gets": len(lat_cold),
+        "cold_p50_ms": round(_quantile(lat_cold, 0.50) * 1e3, 3),
+        "cold_p99_ms": round(_quantile(lat_cold, 0.99) * 1e3, 3),
+        "ranged_gets": len(lat_ranged),
+        "ranged_p50_ms": round(_quantile(lat_ranged, 0.50) * 1e3, 3),
+        "ranged_p99_ms": round(_quantile(lat_ranged, 0.99) * 1e3, 3),
+    }
+
+
 def keyspace_names(es, mode: str, total: int = 32,
                    prefix: str = "ks") -> list[str]:
     """Object names with PROVEN set placement (PR 10 device sharding):
@@ -88,13 +124,20 @@ def keyspace_names(es, mode: str, total: int = 32,
 def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
              put_frac: float = 0.5, duration_s: float = 5.0,
              bucket: str = "loadgen", warm_objects: int = 8,
-             seed: int = 0, keyspace: str = "default") -> dict:
+             seed: int = 0, keyspace: str = "default",
+             zipf: float | None = None,
+             range_frac: float = 0.0) -> dict:
     """Drive `clients` closed-loop workers against `es` for
     `duration_s`; returns aggregate GB/s, p50/p99 latency, and mean
     coalesced dispatch occupancy over the run.  `keyspace` picks the
     set-placement shape of every key touched (see keyspace_names);
     non-default modes add a per-set hit histogram and per-device lane
-    dispatch stats to the result."""
+    dispatch stats to the result.
+
+    `zipf` switches GET key choice from uniform to Zipf(s) over the
+    warm set (rank 0 hottest) and adds hot-vs-cold p50/p99 SLO rows to
+    the result; `range_frac` makes that fraction of GETs ranged
+    (random aligned window), reported as their own SLO row."""
     if not es.bucket_exists(bucket):
         es.make_bucket(bucket)
     rng = np.random.default_rng(seed)
@@ -103,6 +146,13 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
                           prefix="warm")
     for name in warm:
         es.put_object(bucket, name, body)
+    cdf = zipf_cdf(len(warm), zipf) if zipf else None
+    cut = hot_rank_cut(len(warm))
+    tier = getattr(es, "hot_tier", None) \
+        or next((t for s in getattr(es, "sets", [])
+                 if (t := getattr(s, "hot_tier", None)) is not None),
+                None)
+    tier0 = tier.stats() if tier is not None else None
     # PUT pool: placement-proven names partitioned per client (closed
     # loops overwrite within their own slice — no cross-client races).
     put_pool = keyspace_names(es, keyspace, total=max(clients * 8, 16),
@@ -116,6 +166,9 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     stop = threading.Event()
     lat_put: list[list[float]] = [[] for _ in range(clients)]
     lat_get: list[list[float]] = [[] for _ in range(clients)]
+    lat_hot: list[list[float]] = [[] for _ in range(clients)]
+    lat_cold: list[list[float]] = [[] for _ in range(clients)]
+    lat_ranged: list[list[float]] = [[] for _ in range(clients)]
     nbytes = [0] * clients
     set_hits = [dict() for _ in range(clients)]
     errors: list[BaseException] = []
@@ -128,19 +181,42 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
             while not stop.is_set():
                 is_put = crng.random() < put_frac
                 t0 = time.monotonic()
+                got_bytes = object_size
+                rank = -1
+                ranged = False
                 if is_put:
                     name = (mine[j % len(mine)] if name_set
                             else f"c{ci}-{j}")
                     es.put_object(bucket, name, body)
                     j += 1
                 else:
-                    name = warm[int(crng.integers(0, len(warm)))]
-                    _, got = es.get_object(bucket, name)
-                    if len(got) != object_size:
-                        raise AssertionError("short read")
+                    rank = (_zipf_pick(cdf, crng) if cdf is not None
+                            else int(crng.integers(0, len(warm))))
+                    name = warm[rank]
+                    ranged = (range_frac > 0
+                              and crng.random() < range_frac)
+                    if ranged:
+                        off = int(crng.integers(0, object_size))
+                        ln = int(crng.integers(
+                            1, object_size - off + 1))
+                        _, got = es.get_object(bucket, name, off, ln)
+                        got_bytes = ln
+                        if len(got) != ln:
+                            raise AssertionError("short ranged read")
+                    else:
+                        _, got = es.get_object(bucket, name)
+                        if len(got) != object_size:
+                            raise AssertionError("short read")
                 dt = time.monotonic() - t0
                 (lat_put if is_put else lat_get)[ci].append(dt)
-                nbytes[ci] += object_size
+                if not is_put:
+                    if ranged:
+                        lat_ranged[ci].append(dt)
+                    elif 0 <= rank < cut:
+                        lat_hot[ci].append(dt)
+                    else:
+                        lat_cold[ci].append(dt)
+                nbytes[ci] += got_bytes
                 if name_set:
                     s = name_set.get(name, -1)
                     set_hits[ci][s] = set_hits[ci].get(s, 0) + 1
@@ -190,7 +266,7 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     for per in set_hits:
         for s, n in per.items():
             merged_hits[s] = merged_hits.get(s, 0) + n
-    return {
+    out = {
         "clients": clients,
         "object_size": object_size,
         "ops": len(alls),
@@ -217,25 +293,50 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
         "lane_occupancy": {int(k): v for k, v
                            in sorted(lane_occupancy.items())},
     }
+    if zipf:
+        out["zipf_s"] = zipf
+        out.update(hot_cold_rows(
+            [x for per in lat_hot for x in per],
+            [x for per in lat_cold for x in per],
+            [x for per in lat_ranged for x in per]))
+    if tier0 is not None:
+        t1 = tier.stats()
+        d_hits = t1["hits"] - tier0["hits"]
+        d_miss = t1["misses"] - tier0["misses"]
+        out["hotcache_hits"] = d_hits
+        out["hotcache_misses"] = d_miss
+        out["hotcache_hit_ratio"] = (
+            round(d_hits / (d_hits + d_miss), 4)
+            if d_hits + d_miss else 0.0)
+        out["hotcache_fills"] = t1["fills"] - tier0["fills"]
+    return out
 
 
 def _http_clients_loop(endpoint: str, creds: tuple[str, str],
                        bucket: str, warm: list[str], body: bytes,
                        clients: int, put_frac: float,
                        duration_s: float, seed: int,
-                       tag_pools: bool = False) -> dict:
+                       tag_pools: bool = False,
+                       zipf: float | None = None,
+                       range_frac: float = 0.0) -> dict:
     """One load PROCESS: `clients` closed-loop threads, each with its
     own S3Client (own connections).  Returns picklable lat/byte tallies
     so --procs can merge across forks.  tag_pools reads the
     x-mtpu-pool response header off every PUT (multi-pool placement
-    histogram — --during-decom's skew evidence)."""
+    histogram — --during-decom's skew evidence); zipf/range_frac mirror
+    run_load's Zipfian GET mix."""
     from minio_tpu.server.client import S3Client
     stop = threading.Event()
     lat_put: list[list[float]] = [[] for _ in range(clients)]
     lat_get: list[list[float]] = [[] for _ in range(clients)]
+    lat_hot: list[list[float]] = [[] for _ in range(clients)]
+    lat_cold: list[list[float]] = [[] for _ in range(clients)]
+    lat_ranged: list[list[float]] = [[] for _ in range(clients)]
     nbytes = [0] * clients
     pool_hits: list[dict[str, int]] = [dict() for _ in range(clients)]
     errors: list[str] = []
+    cdf = zipf_cdf(len(warm), zipf) if zipf else None
+    cut = hot_rank_cut(len(warm))
 
     def client(ci: int) -> None:
         cli = S3Client(endpoint, creds[0], creds[1])
@@ -245,6 +346,9 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
             while not stop.is_set():
                 is_put = crng.random() < put_frac
                 t0 = time.monotonic()
+                got_bytes = len(body)
+                rank = -1
+                ranged = False
                 if is_put:
                     h = cli.put_object(bucket, f"p{seed}-c{ci}-{j}",
                                        body)
@@ -254,13 +358,33 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
                              or h.get("X-Mtpu-Pool") or "?")
                         pool_hits[ci][p] = pool_hits[ci].get(p, 0) + 1
                 else:
-                    name = warm[int(crng.integers(0, len(warm)))]
-                    got = cli.get_object(bucket, name)
-                    if len(got) != len(body):
-                        raise AssertionError("short read")
+                    rank = (_zipf_pick(cdf, crng) if cdf is not None
+                            else int(crng.integers(0, len(warm))))
+                    name = warm[rank]
+                    ranged = (range_frac > 0
+                              and crng.random() < range_frac)
+                    if ranged:
+                        off = int(crng.integers(0, len(body)))
+                        end = int(crng.integers(off, len(body)))
+                        got = cli.get_object(bucket, name,
+                                             range_=(off, end))
+                        got_bytes = end - off + 1
+                        if len(got) != got_bytes:
+                            raise AssertionError("short ranged read")
+                    else:
+                        got = cli.get_object(bucket, name)
+                        if len(got) != len(body):
+                            raise AssertionError("short read")
                 dt = time.monotonic() - t0
                 (lat_put if is_put else lat_get)[ci].append(dt)
-                nbytes[ci] += len(body)
+                if not is_put:
+                    if ranged:
+                        lat_ranged[ci].append(dt)
+                    elif 0 <= rank < cut:
+                        lat_hot[ci].append(dt)
+                    else:
+                        lat_cold[ci].append(dt)
+                nbytes[ci] += got_bytes
         except BaseException as e:  # noqa: BLE001 — surfaced below
             errors.append(f"{type(e).__name__}: {e}")
             stop.set()
@@ -279,6 +403,9 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
             merged[p] = merged.get(p, 0) + n
     return {"lat_put": [x for per in lat_put for x in per],
             "lat_get": [x for per in lat_get for x in per],
+            "lat_hot": [x for per in lat_hot for x in per],
+            "lat_cold": [x for per in lat_cold for x in per],
+            "lat_ranged": [x for per in lat_ranged for x in per],
             "nbytes": sum(nbytes), "errors": errors,
             "pool_hits": merged}
 
@@ -289,7 +416,9 @@ def run_load_http(endpoint: str, *, clients: int = 4,
                   warm_objects: int = 8, seed: int = 0, procs: int = 1,
                   access_key: str = "minioadmin",
                   secret_key: str = "minioadmin",
-                  tag_pools: bool = False) -> dict:
+                  tag_pools: bool = False,
+                  zipf: float | None = None,
+                  range_frac: float = 0.0) -> dict:
     """HTTP closed loop against a running endpoint; with procs>1 the
     `clients` are spread over that many forked client processes.
     tag_pools adds a pool_hits histogram (PUTs per placement pool,
@@ -316,7 +445,7 @@ def run_load_http(endpoint: str, *, clients: int = 4,
     if procs == 1:
         parts = [_http_clients_loop(endpoint, creds, bucket, warm, body,
                                     clients, put_frac, duration_s,
-                                    seed, tag_pools)]
+                                    seed, tag_pools, zipf, range_frac)]
     else:
         ctx = mp.get_context("fork")
         q: mp.Queue = ctx.Queue()
@@ -324,7 +453,8 @@ def run_load_http(endpoint: str, *, clients: int = 4,
         def entry(i: int, n: int) -> None:
             q.put(_http_clients_loop(endpoint, creds, bucket, warm,
                                      body, n, put_frac, duration_s,
-                                     seed + i, tag_pools))
+                                     seed + i, tag_pools, zipf,
+                                     range_frac))
 
         ps = [ctx.Process(target=entry, args=(i, n), daemon=True)
               for i, n in enumerate(per) if n]
@@ -351,6 +481,12 @@ def run_load_http(endpoint: str, *, clients: int = 4,
         "put_p50_ms": round(_quantile(puts, 0.50) * 1e3, 3),
         "get_p50_ms": round(_quantile(gets, 0.50) * 1e3, 3),
     }
+    if zipf:
+        res["zipf_s"] = zipf
+        res.update(hot_cold_rows(
+            [x for p in parts for x in p.get("lat_hot", [])],
+            [x for p in parts for x in p.get("lat_cold", [])],
+            [x for p in parts for x in p.get("lat_ranged", [])]))
     if tag_pools:
         merged: dict[str, int] = {}
         for part in parts:
@@ -422,6 +558,18 @@ def main(argv=None) -> int:
                     "erasure set (all device lanes busy); pinned: all "
                     "keys land on set 0 (one lane hot).  The output's "
                     "set_hits histogram proves the placement")
+    ap.add_argument("--zipf", type=float, nargs="?", const=1.1,
+                    default=None, metavar="S",
+                    help="Zipf(s) GET key skew over the warm set "
+                    "(rank 0 hottest; bare --zipf means s=1.1). "
+                    "Adds hot-key vs cold-key p50/p99 SLO rows — the "
+                    "split the hot-object cache must win")
+    ap.add_argument("--range-frac", type=float, default=0.0,
+                    help="fraction of GETs issued as random ranged "
+                    "reads (their own SLO row)")
+    ap.add_argument("--warm-objects", type=int, default=None,
+                    help="warm GET keyspace size (default 8, or 64 "
+                    "under --zipf so the skew has a tail)")
     ap.add_argument("--root", default="/tmp/mtpu-loadgen")
     ap.add_argument("--endpoint", default="",
                     help="http(s)://host:port — drive a RUNNING server "
@@ -457,25 +605,36 @@ def main(argv=None) -> int:
         if args.size_kib == 1024:          # only override the default
             args.size_kib = 4096
 
+    warm_objects = (args.warm_objects if args.warm_objects is not None
+                    else (64 if args.zipf else 8))
     if args.endpoint:
         res = run_load_http(args.endpoint, clients=args.clients,
                             object_size=args.size_kib << 10,
                             put_frac=args.mix,
                             duration_s=args.duration,
+                            warm_objects=warm_objects,
                             procs=args.procs,
                             access_key=args.access_key,
                             secret_key=args.secret_key,
-                            tag_pools=args.during_decom)
+                            tag_pools=args.during_decom,
+                            zipf=args.zipf,
+                            range_frac=args.range_frac)
     else:
         es = (make_sets(args.root, nsets=args.sets,
                         set_drives=args.drives, parity=args.parity)
               if args.sets > 1
               else make_set(args.root, n=args.drives,
                             parity=args.parity))
+        from minio_tpu.engine.hotcache import attach_sets, maybe_tier
+        tier = maybe_tier()
+        if tier is not None:
+            attach_sets(es, tier)
         res = run_load(es, clients=args.clients,
                        object_size=args.size_kib << 10,
                        put_frac=args.mix, duration_s=args.duration,
-                       keyspace=args.keyspace)
+                       warm_objects=warm_objects,
+                       keyspace=args.keyspace, zipf=args.zipf,
+                       range_frac=args.range_frac)
     w = max(len(k) for k in res)
     for k, v in res.items():
         print(f"{k:<{w}}  {v}")
