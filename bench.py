@@ -562,6 +562,125 @@ def hotcache_bench(duration_s: float = 3.0, object_kib: int = 1024,
     return out
 
 
+def ilm_bench(duration_s: float = 3.0, object_kib: int = 256,
+              clients: int = 4, n_objects: int = 192) -> dict:
+    """Data-temperature suite (bucket/tier.py): what tiering costs and
+    what it must not break.
+
+    Leg 1 — bulk aging: PUT n_objects, transition every one to an fs
+    warm tier through the exactly-once journal (fsync per intent),
+    report aggregate transition MB/s; the journal must drain to zero
+    and the tier must hold exactly one object per stub.
+
+    Leg 2 — restore: permanent restores timed per object (p50/p99 —
+    the "recall from cold" latency a reader pays once, after which the
+    object is hot again), byte-verified; then temporary restores whose
+    copies the scanner re-expires.  Frees flow through the journal, so
+    pending must return to zero and the tier must shrink by exactly
+    the restored count.
+
+    Leg 3 — serving: loadgen's Zipf(1.1) mix with --ilm-mix 0.25 (the
+    coldest quarter of the warm set lives behind stubs) — stub-GET
+    p50/p99 against hot p50/p99 is the read-through tax, priced under
+    live concurrent traffic, not in isolation.
+
+    n_objects is scaled for a 1-core CI host; the structure (journal
+    per transition, digest verify per copy) is what the number prices,
+    so it transfers to the reference's 100k-object runs."""
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.bucket.tier import DirTierBackend, TierManager
+    from tools.loadgen import _quantile, make_set, run_load
+
+    out: dict = {"ilm_objects": n_objects,
+                 "ilm_object_kib": object_kib}
+    size = object_kib << 10
+
+    # -- legs 1+2: bulk transition, then restores over the same set --------
+    root = tempfile.mkdtemp(prefix="mtpu-ilm-age-")
+    try:
+        es = make_set(root, n=4)
+        es.make_bucket("ilmb")
+        rng = np.random.default_rng(11)
+        body = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        for i in range(n_objects):
+            es.put_object("ilmb", f"o-{i}", body)
+        tm = TierManager(es)
+        tier_dir = os.path.join(root, "tier")
+        tm.add_tier("WARM", DirTierBackend(tier_dir))
+        t0 = time.monotonic()
+        moved = sum(1 for i in range(n_objects)
+                    if tm.transition_object("ilmb", f"o-{i}", "WARM"))
+        dt = time.monotonic() - t0
+        out["ilm_transitioned"] = moved
+        out["ilm_transition_s"] = round(dt, 3)
+        out["ilm_transition_mbps"] = round(moved * size / dt / 1e6, 1)
+        out["ilm_journal_pending_after_transition"] = \
+            tm.journal.pending()
+        out["ilm_tier_objects"] = len(os.listdir(tier_dir))
+
+        nrestore = min(32, n_objects)
+        lat: list[float] = []
+        for i in range(nrestore):
+            t0 = time.monotonic()
+            if not tm.restore_object("ilmb", f"o-{i}"):
+                raise RuntimeError(f"restore o-{i} failed")
+            lat.append(time.monotonic() - t0)
+        _, got = es.get_object("ilmb", "o-0")
+        if got != body:
+            raise RuntimeError("restored bytes differ from original")
+        for _ in range(10):                  # frees retry through the
+            if tm.journal.pending() == 0:    # journal until clean
+                break
+            tm.drain_journal()
+        out["ilm_restores"] = nrestore
+        out["ilm_restore_p50_ms"] = round(
+            _quantile(lat, 0.50) * 1e3, 3)
+        out["ilm_restore_p99_ms"] = round(
+            _quantile(lat, 0.99) * 1e3, 3)
+        out["ilm_journal_pending_after_restore"] = tm.journal.pending()
+        out["ilm_tier_objects_after_restore"] = \
+            len(os.listdir(tier_dir))
+
+        ntemp = min(8, n_objects - nrestore)
+        for i in range(nrestore, nrestore + ntemp):
+            if not tm.restore_object("ilmb", f"o-{i}", days=1):
+                raise RuntimeError(f"temp restore o-{i} failed")
+        out["ilm_temp_restores"] = ntemp
+        out["ilm_reexpired"] = tm.expire_restores(
+            "ilmb", now=time.time() + 2 * 86400)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # -- leg 3: stub-GET tax under live Zipf traffic ------------------------
+    root = tempfile.mkdtemp(prefix="mtpu-ilm-load-")
+    try:
+        es = make_set(root, n=4)
+        r = run_load(es, clients=clients, object_size=size,
+                     put_frac=0.05, duration_s=duration_s,
+                     warm_objects=64, seed=7, zipf=1.1,
+                     range_frac=0.2, ilm_mix=0.25,
+                     tier_root=os.path.join(root, "tier"))
+        out["ilm_load_gbps"] = r["gbps"]
+        out["ilm_hot_p50_ms"] = r["hot_p50_ms"]
+        out["ilm_hot_p99_ms"] = r["hot_p99_ms"]
+        out["ilm_stub_gets"] = r["stub_gets"]
+        out["ilm_stub_p50_ms"] = r["stub_p50_ms"]
+        out["ilm_stub_p99_ms"] = r["stub_p99_ms"]
+        out["ilm_journal_pending_after_load"] = \
+            r["ilm_journal_pending"]
+        if r["hot_p50_ms"]:
+            out["ilm_stub_vs_hot_p50"] = round(
+                r["stub_p50_ms"] / r["hot_p50_ms"], 2)
+            out["ilm_stub_vs_hot_p99"] = round(
+                r["stub_p99_ms"] / r["hot_p99_ms"], 2)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def decom_bench(n_objects: int = 48, object_kib: int = 256) -> dict:
     """Live-decommission suite (background/decom.py): a 2-pool engine,
     pool 0 loaded then drained through the normal write path.  Reports
@@ -1673,10 +1792,55 @@ def _hotcache_main() -> None:
         f.write(doc + "\n")
 
 
+def _ilm_main() -> None:
+    """`python bench.py ilm_bench` — data-temperature suite alone,
+    JSON to stdout and ILM_r15.json for the record."""
+    import os
+    doc = {"rc": 0, "ok": False}
+    try:
+        extras = ilm_bench()
+        doc["ok"] = (
+            extras.get("ilm_journal_pending_after_transition") == 0
+            and extras.get("ilm_journal_pending_after_restore") == 0
+            and extras.get("ilm_journal_pending_after_load") == 0
+            and extras.get("ilm_transitioned")
+            == extras.get("ilm_objects")
+            == extras.get("ilm_tier_objects")
+            and extras.get("ilm_tier_objects_after_restore")
+            == extras.get("ilm_tier_objects", 0)
+            - extras.get("ilm_restores", 0)
+            and extras.get("ilm_reexpired")
+            == extras.get("ilm_temp_restores"))
+        doc["extras"] = extras
+        doc["tail"] = (
+            f"ilm_bench {'OK' if doc['ok'] else 'VIOLATION'}: "
+            f"transition {extras.get('ilm_transition_mbps')} MB/s "
+            f"over {extras.get('ilm_transitioned')} objects, "
+            f"restore p50 {extras.get('ilm_restore_p50_ms')} ms, "
+            f"stub GET p50/p99 {extras.get('ilm_stub_p50_ms')}/"
+            f"{extras.get('ilm_stub_p99_ms')} ms vs hot "
+            f"{extras.get('ilm_hot_p50_ms')}/"
+            f"{extras.get('ilm_hot_p99_ms')} ms, journal drained "
+            f"to zero at every phase")
+    except Exception as e:  # noqa: BLE001 — the round file records it
+        doc["rc"] = 1
+        doc["tail"] = f"{type(e).__name__}: {e}"
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "ILM_r15.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc))
+    if doc["rc"] or not doc["ok"]:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     if sys.argv[1:2] == ["multichip_bench"]:
         _multichip_main()
     elif sys.argv[1:2] == ["hotcache_bench"]:
         _hotcache_main()
+    elif sys.argv[1:2] == ["ilm_bench"]:
+        _ilm_main()
     else:
         main()

@@ -143,6 +143,17 @@ class DataScanner:
         except Exception:  # noqa: BLE001 — ILM must not kill the scan
             pass
 
+    def _ilm_maintenance(self, bucket: str) -> None:
+        """Per-bucket tier upkeep the crawl drives: re-expire lapsed
+        temporary restores (the x-amz-restore window) — one crawl feeds
+        usage + heal + ILM, per ROADMAP item 5."""
+        if self.tier_mgr is None:
+            return
+        try:
+            self.tier_mgr.expire_restores(bucket)
+        except Exception:  # noqa: BLE001 — ILM must not kill the scan
+            pass
+
     def scan_cycle(self, deep: bool = False) -> DataUsage:
         t0 = time.time()
         self.stats.cycles += 1
@@ -155,6 +166,7 @@ class DataScanner:
 
         for bucket in self.pools.list_buckets():
             self._apply_lifecycle(bucket)
+            self._ilm_maintenance(bucket)
             full = (bucket in dirty or deep
                     or cycle % self.full_scan_every == 1)
             if not full and self._last_usage is not None \
@@ -201,6 +213,15 @@ class DataScanner:
                                 pass
                         if self.object_sleep:
                             time.sleep(self.object_sleep)
+
+        # One journal drain per crawl: failed tier deletes and reaped
+        # partial copies retry on the scanner's cadence, so the tier
+        # journal converges to zero without a dedicated loop.
+        if self.tier_mgr is not None:
+            try:
+                self.tier_mgr.drain_journal()
+            except Exception:  # noqa: BLE001 — ILM must not kill the scan
+                pass
 
         usage.scanned_at = time.time()
         self.stats.last_cycle_s = usage.scanned_at - t0

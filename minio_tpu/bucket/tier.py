@@ -1,63 +1,254 @@
-"""Remote tiers: warm backends, transitions, restore, delete journal.
+"""Remote tiers: warm backends, transitions, restore, tier journal.
 
-The cmd/tier*.go + cmd/warm-backend-*.go equivalent: named tiers map to
-warm backends (a remote S3 endpoint, or a directory — the test double
-the reference also effectively has via its MinIO-to-MinIO tier); the
-lifecycle transition worker moves eligible object data to the tier and
-leaves a stub version whose metadata records (tier, tier-key); GETs
-stream through transparently; restore copies the data back; deleting a
-transitioned version enqueues the tier object into a persisted journal
-replayed until the remote delete succeeds (cf. cmd/tier-journal.go).
+The cmd/tier*.go + cmd/warm-backend-*.go + cmd/tier-journal.go
+equivalent: named tiers map to warm backends (a remote S3 endpoint, a
+directory, or a second object-layer pool); the lifecycle transition
+worker moves eligible object data to the tier and leaves a stub version
+whose metadata records (tier, tier-key, size, digest); GETs stream
+through transparently; restore copies the data back — permanently, or
+temporarily with an `x-amz-restore` expiry the scanner re-expires.
+
+Durability contract (the PR 7/11 crash-matrix discipline): every
+transition appends an *intent* record to an fsynced JSONL journal
+before any byte moves, and a *done* record only after the stub is
+published; every delete-of-a-transitioned-version appends a *free*
+record before the remote delete.  Boot replay folds the journal and
+resolves every pending intent exactly once — a kill-9 anywhere in the
+window leaves either the full hot version or a valid stub + tier
+object, never a torn state, and never a tier object that no journal
+entry will ever reap.  Crash points: `ilm.{pre_stub,post_copy,
+pre_delete,checkpoint}` in utils/crashpoints.py.
+
+Memory contract: transitions, read-through and restores stream in
+bounded chunks (MTPU_ILM_CHUNK_MB, default 8 MiB) — a 1 GiB cold
+object moves through a worker in O(chunk), not O(object).
+
+Env knobs:
+  MTPU_ILM             1 (default); 0 = oracle, scanner never tiers
+  MTPU_ILM_WORKERS     transition worker lanes (default 2)
+  MTPU_ILM_CHUNK_MB    streaming chunk size (default 8)
+  MTPU_ILM_FSYNC       1 (default) fsync each journal append
+  MTPU_ILM_CKPT_EVERY  journal appends between compactions (default 256)
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import threading
+import time
 import uuid
 
 from ..storage.drive import SYS_VOL
 from ..storage.errors import ErrObjectNotFound, StorageError
+from ..utils.crashpoints import crash_point
 
 TIER_NAME_KEY = "x-mtpu-internal-tier"
 TIER_OBJ_KEY = "x-mtpu-internal-tier-key"
 TIER_SIZE_KEY = "x-mtpu-internal-tier-size"
+TIER_DIGEST_KEY = "x-mtpu-internal-tier-digest"
+TIER_TIME_KEY = "x-mtpu-internal-tier-time"
+RESTORE_EXPIRY_KEY = "x-mtpu-internal-restore-expiry"
+
+_TIER_META_KEYS = (TIER_NAME_KEY, TIER_OBJ_KEY, TIER_SIZE_KEY,
+                   TIER_DIGEST_KEY, TIER_TIME_KEY, RESTORE_EXPIRY_KEY)
+
+# Pre-JSONL whole-JSON delete journal (adopted once at boot).
 JOURNAL_PATH = "tier/journal.json"
+JOURNAL_FILE = "tier-journal.jsonl"
+
+
+class ErrTierUnavailable(StorageError):
+    """The warm backend failed mid-operation — retryable, maps to 503."""
+
+
+class ErrRestoreInProgress(StorageError):
+    """A restore of this version is already running — maps to 409."""
+
+
+def ilm_enabled() -> bool:
+    return os.environ.get("MTPU_ILM", "1") != "0"
+
+
+def ilm_workers() -> int:
+    try:
+        return max(1, int(os.environ.get("MTPU_ILM_WORKERS", "2")))
+    except ValueError:
+        return 2
+
+
+def _chunk_bytes() -> int:
+    try:
+        mb = float(os.environ.get("MTPU_ILM_CHUNK_MB", "8"))
+    except ValueError:
+        mb = 8.0
+    return max(1 << 16, int(mb * (1 << 20)))
+
+
+def _first_root(pools) -> str | None:
+    """First local drive root across the stack (the decom journal's
+    home-drive rule); None when every drive is remote/rootless."""
+    cands = list(getattr(pools, "pools", [])) or [pools]
+    for p in cands:
+        for es in getattr(p, "sets", [p]):
+            for d in getattr(es, "drives", []):
+                root = getattr(d, "root", None)
+                if d is not None and root:
+                    return root
+    return None
+
+
+def default_journal_path(pools) -> str | None:
+    root = _first_root(pools)
+    return os.path.join(root, SYS_VOL, JOURNAL_FILE) if root else None
+
+
+def _rechunk(chunks, limit: int | None = None):
+    """Re-slice a chunk stream to MTPU_ILM_CHUNK_MB granularity: the
+    engine yields whole device batches (tens of MB on large stripes),
+    but tier backends should see — and account — bounded pieces, so
+    the transition's write granularity is a knob, not an engine
+    artifact."""
+    limit = limit or _chunk_bytes()
+    for piece in chunks:
+        view = memoryview(piece)
+        for off in range(0, len(view), limit):
+            yield bytes(view[off:off + limit])
+
+
+class _ChunkReader:
+    """File-like `.read(n)` over a chunk iterator — feeds the engine's
+    streaming put path so a restore never materialises the object.  A
+    short tier stream RAISES rather than EOFing early, so the put
+    aborts (staging reaped by the recovery sweep) and the stub survives
+    intact instead of being replaced by truncated bytes."""
+
+    def __init__(self, chunks, expect_size: int | None = None):
+        self._it = iter(chunks)
+        self._buf = bytearray()
+        self._n = 0
+        self._expect = expect_size
+        self._eof = False
+
+    def read(self, n: int = -1) -> bytes:
+        while not self._eof and (n < 0 or len(self._buf) < n):
+            try:
+                piece = next(self._it)
+            except StopIteration:
+                self._eof = True
+                if self._expect is not None and self._n != self._expect:
+                    raise ErrTierUnavailable(
+                        f"tier stream truncated: got {self._n} of "
+                        f"{self._expect} bytes") from None
+                break
+            self._buf += piece
+            self._n += len(piece)
+        if n < 0:
+            out = bytes(self._buf)
+            self._buf.clear()
+        else:
+            out = bytes(self._buf[:n])
+            del self._buf[:n]
+        return out
 
 
 class DirTierBackend:
-    """Warm backend over a local directory (NAS-style tier)."""
+    """Warm backend over a local directory (NAS-style tier).
+
+    Writes are atomic (tmp + fsync + rename) so a crashed transition
+    never leaves a half-written tier object a later GET could serve;
+    reads stream in bounded chunks.  Non-ENOENT filesystem errors map
+    to ErrTierUnavailable — the tier is down, not the object missing."""
 
     def __init__(self, root: str):
-        import os
         self.root = root
         os.makedirs(root, exist_ok=True)
 
     def _p(self, key: str) -> str:
-        import os
         return os.path.join(self.root, key.replace("/", "_"))
 
     def put(self, key: str, data: bytes) -> None:
-        with open(self._p(key), "wb") as f:
-            f.write(data)
+        self.put_stream(key, (data,))
+
+    def put_stream(self, key: str, chunks) -> int:
+        path = self._p(key)
+        tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+        n = 0
+        try:
+            try:
+                with open(tmp, "wb") as f:
+                    for piece in chunks:
+                        f.write(piece)
+                        n += len(piece)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except OSError as e:
+                raise ErrTierUnavailable(f"tier write {key}: {e}") from None
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return n
 
     def get(self, key: str) -> bytes:
+        return b"".join(self.get_stream(key))
+
+    def get_stream(self, key: str, offset: int = 0, length: int = -1):
+        path = self._p(key)
+        chunk = _chunk_bytes()
         try:
-            with open(self._p(key), "rb") as f:
-                return f.read()
-        except OSError:
+            f = open(path, "rb")
+        except FileNotFoundError:
             raise ErrObjectNotFound(f"tier object {key}") from None
+        except OSError as e:
+            raise ErrTierUnavailable(f"tier read {key}: {e}") from None
+
+        def gen():
+            with f:
+                try:
+                    if offset:
+                        f.seek(offset)
+                    left = length if length is not None and length >= 0 \
+                        else None
+                    while left is None or left > 0:
+                        want = chunk if left is None else min(chunk, left)
+                        piece = f.read(want)
+                        if not piece:
+                            break
+                        if left is not None:
+                            left -= len(piece)
+                        yield piece
+                except OSError as e:
+                    raise ErrTierUnavailable(
+                        f"tier read {key}: {e}") from None
+        return gen()
+
+    def size(self, key: str) -> int:
+        try:
+            return os.stat(self._p(key)).st_size
+        except FileNotFoundError:
+            raise ErrObjectNotFound(f"tier object {key}") from None
+        except OSError as e:
+            raise ErrTierUnavailable(f"tier stat {key}: {e}") from None
 
     def delete(self, key: str) -> None:
-        import os
         try:
             os.unlink(self._p(key))
-        except OSError:
+        except FileNotFoundError:
             pass
+        except OSError as e:
+            raise ErrTierUnavailable(f"tier delete {key}: {e}") from None
 
 
 class S3TierBackend:
-    """Warm backend over a remote S3 endpoint (warm-backend-s3 role)."""
+    """Warm backend over a remote S3 endpoint (warm-backend-s3 role).
+    Reads stream through ranged GETs; writes buffer to a single PUT —
+    the stub client has no multipart writer, so the memory bound is the
+    largest single tiered object (documented limitation)."""
 
     def __init__(self, endpoint: str, access_key: str, secret_key: str,
                  bucket: str, prefix: str = "tier/"):
@@ -66,38 +257,349 @@ class S3TierBackend:
         self.bucket = bucket
         self.prefix = prefix
 
+    def _err(self, e, key: str) -> StorageError:
+        if getattr(e, "status", 0) == 404:
+            return ErrObjectNotFound(f"tier object {key}")
+        return ErrTierUnavailable(f"tier s3 {key}: {e}")
+
     def put(self, key: str, data: bytes) -> None:
-        self.cli.put_object(self.bucket, self.prefix + key, data)
+        from ..server.client import S3ClientError
+        try:
+            self.cli.put_object(self.bucket, self.prefix + key, data)
+        except S3ClientError as e:
+            raise self._err(e, key) from None
+
+    def put_stream(self, key: str, chunks) -> int:
+        data = b"".join(chunks)
+        self.put(key, data)
+        return len(data)
 
     def get(self, key: str) -> bytes:
         from ..server.client import S3ClientError
         try:
             return self.cli.get_object(self.bucket, self.prefix + key)
-        except S3ClientError:
-            raise ErrObjectNotFound(f"tier object {key}") from None
+        except S3ClientError as e:
+            raise self._err(e, key) from None
+
+    def get_stream(self, key: str, offset: int = 0, length: int = -1):
+        from ..server.client import S3ClientError
+        total = self.size(key)
+        end = total if (length is None or length < 0) \
+            else min(total, offset + length)
+
+        def gen():
+            pos = offset
+            chunk = _chunk_bytes()
+            while pos < end:
+                hi = min(end, pos + chunk) - 1
+                try:
+                    piece = self.cli.get_object(
+                        self.bucket, self.prefix + key, range_=(pos, hi))
+                except S3ClientError as e:
+                    raise self._err(e, key) from None
+                if not piece:
+                    break
+                yield piece
+                pos += len(piece)
+        return gen()
+
+    def size(self, key: str) -> int:
+        from ..server.client import S3ClientError
+        try:
+            h = self.cli.head_object(self.bucket, self.prefix + key)
+        except S3ClientError as e:
+            raise self._err(e, key) from None
+        items = h.items() if hasattr(h, "items") else h
+        for hk, hv in items:
+            if str(hk).lower() == "content-length":
+                return int(hv)
+        return len(self.get(key))
 
     def delete(self, key: str) -> None:
         from ..server.client import S3ClientError
         try:
             self.cli.delete_object(self.bucket, self.prefix + key)
-        except S3ClientError:
+        except S3ClientError as e:
+            if getattr(e, "status", 0) != 404:
+                raise ErrTierUnavailable(f"tier s3 {key}: {e}") from None
+
+
+class PoolTierBackend:
+    """Warm backend over another object layer — the second-local-pool
+    tier: cold bytes live in a dedicated bucket of a separate pool
+    stack and get erasure coding + bitrot-verified reads for free (the
+    reference's MinIO-to-MinIO warm backend, cmd/warm-backend-minio.go)."""
+
+    TIER_BUCKET = "mtpu-tier"
+
+    def __init__(self, layer, bucket: str | None = None):
+        self.layer = layer
+        self.bucket = bucket or self.TIER_BUCKET
+        try:
+            self.layer.make_bucket(self.bucket)
+        except StorageError:
+            pass                         # already exists
+
+    def put(self, key: str, data: bytes) -> None:
+        self.put_stream(key, (data,))
+
+    def put_stream(self, key: str, chunks) -> int:
+        try:
+            fi = self.layer.put_object(self.bucket, key,
+                                       _ChunkReader(chunks), metadata={})
+        except ErrTierUnavailable:
+            raise
+        except StorageError as e:
+            raise ErrTierUnavailable(f"pool tier write {key}: {e}") \
+                from None
+        return fi.size
+
+    def get(self, key: str) -> bytes:
+        return b"".join(self.get_stream(key))
+
+    def get_stream(self, key: str, offset: int = 0, length: int = -1):
+        try:
+            if hasattr(self.layer, "get_object_iter"):
+                _, it = self.layer.get_object_iter(self.bucket, key,
+                                                   offset, length)
+                return it
+            _, data = self.layer.get_object(self.bucket, key, offset,
+                                            length)
+            return iter((data,)) if data else iter(())
+        except ErrObjectNotFound:
+            raise
+        except StorageError as e:
+            raise ErrTierUnavailable(f"pool tier read {key}: {e}") \
+                from None
+
+    def size(self, key: str) -> int:
+        try:
+            return self.layer.head_object(self.bucket, key).size
+        except ErrObjectNotFound:
+            raise
+        except StorageError as e:
+            raise ErrTierUnavailable(f"pool tier stat {key}: {e}") \
+                from None
+
+    def delete(self, key: str) -> None:
+        try:
+            self.layer.delete_object(self.bucket, key)
+        except ErrObjectNotFound:
             pass
+        except StorageError as e:
+            raise ErrTierUnavailable(f"pool tier delete {key}: {e}") \
+                from None
+
+
+class ChaosTierBackend:
+    """Seeded fault/latency injection around any tier backend (the
+    ChaosDrive discipline, storage/chaos.py): one RNG draw per fault
+    class per call, UNCONDITIONALLY, so the fault schedule is a pure
+    function of (seed, call order) and a failing run replays exactly."""
+
+    def __init__(self, backend, seed: int = 0, error_rate: float = 0.0,
+                 slow_rate: float = 0.0, slow_s: float = 0.02):
+        import random
+        self.backend = backend
+        self.error_rate = error_rate
+        self.slow_rate = slow_rate
+        self.slow_s = slow_s
+        self.injected = {"errors": 0, "slows": 0}
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+
+    @property
+    def root(self):
+        return getattr(self.backend, "root", None)
+
+    def chaos_off(self) -> None:
+        self.error_rate = self.slow_rate = 0.0
+
+    def _weather(self, op: str) -> None:
+        with self._mu:
+            err, slow = self._rng.random(), self._rng.random()
+        if slow < self.slow_rate:
+            self.injected["slows"] += 1
+            time.sleep(self.slow_s)
+        if err < self.error_rate:
+            self.injected["errors"] += 1
+            raise ErrTierUnavailable(f"chaos: injected tier fault ({op})")
+
+    def put(self, key, data):
+        self._weather("put")
+        return self.backend.put(key, data)
+
+    def put_stream(self, key, chunks):
+        self._weather("put")
+        return self.backend.put_stream(key, chunks)
+
+    def get(self, key):
+        self._weather("get")
+        return self.backend.get(key)
+
+    def get_stream(self, key, offset=0, length=-1):
+        self._weather("get")             # eager: fail before streaming
+        return self.backend.get_stream(key, offset, length)
+
+    def size(self, key):
+        self._weather("size")
+        return self.backend.size(key)
+
+    def delete(self, key):
+        self._weather("delete")
+        return self.backend.delete(key)
+
+
+class TierJournal:
+    """Crash-replayable fsynced JSONL journal for transitions and tier
+    deletes (cmd/tier-journal.go role; decom/MRF journal discipline).
+
+    Records, folded to net state at load:
+      {"op":"intent","tkey",...}  transition begun: tier copy MAY exist
+      {"op":"done","tkey"}        transition resolved (stub or rollback)
+      {"op":"free","tier","tkey"} tier object awaiting remote delete
+      {"op":"freed","tkey"}       remote delete confirmed
+      {"op":"ckpt",...}           atomic compaction (tmp+fsync+replace)
+
+    A torn trailing line (kill-9 mid-append) is skipped on load; an
+    OSError on append degrades to memory-only (replay re-derives
+    correctness from the namespace, like the decom journal)."""
+
+    def __init__(self, path: str | None, fsync: bool | None = None,
+                 ckpt_every: int | None = None):
+        self.path = path
+        self._fsync = (os.environ.get("MTPU_ILM_FSYNC", "1") != "0"
+                       if fsync is None else fsync)
+        if ckpt_every is None:
+            try:
+                ckpt_every = int(os.environ.get("MTPU_ILM_CKPT_EVERY",
+                                                "256"))
+            except ValueError:
+                ckpt_every = 256
+        self.ckpt_every = max(1, ckpt_every)
+        self._mu = threading.Lock()
+        self._jf = None
+        self._since_ckpt = 0
+        self.intents: dict[str, dict] = {}
+        self.frees: dict[str, dict] = {}
+        self.torn_lines = 0
+        if self.path:
+            self._load()
+
+    def _load(self) -> None:
+        try:
+            f = open(self.path, "r", encoding="utf-8")
+        except OSError:
+            return
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    self.torn_lines += 1
+                    continue
+                self._fold(rec)
+
+    def _fold(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "intent":
+            self.intents[rec["tkey"]] = rec
+        elif op == "done":
+            self.intents.pop(rec.get("tkey"), None)
+        elif op == "free":
+            self.frees[rec["tkey"]] = rec
+        elif op == "freed":
+            self.frees.pop(rec.get("tkey"), None)
+        elif op == "ckpt":
+            self.intents = {r["tkey"]: r for r in rec.get("intents", [])}
+            self.frees = {r["tkey"]: r for r in rec.get("frees", [])}
+
+    def _append_locked(self, rec: dict) -> None:
+        if not self.path:
+            return
+        try:
+            if self._jf is None:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._jf = open(self.path, "a", encoding="utf-8")
+            self._jf.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._jf.flush()
+            if self._fsync:
+                os.fsync(self._jf.fileno())
+        except OSError:
+            self._jf = None
+
+    def record(self, rec: dict) -> None:
+        """Fold into memory AND durably append — the append happens
+        BEFORE the caller proceeds (write-ahead)."""
+        with self._mu:
+            self._fold(rec)
+            self._append_locked(rec)
+            self._since_ckpt += 1
+            if self._since_ckpt >= self.ckpt_every:
+                self._checkpoint_locked()
+
+    def checkpoint(self) -> None:
+        with self._mu:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> None:
+        self._since_ckpt = 0
+        if not self.path:
+            return
+        rec = {"op": "ckpt",
+               "intents": list(self.intents.values()),
+               "frees": list(self.frees.values())}
+        tmp = self.path + ".tmp"
+        try:
+            if self._jf is not None:
+                self._jf.close()
+                self._jf = None
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+    def pending(self) -> int:
+        with self._mu:
+            return len(self.intents) + len(self.frees)
 
 
 class TierManager:
-    def __init__(self, pools, kms=None):
+    def __init__(self, pools, kms=None, journal_path: str | None = None):
         self.pools = pools
         if kms is None:
             from ..crypto.kms import kms_from_env
             kms = kms_from_env()
         self.kms = kms
-        self._mu = threading.Lock()
+        self._mu = threading.Lock()      # tier registry + config RMW
+        self._smu = threading.Lock()     # counters + in-flight guards
         self._tiers: dict[str, object] = {}
-        self._journal: list[dict] = []
-        self._load_journal()
+        self._inflight: set[str] = set()          # tkeys mid-transition
+        self._restoring: set[tuple] = set()       # (bucket,key,vid)
+        self.counters = {
+            "transitioned": 0, "transition_bytes": 0,
+            "transition_errors": 0,
+            "restored": 0, "restore_bytes": 0, "restore_errors": 0,
+            "restore_expired": 0,
+            "read_through": 0, "read_through_bytes": 0,
+            "freed": 0, "orphans_reaped": 0, "replayed": 0,
+        }
+        self.per_tier: dict[str, dict] = {}       # TIER -> objects/bytes
+        self.journal = TierJournal(
+            journal_path if journal_path is not None
+            else default_journal_path(pools))
+        self._adopt_legacy_journal()
         # Re-register tiers persisted by add_tier(config=...) so
-        # transitioned objects survive a service restart.
+        # transitioned objects survive a service restart, THEN resolve
+        # whatever the journal says a crash left half-done.
         self.load_persisted_tiers()
+        self.replay_boot()
 
     # -- registry ------------------------------------------------------------
 
@@ -123,6 +625,27 @@ class TierManager:
                 self._persist_config(key, config)
             self._tiers[key] = backend
 
+    def remove_tier(self, name: str) -> bool:
+        """Unregister a tier.  Refused while transitioned objects may
+        still reference it — the journal carries its pending work."""
+        key = name.upper()
+        with self._smu:
+            busy = any(r.get("tier") == key
+                       for r in list(self.journal.intents.values())
+                       + list(self.journal.frees.values()))
+        if busy:
+            raise ValueError(
+                f"tier {name!r} has pending journal work; drain first")
+        with self._mu:
+            if key not in self._tiers:
+                return False
+            del self._tiers[key]
+            configs = self._load_configs(strict=True)
+            if key in configs:
+                del configs[key]
+                self._persist_configs(configs)
+        return True
+
     _SECRET_FIELDS = ("accessKey", "secretKey", "sessionToken")
 
     def _persist_config(self, name: str, config: dict) -> None:
@@ -131,6 +654,9 @@ class TierManager:
         # tier's still-recoverable sealed registration.
         configs = self._load_configs(strict=True)
         configs[name] = config
+        self._persist_configs(configs)
+
+    def _persist_configs(self, configs: dict) -> None:
         # Tier configs carry remote credentials; the reference persists
         # them sealed with the cluster KMS (cmd/tier.go saveTierConfig).
         # Refuse to write credentials in the clear when no KMS is
@@ -207,6 +733,12 @@ class TierManager:
                                             cfg["accessKey"],
                                             cfg["secretKey"],
                                             cfg["bucket"])
+                elif kind == "pool":
+                    # Same-process pool tier: cold bucket on our own
+                    # object layer (a dedicated pool in multi-pool
+                    # deployments via placement policy).
+                    backend = PoolTierBackend(self.pools,
+                                              cfg.get("bucket"))
                 else:
                     continue
                 self.add_tier(name, backend, replace=True)
@@ -226,50 +758,299 @@ class TierManager:
         with self._mu:
             return sorted(self._tiers)
 
-    # -- transition / read-through / restore ---------------------------------
+    # -- counters ------------------------------------------------------------
 
-    def transition_object(self, bucket: str, key: str, tier: str) -> None:
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._smu:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def _tier_acct(self, tier: str, dobj: int, dbytes: int) -> None:
+        with self._smu:
+            t = self.per_tier.setdefault(tier.upper(),
+                                         {"objects": 0, "bytes": 0})
+            t["objects"] = max(0, t["objects"] + dobj)
+            t["bytes"] = max(0, t["bytes"] + dbytes)
+
+    def stats(self) -> dict:
+        with self._smu:
+            out = dict(self.counters)
+            out["tiers"] = {t: dict(v) for t, v in self.per_tier.items()}
+        out["journal_pending"] = self.journal.pending()
+        out["names"] = self.list_tiers()
+        out["enabled"] = ilm_enabled()
+        out["workers"] = ilm_workers()
+        return out
+
+    def _mark_dirty(self, bucket: str) -> None:
+        """Bump every set's mutation generation for `bucket` — the hot
+        cache and FileInfo cache must never serve pre-replay bytes
+        after a journal-replayed mutation (PR 14 audit discipline).
+        The normal put/delete paths bump it inside the engine; this is
+        for replay-time resolutions that bypass those paths."""
+        for p in getattr(self.pools, "pools", [self.pools]):
+            for es in getattr(p, "sets", [p]):
+                md = getattr(es, "_mark_dirty", None)
+                if md is not None:
+                    try:
+                        md(bucket)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    # -- transition ----------------------------------------------------------
+
+    def _get_iter(self, bucket: str, key: str, version_id: str = ""):
+        """(fi, chunk iterator) through the engine's verified read path
+        — transition sources are bitrot-checked, PR 14 taint rules."""
+        if hasattr(self.pools, "get_object_iter"):
+            return self.pools.get_object_iter(bucket, key,
+                                              version_id=version_id)
+        fi, data = self.pools.get_object(bucket, key,
+                                         version_id=version_id)
+        return fi, (iter((data,)) if data else iter(()))
+
+    def transition_object(self, bucket: str, key: str, tier: str,
+                          version_id: str = "") -> bool:
         """Move the current version's data to the tier, leave a stub
-        (cf. TransitionObject, cmd/erasure-object.go:1556)."""
+        (cf. TransitionObject, cmd/erasure-object.go:1556).
+
+        Exactly-once protocol: journal intent (fsync) -> stream-copy
+        hot->tier -> verify the tier copy by digest -> publish the stub
+        IN PLACE (same version id, mod_time+1 so the engine's
+        preserved-timestamp guard refuses to clobber a newer racing
+        client write) -> journal done.  Any crash in the window is
+        resolved by boot replay; any tier failure leaves the intent
+        pending for drain_journal to reap."""
         backend = self.get_tier(tier)
-        fi, data = self.pools.get_object(bucket, key)
-        if fi.metadata.get(TIER_NAME_KEY):
-            return                              # already transitioned
-        tier_key = f"{bucket}/{uuid.uuid4().hex}"
-        backend.put(tier_key, data)
-        meta = dict(fi.metadata)
-        meta[TIER_NAME_KEY] = tier.upper()
-        meta[TIER_OBJ_KEY] = tier_key
-        meta[TIER_SIZE_KEY] = str(len(data))
-        # Stub version: empty data, same etag/user metadata.
-        self.pools.put_object(bucket, key, b"", metadata=meta)
+        fi, chunks = self._get_iter(bucket, key, version_id)
+        if self.is_transitioned(fi):
+            return False
+        if fi.size == 0:
+            return False                 # stubs are zero-byte already
+        tkey = f"{bucket}/{uuid.uuid4().hex}"
+        with self._smu:
+            self._inflight.add(tkey)
+        try:
+            self.journal.record({
+                "op": "intent", "tkey": tkey, "tier": tier.upper(),
+                "bucket": bucket, "key": key,
+                "vid": fi.version_id or "", "size": fi.size})
+            crash_point("ilm.pre_stub")
+            digest = hashlib.blake2b(digest_size=16)
+            copied = {"n": 0}
+
+            def hashed():
+                for piece in _rechunk(chunks):
+                    digest.update(piece)
+                    copied["n"] += len(piece)
+                    yield piece
+
+            try:
+                backend.put_stream(tkey, hashed())
+                # Verify the tier copy BEFORE the hot bytes are
+                # replaced — the decom mover's dest-verify discipline.
+                vh = hashlib.blake2b(digest_size=16)
+                vn = 0
+                for piece in backend.get_stream(tkey):
+                    vh.update(piece)
+                    vn += len(piece)
+                if vn != copied["n"] or vh.digest() != digest.digest():
+                    raise ErrTierUnavailable(
+                        f"tier {tier}: copy verify failed for "
+                        f"{bucket}/{key} ({vn} vs {copied['n']} bytes)")
+            except StorageError:
+                self._bump("transition_errors")
+                # Intent stays journaled: drain_journal / boot replay
+                # reaps whatever partial copy the tier holds.
+                raise
+            crash_point("ilm.post_copy")
+            meta = dict(fi.metadata)
+            meta[TIER_NAME_KEY] = tier.upper()
+            meta[TIER_OBJ_KEY] = tkey
+            meta[TIER_SIZE_KEY] = str(copied["n"])
+            meta[TIER_DIGEST_KEY] = digest.hexdigest()
+            meta[TIER_TIME_KEY] = str(time.time())
+            new = self.pools.put_object(
+                bucket, key, b"", metadata=meta,
+                versioned=bool(fi.version_id),
+                version_id=fi.version_id or None,
+                mod_time_ns=fi.mod_time_ns + 1)
+            if new.metadata.get(TIER_OBJ_KEY) != tkey:
+                # A racing client write won the slot — its bytes are
+                # newer and our tier copy is garbage; reap it.  If the
+                # reap fails the intent stays pending and drain gets it.
+                try:
+                    backend.delete(tkey)
+                    self.journal.record({"op": "done", "tkey": tkey})
+                except StorageError:
+                    pass
+                return False
+            crash_point("ilm.checkpoint")
+            self.journal.record({"op": "done", "tkey": tkey})
+            self._bump("transitioned")
+            self._bump("transition_bytes", copied["n"])
+            self._tier_acct(tier, +1, copied["n"])
+            return True
+        finally:
+            with self._smu:
+                self._inflight.discard(tkey)
+
+    # -- read-through / restore ----------------------------------------------
 
     def is_transitioned(self, fi) -> bool:
         return bool(fi.metadata.get(TIER_NAME_KEY))
 
-    def read_through(self, fi) -> bytes:
+    def restore_fresh(self, fi, now: float | None = None) -> bool:
+        """True when a temporarily-restored copy is live in the hot
+        store — the stub carries the full body (size > 0) and the
+        restore has not expired.  Serve it directly, no tier round
+        trip."""
+        if not self.is_transitioned(fi) or fi.size == 0:
+            return False
+        exp = fi.metadata.get(RESTORE_EXPIRY_KEY)
+        if not exp:
+            return True
+        try:
+            return float(exp) > (time.time() if now is None else now)
+        except ValueError:
+            return False
+
+    def restore_expiry(self, fi) -> float | None:
+        exp = fi.metadata.get(RESTORE_EXPIRY_KEY)
+        try:
+            return float(exp) if exp else None
+        except ValueError:
+            return None
+
+    def read_through_iter(self, fi, offset: int = 0, length: int = -1):
+        """Stream a transitioned version's bytes from its tier in
+        bounded chunks.  Full reads digest-verify at EOF — a corrupt
+        tier copy raises instead of EOFing clean, so a buffered caller
+        errors and a restore aborts (ranged reads cannot verify; the
+        backend's own integrity applies)."""
         backend = self.get_tier(fi.metadata[TIER_NAME_KEY])
-        return backend.get(fi.metadata[TIER_OBJ_KEY])
+        tkey = fi.metadata[TIER_OBJ_KEY]
+        expect = fi.metadata.get(TIER_DIGEST_KEY)
+        size = int(fi.metadata.get(TIER_SIZE_KEY, "0") or 0)
+        self._bump("read_through")
+        full = offset == 0 and (length is None or length < 0)
+
+        def gen():
+            h = hashlib.blake2b(digest_size=16) \
+                if (full and expect) else None
+            n = 0
+            for piece in backend.get_stream(tkey, offset, length):
+                if h is not None:
+                    h.update(piece)
+                n += len(piece)
+                yield piece
+            if h is not None and (n != size
+                                  or h.hexdigest() != expect):
+                raise ErrTierUnavailable(
+                    f"tier object {tkey}: digest verify failed "
+                    f"({n} of {size} bytes)")
+            self._bump("read_through_bytes", n)
+        return gen()
+
+    def read_through(self, fi) -> bytes:
+        return b"".join(self.read_through_iter(fi))
 
     def restore_object(self, bucket: str, key: str,
-                       version_id: str = "") -> bool:
+                       version_id: str = "",
+                       days: float | None = None) -> bool:
         """Copy tiered data back into the hot store (PostRestoreObject).
+
+        days=None: permanent restore — tier metadata is stripped and
+        the tier object freed through the journal (the pre-existing
+        behaviour).  days=N: temporary restore — the stub keeps its
+        tier pointers, gains an expiry the scanner re-expires, and the
+        body comes back hot (`x-amz-restore` semantics).
+
         Returns False when the targeted version is not transitioned —
         callers map that to InvalidObjectState, like S3 does for a
-        restore of a non-archived object."""
+        restore of a non-archived object.  A concurrent restore of the
+        same version raises ErrRestoreInProgress (409)."""
         fi = self.pools.head_object(bucket, key, version_id)
         if not self.is_transitioned(fi):
             return False
-        data = self.read_through(fi)
-        meta = {k: v for k, v in fi.metadata.items()
-                if k not in (TIER_NAME_KEY, TIER_OBJ_KEY, TIER_SIZE_KEY)}
-        self.pools.put_object(bucket, key, data, metadata=meta)
-        self.enqueue_delete(fi.metadata[TIER_NAME_KEY],
-                            fi.metadata[TIER_OBJ_KEY])
-        self.drain_journal()
-        return True
+        rkey = (bucket, key, fi.version_id or "")
+        with self._smu:
+            if rkey in self._restoring:
+                raise ErrRestoreInProgress(
+                    f"restore of {bucket}/{key} already in progress")
+            self._restoring.add(rkey)
+        try:
+            tier = fi.metadata[TIER_NAME_KEY]
+            tkey = fi.metadata[TIER_OBJ_KEY]
+            size = int(fi.metadata.get(TIER_SIZE_KEY, "0") or 0)
+            if days is None:
+                meta = {k: v for k, v in fi.metadata.items()
+                        if k not in _TIER_META_KEYS}
+            else:
+                meta = dict(fi.metadata)
+                meta[RESTORE_EXPIRY_KEY] = str(time.time()
+                                               + days * 86400.0)
+            reader = _ChunkReader(self.read_through_iter(fi),
+                                  expect_size=size)
+            try:
+                new = self.pools.put_object(
+                    bucket, key, reader, metadata=meta,
+                    versioned=bool(fi.version_id),
+                    version_id=fi.version_id or None,
+                    mod_time_ns=fi.mod_time_ns + 1)
+            except StorageError:
+                self._bump("restore_errors")
+                raise
+            landed = new.mod_time_ns == fi.mod_time_ns + 1
+            if not landed:
+                # A racing client write superseded the stub; the
+                # overwrite hook already freed the tier object.
+                return True
+            self._bump("restored")
+            self._bump("restore_bytes", size)
+            if days is None:
+                self.enqueue_delete(tier, tkey, size)
+                self.drain_journal()
+            return True
+        finally:
+            with self._smu:
+                self._restoring.discard(rkey)
 
-    # -- delete journal (cf. cmd/tier-journal.go) ----------------------------
+    def expire_restores(self, bucket: str,
+                        now: float | None = None) -> int:
+        """Scanner hook: re-expire temporary restores whose window
+        passed — the stub is rewritten empty (tier pointers kept, body
+        dropped) and the next GET streams from the tier again."""
+        now = time.time() if now is None else now
+        try:
+            infos = self.pools.list_objects(bucket, max_keys=1000000)
+        except StorageError:
+            return 0
+        expired = 0
+        for fi in infos:
+            exp = fi.metadata.get(RESTORE_EXPIRY_KEY)
+            if (not exp or not self.is_transitioned(fi)
+                    or fi.size == 0):
+                continue
+            try:
+                if float(exp) > now:
+                    continue
+            except ValueError:
+                pass
+            meta = dict(fi.metadata)
+            meta.pop(RESTORE_EXPIRY_KEY, None)
+            try:
+                self.pools.put_object(
+                    bucket, fi.name, b"", metadata=meta,
+                    versioned=bool(fi.version_id),
+                    version_id=fi.version_id or None,
+                    mod_time_ns=fi.mod_time_ns + 1)
+            except StorageError:
+                continue
+            expired += 1
+            self._bump("restore_expired")
+        return expired
+
+    # -- journal plumbing (sys-volume files) ---------------------------------
 
     def _write_sys(self, path: str, payload: bytes) -> None:
         for pool in getattr(self.pools, "pools", []):
@@ -308,79 +1089,178 @@ class TierManager:
                 "errors seen); refusing to treat as absent")
         return None
 
-    def _save_journal(self) -> None:
-        payload = json.dumps(self._journal).encode()
-        for pool in getattr(self.pools, "pools", []):
-            for es in getattr(pool, "sets", [pool]):
-                try:
-                    for d in es.drives:
-                        if d is not None:
-                            d.write_all(SYS_VOL, JOURNAL_PATH, payload)
-                    return
-                except StorageError:
-                    continue
+    def _adopt_legacy_journal(self) -> None:
+        """One-time adoption of the pre-JSONL whole-JSON delete journal
+        — its entries become `free` records so nothing queued before
+        the format change is ever orphaned."""
+        try:
+            raw = self._read_sys(JOURNAL_PATH)
+            entries = json.loads(raw) if raw else []
+        except Exception:  # noqa: BLE001
+            entries = []
+        if not isinstance(entries, list) or not entries:
+            return
+        for e in entries:
+            try:
+                self.journal.record({"op": "free",
+                                     "tier": str(e["tier"]).upper(),
+                                     "tkey": e["key"]})
+            except (KeyError, TypeError):
+                continue
+        self._write_sys(JOURNAL_PATH, b"[]")
 
-    def _load_journal(self) -> None:
-        for pool in getattr(self.pools, "pools", []):
-            for es in getattr(pool, "sets", [pool]):
-                for d in es.drives:
-                    if d is None:
-                        continue
-                    try:
-                        self._journal = json.loads(
-                            d.read_all(SYS_VOL, JOURNAL_PATH))
-                        return
-                    except (StorageError, ValueError):
-                        continue
+    # -- journal replay / drain ----------------------------------------------
 
-    def enqueue_delete(self, tier: str, tier_key: str) -> None:
-        with self._mu:
-            self._journal.append({"tier": tier, "key": tier_key})
-        self._save_journal()
+    def replay_boot(self) -> dict:
+        """Resolve everything a crash left half-done, exactly once:
+        a pending intent whose stub published rolls FORWARD (done); one
+        whose stub never published means the hot version is intact and
+        the tier copy (if any) is an orphan — reap it.  Pending frees
+        retry their remote delete.  Ends with a compacting checkpoint
+        so the journal drains to zero."""
+        out = {"rolled_forward": 0, "orphans_reaped": 0, "freed": 0}
+        with self.journal._mu:
+            intents = list(self.journal.intents.items())
+        for tkey, rec in intents:
+            res = self._resolve_intent(tkey, rec)
+            if res == "forward":
+                out["rolled_forward"] += 1
+            elif res == "reaped":
+                out["orphans_reaped"] += 1
+        out["freed"] = self._drain_frees()
+        replayed = sum(out.values())
+        if replayed:
+            self._bump("replayed", replayed)
+            self.journal.checkpoint()
+        return out
+
+    def _resolve_intent(self, tkey: str, rec: dict) -> str:
+        with self._smu:
+            if tkey in self._inflight:
+                return "pending"         # a live transition owns it
+        try:
+            backend = self.get_tier(rec.get("tier", ""))
+        except StorageError:
+            return "pending"             # tier not registered (yet)
+        bucket, key = rec.get("bucket", ""), rec.get("key", "")
+        stub_live = False
+        try:
+            fi = self.pools.head_object(bucket, key,
+                                        rec.get("vid", "") or "")
+            stub_live = fi.metadata.get(TIER_OBJ_KEY) == tkey
+        except StorageError:
+            stub_live = False
+        if stub_live:
+            # Stub published before the crash: the transition
+            # completed; roll forward.
+            self.journal.record({"op": "done", "tkey": tkey})
+            self._tier_acct(rec.get("tier", ""), +1,
+                            int(rec.get("size", 0) or 0))
+            self._mark_dirty(bucket)
+            return "forward"
+        try:
+            backend.delete(tkey)         # idempotent: absent is fine
+        except StorageError:
+            return "pending"             # tier unreachable; retry later
+        self.journal.record({"op": "done", "tkey": tkey})
+        self._bump("orphans_reaped")
+        if bucket:
+            self._mark_dirty(bucket)
+        return "reaped"
+
+    def _drain_frees(self) -> int:
+        done = 0
+        with self.journal._mu:
+            frees = list(self.journal.frees.items())
+        for tkey, rec in frees:
+            try:
+                backend = self.get_tier(rec.get("tier", ""))
+            except StorageError:
+                continue
+            crash_point("ilm.pre_delete")
+            try:
+                backend.delete(tkey)
+            except ErrObjectNotFound:
+                pass
+            except StorageError:
+                continue                 # stays queued; retried later
+            self.journal.record({"op": "freed", "tkey": tkey})
+            done += 1
+            self._bump("freed")
+            self._tier_acct(rec.get("tier", ""), -1,
+                            -int(rec.get("size", 0) or 0))
+        return done
+
+    def enqueue_delete(self, tier: str, tier_key: str,
+                       size: int = 0) -> None:
+        self.journal.record({"op": "free", "tier": tier.upper(),
+                             "tkey": tier_key, "size": size})
 
     def drain_journal(self) -> int:
-        """Replay pending tier deletes; survivors stay queued."""
-        with self._mu:
-            pending = list(self._journal)
-        done = 0
-        remaining = []
-        for entry in pending:
-            try:
-                self.get_tier(entry["tier"]).delete(entry["key"])
-                done += 1
-            except StorageError:
-                remaining.append(entry)
-        with self._mu:
-            self._journal = remaining
-        self._save_journal()
-        return done
+        """Replay pending tier work: frees retry their remote delete,
+        and pending intents from FAILED transitions (tier fault mid-
+        copy) get their partial tier copies reaped.  Survivors stay
+        queued.  Returns the number of frees completed."""
+        with self.journal._mu:
+            intents = list(self.journal.intents.items())
+        for tkey, rec in intents:
+            self._resolve_intent(tkey, rec)
+        return self._drain_frees()
 
     def on_version_deleted(self, fi) -> None:
         """Hook: a transitioned version was removed from the hot store."""
         if self.is_transitioned(fi):
-            self.enqueue_delete(fi.metadata[TIER_NAME_KEY],
-                                fi.metadata[TIER_OBJ_KEY])
+            self.enqueue_delete(
+                fi.metadata[TIER_NAME_KEY], fi.metadata[TIER_OBJ_KEY],
+                int(fi.metadata.get(TIER_SIZE_KEY, "0") or 0))
             self.drain_journal()
 
 
 def run_transitions(pools, bucket: str, lc, tier_mgr: TierManager,
-                    now: float | None = None) -> int:
-    """Apply lifecycle transition actions (initBackgroundTransition role,
-    cmd/bucket-lifecycle.go:213)."""
+                    now: float | None = None,
+                    workers: int | None = None) -> int:
+    """Apply lifecycle transition actions (initBackgroundTransition
+    role, cmd/bucket-lifecycle.go:213): gather eligible versions from
+    one namespace listing, then move them on a bounded worker pool
+    (MTPU_ILM_WORKERS).  MTPU_ILM=0 is the oracle — nothing tiers."""
+    if not ilm_enabled():
+        return 0
     from .lifecycle import _object_tags
-    moved = 0
     try:
         infos = pools.list_objects(bucket, max_keys=1000000)
     except StorageError:
         return 0
+    cands: list[tuple[str, str]] = []
     for fi in infos:
+        if fi.metadata.get(TIER_NAME_KEY):
+            continue                     # already transitioned
         action = lc.eval(fi.name, fi.mod_time_ns,
                          tags=_object_tags(fi), now=now)
         if action.startswith("transition:"):
-            tier = action.split(":", 1)[1]
-            try:
-                tier_mgr.transition_object(bucket, fi.name, tier)
-                moved += 1
-            except StorageError:
-                continue
-    return moved
+            cands.append((fi.name, action.split(":", 1)[1]))
+    if not cands:
+        return 0
+    if workers is None:
+        workers = ilm_workers()
+    workers = max(1, min(workers, len(cands)))
+    moved = [0]
+    mu = threading.Lock()
+
+    def one(item: tuple[str, str]) -> None:
+        name, tier = item
+        try:
+            if tier_mgr.transition_object(bucket, name, tier):
+                with mu:
+                    moved[0] += 1
+        except StorageError:
+            pass                         # journal reaps; next scan retries
+
+    if workers == 1:
+        for item in cands:
+            one(item)
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="ilm") as ex:
+            list(ex.map(one, cands))
+    return moved[0]

@@ -813,6 +813,47 @@ class MetricsRegistry:
                                     "Hot-cache body bytes cached")
         self.hotcache_segment = Gauge("mtpu_hotcache_total_bytes",
                                       "Hot-cache shared-segment size")
+        # ILM transition/restore + warm-tier families (bucket/tier.py;
+        # cf. getClusterTierMetrics, cmd/metrics-v3-cluster-usage.go).
+        self.ilm_transitioned = Gauge(
+            "mtpu_ilm_transitioned_total",
+            "Versions moved to a warm tier (stub left hot)")
+        self.ilm_transition_bytes = Gauge(
+            "mtpu_ilm_transition_bytes_total",
+            "Bytes streamed to warm tiers by transitions")
+        self.ilm_transition_errors = Gauge(
+            "mtpu_ilm_transition_errors_total",
+            "Transitions aborted by tier faults (journal reaps)")
+        self.ilm_restored = Gauge(
+            "mtpu_ilm_restored_total",
+            "Restore-on-POST rehydrations completed")
+        self.ilm_restore_bytes = Gauge(
+            "mtpu_ilm_restore_bytes_total",
+            "Bytes streamed back hot by restores")
+        self.ilm_restore_expired = Gauge(
+            "mtpu_ilm_restore_expired_total",
+            "Temporary restores re-expired by the scanner")
+        self.ilm_journal_pending = Gauge(
+            "mtpu_ilm_journal_pending",
+            "Tier-journal records awaiting resolution (drains to 0)")
+        self.ilm_journal_replayed = Gauge(
+            "mtpu_ilm_journal_replayed_total",
+            "Journal records resolved by boot replay")
+        self.ilm_orphans_reaped = Gauge(
+            "mtpu_ilm_orphans_reaped_total",
+            "Orphaned tier objects reaped via the journal")
+        self.tier_objects = Gauge(
+            "mtpu_tier_objects",
+            "Objects currently resident in the warm tier", ("tier",))
+        self.tier_bytes = Gauge(
+            "mtpu_tier_bytes",
+            "Bytes currently resident in the warm tier", ("tier",))
+        self.tier_read_through = Gauge(
+            "mtpu_tier_read_through_total",
+            "Stub GET/HEAD reads streamed through from tiers")
+        self.tier_freed = Gauge(
+            "mtpu_tier_freed_total",
+            "Tier objects deleted through the journal")
         # Multi-pool placement + decommission families (cf.
         # getClusterHealthMetrics pool rows, cmd/metrics-v3-cluster.go).
         self.pool_total_bytes = Gauge(
@@ -904,7 +945,29 @@ class MetricsRegistry:
         if bucket:
             self.bandwidth.record(bucket, rx, tx)
 
-    def update_cluster(self, pools, scanner=None) -> None:
+    def update_ilm(self, tier_mgr) -> None:
+        """Refresh ILM/tier gauges from TierManager.stats() (scrape
+        time, same pattern as the hot-cache block)."""
+        if tier_mgr is None:
+            return
+        st = tier_mgr.stats()
+        self.ilm_transitioned.set(st["transitioned"])
+        self.ilm_transition_bytes.set(st["transition_bytes"])
+        self.ilm_transition_errors.set(st["transition_errors"])
+        self.ilm_restored.set(st["restored"])
+        self.ilm_restore_bytes.set(st["restore_bytes"])
+        self.ilm_restore_expired.set(st["restore_expired"])
+        self.ilm_journal_pending.set(st["journal_pending"])
+        self.ilm_journal_replayed.set(st["replayed"])
+        self.ilm_orphans_reaped.set(st["orphans_reaped"])
+        self.tier_read_through.set(st["read_through"])
+        self.tier_freed.set(st["freed"])
+        for tname, usage in st["tiers"].items():
+            self.tier_objects.set(usage["objects"], tier=tname)
+            self.tier_bytes.set(usage["bytes"], tier=tname)
+
+    def update_cluster(self, pools, scanner=None, tier_mgr=None) -> None:
+        self.update_ilm(tier_mgr)
         cm = getattr(pools, "cache_metrics", None)
         if callable(cm):
             c = cm()

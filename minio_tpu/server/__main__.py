@@ -327,6 +327,18 @@ def main(argv: list[str] | None = None) -> int:
     scanner = (DataScanner(pools).start()
                if os.environ.get("MTPU_SCANNER", "1") != "0" else None)
     notify = NotificationSystem()
+    # ILM/tiering plane: persisted tiers reload and the tier journal
+    # replays BEFORE traffic — a kill-9 mid-transition resolves to
+    # either the full hot version or a valid stub + tier object here.
+    from ..bucket.tier import TierManager
+    tier_mgr = TierManager(pools)
+    replay = getattr(tier_mgr, "journal", None)
+    if tier_mgr.counters.get("replayed"):
+        print(f"minio_tpu: tier journal: replayed "
+              f"{tier_mgr.counters['replayed']} record(s) "
+              f"({tier_mgr.counters['orphans_reaped']} orphan(s) "
+              f"reaped), {replay.pending() if replay else 0} pending",
+              flush=True)
 
     import threading
     stop = threading.Event()
@@ -336,6 +348,7 @@ def main(argv: list[str] | None = None) -> int:
         srv = S3Server(pools, creds, host=args.host, port=port,
                        iam=iam, scanner=scanner, notify=notify,
                        replication=replication, certs=certs,
+                       tier_mgr=tier_mgr,
                        bucket_dns=bucket_dns_from_env(args.host,
                                                       port)).start()
         port = srv.port                  # keep the port across restarts

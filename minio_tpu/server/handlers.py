@@ -224,7 +224,8 @@ class S3Handlers:
             # data.
             fi, stored = self.pools.get_object(bucket, key,
                                                version_id=version_id)
-            if self._is_transitioned(fi):
+            if self._is_transitioned(fi) \
+                    and not self.tier_mgr.restore_fresh(fi):
                 stored = self.tier_mgr.read_through(fi)
         except StorageError as e:
             raise from_storage_error(e) from None
@@ -634,6 +635,20 @@ class S3Handlers:
         if "x-amz-replication-status" in fi.metadata:
             h["x-amz-replication-status"] = \
                 fi.metadata["x-amz-replication-status"]
+        from ..bucket.tier import RESTORE_EXPIRY_KEY, TIER_NAME_KEY
+        if TIER_NAME_KEY in fi.metadata:
+            # Transitioned stub: the tier name IS the storage class the
+            # client sees; a live temporary restore adds x-amz-restore
+            # (cf. postRestoreOpts, cmd/object-handlers.go).
+            h[S3Handlers.SC_HEADER] = fi.metadata[TIER_NAME_KEY]
+            exp = fi.metadata.get(RESTORE_EXPIRY_KEY)
+            if exp:
+                try:
+                    h["x-amz-restore"] = (
+                        'ongoing-request="false", expiry-date="'
+                        + _http_date(int(float(exp) * 1e9)) + '"')
+                except ValueError:
+                    pass
         for k, v in fi.metadata.items():
             if k.startswith(AMZ_META_PREFIX):
                 h[k] = v
@@ -769,9 +784,15 @@ class S3Handlers:
         if cond is not None:
             return cond
 
-        transformed = (sse.is_encrypted(fi.metadata)
-                       or cz.is_compressed(fi.metadata)
-                       or self._is_transitioned(fi))
+        # A transitioned stub without other transforms streams straight
+        # from its tier; with SSE/compression the whole-decode path
+        # below applies.  A fresh temporary restore serves the hot body
+        # like any other object.
+        tiered = (self._is_transitioned(fi)
+                  and not self.tier_mgr.restore_fresh(fi))
+        transcoded = (sse.is_encrypted(fi.metadata)
+                      or cz.is_compressed(fi.metadata))
+        transformed = transcoded or tiered
         size = self._logical_size(fi)
         rng = headers.get("Range") or headers.get("range")
         offset, length = 0, size
@@ -784,7 +805,21 @@ class S3Handlers:
         data = b""
         body_iter = None
         if not head:
-            if transformed:
+            if tiered and not transcoded:
+                # Restore-on-GET: stream the tier object in bounded
+                # chunks, ranged offsets passed straight through — no
+                # whole-object buffer (satellite: a 1 GiB cold GET is
+                # O(chunk)).  The eager first pull surfaces tier-down
+                # errors while they can still become S3 responses.
+                import itertools
+                try:
+                    body_iter = self.tier_mgr.read_through_iter(
+                        fi, offset, length)
+                    first = next(body_iter, b"")
+                except StorageError as e:
+                    raise from_storage_error(e) from None
+                body_iter = itertools.chain((first,), body_iter)
+            elif transformed:
                 # Ranged reads on transformed objects decode the whole
                 # stream then slice by logical offsets (cf. the decrypt/
                 # decompress cleanup stack in GetObjectReader,

@@ -589,6 +589,15 @@ class S3Server:
         if scanner is not None:
             self.scanner = scanner
             self._handler_opts["scanner"] = scanner
+        if self._handler_opts.get("tier_mgr") is None:
+            # The ILM plane needs the object layer; now that it exists,
+            # stand the tier manager up (journal replay included) so
+            # cluster-mode boots serve restore/tier admin too.
+            from ..bucket.tier import TierManager
+            try:
+                self._handler_opts["tier_mgr"] = TierManager(pools)
+            except Exception:  # noqa: BLE001 — tiering must not block boot
+                pass
         self.handlers = S3Handlers(pools, **self._handler_opts)
         if self.scanner is not None \
                 and hasattr(self.scanner, "attach_config"):
@@ -988,6 +997,7 @@ class S3Server:
         "profile": "admin:Profiling",
         "service": "admin:ServiceRestart",
         "tier": "admin:SetTier",
+        "ilm": "admin:SetTier",
         "inspect": "admin:InspectData",
         "kms": "admin:KMSKeyStatus",
         "top": "admin:ServerTrace",
@@ -1635,8 +1645,22 @@ class S3Server:
             if tm is None:
                 return j({"error": "tiering not enabled"}, 501)
             if method == "GET":
-                return j({"tiers": tm.list_tiers()})
-            if method == "POST":
+                st = tm.stats()
+                return j({"tiers": tm.list_tiers(),
+                          "usage": st["tiers"],
+                          "journal_pending": st["journal_pending"]})
+            if method == "DELETE":
+                name = query.get("name", [""])[0]
+                if not name:
+                    raise S3Error("InvalidArgument", "name required")
+                try:
+                    removed = tm.remove_tier(name)
+                except ValueError as e:
+                    return j({"error": str(e)}, 409)
+                if not removed:
+                    return j({"error": f"no tier {name!r}"}, 404)
+                return j({"ok": True})
+            if method in ("POST", "PUT"):
                 req_obj = _json.loads(body or b"{}")
                 try:
                     name = req_obj["name"]
@@ -1649,21 +1673,61 @@ class S3Server:
                         backend = S3TierBackend(
                             req_obj["endpoint"], req_obj["accessKey"],
                             req_obj["secretKey"], req_obj["bucket"])
+                    elif kind == "pool":
+                        # Second-local-pool tier: cold bucket on this
+                        # deployment's own object layer.
+                        from ..bucket.tier import PoolTierBackend
+                        backend = PoolTierBackend(self.pools,
+                                                  req_obj.get("bucket"))
                     else:
                         raise S3Error("InvalidArgument",
                                       f"unknown tier type {kind!r}")
                     # config persists the registration across restarts;
                     # duplicates are refused (409) — replacing a live
-                    # tier's backend would orphan transitioned objects
+                    # tier's backend would orphan transitioned objects.
+                    # PUT is the explicit credential-rotation path
+                    # (cf. EditTierHandler, cmd/admin-handlers-pools.go).
                     cfg = {k: v for k, v in req_obj.items()
                            if k != "name"}
-                    tm.add_tier(name, backend, config=cfg)
+                    tm.add_tier(name, backend, config=cfg,
+                                replace=(method == "PUT"))
                 except KeyError as e:
                     raise S3Error("InvalidArgument",
                                   f"missing field {e}") from None
                 except ValueError as e:
                     return j({"error": str(e)}, 409)
                 return j({"ok": True})
+        if sub == "ilm":
+            # ILM plane: GET = stats (the crash harness polls
+            # journal_pending to zero); POST = explicit transition
+            # trigger / journal drain (what the scanner does on its own
+            # cadence, made deterministic for tests and the matrix).
+            tm = self.handlers.tier_mgr
+            if tm is None:
+                return j({"error": "tiering not enabled"}, 501)
+            if method == "GET":
+                return j(tm.stats())
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                if req_obj.get("op") == "drain":
+                    freed = tm.drain_journal()
+                    return j({"freed": freed,
+                              "pending": tm.journal.pending()})
+                bkt = req_obj.get("bucket")
+                okey = req_obj.get("object")
+                tname = req_obj.get("tier")
+                if not bkt or not okey or not tname:
+                    raise S3Error("InvalidArgument",
+                                  "bucket, object, tier required")
+                from ..storage.errors import StorageError as _SE
+                try:
+                    moved = tm.transition_object(
+                        bkt, okey, tname,
+                        req_obj.get("versionId", ""))
+                except _SE as e:
+                    from .api_errors import from_storage_error as _fse
+                    raise _fse(e) from None
+                return j({"transitioned": bool(moved)})
         if sub.startswith("inspect") and method == "GET":
             # Raw per-drive metadata download for debugging
             # (cf. InspectDataHandler, cmd/admin-handlers.go).
@@ -2108,7 +2172,8 @@ class S3Server:
         tok = _rest.set_deadline(1.0 if left is None else min(1.0, left))
         try:
             if self.pools is not None:
-                self.metrics.update_cluster(self.pools, self.scanner)
+                self.metrics.update_cluster(self.pools, self.scanner,
+                                            self.handlers.tier_mgr)
             if self.cluster_node is not None:
                 self.metrics.update_peers(
                     self.cluster_node.peer_clients.values())
@@ -2203,6 +2268,8 @@ class S3Server:
             "coalescer": coalescer,
             "workers": workers,
             "hotcache": tier.stats() if tier is not None else None,
+            "ilm": (self.handlers.tier_mgr.stats()
+                    if self.handlers.tier_mgr is not None else None),
             "audit": [t.stats() for t in self.audit_targets],
             "slo": (self.metrics.last_minute.snapshot()
                     if self.slo_enabled else {}),
@@ -2707,10 +2774,31 @@ class S3Server:
             if "restore" in query:
                 if h.tier_mgr is None:
                     raise S3Error("NotImplemented", "tiering not enabled")
+                # <RestoreRequest><Days>N</Days></RestoreRequest> makes
+                # the restore TEMPORARY (x-amz-restore semantics, the
+                # scanner re-expires it); an empty body restores
+                # permanently (the pre-existing behaviour).
+                days = None
+                if body:
+                    import xml.etree.ElementTree as _ET
+                    try:
+                        root = _ET.fromstring(body)
+                        dtext = root.findtext(
+                            ".//{*}Days") or root.findtext(".//Days")
+                        if dtext is not None:
+                            days = float(dtext)
+                            if days <= 0:
+                                raise ValueError(dtext)
+                    except _ET.ParseError:
+                        raise S3Error("MalformedXML") from None
+                    except ValueError as e:
+                        raise S3Error("InvalidArgument",
+                                      f"bad Days: {e}") from None
                 from ..storage.errors import StorageError as _SE
                 try:
                     restored = h.tier_mgr.restore_object(
-                        bucket, key, query.get("versionId", [""])[0])
+                        bucket, key, query.get("versionId", [""])[0],
+                        days=days)
                 except _SE as e:
                     from .api_errors import from_storage_error as _fse
                     raise _fse(e) from None

@@ -544,6 +544,241 @@ def run_decom_scenario(sc: dict, base_dir: str, seed: int = 0,
     return res
 
 
+# ---------------------------------------------------------------------------
+# ILM kill-9 matrix: one row per ilm.* crash point.  Each scenario
+# kills the server inside the tier transition (or tier-free) window,
+# reboots, lets the tier journal replay at boot, and asserts the
+# exactly-once contract: the object is EITHER a full hot version OR a
+# valid stub backed by exactly one tier object — never torn, never
+# orphaned — and the journal drains to zero.
+# ---------------------------------------------------------------------------
+
+#: expect encodes which side of the transition the recovery must land
+#: on:  hot  — the hot version survives byte-exact and the tier dir is
+#:             empty (pre-copy kill, or post-copy orphan reaped);
+#:      stub — the stub stands, GETs (plain + ranged) stream through
+#:             the tier byte-exact, exactly ONE tier object exists;
+#:      gone — a kill mid tier-free (DELETE of a transitioned object):
+#:             the version stays deleted and the replayed free leaves
+#:             no tier object behind.
+ILM_SCENARIOS = (
+    {"point": "ilm.pre_stub", "nth": 1, "expect": "hot"},
+    {"point": "ilm.post_copy", "nth": 1, "expect": "hot"},
+    {"point": "ilm.checkpoint", "nth": 1, "expect": "stub"},
+    {"point": "ilm.pre_delete", "nth": 1, "expect": "gone"},
+)
+
+ILM_TIER = "WARM"
+ILM_DRAIN_DEADLINE_S = 60.0
+
+
+def _admin_post(cli, sub: str, obj: dict) -> dict:
+    import json
+    status, _, body = cli.request(
+        "POST", f"/minio/admin/v3/{sub}", body=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    if status != 200:
+        raise ScenarioError(
+            f"admin POST {sub} -> {status}: {body[:200]!r}")
+    return json.loads(body) if body else {}
+
+
+def tier_residue(tier_root: str) -> list[str]:
+    """Every object file under the dir tier backend's root."""
+    left = []
+    for dirpath, _, names in os.walk(tier_root):
+        rel = os.path.relpath(dirpath, tier_root)
+        left += [os.path.join(rel, n) for n in names]
+    return left
+
+
+def _wait_journal_drained(cli, deadline_s: float = ILM_DRAIN_DEADLINE_S
+                          ) -> dict:
+    """Replay runs at boot; failed frees retry on drain — poll (with a
+    drain nudge, what the scanner does on its cadence) to zero."""
+    deadline = time.monotonic() + deadline_s
+    st = {}
+    while time.monotonic() < deadline:
+        st = _retry(lambda: _admin(cli, "GET", "ilm"))
+        if st.get("journal_pending") == 0:
+            return st
+        _retry(lambda: _admin_post(cli, "ilm", {"op": "drain"}))
+        time.sleep(0.25)
+    raise ScenarioError(
+        f"tier journal never drained: "
+        f"pending={st.get('journal_pending')}")
+
+
+def run_ilm_scenario(sc: dict, base_dir: str, seed: int = 0,
+                     extra_env: dict | None = None) -> dict:
+    """Kill-9 the tier transition (or tier-free) at an armed ilm.*
+    point, reboot, let the journal replay, assert exactly-once:
+
+      boot A  (unarmed)  PUT the victim, register an fs tier; for the
+              free-window point also transition the victim; SIGKILL;
+      boot B  (armed)    drive the victim op — an admin transition
+              trigger, or DELETE for ilm.pre_delete — into the armed
+              point; the server dies with 137 inside the window;
+      boot C  (unarmed)  boot-time replay resolves the torn window;
+              assert per `expect` (hot / stub / gone), the journal at
+              zero, no orphaned tier objects, and the system writable.
+    """
+    os.makedirs(base_dir, exist_ok=True)
+    point, nth, expect = sc["point"], sc["nth"], sc["expect"]
+    res = {"point": point, "nth": nth, "op": "ilm", "expect": expect,
+           "seed": seed}
+    tier_root = os.path.join(base_dir, "tier-warm")
+    data = _payload(seed * 11 + 1, 256 * 1024)
+
+    # -- boot A: victim object + tier registration, then kill -9 ------------
+    port = free_port()
+    proc = boot_server(base_dir, port, extra_env=extra_env)
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(f"{point}: boot A never became ready")
+        cli = make_client(port)
+        _retry(lambda: cli.make_bucket(BUCKET))
+        _retry(lambda: cli.put_object(BUCKET, "victim", data))
+        _retry(lambda: _admin_post(cli, "tier", {
+            "name": ILM_TIER, "type": "fs", "path": tier_root}))
+        if expect == "gone":
+            # The free-window point kills a DELETE of a transitioned
+            # object — transition it cleanly first.
+            r = _retry(lambda: _admin_post(cli, "ilm", {
+                "bucket": BUCKET, "object": "victim",
+                "tier": ILM_TIER}))
+            if not r.get("transitioned"):
+                raise ScenarioError(
+                    f"{point}: boot A transition refused: {r}")
+            if _retry(lambda: cli.get_object(BUCKET, "victim")) != data:
+                raise ScenarioError(
+                    f"{point}: boot A stub read-through mismatch")
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+    # -- boot B: armed, victim op dies inside the tier window ---------------
+    port = free_port()
+    proc = boot_server(base_dir, port, crash=f"{point}:{nth}",
+                       extra_env=extra_env)
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(
+                f"{point}:{nth}: boot B died before the victim op "
+                f"(a boot-path tier op tripped the point)")
+        cli = make_client(port)
+        try:
+            if expect == "gone":
+                cli.delete_object(BUCKET, "victim")
+            else:
+                _admin_post(cli, "ilm", {
+                    "bucket": BUCKET, "object": "victim",
+                    "tier": ILM_TIER})
+        except Exception:  # noqa: BLE001 — expected: died mid-op
+            pass
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    if proc.returncode != 137:
+        raise ScenarioError(
+            f"{point}:{nth}: boot B exit {proc.returncode}, wanted 137 "
+            f"(crash point never fired?)")
+
+    # -- boot C: replay boot + assertions -----------------------------------
+    port = free_port()
+    proc = boot_server(base_dir, port, extra_env=extra_env)
+    try:
+        if not wait_ready(port, proc):
+            raise ScenarioError(f"{point}: recovery boot never ready")
+        cli = make_client(port)
+        st = _wait_journal_drained(cli)
+        res["replayed"] = st.get("replayed")
+        left = tier_residue(tier_root)
+        got = _get_or_absent(cli, "victim")
+        if expect in ("hot", "stub"):
+            if got != data:
+                raise ScenarioError(
+                    f"{point}: victim lost/torn after replay "
+                    f"({'absent' if got is None else len(got)} vs "
+                    f"{len(data)} bytes)")
+            status, h, body = cli.request(
+                "GET", f"/{BUCKET}/victim",
+                headers={"Range": "bytes=1024-2047"})
+            if status != 206 or body != data[1024:2048]:
+                raise ScenarioError(
+                    f"{point}: ranged GET mismatch after replay "
+                    f"(status {status})")
+            sc_hdr = h.get("x-amz-storage-class") \
+                or h.get("X-Amz-Storage-Class")
+        if expect == "hot":
+            # Pre-copy kill (or reaped post-copy orphan): the full hot
+            # version stands and the tier holds nothing.
+            if sc_hdr:
+                raise ScenarioError(
+                    f"{point}: victim half-transitioned "
+                    f"(storage-class {sc_hdr!r})")
+            if left:
+                raise ScenarioError(
+                    f"{point}: orphaned tier objects after replay: "
+                    f"{left[:4]}")
+        elif expect == "stub":
+            # Stub published pre-kill: replay rolls the intent forward
+            # and the one tier object backs the stub.
+            if sc_hdr != ILM_TIER:
+                raise ScenarioError(
+                    f"{point}: stub lost its storage class "
+                    f"({sc_hdr!r})")
+            if len(left) != 1:
+                raise ScenarioError(
+                    f"{point}: want exactly 1 tier object backing the "
+                    f"stub, found {len(left)}: {left[:4]}")
+        elif expect == "gone":
+            if got is not None:
+                raise ScenarioError(
+                    f"{point}: deleted victim resurrected by replay")
+            if left:
+                raise ScenarioError(
+                    f"{point}: tier object leaked past the replayed "
+                    f"free: {left[:4]}")
+        # System stays writable: the victim key re-PUTs and verifies.
+        reput = _payload(seed * 11 + 2, 64 * 1024)
+        _retry(lambda: cli.put_object(BUCKET, "victim", reput))
+        if cli.get_object(BUCKET, "victim") != reput:
+            raise ScenarioError(f"{point}: re-PUT readback mismatch")
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        if proc.returncode != 0:
+            raise ScenarioError(
+                f"{point}: graceful exit returned {proc.returncode}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    res["ok"] = True
+    return res
+
+
+def run_ilm_matrix(scenarios=ILM_SCENARIOS, base_dir: str | None = None,
+                   seed: int = 0, progress=None) -> list[dict]:
+    import tempfile
+    root = base_dir or tempfile.mkdtemp(prefix="mtpu-ilm-")
+    results = []
+    for i, sc in enumerate(scenarios):
+        d = os.path.join(root, f"il{i}-{sc['point'].replace('.', '_')}")
+        try:
+            r = run_ilm_scenario(sc, d, seed=seed)
+        except ScenarioError as e:
+            r = {**sc, "ok": False, "error": str(e)}
+        results.append(r)
+        if progress is not None:
+            mark = "ok" if r.get("ok") else f"FAIL: {r.get('error')}"
+            progress(f"[{i + 1}/{len(scenarios)}] "
+                     f"{sc['point']}:{sc['nth']} (ilm) {mark}")
+    return results
+
+
 def run_decom_matrix(scenarios=DECOM_SCENARIOS,
                      base_dir: str | None = None, seed: int = 0,
                      progress=None) -> list[dict]:

@@ -52,6 +52,11 @@ POINTS = (
     "decom.post_copy",           # mover: copy published, source intact
     "decom.pre_delete",          # mover: dest verified, source not deleted
     "decom.checkpoint",          # mover: source gone, journal not appended
+    # bucket/tier.py — the ILM transition worker's exactly-once window
+    "ilm.pre_stub",              # intent journaled, before the tier copy
+    "ilm.post_copy",             # tier object durable, hot version intact
+    "ilm.pre_delete",            # free journaled, tier object not deleted
+    "ilm.checkpoint",            # stub published, journal 'done' not appended
 )
 
 _mu = threading.Lock()

@@ -114,6 +114,17 @@ def hotcache_mode(request, monkeypatch):
     return request.param
 
 
+@pytest.fixture(params=["1", "0"], ids=["ilm", "noilm"])
+def ilm_mode(request, monkeypatch):
+    """Oracle guard for the data-temperature plane: tests using this
+    fixture run once with scanner-driven transitions armed (MTPU_ILM=1,
+    the default) and once with the plane disabled (=0) — objects the
+    oracle run keeps hot and the ILM run serves through stubs must stay
+    byte-identical on GET/ranged-GET/HEAD."""
+    monkeypatch.setenv("MTPU_ILM", request.param)
+    return request.param
+
+
 @pytest.fixture(params=["1", "0"], ids=["breaker", "nobreaker"])
 def breaker_mode(request, monkeypatch):
     """Oracle guard for the drive circuit breaker: MTPU_BREAKER=0 pins

@@ -90,6 +90,43 @@ class TestCrashMatrix:
         _run(sc, tmp_path)
 
 
+class TestILMCrashSmoke:
+    """Tier-1 smoke for the ilm.* window: the two points that straddle
+    the transition's point of no return.  A kill on either side must
+    leave EITHER the full hot version OR a valid stub backed by exactly
+    one tier object — never torn, never orphaned."""
+
+    def test_kill_post_copy_reaps_orphan(self, tmp_path):
+        # Tier copy durable, stub never published: the recovery boot
+        # must reap the orphaned tier object and keep the hot version.
+        res = cm.run_ilm_scenario(
+            {"point": "ilm.post_copy", "nth": 1, "expect": "hot"},
+            str(tmp_path / "site"), seed=7)
+        assert res["ok"]
+
+    def test_kill_at_checkpoint_rolls_forward(self, tmp_path):
+        # Stub published, journal 'done' never appended: replay must
+        # roll the intent forward — the stub stands and GETs (plain and
+        # ranged) stream through the tier byte-exact.
+        res = cm.run_ilm_scenario(
+            {"point": "ilm.checkpoint", "nth": 1, "expect": "stub"},
+            str(tmp_path / "site"), seed=7)
+        assert res["ok"]
+
+
+class TestILMCrashMatrix:
+    """The full ilm.* sweep: every transition/free window point, each
+    over a fresh drive tree, three boots per scenario."""
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "sc", cm.ILM_SCENARIOS,
+        ids=[f"{s['point']}:{s['nth']}" for s in cm.ILM_SCENARIOS])
+    def test_point(self, sc, tmp_path):
+        res = cm.run_ilm_scenario(sc, str(tmp_path / "site"), seed=7)
+        assert res["ok"]
+
+
 class _DripReader:
     """A .read(n) body that trickles out slowly — keeps a streaming PUT
     inflight long enough to SIGTERM the server underneath it."""

@@ -335,3 +335,272 @@ class TestLifecycleTierFreeVersion:
                 pools.head_object("scanlc", "doomed")
         finally:
             srv.shutdown()
+
+
+class TestILMPlane:
+    """PR 15 regression surface: in-process journal replay, the
+    hot-cache mutation audit, ranged GETs through stubs, the temporary
+    restore window, the MTPU_ILM=0 oracle, the pool tier backend, and
+    bounded-chunk streaming."""
+
+    @staticmethod
+    def _cached_pools(tmp_path, name="p"):
+        from minio_tpu.engine.hotcache import HotObjectCache, attach_pools
+        pools = make_pools(tmp_path, name)
+        cache = HotObjectCache(total_bytes=16 << 20)
+        attach_pools(pools, cache)
+        return pools, cache
+
+    def test_journal_replay_rolls_forward_reaps_and_skips_torn_tail(
+            self, tmp_path):
+        """The three crash leftovers a boot can find — a completed
+        transition missing its 'done', an orphaned tier copy whose stub
+        never published, and a torn trailing journal line — resolve in
+        one replay: roll forward, reap, skip; journal at zero."""
+        from minio_tpu.bucket.tier import (TIER_OBJ_KEY,
+                                           default_journal_path)
+        pools, _ = self._cached_pools(tmp_path)
+        cold = str(tmp_path / "cold")
+        tm = TierManager(pools)
+        tm.add_tier("COLD", DirTierBackend(cold),
+                    config={"type": "fs", "path": cold})
+        pools.make_bucket("tb")
+        data = payload(120000, 9)
+        pools.put_object("tb", "x", data)
+        for _ in range(3):               # cache the HOT bytes
+            pools.get_object("tb", "x")
+        assert tm.transition_object("tb", "x", "COLD")
+        fi = pools.head_object("tb", "x")
+        tkey = fi.metadata[TIER_OBJ_KEY]
+        # Forge the torn windows a kill-9 leaves: an orphan copy with a
+        # pending intent (stub never published), the live stub's intent
+        # re-opened (crash before 'done'), and a half-appended line.
+        tm.get_tier("COLD").put("tb/orphan0000", b"dead bytes")
+        tm.journal.record({"op": "intent", "tkey": "tb/orphan0000",
+                           "tier": "COLD", "bucket": "tb",
+                           "key": "ghost", "vid": "", "size": 10})
+        tm.journal.record({"op": "intent", "tkey": tkey,
+                           "tier": "COLD", "bucket": "tb", "key": "x",
+                           "vid": fi.version_id or "",
+                           "size": len(data)})
+        with open(default_journal_path(pools), "a",
+                  encoding="utf-8") as f:
+            f.write('{"op":"intent","tkey":"tb/half')
+
+        tm2 = TierManager(pools)         # the recovery boot
+        assert tm2.journal.torn_lines == 1
+        assert tm2.journal.pending() == 0
+        st = tm2.stats()
+        assert st["orphans_reaped"] == 1 and st["replayed"] >= 2
+        import os as _os
+        assert _os.listdir(cold) == [tkey.replace("/", "_")]
+        # Post-replay reads are fresh (no stale cached hot bytes) and
+        # byte-exact through the surviving stub.
+        fi2, body = pools.get_object("tb", "x")
+        assert tm2.is_transitioned(fi2) and bytes(body) == b""
+        assert tm2.read_through(fi2) == data
+
+    def test_no_stale_reads_across_ilm_mutations(self, tmp_path):
+        """The hot-cache audit, per mutation path: transition, temp
+        restore, scanner re-expiry, permanent restore.  After each, a
+        cached reader must see the NEW truth — a stale hit would serve
+        full hot bytes for a stub (or stub emptiness for a restore)."""
+        from minio_tpu.bucket.tier import RESTORE_EXPIRY_KEY
+        pools, _ = self._cached_pools(tmp_path)
+        tm = TierManager(pools)
+        tm.add_tier("COLD", DirTierBackend(str(tmp_path / "cold")))
+        pools.make_bucket("tb")
+        data = payload(150000, 11)
+        pools.put_object("tb", "x", data)
+
+        def read3():
+            for _ in range(3):           # ghost -> fill -> hit
+                fi, body = pools.get_object("tb", "x")
+            return fi, bytes(body)
+
+        fi, body = read3()               # cache holds the hot body
+        assert body == data
+        assert tm.transition_object("tb", "x", "COLD")
+        fi, body = read3()
+        assert tm.is_transitioned(fi) and body == b"", \
+            "stale cached hot bytes served for a transitioned stub"
+        assert tm.restore_object("tb", "x", days=1)
+        fi, body = read3()
+        assert body == data and RESTORE_EXPIRY_KEY in fi.metadata, \
+            "stale stub served after a temporary restore"
+        assert tm.expire_restores("tb", now=time.time() + 2 * 86400) == 1
+        fi, body = read3()
+        assert body == b"" and RESTORE_EXPIRY_KEY not in fi.metadata, \
+            "stale restored body served after re-expiry"
+        assert tm.is_transitioned(fi)
+        assert tm.restore_object("tb", "x")      # permanent
+        fi, body = read3()
+        assert body == data and not tm.is_transitioned(fi), \
+            "stale stub served after a permanent restore"
+
+    def test_ranged_gets_through_stub(self, tmp_path):
+        pools = make_pools(tmp_path)
+        tm = TierManager(pools)
+        tm.add_tier("COLD", DirTierBackend(str(tmp_path / "cold")))
+        srv = S3Server(pools, Credentials(ROOT, SECRET),
+                       tier_mgr=tm).start()
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("tbkt")
+            data = payload(300000, 21)
+            cli.put_object("tbkt", "r", data)
+            assert tm.transition_object("tbkt", "r", "COLD")
+            for a, b in ((0, 0), (0, 99), (1234, 56789),
+                         (len(data) - 100, len(data) - 1)):
+                got = cli.get_object("tbkt", "r", range_=(a, b))
+                assert got == data[a:b + 1], f"range {a}-{b} mismatch"
+            # suffix range
+            status, h, body = cli.request(
+                "GET", "/tbkt/r", headers={"Range": "bytes=-777"})
+            assert status == 206 and body == data[-777:]
+            assert h.get("Content-Range", "").endswith(f"/{len(data)}")
+        finally:
+            srv.shutdown()
+
+    def test_temporary_restore_header_and_reexpiry(self, tmp_path):
+        pools = make_pools(tmp_path)
+        tm = TierManager(pools)
+        tm.add_tier("COLD", DirTierBackend(str(tmp_path / "cold")))
+        srv = S3Server(pools, Credentials(ROOT, SECRET),
+                       tier_mgr=tm).start()
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("tbkt")
+            data = payload(180000, 23)
+            cli.put_object("tbkt", "t", data)
+            assert tm.transition_object("tbkt", "t", "COLD")
+            status, _, _ = cli.request(
+                "POST", "/tbkt/t", query={"restore": ""},
+                body=b"<RestoreRequest><Days>1</Days></RestoreRequest>")
+            assert status == 202
+            fi = pools.head_object("tbkt", "t")
+            assert fi.size == len(data), "temp restore did not land hot"
+            assert tm.is_transitioned(fi), \
+                "temp restore must KEEP the tier pointers"
+            h = cli.head_object("tbkt", "t")
+            restore_hdr = h.get("x-amz-restore", "")
+            assert 'ongoing-request="false"' in restore_hdr
+            assert "expiry-date=" in restore_hdr
+            assert h.get("x-amz-storage-class") == "COLD"
+            assert cli.get_object("tbkt", "t") == data
+            # the scanner's re-expiry pass drops the hot body again
+            assert tm.expire_restores(
+                "tbkt", now=time.time() + 2 * 86400) == 1
+            fi = pools.head_object("tbkt", "t")
+            assert fi.size == 0 and tm.is_transitioned(fi)
+            h = cli.head_object("tbkt", "t")
+            assert "x-amz-restore" not in h
+            assert int(h["Content-Length"]) == len(data)
+            assert cli.get_object("tbkt", "t") == data
+        finally:
+            srv.shutdown()
+
+    def test_ilm_oracle_byte_identity(self, tmp_path, ilm_mode):
+        """Acceptance differential: the same client traffic against
+        MTPU_ILM=1 (object transitions to a stub) and the =0 oracle
+        (object stays hot) must be byte-identical on GET, ranged GET,
+        and HEAD Content-Length."""
+        pools = make_pools(tmp_path)
+        tm = TierManager(pools)
+        tm.add_tier("COLD", DirTierBackend(str(tmp_path / "cold")))
+        srv = S3Server(pools, Credentials(ROOT, SECRET),
+                       tier_mgr=tm).start()
+        try:
+            cli = S3Client(srv.endpoint, ROOT, SECRET)
+            cli.make_bucket("obkt")
+            data = payload(220000, 31)
+            cli.put_object("obkt", "old/o", data)
+            lc = Lifecycle.parse(b"""<LifecycleConfiguration><Rule>
+                <Status>Enabled</Status>
+                <Filter><Prefix>old/</Prefix></Filter>
+                <Transition><Days>1</Days>
+                <StorageClass>COLD</StorageClass>
+                </Transition></Rule></LifecycleConfiguration>""")
+            moved = run_transitions(pools, "obkt", lc, tm,
+                                    now=time.time() + 2 * 86400)
+            fi = pools.head_object("obkt", "old/o")
+            if ilm_mode == "1":
+                assert moved == 1 and tm.is_transitioned(fi)
+            else:
+                assert moved == 0 and not tm.is_transitioned(fi)
+            assert cli.get_object("obkt", "old/o") == data
+            assert cli.get_object("obkt", "old/o",
+                                  range_=(5000, 90000)) == \
+                data[5000:90001]
+            h = cli.head_object("obkt", "old/o")
+            assert int(h["Content-Length"]) == len(data)
+        finally:
+            srv.shutdown()
+
+    def test_pool_tier_backend_roundtrip(self, tmp_path):
+        """Second-local-pool tier: the cold pool is another object
+        layer; transitions land in its mtpu-tier bucket, restores drain
+        it back out through the journal."""
+        from minio_tpu.bucket.tier import PoolTierBackend
+        pools = make_pools(tmp_path, "hotp")
+        cold_pools = make_pools(tmp_path, "coldp")
+        tm = TierManager(pools)
+        backend = PoolTierBackend(cold_pools)
+        tm.add_tier("POOLTIER", backend)
+        pools.make_bucket("pb")
+        data = payload(260000, 41)
+        pools.put_object("pb", "x", data)
+        assert tm.transition_object("pb", "x", "POOLTIER")
+        fi = pools.head_object("pb", "x")
+        assert tm.is_transitioned(fi) and fi.size == 0
+        assert len(cold_pools.list_objects(backend.bucket)) == 1
+        assert tm.read_through(fi) == data
+        assert tm.restore_object("pb", "x")      # permanent restore
+        fi = pools.head_object("pb", "x")
+        assert not tm.is_transitioned(fi)
+        assert pools.get_object("pb", "x")[1] == data
+        assert tm.journal.pending() == 0
+        assert cold_pools.list_objects(backend.bucket) == []
+
+    def test_transition_and_readthrough_stream_bounded(self, tmp_path,
+                                                       monkeypatch):
+        """Satellite: tier traffic streams in bounded chunks — the
+        transition copy, the read-through, and the restore must never
+        see the object as one buffer (a 1 GiB object must not OOM)."""
+        monkeypatch.setenv("MTPU_ILM_CHUNK_MB", "0.25")
+
+        class _SpyBackend(DirTierBackend):
+            max_in = max_out = chunks_in = chunks_out = 0
+
+            def put_stream(self, key, chunks):
+                def watched():
+                    for c in chunks:
+                        _SpyBackend.chunks_in += 1
+                        _SpyBackend.max_in = max(_SpyBackend.max_in,
+                                                 len(c))
+                        yield c
+                return super().put_stream(key, watched())
+
+            def get_stream(self, key, offset=0, length=-1):
+                for c in super().get_stream(key, offset, length):
+                    _SpyBackend.chunks_out += 1
+                    _SpyBackend.max_out = max(_SpyBackend.max_out,
+                                              len(c))
+                    yield c
+
+        pools = make_pools(tmp_path)
+        tm = TierManager(pools)
+        tm.add_tier("COLD", _SpyBackend(str(tmp_path / "cold")))
+        pools.make_bucket("sb")
+        total = 4 << 20
+        data = payload(total, 51)
+        pools.put_object("sb", "big", data)
+        assert tm.transition_object("sb", "big", "COLD")
+        assert _SpyBackend.chunks_in > 1, "transition buffered the body"
+        assert _SpyBackend.max_in < total
+        fi = pools.head_object("sb", "big")
+        assert tm.read_through(fi) == data
+        assert _SpyBackend.chunks_out > 4, "read-through buffered"
+        assert _SpyBackend.max_out <= (1 << 18) + 1
+        assert tm.restore_object("sb", "big")
+        assert pools.get_object("sb", "big")[1] == data

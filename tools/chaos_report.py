@@ -47,6 +47,15 @@ runs under -m 'decom and slow'):
     $ python tools/chaos_report.py --decom
     $ python tools/chaos_report.py --decom \\
           --decom-points decom.pre_delete,decom.checkpoint
+
+`--ilm` runs the ILM kill-9 matrix instead: a server is SIGKILLed
+inside every MTPU_CRASH=ilm.* point mid-transition (or mid tier-free),
+rebooted, tier-journal replayed, and the exactly-once verdicts are
+tabled (the same scenarios tests/test_crash.py runs under
+-m 'crash and slow'):
+
+    $ python tools/chaos_report.py --ilm
+    $ python tools/chaos_report.py --ilm --ilm-points ilm.post_copy
 """
 
 import argparse
@@ -304,6 +313,48 @@ def run_decom_matrix(args) -> int:
     return 0
 
 
+def run_ilm_matrix(args) -> int:
+    """ILM kill-9 matrix: a server killed inside every ilm.* crash
+    point mid-transition (or mid tier-free), rebooted, tier-journal
+    replayed; per-scenario exactly-once verdict table."""
+    from minio_tpu.tools import crash_matrix as cm
+
+    scenarios = cm.ILM_SCENARIOS
+    if args.ilm_points:
+        wanted = {p.strip() for p in args.ilm_points.split(",")
+                  if p.strip()}
+        unknown = wanted - {s["point"] for s in cm.ILM_SCENARIOS}
+        if unknown:
+            print(f"unknown ilm point(s): {', '.join(sorted(unknown))}")
+            return 2
+        scenarios = tuple(s for s in cm.ILM_SCENARIOS
+                          if s["point"] in wanted)
+    print(f"== ILM kill-9 matrix :: seed {args.crash_seed}, "
+          f"{len(scenarios)} scenario(s) " + "=" * 24)
+    results = cm.run_ilm_matrix(scenarios, seed=args.crash_seed,
+                                progress=print)
+    print()
+    print(f'{"point":<18} {"nth":>3}  {"expect":<6} result')
+    bad = 0
+    for r in results:
+        if r.get("ok"):
+            verdict = "ok"
+        else:
+            verdict = f"FAIL ({r.get('error', '?')})"
+            bad += 1
+        print(f'{r["point"]:<18} {r["nth"]:>3}  {r["expect"]:<6} '
+              f'{verdict}')
+    print()
+    if bad:
+        print(f"{bad}/{len(results)} scenario(s) violated the "
+              f"tiering exactly-once contract")
+        return 1
+    print(f"all {len(results)} scenario(s) clean: every kill left a "
+          f"full hot version or a valid stub, the tier journal "
+          f"drained to zero, no tier object orphaned or leaked")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="seeded chaos scenario report for minio_tpu")
@@ -342,6 +393,13 @@ def main(argv=None) -> int:
     ap.add_argument("--decom-points", default="",
                     help="comma-separated subset of decom.* points to "
                          "run (default: the full matrix)")
+    ap.add_argument("--ilm", action="store_true",
+                    help="run the ILM kill-9 matrix (a server killed "
+                         "inside every ilm.* point mid-transition, "
+                         "then tier-journal replayed at boot)")
+    ap.add_argument("--ilm-points", default="",
+                    help="comma-separated subset of ilm.* points to "
+                         "run (default: the full matrix)")
     args = ap.parse_args(argv)
 
     if args.crash_matrix:
@@ -350,6 +408,8 @@ def main(argv=None) -> int:
         return run_net_matrix(args)
     if args.decom:
         return run_decom_matrix(args)
+    if args.ilm:
+        return run_ilm_matrix(args)
 
     seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
     failures = 0

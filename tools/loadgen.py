@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -126,7 +127,9 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
              bucket: str = "loadgen", warm_objects: int = 8,
              seed: int = 0, keyspace: str = "default",
              zipf: float | None = None,
-             range_frac: float = 0.0) -> dict:
+             range_frac: float = 0.0,
+             ilm_mix: float = 0.0, tier_mgr=None,
+             tier_root: str | None = None) -> dict:
     """Drive `clients` closed-loop workers against `es` for
     `duration_s`; returns aggregate GB/s, p50/p99 latency, and mean
     coalesced dispatch occupancy over the run.  `keyspace` picks the
@@ -137,7 +140,15 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     `zipf` switches GET key choice from uniform to Zipf(s) over the
     warm set (rank 0 hottest) and adds hot-vs-cold p50/p99 SLO rows to
     the result; `range_frac` makes that fraction of GETs ranged
-    (random aligned window), reported as their own SLO row."""
+    (random aligned window), reported as their own SLO row.
+
+    `ilm_mix` transitions that fraction of the warm set — its COLDEST
+    Zipf ranks, the shape the scanner ages out — to a warm tier before
+    the run; their GETs are served through stubs (head + tier
+    read-through, the same path the HTTP handlers take) and tagged as
+    their own stub_p50/p99 SLO row.  Pass a live `tier_mgr` to reuse
+    one (ilm_bench does), else a DirTierBackend is stood up under
+    `tier_root`."""
     if not es.bucket_exists(bucket):
         es.make_bucket(bucket)
     rng = np.random.default_rng(seed)
@@ -148,6 +159,20 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
         es.put_object(bucket, name, body)
     cdf = zipf_cdf(len(warm), zipf) if zipf else None
     cut = hot_rank_cut(len(warm))
+    stub_names: set[str] = set()
+    if ilm_mix > 0:
+        from minio_tpu.bucket.tier import DirTierBackend, TierManager
+        if tier_mgr is None:
+            tier_mgr = TierManager(es)
+        if not tier_mgr.list_tiers():
+            root = tier_root or os.path.join(
+                tempfile.mkdtemp(prefix="mtpu-loadgen-"), "tier")
+            tier_mgr.add_tier("LGWARM", DirTierBackend(root))
+        tname = tier_mgr.list_tiers()[0]
+        ncold = max(1, min(len(warm), int(round(len(warm) * ilm_mix))))
+        for name in warm[-ncold:]:       # coldest Zipf ranks age out
+            if tier_mgr.transition_object(bucket, name, tname):
+                stub_names.add(name)
     tier = getattr(es, "hot_tier", None) \
         or next((t for s in getattr(es, "sets", [])
                  if (t := getattr(s, "hot_tier", None)) is not None),
@@ -169,9 +194,25 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     lat_hot: list[list[float]] = [[] for _ in range(clients)]
     lat_cold: list[list[float]] = [[] for _ in range(clients)]
     lat_ranged: list[list[float]] = [[] for _ in range(clients)]
+    lat_stub: list[list[float]] = [[] for _ in range(clients)]
     nbytes = [0] * clients
     set_hits = [dict() for _ in range(clients)]
     errors: list[BaseException] = []
+
+    def stub_get(name: str, off: int | None, ln: int | None) -> bytes:
+        # The handlers' read path for transitioned versions: HEAD the
+        # stub, stream the bytes back from the tier.  The engine's own
+        # GET would return the stub's empty body (or raise out-of-range
+        # for a ranged read against size 0).
+        fi = es.head_object(bucket, name)
+        if not tier_mgr.is_transitioned(fi) or fi.size > 0:
+            # raced a restore: the hot copy is live again
+            _, got = es.get_object(bucket, name, *(
+                (off, ln) if off is not None else ()))
+            return got
+        if off is not None:
+            return b"".join(tier_mgr.read_through_iter(fi, off, ln))
+        return tier_mgr.read_through(fi)
 
     def client(ci: int) -> None:
         crng = np.random.default_rng(seed * 1000 + ci)
@@ -184,6 +225,7 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
                 got_bytes = object_size
                 rank = -1
                 ranged = False
+                is_stub = False
                 if is_put:
                     name = (mine[j % len(mine)] if name_set
                             else f"c{ci}-{j}")
@@ -195,22 +237,32 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
                     name = warm[rank]
                     ranged = (range_frac > 0
                               and crng.random() < range_frac)
+                    is_stub = name in stub_names
                     if ranged:
                         off = int(crng.integers(0, object_size))
                         ln = int(crng.integers(
                             1, object_size - off + 1))
-                        _, got = es.get_object(bucket, name, off, ln)
+                        if is_stub:
+                            got = stub_get(name, off, ln)
+                        else:
+                            _, got = es.get_object(bucket, name,
+                                                   off, ln)
                         got_bytes = ln
                         if len(got) != ln:
                             raise AssertionError("short ranged read")
                     else:
-                        _, got = es.get_object(bucket, name)
+                        if is_stub:
+                            got = stub_get(name, None, None)
+                        else:
+                            _, got = es.get_object(bucket, name)
                         if len(got) != object_size:
                             raise AssertionError("short read")
                 dt = time.monotonic() - t0
                 (lat_put if is_put else lat_get)[ci].append(dt)
                 if not is_put:
-                    if ranged:
+                    if is_stub:
+                        lat_stub[ci].append(dt)
+                    elif ranged:
                         lat_ranged[ci].append(dt)
                     elif 0 <= rank < cut:
                         lat_hot[ci].append(dt)
@@ -299,6 +351,15 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
             [x for per in lat_hot for x in per],
             [x for per in lat_cold for x in per],
             [x for per in lat_ranged for x in per]))
+    if ilm_mix > 0:
+        stubs = [x for per in lat_stub for x in per]
+        out["ilm_mix"] = ilm_mix
+        out["stub_objects"] = len(stub_names)
+        out["stub_gets"] = len(stubs)
+        out["stub_p50_ms"] = round(_quantile(stubs, 0.50) * 1e3, 3)
+        out["stub_p99_ms"] = round(_quantile(stubs, 0.99) * 1e3, 3)
+        # exactly-once evidence: nothing left in flight after the run
+        out["ilm_journal_pending"] = tier_mgr.journal.pending()
     if tier0 is not None:
         t1 = tier.stats()
         d_hits = t1["hits"] - tier0["hits"]
@@ -318,13 +379,17 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
                        duration_s: float, seed: int,
                        tag_pools: bool = False,
                        zipf: float | None = None,
-                       range_frac: float = 0.0) -> dict:
+                       range_frac: float = 0.0,
+                       stub_names: frozenset = frozenset()) -> dict:
     """One load PROCESS: `clients` closed-loop threads, each with its
     own S3Client (own connections).  Returns picklable lat/byte tallies
     so --procs can merge across forks.  tag_pools reads the
     x-mtpu-pool response header off every PUT (multi-pool placement
     histogram — --during-decom's skew evidence); zipf/range_frac mirror
-    run_load's Zipfian GET mix."""
+    run_load's Zipfian GET mix.  GETs of `stub_names` (warm keys the
+    caller transitioned to a tier) are issued raw so the x-amz-
+    storage-class response header can be checked — proof the bytes
+    came through a stub — and tagged as their own lat_stub bucket."""
     from minio_tpu.server.client import S3Client
     stop = threading.Event()
     lat_put: list[list[float]] = [[] for _ in range(clients)]
@@ -332,8 +397,10 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
     lat_hot: list[list[float]] = [[] for _ in range(clients)]
     lat_cold: list[list[float]] = [[] for _ in range(clients)]
     lat_ranged: list[list[float]] = [[] for _ in range(clients)]
+    lat_stub: list[list[float]] = [[] for _ in range(clients)]
     nbytes = [0] * clients
     pool_hits: list[dict[str, int]] = [dict() for _ in range(clients)]
+    stub_noclass = [0] * clients
     errors: list[str] = []
     cdf = zipf_cdf(len(warm), zipf) if zipf else None
     cut = hot_rank_cut(len(warm))
@@ -363,22 +430,47 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
                     name = warm[rank]
                     ranged = (range_frac > 0
                               and crng.random() < range_frac)
+                    is_stub = name in stub_names
                     if ranged:
                         off = int(crng.integers(0, len(body)))
                         end = int(crng.integers(off, len(body)))
-                        got = cli.get_object(bucket, name,
-                                             range_=(off, end))
                         got_bytes = end - off + 1
+                        if is_stub:
+                            st, h, got = cli.request(
+                                "GET", f"/{bucket}/{name}",
+                                headers={"Range":
+                                         f"bytes={off}-{end}"})
+                            if st != 206:
+                                raise AssertionError(
+                                    f"stub ranged GET -> {st}")
+                            if not (h.get("x-amz-storage-class") or
+                                    h.get("X-Amz-Storage-Class")):
+                                stub_noclass[ci] += 1
+                        else:
+                            got = cli.get_object(bucket, name,
+                                                 range_=(off, end))
                         if len(got) != got_bytes:
                             raise AssertionError("short ranged read")
                     else:
-                        got = cli.get_object(bucket, name)
+                        if is_stub:
+                            st, h, got = cli.request(
+                                "GET", f"/{bucket}/{name}")
+                            if st != 200:
+                                raise AssertionError(
+                                    f"stub GET -> {st}")
+                            if not (h.get("x-amz-storage-class") or
+                                    h.get("X-Amz-Storage-Class")):
+                                stub_noclass[ci] += 1
+                        else:
+                            got = cli.get_object(bucket, name)
                         if len(got) != len(body):
                             raise AssertionError("short read")
                 dt = time.monotonic() - t0
                 (lat_put if is_put else lat_get)[ci].append(dt)
                 if not is_put:
-                    if ranged:
+                    if is_stub:
+                        lat_stub[ci].append(dt)
+                    elif ranged:
                         lat_ranged[ci].append(dt)
                     elif 0 <= rank < cut:
                         lat_hot[ci].append(dt)
@@ -406,6 +498,8 @@ def _http_clients_loop(endpoint: str, creds: tuple[str, str],
             "lat_hot": [x for per in lat_hot for x in per],
             "lat_cold": [x for per in lat_cold for x in per],
             "lat_ranged": [x for per in lat_ranged for x in per],
+            "lat_stub": [x for per in lat_stub for x in per],
+            "stub_noclass": sum(stub_noclass),
             "nbytes": sum(nbytes), "errors": errors,
             "pool_hits": merged}
 
@@ -418,12 +512,22 @@ def run_load_http(endpoint: str, *, clients: int = 4,
                   secret_key: str = "minioadmin",
                   tag_pools: bool = False,
                   zipf: float | None = None,
-                  range_frac: float = 0.0) -> dict:
+                  range_frac: float = 0.0,
+                  ilm_mix: float = 0.0,
+                  tier_path: str | None = None) -> dict:
     """HTTP closed loop against a running endpoint; with procs>1 the
     `clients` are spread over that many forked client processes.
     tag_pools adds a pool_hits histogram (PUTs per placement pool,
     from the x-mtpu-pool response header) — run it against a server
-    mid-decommission and the draining pool must show zero hits."""
+    mid-decommission and the draining pool must show zero hits.
+
+    `ilm_mix` registers an fs warm tier through the admin plane (at
+    `tier_path`, which must be a directory the SERVER can reach — this
+    mode assumes a local endpoint) and transitions that fraction of
+    the warm set's coldest ranks before the run; their GETs come back
+    through stubs and are reported as stub_p50/p99 rows, with the
+    x-amz-storage-class response header checked on every one."""
+    import json as _json
     import multiprocessing as mp
     from minio_tpu.server.client import S3Client
 
@@ -436,6 +540,34 @@ def run_load_http(endpoint: str, *, clients: int = 4,
     for name in warm:
         cli.put_object(bucket, name, body)
 
+    stub_names: frozenset = frozenset()
+    if ilm_mix > 0:
+        tname = "LGWARM"
+        path = tier_path or tempfile.mkdtemp(prefix="mtpu-lg-tier-")
+        st, _, rb = cli.request(
+            "POST", "/minio/admin/v3/tier",
+            body=_json.dumps({"name": tname, "type": "fs",
+                              "path": path}).encode(),
+            headers={"Content-Type": "application/json"})
+        # 409 = tier already registered from an earlier run: reuse it
+        if st not in (200, 409):
+            raise RuntimeError(f"tier add -> {st}: {rb[:200]!r}")
+        moved = []
+        ncold = max(1, min(len(warm),
+                           int(round(len(warm) * ilm_mix))))
+        for name in warm[-ncold:]:       # coldest Zipf ranks age out
+            st, _, rb = cli.request(
+                "POST", "/minio/admin/v3/ilm",
+                body=_json.dumps({"bucket": bucket, "object": name,
+                                  "tier": tname}).encode(),
+                headers={"Content-Type": "application/json"})
+            if st != 200:
+                raise RuntimeError(
+                    f"transition {name} -> {st}: {rb[:200]!r}")
+            if _json.loads(rb).get("transitioned"):
+                moved.append(name)
+        stub_names = frozenset(moved)
+
     procs = max(1, min(procs, clients))
     # spread clients over processes; earlier procs take the remainder
     per = [clients // procs + (1 if i < clients % procs else 0)
@@ -445,7 +577,8 @@ def run_load_http(endpoint: str, *, clients: int = 4,
     if procs == 1:
         parts = [_http_clients_loop(endpoint, creds, bucket, warm, body,
                                     clients, put_frac, duration_s,
-                                    seed, tag_pools, zipf, range_frac)]
+                                    seed, tag_pools, zipf, range_frac,
+                                    stub_names)]
     else:
         ctx = mp.get_context("fork")
         q: mp.Queue = ctx.Queue()
@@ -454,7 +587,7 @@ def run_load_http(endpoint: str, *, clients: int = 4,
             q.put(_http_clients_loop(endpoint, creds, bucket, warm,
                                      body, n, put_frac, duration_s,
                                      seed + i, tag_pools, zipf,
-                                     range_frac))
+                                     range_frac, stub_names))
 
         ps = [ctx.Process(target=entry, args=(i, n), daemon=True)
               for i, n in enumerate(per) if n]
@@ -487,6 +620,17 @@ def run_load_http(endpoint: str, *, clients: int = 4,
             [x for p in parts for x in p.get("lat_hot", [])],
             [x for p in parts for x in p.get("lat_cold", [])],
             [x for p in parts for x in p.get("lat_ranged", [])]))
+    if ilm_mix > 0:
+        stubs = [x for p in parts for x in p.get("lat_stub", [])]
+        noclass = sum(p.get("stub_noclass", 0) for p in parts)
+        res["ilm_mix"] = ilm_mix
+        res["stub_objects"] = len(stub_names)
+        res["stub_gets"] = len(stubs)
+        res["stub_p50_ms"] = round(_quantile(stubs, 0.50) * 1e3, 3)
+        res["stub_p99_ms"] = round(_quantile(stubs, 0.99) * 1e3, 3)
+        # every stub GET must carry the tier's storage class — 0 here
+        # means every tagged read provably came through a stub
+        res["stub_missing_storage_class"] = noclass
     if tag_pools:
         merged: dict[str, int] = {}
         for part in parts:
@@ -567,6 +711,15 @@ def main(argv=None) -> int:
     ap.add_argument("--range-frac", type=float, default=0.0,
                     help="fraction of GETs issued as random ranged "
                     "reads (their own SLO row)")
+    ap.add_argument("--ilm-mix", type=float, default=0.0,
+                    metavar="FRAC",
+                    help="transition FRAC of the warm set's coldest "
+                    "ranks to a warm tier before the run and tag "
+                    "their GETs — served through ILM stubs — as their "
+                    "own stub_p50/p99 SLO row.  Engine mode reads "
+                    "through a local dir tier; HTTP mode registers an "
+                    "fs tier via the admin plane (local endpoint) and "
+                    "checks x-amz-storage-class on every stub GET")
     ap.add_argument("--warm-objects", type=int, default=None,
                     help="warm GET keyspace size (default 8, or 64 "
                     "under --zipf so the skew has a tail)")
@@ -618,7 +771,8 @@ def main(argv=None) -> int:
                             secret_key=args.secret_key,
                             tag_pools=args.during_decom,
                             zipf=args.zipf,
-                            range_frac=args.range_frac)
+                            range_frac=args.range_frac,
+                            ilm_mix=args.ilm_mix)
     else:
         es = (make_sets(args.root, nsets=args.sets,
                         set_drives=args.drives, parity=args.parity)
@@ -634,7 +788,9 @@ def main(argv=None) -> int:
                        put_frac=args.mix, duration_s=args.duration,
                        warm_objects=warm_objects,
                        keyspace=args.keyspace, zipf=args.zipf,
-                       range_frac=args.range_frac)
+                       range_frac=args.range_frac,
+                       ilm_mix=args.ilm_mix,
+                       tier_root=os.path.join(args.root, "tier"))
     w = max(len(k) for k in res)
     for k, v in res.items():
         print(f"{k:<{w}}  {v}")
