@@ -562,6 +562,106 @@ def hotcache_bench(duration_s: float = 3.0, object_kib: int = 1024,
     return out
 
 
+def zerocopy_bench(duration_s: float = 3.0, clients: int = 4) -> dict:
+    """Zero-copy data-path suite (ISSUE 16): GB/s AND CPU-seconds-per-
+    GB, MTPU_ZEROCOPY=1 vs the =0 buffered/copying oracle, per leg.
+
+    The engine runs in-process, so RUSAGE_SELF over each run window is
+    the server-side CPU bill for the bytes moved — on a 1-core,
+    GIL-bound host, CPU-s/GB IS the reciprocal throughput ceiling, and
+    it's the metric the vertical budgets (the GB/s delta follows from
+    it whenever the leg is CPU-bound).
+
+    Legs, each run under both flag values:
+      * healthy_get — 1 MiB whole GETs of cold-ish keys (hot tier off):
+        vectored reads + view-based assembly, no response copy.
+      * hotcache_get — Zipf(1.1) GETs over a RAM-resident warm set:
+        arena-view hits (no bytes() per hit) — the ≥20% CPU-s/GB win
+        the acceptance gate names.
+      * mp_put — 1 MiB PUTs: staging fan-out through one
+        fallocate+pwritev per drive instead of per-batch appends.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.engine.hotcache import HotObjectCache, attach_sets
+    from tools.loadgen import make_set, run_load
+
+    # Drives on tmpfs when available: this suite prices the CPU per
+    # byte moved, and disk writeback throttling stalls arbitrary
+    # client threads — ±50% run-to-run noise that swamps the flag
+    # deltas.  tmpfs write cost is pure CPU (page copies), exactly the
+    # axis MTPU_ZEROCOPY moves.
+    shm = "/dev/shm" if os.access("/dev/shm", os.W_OK) else None
+    out: dict = {}
+    legs = {
+        # put_frac=0 + uniform GETs over a set larger than one batch;
+        # use_iter = the serving path (what the HTTP writer consumes)
+        "healthy_get": dict(clients=clients, object_size=1 << 20,
+                            put_frac=0.0, warm_objects=16, seed=16,
+                            use_iter=True),
+        # GET-dominated Zipf mix over 32 cacheable keys
+        "hotcache_get": dict(clients=clients, object_size=512 << 10,
+                             put_frac=0.0, warm_objects=32, seed=17,
+                             zipf=1.1, use_iter=True),
+        "mp_put": dict(clients=clients, object_size=1 << 20,
+                       put_frac=1.0, warm_objects=2, seed=18),
+    }
+    for leg, mix in legs.items():
+        # ABBA schedule: PUT-heavy legs show a systematic later-run
+        # advantage on this box (writeback/frequency ramp) — running
+        # zc, oracle, oracle, zc and averaging per flag cancels the
+        # linear drift a single ordered pair bakes in.
+        acc: dict = {"zc": [], "oracle": []}
+        for label, flag in (("zc", "1"), ("oracle", "0"),
+                            ("oracle", "0"), ("zc", "1")):
+            os.environ["MTPU_ZEROCOPY"] = flag
+            root = tempfile.mkdtemp(prefix=f"mtpu-zc-{leg}-{label}-",
+                                    dir=shm)
+            try:
+                es = make_set(root, n=4)
+                if leg == "hotcache_get":
+                    attach_sets(es, HotObjectCache(
+                        total_bytes=256 << 20))
+                # Untimed warmup: first-use costs (kernel compilation,
+                # lazy imports, cache admission) must not land inside
+                # whichever flag value happens to run first — the
+                # first sustained PUT run in a process measures ~2x
+                # slow under EITHER flag without this.
+                run_load(es, duration_s=2.0, **mix)
+                r = run_load(es, duration_s=duration_s, **mix)
+                acc[label].append(r)
+                if leg == "hotcache_get" and flag == "1":
+                    out["hotcache_hit_ratio"] = r.get(
+                        "hotcache_hit_ratio", 0.0)
+            finally:
+                os.environ.pop("MTPU_ZEROCOPY", None)
+                shutil.rmtree(root, ignore_errors=True)
+        for label, runs in acc.items():
+            for key, col in (("gbps", "gbps"),
+                             ("cpu_s_per_gb", "cpu_s_per_gb"),
+                             ("cpu_util", "cpu_util"),
+                             ("p50_ms", "p50_ms")):
+                out[f"{leg}_{label}_{key}"] = round(
+                    sum(r[col] for r in runs) / len(runs), 3)
+        o, z = out[f"{leg}_oracle_cpu_s_per_gb"], \
+            out[f"{leg}_zc_cpu_s_per_gb"]
+        out[f"{leg}_cpu_per_gb_saving"] = round(1 - z / o, 3) if o else 0.0
+        out[f"{leg}_gbps_ratio"] = round(
+            out[f"{leg}_zc_gbps"] / out[f"{leg}_oracle_gbps"], 3) \
+            if out[f"{leg}_oracle_gbps"] else 0.0
+    # transport counter deltas over the whole suite prove which paths
+    # actually fired (views/sendmsg live behind the HTTP writer; the
+    # engine legs exercise views + vectored writes)
+    from minio_tpu.observe.metrics import DATA_PATH
+    snap = DATA_PATH.snapshot()
+    for k in ("zerocopy_hot_views", "zerocopy_vectored_writes",
+              "zerocopy_fallbacks"):
+        out[k] = snap[k]
+    return out
+
+
 def ilm_bench(duration_s: float = 3.0, object_kib: int = 256,
               clients: int = 4, n_objects: int = 192) -> dict:
     """Data-temperature suite (bucket/tier.py): what tiering costs and
@@ -1835,6 +1935,45 @@ def _ilm_main() -> None:
         raise SystemExit(1)
 
 
+def _zerocopy_main() -> None:
+    """`python bench.py zerocopy_bench` — zero-copy suite alone, JSON
+    to stdout and ZEROCOPY_r16.json for the record.  Gates (ISSUE 16):
+    healthy-GET and mp-PUT GB/s must not regress vs the oracle, and
+    the hot-cache GET leg must cut CPU-seconds-per-GB by >= 20%."""
+    import os
+    doc = {"rc": 0, "ok": False}
+    try:
+        extras = zerocopy_bench()
+        doc["ok"] = (
+            extras.get("healthy_get_gbps_ratio", 0.0) >= 1.0
+            and extras.get("mp_put_gbps_ratio", 0.0) >= 1.0
+            and extras.get("hotcache_get_cpu_per_gb_saving", 0.0)
+            >= 0.20)
+        doc["extras"] = extras
+        doc["tail"] = (
+            f"zerocopy_bench {'OK' if doc['ok'] else 'VIOLATION'}: "
+            f"hot-cache CPU-s/GB "
+            f"{extras.get('hotcache_get_oracle_cpu_s_per_gb')} -> "
+            f"{extras.get('hotcache_get_zc_cpu_s_per_gb')} "
+            f"({extras.get('hotcache_get_cpu_per_gb_saving', 0.0):.0%}"
+            f" saved), healthy-GET x"
+            f"{extras.get('healthy_get_gbps_ratio')}, mp-PUT x"
+            f"{extras.get('mp_put_gbps_ratio')} vs oracle; "
+            f"{extras.get('zerocopy_hot_views')} view hits, "
+            f"{extras.get('zerocopy_vectored_writes')} vectored writes")
+    except Exception as e:  # noqa: BLE001 — the round file records it
+        doc["rc"] = 1
+        doc["tail"] = f"{type(e).__name__}: {e}"
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "ZEROCOPY_r16.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc))
+    if doc["rc"] or not doc["ok"]:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     if sys.argv[1:2] == ["multichip_bench"]:
         _multichip_main()
@@ -1842,5 +1981,7 @@ if __name__ == "__main__":
         _hotcache_main()
     elif sys.argv[1:2] == ["ilm_bench"]:
         _ilm_main()
+    elif sys.argv[1:2] == ["zerocopy_bench"]:
+        _zerocopy_main()
     else:
         main()

@@ -33,6 +33,7 @@ from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
 from ..ops import coalesce, fused
 from ..ops import devices as devices_mod
+from ..ops import zerocopy as zc
 from ..ops.erasure_cpu import ReedSolomonCPU
 from ..ops.erasure_jax import ReedSolomonTPU
 from ..parallel import pipeline as pl
@@ -644,9 +645,20 @@ class ErasureSet:
                 d = self.drives[pos]
                 if d is None:
                     raise ErrDiskNotFound("offline")
-                for pdc in per_drive:
+                bufs = [pdc[pos] for pdc in per_drive]
+                # Vectored staging: the whole per-drive fan-out is one
+                # open + fallocate + pwritev instead of one
+                # open/write/close per batch.  Feature-detected so
+                # RPC/remote drives (no write_file_batches) keep the
+                # append loop; MTPU_ZEROCOPY=0 is the oracle.
+                wfb = (getattr(d, "write_file_batches", None)
+                       if zc.zerocopy_enabled() else None)
+                if wfb is not None:
+                    wfb(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.1", bufs)
+                    return
+                for buf in bufs:
                     d.append_file(SYS_VOL,
-                                  f"{TMP_DIR}/{tmp_id}/part.1", pdc[pos])
+                                  f"{TMP_DIR}/{tmp_id}/part.1", buf)
 
             # Quorum gate BETWEEN staging and publish: nothing becomes
             # visible unless enough drives staged — a failed PUT must
@@ -702,6 +714,16 @@ class ErasureSet:
                 def write_one(pos):
                     d = self.drives[pos]
                     if d is None or failed[pos]:
+                        return
+                    # Streaming batches ride the vectored writer too (a
+                    # one-element iovec): same single open per batch,
+                    # but with fallocate extension and the
+                    # O_DIRECT-when-aligned path for bulk shards.
+                    wfb = (getattr(d, "write_file_batches", None)
+                           if zc.zerocopy_enabled() else None)
+                    if wfb is not None:
+                        wfb(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.1",
+                            [per_drive[pos]])
                         return
                     d.append_file(SYS_VOL, f"{TMP_DIR}/{tmp_id}/part.1",
                                   per_drive[pos])
@@ -1284,10 +1306,15 @@ class ErasureSet:
             return fi, b""
         data = self._read_whole_small(bucket, obj, fi, metas, version_id)
         if data is not None:
-            # Inline/v1 objects are small — the (rare) ranged slice copy
-            # is cheaper than making every caller memoryview-safe.
             if offset == 0 and length == len(data):
                 return fi, data
+            # Ranged inline/v1 reads serve a memoryview SLICE of the
+            # already-materialized body: every consumer (socket writer,
+            # hashing, bytes()) takes any buffer, so the per-request
+            # copy was pure CPU tax.  MTPU_ZEROCOPY=0 keeps the copying
+            # bytes slice as the byte-identical oracle.
+            if zc.zerocopy_enabled():
+                return fi, memoryview(data)[offset:offset + length]
             return fi, data[offset:offset + length]
 
         # The zeroed destination buffer is real time at 10s of MiB
@@ -1482,13 +1509,30 @@ class ErasureSet:
         (the GetObjectReader role, cmd/object-api-utils.go:392-528)."""
         if self.hot_tier is not None and self.hot_tier.enabled:
             tier = self.hot_tier
-            got = tier.lookup(bucket, obj, version_id)
-            if got is not None:
-                hfi, body = got
-                chunk = self._hot_range(hfi, memoryview(body), offset,
-                                        length)
-                return hfi, (iter(()) if len(chunk) == 0
-                             else iter((chunk,)))
+            if zc.zerocopy_enabled() \
+                    and hasattr(tier, "lookup_view"):
+                # Zero-copy hit: the chunk is an ndarray view pinned
+                # over the shared arena (release rides the view's GC;
+                # eviction under the pin only defers slot reuse).  The
+                # socket writer sends it via sendmsg without any
+                # bytes() materialization — ranged GETs slice the view,
+                # not copy it.
+                got = tier.lookup_view(bucket, obj, version_id)
+                if got is not None:
+                    hfi, body = got
+                    chunk = self._hot_range(hfi, body, offset, length)
+                    DATA_PATH.record_zerocopy_hot_view(len(chunk))
+                    return hfi, (iter(()) if len(chunk) == 0
+                                 else iter((chunk,)))
+            else:
+                got = tier.lookup(bucket, obj, version_id)
+                if got is not None:
+                    hfi, body = got
+                    chunk = self._hot_range(hfi, memoryview(body),
+                                            offset, length)
+                    return hfi, (iter(()) if len(chunk) == 0
+                                 else iter((chunk,)))
+            got = None
             # Cold cacheable object: delegate to the single-flight
             # whole-read (fills the cache; O(max_obj) memory is the
             # admission bound, so streaming degrades to nothing).
@@ -1545,6 +1589,91 @@ class ErasureSet:
                                        healthy=not degraded)
         return fi, pl.prefetch_map(ospan.wrap_ctx(read_seg), segs, pool,
                                    depth=1)
+
+    def sendfile_plan(self, bucket: str, obj: str, offset: int = 0,
+                      length: int = -1, version_id: str = ""):
+        """Kernel-send plan for a whole healthy GET, or None.
+
+        When the object's framing allows it — k=1 layout, so each
+        part's single data shard IS the plaintext interleaved with
+        bitrot frames — the response body can leave via os.sendfile of
+        the data runs: the bytes go page cache -> socket without ever
+        entering the process.  Returns (fi, [FilePlan, ...]) with the
+        shard files ALREADY digest-verified through an mmap over the
+        same fds the sends will use (a racing delete only unlinks the
+        name), or None when any gate fails — the caller then takes the
+        normal engine read, so this is a pure opportunistic overlay.
+
+        Gates: MTPU_ZEROCOPY on; whole object (offset 0, full length);
+        k=1 streaming layout (not inline, not legacy v1); nothing
+        degraded; the shard drive is a healthy LocalDrive; and the
+        object is NOT hot-cacheable when the RAM tier is on (the tier
+        owns the small hot set — sendfile serves what the cache
+        can't)."""
+        if not zc.zerocopy_enabled():
+            return None
+        try:
+            fi, metas, offset, length = self._plan_read(
+                bucket, obj, offset, length, version_id)
+        except StorageError:
+            return None          # normal path surfaces the real error
+        if offset != 0 or length != fi.size or fi.size <= 0:
+            return None
+        if fi.erasure.data_blocks != 1:
+            return None
+        if fi.inline_data is not None or not fi.parts \
+                or not fi.data_dir:
+            return None
+        from ..storage import xlmeta_v1
+        if xlmeta_v1.is_v1(fi):
+            return None
+        if self.hot_tier is not None and self.hot_tier.enabled \
+                and self._hot_cacheable(fi):
+            return None
+        if any(m is None for m in metas) \
+                or any(d is None for d in self.drives):
+            return None
+        order = Q.shuffle_by_distribution(list(range(self.n)),
+                                          fi.erasure.distribution)
+        d = self.drives[order[0]]
+        if not isinstance(d, LocalDrive) or not drive_available(d):
+            return None
+        import mmap as _mmap
+        shard_size = fi.erasure.shard_size
+        plans: list[zc.FilePlan] = []
+        try:
+            for part in fi.parts:
+                algo = fi.erasure.bitrot_algo(part.number)
+                hs = bitrot_io.digest_size(algo)
+                frame = hs + shard_size
+                fd = d.open_read_fd(
+                    bucket, f"{obj}/{fi.data_dir}/part.{part.number}")
+                full = part.size // shard_size
+                tail = part.size - full * shard_size
+                runs = [(b * frame + hs, shard_size)
+                        for b in range(full)]
+                if tail:
+                    runs.append((full * frame + hs, tail))
+                # FilePlan owns the fd from here (closes on any bail).
+                plan = zc.FilePlan(fd, runs, part.size)
+                plans.append(plan)
+                want = bitrot_io.bitrot_shard_file_size(
+                    part.size, shard_size, algo)
+                if os.fstat(fd).st_size != want:
+                    raise ErrFileCorrupt("sendfile plan size mismatch")
+                # Verify the framed shard through the SAME fd the sends
+                # will use.  The mmap is dropped, not closed: numpy may
+                # still export its buffer and GC unmaps it safely.
+                mm = _mmap.mmap(fd, want, prot=_mmap.PROT_READ)
+                bitrot_io.unframe_shard(memoryview(mm), shard_size,
+                                        verify=True,
+                                        logical_size=part.size,
+                                        algo=algo)
+        except (StorageError, OSError, ValueError):
+            for p in plans:
+                p.close()
+            return None
+        return fi, plans
 
     def _read_v1_object(self, bucket, obj, fi) -> bytes:
         """Whole-object read of a legacy (xl.json) object: per-drive

@@ -50,10 +50,12 @@ the segment before any engine/jax import happens.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import os
 import pickle
 import threading
+import weakref
 
 import numpy as np
 
@@ -192,6 +194,12 @@ class HotObjectCache:
         ctx = multiprocessing.get_context("fork")
         self._mu = ctx.RLock()
         self.flights = SingleFlight()
+        #: arena offsets whose zero-copy view died (weakref.finalize)
+        #: — released on the next cache operation, NOT in the GC
+        #: callback: release() takes the arena's non-reentrant
+        #: fork-shared lock, and cyclic GC can run while this thread
+        #: already holds it.  deque append/popleft are atomic.
+        self._dead_views: collections.deque = collections.deque()
         #: optional per-process observer — pool workers point this at
         #: their SharedState slab slot (hit/miss per worker).
         self.on_lookup = None
@@ -265,6 +273,7 @@ class HotObjectCache:
     def lookup(self, bucket: str, obj: str, version_id: str):
         """Full hit: (fi, body bytes) or None.  The returned FileInfo
         is a fresh unpickle — callers may mutate it freely."""
+        self.drain_released_views()
         key = _key_bytes(bucket, obj, version_id)
         h = _key_hash(key)
         with self._mu:
@@ -283,6 +292,69 @@ class HotObjectCache:
                 self._hdr[0] -= 1
                 self._hdr[1] += 1
                 self._hdr[8] += 1
+        if self.on_lookup is not None:
+            self.on_lookup(False)
+        return None
+
+    def drain_released_views(self) -> None:
+        """Release the arena pins of dead lookup_view results (queued
+        by their finalizers); called at the top of every cache
+        operation and exposed for tests that assert pin counts."""
+        dq = self._dead_views
+        while dq:
+            try:
+                off = dq.popleft()
+            except IndexError:
+                break
+            self.arena.release(off)
+
+    def lookup_view(self, bucket: str, obj: str, version_id: str):
+        """Zero-copy full hit: (fi, body) with the body a uint8 ndarray
+        view STRAIGHT OVER the arena run — no bytes() copy, no slice
+        copy (the MTPU_ZEROCOPY serve path; lookup() is the copying
+        oracle).
+
+        The run stays retained until the view's base array dies
+        (weakref.finalize queues the release), so the caller can hand
+        the view — or any slice of it, slices keep the base alive — to
+        sendmsg and simply drop it.  Eviction while pinned only DEFERS
+        the arena free (ShmArena pending-free), so the bytes under the
+        view can never be reused mid-send: torn bodies stay impossible.
+        """
+        self.drain_released_views()
+        key = _key_bytes(bucket, obj, version_id)
+        h = _key_hash(key)
+        with self._mu:
+            pinned = self._pin_locked(bucket, h)
+            if pinned is None:
+                self._hdr[1] += 1
+            else:
+                self._hdr[0] += 1
+        if pinned is not None:
+            off, total = pinned
+            base = self.arena.view(off, total)
+            try:
+                klen = int.from_bytes(base[:4].tobytes(), "little")
+                filen = int.from_bytes(base[4:8].tobytes(), "little")
+                meta_end = _BLOB_HDR + klen + filen
+                if base[_BLOB_HDR:_BLOB_HDR + klen].tobytes() != key:
+                    raise KeyError      # 64-bit hash collision
+                fi = pickle.loads(
+                    base[_BLOB_HDR + klen:meta_end].tobytes())
+            except Exception:  # noqa: BLE001 — collision/corrupt blob
+                self.arena.release(off)
+                with self._mu:          # a miss after all
+                    self._hdr[0] -= 1
+                    self._hdr[1] += 1
+                    self._hdr[8] += 1
+                if self.on_lookup is not None:
+                    self.on_lookup(False)
+                return None
+            weakref.finalize(
+                base, self._dead_views.append, off)
+            if self.on_lookup is not None:
+                self.on_lookup(True)
+            return fi, base[meta_end:]
         if self.on_lookup is not None:
             self.on_lookup(False)
         return None
@@ -333,6 +405,7 @@ class HotObjectCache:
         captured BEFORE the engine read started — if a write raced the
         read, the stamp mismatches and the fill is dropped (a cached
         entry may never outlive the bytes it was read from)."""
+        self.drain_released_views()
         blen = len(body)
         if blen == 0 or blen > self.max_obj:
             self.note_bypass()

@@ -263,6 +263,27 @@ class ServerPools:
             raise ErrBucketNotFound(bucket)
         raise last or ErrObjectNotFound(f"{bucket}/{obj}")
 
+    def sendfile_plan(self, bucket: str, obj: str, offset: int = 0,
+                      length: int = -1, version_id: str = ""):
+        """Kernel-send plan (fi, [FilePlan...]) from the pool that owns
+        the object, or None — never raises; the normal read path is the
+        error oracle."""
+        order = list(self.pools)
+        if self.draining and not version_id:
+            idx = self._read_pool_idx(bucket, obj)
+            order = [self.pools[idx]] if idx is not None else []
+        for p in order:
+            sp = getattr(p, "sendfile_plan", None)
+            if sp is None:
+                continue
+            try:
+                got = sp(bucket, obj, offset, length, version_id)
+            except StorageError:
+                return None
+            if got is not None:
+                return got
+        return None
+
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
         last: StorageError | None = None

@@ -119,6 +119,13 @@ class ErasureSets:
         return self.set_for(obj).get_object_iter(bucket, obj, offset,
                                                  length, version_id)
 
+    def sendfile_plan(self, bucket: str, obj: str, offset: int = 0,
+                      length: int = -1, version_id: str = ""):
+        """Kernel-send plan when the owning set's framing allows it
+        (ErasureSet.sendfile_plan), else None."""
+        return self.set_for(obj).sendfile_plan(bucket, obj, offset,
+                                               length, version_id)
+
     def head_object(self, bucket: str, obj: str,
                     version_id: str = "") -> FileInfo:
         return self.set_for(obj).head_object(bucket, obj, version_id)

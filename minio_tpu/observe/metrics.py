@@ -271,6 +271,20 @@ class DataPathStats:
             self.netchaos_injected = {"slow": 0, "reset": 0,
                                       "blackhole": 0, "truncate": 0,
                                       "oneway": 0}
+            # Zero-copy data path (PR 16, ops/zerocopy.py): hot-cache
+            # GETs served as pinned arena views (no userspace body
+            # copy), gather-write sendmsg responses, kernel sendfile
+            # responses, vectored shard writes (pwritev batches), and
+            # eligibility fallbacks to the buffered path.
+            self.zerocopy_hot_views = 0
+            self.zerocopy_hot_view_bytes = 0
+            self.zerocopy_sendmsg = 0
+            self.zerocopy_sendmsg_bytes = 0
+            self.zerocopy_sendfile = 0
+            self.zerocopy_sendfile_bytes = 0
+            self.zerocopy_vectored_writes = 0
+            self.zerocopy_vectored_write_bytes = 0
+            self.zerocopy_fallbacks = 0
 
     def record_heal_batch(self, blocks: int, capacity: int,
                           source_bytes: int, out_bytes: int,
@@ -433,6 +447,38 @@ class DataPathStats:
             if kind in self.netchaos_injected:
                 self.netchaos_injected[kind] += 1
 
+    def record_zerocopy_hot_view(self, nbytes: int) -> None:
+        """One hot-cache GET answered with a pinned arena view (the
+        body never crossed into a userspace copy)."""
+        with self._mu:
+            self.zerocopy_hot_views += 1
+            self.zerocopy_hot_view_bytes += nbytes
+
+    def record_zerocopy_send(self, kind: str, nbytes: int) -> None:
+        """One response body shipped by the zero-copy writer; `kind`
+        is "sendmsg" (gather) or "sendfile" (kernel file send)."""
+        with self._mu:
+            if kind == "sendfile":
+                self.zerocopy_sendfile += 1
+                self.zerocopy_sendfile_bytes += nbytes
+            else:
+                self.zerocopy_sendmsg += 1
+                self.zerocopy_sendmsg_bytes += nbytes
+
+    def record_zerocopy_vectored_write(self, nbytes: int) -> None:
+        """One pwritev-batched shard append (all stripes of one shard
+        in a single vectored syscall)."""
+        with self._mu:
+            self.zerocopy_vectored_writes += 1
+            self.zerocopy_vectored_write_bytes += nbytes
+
+    def record_zerocopy_fallback(self) -> None:
+        """A response that was eligible-looking but fell back to the
+        buffered writer (TLS socket, chunked framing, flag off at send
+        time)."""
+        with self._mu:
+            self.zerocopy_fallbacks += 1
+
     def snapshot(self) -> dict:
         with self._mu:
             return {
@@ -500,6 +546,16 @@ class DataPathStats:
                 "rpc_retries": self.rpc_retries,
                 "rpc_deadline_exceeded": self.rpc_deadline_exceeded,
                 "netchaos_injected": dict(self.netchaos_injected),
+                "zerocopy_hot_views": self.zerocopy_hot_views,
+                "zerocopy_hot_view_bytes": self.zerocopy_hot_view_bytes,
+                "zerocopy_sendmsg": self.zerocopy_sendmsg,
+                "zerocopy_sendmsg_bytes": self.zerocopy_sendmsg_bytes,
+                "zerocopy_sendfile": self.zerocopy_sendfile,
+                "zerocopy_sendfile_bytes": self.zerocopy_sendfile_bytes,
+                "zerocopy_vectored_writes": self.zerocopy_vectored_writes,
+                "zerocopy_vectored_write_bytes":
+                    self.zerocopy_vectored_write_bytes,
+                "zerocopy_fallbacks": self.zerocopy_fallbacks,
             }
 
 
@@ -813,6 +869,52 @@ class MetricsRegistry:
                                     "Hot-cache body bytes cached")
         self.hotcache_segment = Gauge("mtpu_hotcache_total_bytes",
                                       "Hot-cache shared-segment size")
+        # Zero-copy data path (ops/zerocopy.py + ops/bpool.py; cf.
+        # internal/bpool/bpool.go and the xl-storage O_DIRECT write
+        # contract).  Synced from DATA_PATH / ops.bpool.stats().
+        self.zerocopy_hot_views = Gauge(
+            "mtpu_zerocopy_hot_views_total",
+            "Hot-cache GETs served as pinned arena views (no body copy)")
+        self.zerocopy_hot_view_bytes = Gauge(
+            "mtpu_zerocopy_hot_view_bytes_total",
+            "Body bytes served straight from pinned arena views")
+        self.zerocopy_sendmsg = Gauge(
+            "mtpu_zerocopy_sendmsg_total",
+            "Responses shipped by gather-write sendmsg")
+        self.zerocopy_sendmsg_bytes = Gauge(
+            "mtpu_zerocopy_sendmsg_bytes_total",
+            "Body bytes shipped by gather-write sendmsg")
+        self.zerocopy_sendfile = Gauge(
+            "mtpu_zerocopy_sendfile_total",
+            "Responses shipped by kernel sendfile")
+        self.zerocopy_sendfile_bytes = Gauge(
+            "mtpu_zerocopy_sendfile_bytes_total",
+            "Body bytes shipped by kernel sendfile")
+        self.zerocopy_vectored_writes = Gauge(
+            "mtpu_zerocopy_vectored_writes_total",
+            "Shard appends written as single pwritev batches")
+        self.zerocopy_vectored_write_bytes = Gauge(
+            "mtpu_zerocopy_vectored_write_bytes_total",
+            "Shard bytes written through vectored batches")
+        self.zerocopy_fallbacks = Gauge(
+            "mtpu_zerocopy_fallbacks_total",
+            "Eligible responses that fell back to the buffered writer")
+        self.bpool_gets = Gauge(
+            "mtpu_bpool_gets_total",
+            "Scratch-buffer leases handed out by the aligned pool")
+        self.bpool_fallbacks = Gauge(
+            "mtpu_bpool_fallbacks_total",
+            "Leases served by anonymous mmap (pool off or full)")
+        self.bpool_released = Gauge(
+            "mtpu_bpool_released_total",
+            "Leases explicitly released back to the pool")
+        self.bpool_leak_reclaims = Gauge(
+            "mtpu_bpool_leak_reclaims_total",
+            "Leaked leases reclaimed by the finalize backstop")
+        self.bpool_bytes = Gauge(
+            "mtpu_bpool_total_bytes", "Aligned-pool arena size")
+        self.bpool_in_use = Gauge(
+            "mtpu_bpool_in_use_bytes", "Aligned-pool bytes leased out")
         # ILM transition/restore + warm-tier families (bucket/tier.py;
         # cf. getClusterTierMetrics, cmd/metrics-v3-cluster-usage.go).
         self.ilm_transitioned = Gauge(
@@ -1139,6 +1241,27 @@ class MetricsRegistry:
         self.rpc_deadline_exceeded.set(snap["rpc_deadline_exceeded"])
         for kind, n in snap["netchaos_injected"].items():
             self.netchaos_injected.set(n, kind=kind)
+        self.zerocopy_hot_views.set(snap["zerocopy_hot_views"])
+        self.zerocopy_hot_view_bytes.set(snap["zerocopy_hot_view_bytes"])
+        self.zerocopy_sendmsg.set(snap["zerocopy_sendmsg"])
+        self.zerocopy_sendmsg_bytes.set(snap["zerocopy_sendmsg_bytes"])
+        self.zerocopy_sendfile.set(snap["zerocopy_sendfile"])
+        self.zerocopy_sendfile_bytes.set(snap["zerocopy_sendfile_bytes"])
+        self.zerocopy_vectored_writes.set(snap["zerocopy_vectored_writes"])
+        self.zerocopy_vectored_write_bytes.set(
+            snap["zerocopy_vectored_write_bytes"])
+        self.zerocopy_fallbacks.set(snap["zerocopy_fallbacks"])
+        # Aligned-buffer pool: scrape-only, never forces the shared
+        # segment into existence (bpool.stats() is None until first use).
+        from ..ops import bpool as _bpool
+        bsnap = _bpool.stats()
+        if bsnap is not None:
+            self.bpool_gets.set(bsnap["gets"])
+            self.bpool_fallbacks.set(bsnap["fallbacks"])
+            self.bpool_released.set(bsnap["released"])
+            self.bpool_leak_reclaims.set(bsnap["leak_reclaims"])
+            self.bpool_bytes.set(bsnap["pool_bytes"])
+            self.bpool_in_use.set(bsnap["in_use_bytes"])
 
     def _sync_spans(self) -> None:
         # Imported lazily: span.py is the one observe module allowed to

@@ -86,7 +86,11 @@ class ShmArena:
         # the free is deferred and performed by the last release().
         self._ref_off = _HDR_BYTES + self.nslots
         self._pend_off = self._ref_off + self.nslots * 4
-        self._data_off = self._pend_off + self.nslots * 4
+        # Page-align the data region: slot sizes are powers of two, so
+        # every slot start is then page-aligned too — a requirement for
+        # O_DIRECT readv into pooled scratch (ops/bpool.py).
+        self._data_off = -(-(self._pend_off + self.nslots * 4)
+                           // mmap.PAGESIZE) * mmap.PAGESIZE
         self._mm = mmap.mmap(-1, self._data_off
                              + self.nslots * self.slot_bytes)
         self._hdr = np.frombuffer(self._mm, dtype=np.int64,

@@ -1,0 +1,191 @@
+"""Zero-copy socket transport: gather-writes and kernel file sends.
+
+The serving path used to assemble every response in userspace — header
+bytes through the BufferedWriter, body through a second write, ranged
+hot-cache hits through a fresh bytes() slice — which on a permanently
+1-core, GIL-bound host turns straight into CPU-seconds-per-GB (the
+throughput ceiling, ISSUE 16).  This module is the transport half of
+the MTPU_ZEROCOPY vertical:
+
+* ``send_gather(sock, segments)`` — one ``socket.sendmsg`` carries the
+  coalesced header block plus any number of body segments (bytes,
+  memoryviews, ShmArena ndarray views) with a partial-send
+  continuation loop and IOV_MAX chunking, so a k-segment response is
+  one or two syscalls and the segments are never joined in userspace.
+* ``send_file(sock, fd, runs)`` — ``os.sendfile`` of verified on-disk
+  shard ranges (the k=1 "framing allows" case): object bytes go page
+  cache -> socket without ever entering the process, with a pread
+  fallback when sendfile is refused mid-stream.
+
+Both map EPIPE/ECONNRESET ``OSError``s back to ``BrokenPipeError`` /
+``ConnectionResetError`` so the server's existing quiet-499
+client-disconnect handling covers the new syscall paths — a killed
+client must never surface as a raw OSError traceback.
+
+``MTPU_ZEROCOPY=0`` is the byte-identical oracle: every caller keeps
+its buffered/copying path and tests assert both modes byte-exact
+(tests/conftest.py zerocopy_mode).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import select
+
+#: Linux UIO_MAXIOV is 1024; stay under it with headroom so a
+#: many-segment response chunks instead of bouncing with EMSGSIZE.
+IOV_MAX = 512
+
+#: sendfile per-call cap: bounded so a slow client can't pin one
+#: syscall forever (the kernel blocks until the socket buffer drains).
+SENDFILE_CHUNK = 8 << 20
+
+_DISCONNECT_ERRNOS = (errno.EPIPE, errno.ECONNRESET, errno.ESHUTDOWN,
+                      errno.ETIMEDOUT)
+
+
+def zerocopy_enabled() -> bool:
+    """Default ON; =0 is the byte-identical buffered-write oracle,
+    the same hot-path-flag contract as MTPU_GET_FASTPATH /
+    MTPU_HOTCACHE.  Read per call so tests flip it live."""
+    return os.environ.get("MTPU_ZEROCOPY", "1") != "0"
+
+
+def _map_disconnect(e: OSError):
+    """sendmsg/sendfile surface client disconnects as plain OSErrors;
+    re-raise the two the server's 499 handling already catches."""
+    if e.errno == errno.EPIPE or e.errno == errno.ESHUTDOWN:
+        raise BrokenPipeError(e.errno, e.strerror or "broken pipe") from e
+    if e.errno == errno.ECONNRESET:
+        raise ConnectionResetError(e.errno,
+                                   e.strerror or "connection reset") from e
+    raise e
+
+
+def send_gather(sock, segments) -> int:
+    """Vectored send of `segments` (any buffer-protocol objects) via
+    sendmsg: IOV_MAX chunking + partial-send continuation.  Returns
+    total bytes sent; raises BrokenPipeError/ConnectionResetError on
+    client disconnect."""
+    iov = [memoryview(s).cast("B") for s in segments if len(s)]
+    total = 0
+    while iov:
+        try:
+            n = sock.sendmsg(iov[:IOV_MAX])
+        except OSError as e:
+            _map_disconnect(e)
+        if n <= 0:
+            raise BrokenPipeError(errno.EPIPE, "zero-length send")
+        total += n
+        # Continuation: drop fully-sent segments, slice the partial one.
+        while iov and n >= len(iov[0]):
+            n -= len(iov[0])
+            iov.pop(0)
+        if n:
+            iov[0] = iov[0][n:]
+    return total
+
+
+def send_file(sock, fd: int, runs) -> int:
+    """sendfile each (file_offset, length) run of `fd` to `sock`.
+
+    Object bytes cross page cache -> socket in kernel space.  When the
+    kernel refuses (EINVAL/ENOSYS/EOVERFLOW — e.g. an exotic fs or a
+    non-stream socket) the remaining bytes of the run degrade to
+    pread+sendall, so a response that already has its headers on the
+    wire always completes.  Returns total payload bytes sent."""
+    total = 0
+    for off, ln in runs:
+        sent = 0
+        while sent < ln:
+            want = min(ln - sent, SENDFILE_CHUNK)
+            try:
+                n = os.sendfile(sock.fileno(), fd, off + sent, want)
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    # Raw sendfile bypasses the socket-object timeout
+                    # machinery: a full send buffer surfaces EAGAIN
+                    # here.  Wait for writability under the socket's
+                    # own timeout, then retry.
+                    _wait_writable(sock)
+                    continue
+                if e.errno in (errno.EINVAL, errno.ENOSYS,
+                               errno.EOVERFLOW, errno.ENOTSOCK):
+                    _pread_send(sock, fd, off + sent, ln - sent)
+                    sent = ln
+                    break
+                _map_disconnect(e)
+            if n == 0:
+                raise BrokenPipeError(errno.EPIPE,
+                                      "sendfile hit EOF short")
+            sent += n
+        total += sent
+    return total
+
+
+def _wait_writable(sock) -> None:
+    """Block until `sock` accepts more bytes, honoring its timeout —
+    the wait socket.send would have done had the kernel call gone
+    through the socket object instead of raw sendfile."""
+    timeout = sock.gettimeout()
+    _, w, _ = select.select((), (sock,), (), timeout)
+    if not w:
+        raise TimeoutError("timed out waiting for socket writability")
+
+
+def _pread_send(sock, fd: int, off: int, ln: int) -> None:
+    """Userspace fallback for one run (sendfile refused)."""
+    sent = 0
+    while sent < ln:
+        chunk = os.pread(fd, min(ln - sent, SENDFILE_CHUNK), off + sent)
+        if not chunk:
+            raise BrokenPipeError(errno.EPIPE, "file truncated mid-send")
+        try:
+            sock.sendall(chunk)
+        except OSError as e:
+            _map_disconnect(e)
+        sent += len(chunk)
+
+
+class FilePlan:
+    """One part's worth of verified, kernel-sendable byte runs.
+
+    Carries an OPEN fd (dup'd from the verification pass) so the bytes
+    sendfile will ship are the bytes that were digest-verified — a
+    racing delete only unlinks the name, never this content.  The
+    server closes it after the send; __del__ is the GC backstop for
+    responses that never reach the writer (client vanished first).
+    """
+
+    __slots__ = ("fd", "runs", "nbytes")
+
+    def __init__(self, fd: int, runs, nbytes: int):
+        self.fd = fd
+        self.runs = runs
+        self.nbytes = nbytes
+
+    def close(self) -> None:
+        fd, self.fd = self.fd, -1
+        if fd >= 0:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    def read_all(self) -> bytes:
+        """Userspace materialization of the plan (the oracle/TLS path
+        and tests): pread every run in order."""
+        out = bytearray()
+        for off, ln in self.runs:
+            got = 0
+            while got < ln:
+                chunk = os.pread(self.fd, ln - got, off + got)
+                if not chunk:
+                    raise OSError(errno.EIO, "file truncated under plan")
+                out += chunk
+                got += len(chunk)
+        return bytes(out)
+
+    def __del__(self):
+        self.close()

@@ -59,12 +59,17 @@ S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
 class Response:
     def __init__(self, status: int = 200, body: bytes = b"",
                  headers: dict[str, str] | None = None,
-                 body_iter=None):
+                 body_iter=None, body_file=None):
         """body_iter: optional iterator of byte chunks streamed to the
-        client instead of `body`; headers must carry Content-Length."""
+        client instead of `body`; headers must carry Content-Length.
+        body_file: optional list of ops.zerocopy.FilePlan — the body
+        leaves via os.sendfile of verified shard runs (TLS/oracle
+        writers materialize through plan.read_all()); headers must
+        carry Content-Length."""
         self.status = status
         self.body = body
         self.body_iter = body_iter
+        self.body_file = body_file
         self.headers = headers or {}
 
 
@@ -804,6 +809,7 @@ class S3Handlers:
                 partial = True
         data = b""
         body_iter = None
+        body_file = None
         if not head:
             if tiered and not transcoded:
                 # Restore-on-GET: stream the tier object in bounded
@@ -835,7 +841,20 @@ class S3Handlers:
                 # engine in device-batch chunks — O(batch) memory
                 # (the GetObjectReader role without a cleanup stack).
                 try:
-                    if hasattr(self.pools, "get_object_iter"):
+                    # Whole healthy GETs of kernel-sendable layouts get
+                    # a verified sendfile plan: the body never enters
+                    # the process (ops/zerocopy.py).  None on any gate
+                    # miss — ranged, cached, inline, degraded, flag off.
+                    sp = getattr(self.pools, "sendfile_plan", None)
+                    if sp is not None:
+                        with _span("engine.sendfile_plan"):
+                            got = sp(bucket, key, offset, length,
+                                     version_id)
+                        if got is not None:
+                            fi, body_file = got
+                    if body_file is not None:
+                        pass
+                    elif hasattr(self.pools, "get_object_iter"):
                         with _span("engine.get_object"):
                             fi, body_iter = self.pools.get_object_iter(
                                 bucket, key, offset, length, version_id)
@@ -881,7 +900,8 @@ class S3Handlers:
             status = 200
         if head:
             return Response(status, b"", h)
-        return Response(status, data, h, body_iter=body_iter)
+        return Response(status, data, h, body_iter=body_iter,
+                        body_file=body_file)
 
     def select_object_content(self, bucket: str, key: str, query: dict,
                               body: bytes,

@@ -36,7 +36,7 @@ from .naughty import INTERCEPTED, NaughtyDrive
 #: Adding it here does NOT shift the seeded draw sequence: r_torn is
 #: drawn unconditionally for every intercepted call either way.
 TORN_METHODS = ("write_all", "create_file", "append_file",
-                "rename_data")
+                "rename_data", "write_file_batches")
 
 
 class ErrChaosInjected(StorageError):
@@ -125,9 +125,16 @@ class ChaosDrive(NaughtyDrive):
                     raise ErrChaosInjected(
                         f"chaos[{self.seed}]: torn rename_data")
                 data = a[2] if len(a) >= 3 else kw.get("data", b"")
+                if name == "write_file_batches":
+                    # vectored appends carry a LIST of buffers: tear the
+                    # flattened stream at its midpoint, still vectored.
+                    data = b"".join(bytes(memoryview(b)) for b in data)
                 half = bytes(memoryview(data)[:max(0, len(data) // 2)])
                 try:
-                    real(a[0], a[1], half)
+                    if name == "write_file_batches":
+                        real(a[0], a[1], [half])
+                    else:
+                        real(a[0], a[1], half)
                 except Exception:  # noqa: BLE001 — already failing the call
                     pass
                 raise ErrChaosInjected(f"chaos[{self.seed}]: torn {name}")

@@ -82,9 +82,12 @@ def _direct_read(path: str, offset: int, length: int) -> bytes | None:
     except OSError:
         return None
     try:
-        buf = mmap.mmap(-1, need)      # anonymous maps are page-aligned
-        view = memoryview(buf)
-        try:
+        # Page-aligned scratch leased from the recycling pool
+        # (ops/bpool.py) instead of a fresh anonymous mmap per call —
+        # the pool's own fallback IS that mmap when it's full or off.
+        from ..ops import bpool
+        with bpool.default_pool().get(need) as buf:
+            view = memoryview(buf)
             os.lseek(fd, a_off, os.SEEK_SET)
             got = 0
             while got < need:
@@ -95,11 +98,7 @@ def _direct_read(path: str, offset: int, length: int) -> bytes | None:
                 got += n
             lo = offset - a_off
             hi = min(lo + length, got)
-            out = b"" if hi <= lo else bytes(view[lo:hi])
-            return out
-        finally:
-            view.release()
-            buf.close()
+            return b"" if hi <= lo else bytes(view[lo:hi])
     except OSError:
         return None
     finally:

@@ -29,6 +29,7 @@ INTERCEPTED = (
     "read_version", "write_metadata", "update_metadata",
     "delete_version", "file_size",
     "list_dir", "list_raw", "verify_file", "disk_info",
+    "write_file_batches", "open_read_fd",
 )
 
 

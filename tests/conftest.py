@@ -125,6 +125,18 @@ def ilm_mode(request, monkeypatch):
     return request.param
 
 
+@pytest.fixture(params=["1", "0"], ids=["zerocopy", "oracle"])
+def zerocopy_mode(request, monkeypatch):
+    """Oracle guard for the zero-copy data path: tests using this
+    fixture run once with gather-write/sendfile responses, arena-view
+    hot hits, and vectored shard IO armed (MTPU_ZEROCOPY=1, the
+    default) and once on the buffered/copying oracle (=0) — every
+    byte on the wire (plain, ranged, suffix, conditional, aws-chunked)
+    must be identical between the two runs."""
+    monkeypatch.setenv("MTPU_ZEROCOPY", request.param)
+    return request.param
+
+
 @pytest.fixture(params=["1", "0"], ids=["breaker", "nobreaker"])
 def breaker_mode(request, monkeypatch):
     """Oracle guard for the drive circuit breaker: MTPU_BREAKER=0 pins

@@ -50,6 +50,49 @@ def _quantile(lat_s: list[float], q: float) -> float:
     return float(np.quantile(np.asarray(lat_s), q))
 
 
+def _proc_tree_cpu_s(pid: int) -> float | None:
+    """user+sys CPU seconds of `pid` AND its descendants (the pre-fork
+    worker pool) from /proc — the server-side bill an HTTP run can't
+    get from its own rusage.  None when /proc is unreadable (non-Linux,
+    process gone)."""
+    try:
+        tick = os.sysconf("SC_CLK_TCK")
+    except (ValueError, OSError):
+        return None
+
+    def one(p: int) -> float:
+        with open(f"/proc/{p}/stat", "rb") as f:
+            # field 2 (comm) may contain spaces: split after ')'
+            rest = f.read().rpartition(b")")[2].split()
+        return (int(rest[11]) + int(rest[12])) / tick  # utime, stime
+
+    def kids(p: int) -> list[int]:
+        out: list[int] = []
+        try:
+            for task in os.listdir(f"/proc/{p}/task"):
+                with open(f"/proc/{p}/task/{task}/children", "rb") as f:
+                    out += [int(c) for c in f.read().split()]
+        except OSError:
+            pass
+        return out
+
+    try:
+        total, queue, seen = 0.0, [pid], set()
+        while queue:
+            p = queue.pop()
+            if p in seen:
+                continue
+            seen.add(p)
+            try:
+                total += one(p)
+            except (OSError, IndexError, ValueError):
+                continue
+            queue += kids(p)
+        return total
+    except Exception:  # noqa: BLE001 — metrics-only, never break a run
+        return None
+
+
 def zipf_cdf(n: int, s: float) -> np.ndarray:
     """CDF of a Zipf(s) distribution over ranks 1..n: P(i) ∝ 1/i^s.
     Rank 0 is the hottest key.  Sampling = searchsorted(uniform) —
@@ -129,7 +172,8 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
              zipf: float | None = None,
              range_frac: float = 0.0,
              ilm_mix: float = 0.0, tier_mgr=None,
-             tier_root: str | None = None) -> dict:
+             tier_root: str | None = None,
+             use_iter: bool = False) -> dict:
     """Drive `clients` closed-loop workers against `es` for
     `duration_s`; returns aggregate GB/s, p50/p99 latency, and mean
     coalesced dispatch occupancy over the run.  `keyspace` picks the
@@ -141,6 +185,13 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     warm set (rank 0 hottest) and adds hot-vs-cold p50/p99 SLO rows to
     the result; `range_frac` makes that fraction of GETs ranged
     (random aligned window), reported as their own SLO row.
+
+    `use_iter` consumes GETs through get_object_iter — the serving
+    path the HTTP handlers drive — measuring chunk lengths without
+    materializing bytes, like a socket writer that hands each buffer
+    to sendmsg.  This is the mode that exposes the zero-copy hot-view
+    CPU saving; the default get_object path re-copies hot bodies in
+    both flag modes.
 
     `ilm_mix` transitions that fraction of the warm set — its COLDEST
     Zipf ranks, the shape the scanner ages out — to a warm tier before
@@ -243,19 +294,28 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
                         ln = int(crng.integers(
                             1, object_size - off + 1))
                         if is_stub:
-                            got = stub_get(name, off, ln)
+                            got_n = len(stub_get(name, off, ln))
+                        elif use_iter:
+                            _, it = es.get_object_iter(bucket, name,
+                                                       off, ln)
+                            got_n = sum(len(c) for c in it)
                         else:
                             _, got = es.get_object(bucket, name,
                                                    off, ln)
+                            got_n = len(got)
                         got_bytes = ln
-                        if len(got) != ln:
+                        if got_n != ln:
                             raise AssertionError("short ranged read")
                     else:
                         if is_stub:
-                            got = stub_get(name, None, None)
+                            got_n = len(stub_get(name, None, None))
+                        elif use_iter:
+                            _, it = es.get_object_iter(bucket, name)
+                            got_n = sum(len(c) for c in it)
                         else:
                             _, got = es.get_object(bucket, name)
-                        if len(got) != object_size:
+                            got_n = len(got)
+                        if got_n != object_size:
                             raise AssertionError("short read")
                 dt = time.monotonic() - t0
                 (lat_put if is_put else lat_get)[ci].append(dt)
@@ -279,6 +339,11 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     snap0 = DATA_PATH.snapshot()
     threads = [threading.Thread(target=client, args=(ci,), daemon=True)
                for ci in range(clients)]
+    # CPU-seconds-per-GB attribution (ISSUE 16): the engine runs
+    # in-process here, so RUSAGE_SELF over the run window IS the
+    # server-side CPU bill for the bytes moved.
+    import resource
+    ru0 = resource.getrusage(resource.RUSAGE_SELF)
     t_start = time.monotonic()
     for t in threads:
         t.start()
@@ -287,6 +352,8 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     for t in threads:
         t.join(60.0)
     wall = time.monotonic() - t_start
+    ru1 = resource.getrusage(resource.RUSAGE_SELF)
+    cpu_s = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime - ru0.ru_stime)
     snap1 = DATA_PATH.snapshot()
     if errors:
         raise errors[0]
@@ -326,6 +393,11 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
         "gets": len(gets),
         "wall_s": round(wall, 3),
         "gbps": round(sum(nbytes) / wall / 1e9, 3),
+        # user+sys seconds burned per GB moved — the zero-copy
+        # vertical's budget metric (lower = more kernel, less Python)
+        "cpu_util": round(cpu_s / wall, 3) if wall else 0.0,
+        "cpu_s_per_gb": round(cpu_s / (sum(nbytes) / 1e9), 3)
+        if sum(nbytes) else 0.0,
         "p50_ms": round(_quantile(alls, 0.50) * 1e3, 3),
         "p99_ms": round(_quantile(alls, 0.99) * 1e3, 3),
         "put_p50_ms": round(_quantile(puts, 0.50) * 1e3, 3),
@@ -514,9 +586,17 @@ def run_load_http(endpoint: str, *, clients: int = 4,
                   zipf: float | None = None,
                   range_frac: float = 0.0,
                   ilm_mix: float = 0.0,
-                  tier_path: str | None = None) -> dict:
+                  tier_path: str | None = None,
+                  server_pid: int | None = None) -> dict:
     """HTTP closed loop against a running endpoint; with procs>1 the
     `clients` are spread over that many forked client processes.
+
+    `server_pid` (a LOCAL server process) adds server_cpu_util and
+    server_cpu_s_per_gb columns from /proc/<pid>/stat across the
+    process tree (MTPU_WORKERS children included) — the server-side
+    CPU bill per byte served, the zero-copy budget metric.  Without
+    it only client_cpu_util is reported, and that is CLIENT-side CPU
+    (SigV4 signing + socket reads), not the server's.
     tag_pools adds a pool_hits histogram (PUTs per placement pool,
     from the x-mtpu-pool response header) — run it against a server
     mid-decommission and the draining pool must show zero hits.
@@ -573,6 +653,9 @@ def run_load_http(endpoint: str, *, clients: int = 4,
     per = [clients // procs + (1 if i < clients % procs else 0)
            for i in range(procs)]
     creds = (access_key, secret_key)
+    import resource
+    ru0 = resource.getrusage(resource.RUSAGE_SELF)
+    srv_cpu0 = _proc_tree_cpu_s(server_pid) if server_pid else None
     t_start = time.monotonic()
     if procs == 1:
         parts = [_http_clients_loop(endpoint, creds, bucket, warm, body,
@@ -597,23 +680,38 @@ def run_load_http(endpoint: str, *, clients: int = 4,
         for p in ps:
             p.join(30.0)
     wall = time.monotonic() - t_start
+    ru1 = resource.getrusage(resource.RUSAGE_SELF)
+    srv_cpu1 = _proc_tree_cpu_s(server_pid) if server_pid else None
     errs = [e for part in parts for e in part["errors"]]
     if errs:
         raise RuntimeError(f"loadgen client error: {errs[0]}")
     puts = [x for part in parts for x in part["lat_put"]]
     gets = [x for part in parts for x in part["lat_get"]]
     alls = puts + gets
+    total_bytes = sum(p["nbytes"] for p in parts)
     res = {
         "endpoint": endpoint, "clients": clients, "procs": procs,
         "object_size": object_size,
         "ops": len(alls), "puts": len(puts), "gets": len(gets),
         "wall_s": round(wall, 3),
-        "gbps": round(sum(p["nbytes"] for p in parts) / wall / 1e9, 3),
+        "gbps": round(total_bytes / wall / 1e9, 3),
+        # CLIENT-side CPU (signing, socket reads) — NOT the server's;
+        # forked --procs workers bill their own rusage, so this row is
+        # only the coordinating process and is indicative at best.
+        "client_cpu_util": round(
+            ((ru1.ru_utime - ru0.ru_utime)
+             + (ru1.ru_stime - ru0.ru_stime)) / wall, 3)
+        if wall else 0.0,
         "p50_ms": round(_quantile(alls, 0.50) * 1e3, 3),
         "p99_ms": round(_quantile(alls, 0.99) * 1e3, 3),
         "put_p50_ms": round(_quantile(puts, 0.50) * 1e3, 3),
         "get_p50_ms": round(_quantile(gets, 0.50) * 1e3, 3),
     }
+    if srv_cpu0 is not None and srv_cpu1 is not None:
+        srv_cpu = max(0.0, srv_cpu1 - srv_cpu0)
+        res["server_cpu_util"] = round(srv_cpu / wall, 3) if wall else 0.0
+        res["server_cpu_s_per_gb"] = round(
+            srv_cpu / (total_bytes / 1e9), 3) if total_bytes else 0.0
     if zipf:
         res["zipf_s"] = zipf
         res.update(hot_cold_rows(
@@ -742,6 +840,13 @@ def main(argv=None) -> int:
                     "ETag-digest-bound shape the multi-buffer MD5 "
                     "lanes exist for (dg_md5_* in the output show "
                     "lane occupancy and aggregate hash rate)")
+    ap.add_argument("--server-pid", type=int, default=None,
+                    help="HTTP mode: pid of the LOCAL server — adds "
+                    "server_cpu_util / server_cpu_s_per_gb columns "
+                    "from /proc across its worker tree (the zero-copy "
+                    "CPU-per-GB budget).  Engine mode reports this "
+                    "inherently via cpu_util/cpu_s_per_gb: the engine "
+                    "runs in-process, so rusage IS the server bill")
     ap.add_argument("--during-decom", action="store_true",
                     help="HTTP mode: tag every PUT with the pool it "
                     "landed on (x-mtpu-pool response header) and "
@@ -772,7 +877,8 @@ def main(argv=None) -> int:
                             tag_pools=args.during_decom,
                             zipf=args.zipf,
                             range_frac=args.range_frac,
-                            ilm_mix=args.ilm_mix)
+                            ilm_mix=args.ilm_mix,
+                            server_pid=args.server_pid)
     else:
         es = (make_sets(args.root, nsets=args.sets,
                         set_drives=args.drives, parity=args.parity)
