@@ -32,6 +32,7 @@ from ..cluster.dynamic_timeout import DynamicTimeout
 from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
 from ..ops import coalesce, fused
+from ..ops import devcache as devcache_mod
 from ..ops import devices as devices_mod
 from ..ops import zerocopy as zc
 from ..ops.erasure_cpu import ReedSolomonCPU
@@ -239,6 +240,11 @@ class ErasureSet:
         # skews a hint, nothing more.
         self._hedge_dyn = DynamicTimeout(0.05, 0.002, 2.0)
         self._read_ewma_ms = [0.0] * self.n
+        # Device-resident shard cache identity (ops/devcache.py): a
+        # fresh per-process owner token per ErasureSet instance, so a
+        # reopened set (crash recovery, decom re-attach) can never see
+        # entries filled by a previous incarnation.
+        self._devcache_owner = devcache_mod.next_owner()
         from .metacache import Metacache
         self.metacache = Metacache(self)
 
@@ -255,6 +261,10 @@ class ErasureSet:
         self.metacache.bump(bucket)
         if self.hot_tier is not None:
             self.hot_tier.note_mutation(bucket)
+        # Always recorded, even with MTPU_DEVCACHE=0 — a mutation made
+        # while the cache is disabled must still invalidate entries a
+        # later re-enable would otherwise resurrect.
+        devcache_mod.get().note_mutation(self._devcache_owner, bucket)
 
     # -- codec helpers -------------------------------------------------------
 
@@ -1032,6 +1042,37 @@ class ErasureSet:
                     self._native(k, m).encode_blocks(stacked))
             return [(parity[lo:hi], None) for lo, hi in spans]
 
+        if fused_dev or self._use_device:
+            def launch(x, n, spans, ctx):
+                # Pipeline form: `x` arrives staged on the lane's
+                # device, padded to BATCH_BLOCKS.  Encode inputs are
+                # placement-owned (nothing retains them), so the fused
+                # dispatch donates the buffer — XLA reuses the device
+                # allocation instead of growing one per batch.
+                if fused_dev:
+                    parity_d, digests_d = fused.encode_and_hash(
+                        x, k, m, algo=algo, device=device, donate=True)
+
+                    def resolve():
+                        parity = np.asarray(parity_d)[:n]
+                        digests = np.asarray(digests_d)[:, :n]
+                        return [(parity[lo:hi], digests[:, lo:hi])
+                                for lo, hi in spans]
+
+                    return resolve
+                if not self._use_device:
+                    raise RuntimeError("device codec unavailable")
+                parity_d = self._codec(k, m).encode_blocks(
+                    devices_mod.put(x, device))
+
+                def resolve():
+                    parity = np.asarray(parity_d)[:n]
+                    return [(parity[lo:hi], None) for lo, hi in spans]
+
+                return resolve
+
+            kernel.launch = launch
+            kernel.pad_rows = BATCH_BLOCKS
         return kernel
 
     def _direct_encode(self, blocks, k: int, m: int, algo: str):
@@ -1067,6 +1108,25 @@ class ErasureSet:
                      out[lo:hi] if out is not None else None)
                     for lo, hi in spans]
 
+        def launch(x, n, spans, ctx):
+            # Pipeline form (ops/coalesce.py): `x` is the lane's staged
+            # device array, already padded to BATCH_BLOCKS and counted
+            # at its upload — the sync moves to resolve(), one dispatch
+            # behind.
+            digests_d, out_d = fused.verify_and_transform(
+                x, k, m, sources, targets, algo=algo, device=device)
+
+            def resolve():
+                digests = np.asarray(digests_d)[:n]
+                out = np.asarray(out_d)[:n] if targets else None
+                return [(digests[lo:hi],
+                         out[lo:hi] if out is not None else None)
+                        for lo, hi in spans]
+
+            return resolve
+
+        kernel.launch = launch
+        kernel.pad_rows = BATCH_BLOCKS
         return kernel
 
     def _encode_chunks(self, chunks, k: int, m: int,
@@ -1876,6 +1936,14 @@ class ErasureSet:
                 and not _mesh_mode() and k + m <= 64):
             fused_host = _ecio_mod()
         co = coalesce.get() if coalesce.enabled() else None
+        # Device-resident shard cache (ops/devcache.py): generation is
+        # captured BEFORE any shard read so a racing write invalidates
+        # the fill rather than the fill masking the write.  Only fully
+        # verified fast-path reads fill; hits serve the verified host
+        # copy with zero disk reads, zero uploads, zero dispatches.
+        dcache = devcache_mod.get() if devcache_mod.enabled() else None
+        dc_gen0 = (dcache.current_gen(self._devcache_owner, bucket)
+                   if dcache is not None else 0)
 
         def read_shard(pos: int):
             """Fetch + structurally parse one shard's frame range.
@@ -2093,6 +2161,59 @@ class ErasureSet:
                 # the full-k verify (dict ops are GIL-atomic enough for
                 # the prefetch pool's one-writer-per-segment pattern).
                 report["fast"] = report.get("fast", 0) + 1
+            if dcache is not None and nb and y is not None:
+                # Fill with private copies: `y` may view the caller's
+                # dst buffer or a fused-host arena, and `tail_np` the
+                # mmap'd frames — the cache must own its bytes.
+                dcache.fill(
+                    (self._devcache_owner, bucket, obj, part_number,
+                     fi.data_dir, b0, b1, algo),
+                    dc_gen0, np.array(y, copy=True),
+                    tail=(np.array(tail_np, copy=True)
+                          if tail_np is not None else None),
+                    device=self.device_idx)
+            return (res,)
+
+        def devcache_hit(e, boff):
+            """Assemble the read from a resident verified entry — the
+            exact fast_path assembly over cached rows, no disk, no
+            device, no dispatch.  Returns (res,) or None (entry lacks
+            the tail fragment this range needs)."""
+            t0 = time.monotonic()
+            if has_tail and e.tail is None:
+                return None
+            y = e.host[boff:boff + nb] if nb else None
+            tail_np = e.tail[:geo["tail_len"]] if has_tail else None
+            full_bytes = nb * k * shard_size
+            aligned = (dst is not None and lo == 0
+                       and length >= full_bytes)
+            if aligned:
+                if nb:
+                    dst[:full_bytes] = memoryview(y.reshape(-1))
+                if tail_np is not None and length > full_bytes:
+                    dst[full_bytes:length] = memoryview(
+                        np.ascontiguousarray(
+                            tail_np[:length - full_bytes]))
+                res = None
+            else:
+                flat = (y.reshape(-1) if nb
+                        else np.zeros(0, dtype=np.uint8))
+                data = (np.concatenate([flat, tail_np])
+                        if tail_np is not None else flat)
+                view = data[lo:lo + length]
+                if dst is not None:
+                    dst[:length] = memoryview(np.ascontiguousarray(view))
+                    res = None
+                elif view.size == data.size:
+                    res = memoryview(view)
+                else:
+                    res = view.tobytes()
+            done = time.monotonic()
+            DATA_PATH.record_healthy_read(
+                length, read_s=0.0, verify_s=0.0, assemble_s=done - t0)
+            ospan.record("engine.assemble", done - t0)
+            if report is not None:
+                report["fast"] = report.get("fast", 0) + 1
             return (res,)
 
         # BLOCK_SIZE % k gate: the padded (non-dividing k) layout needs
@@ -2100,6 +2221,14 @@ class ErasureSet:
         if (_get_fastpath() and healthy is not False and not degraded
                 and BLOCK_SIZE % k == 0
                 and all(s in candidates for s in range(k))):
+            if dcache is not None:
+                found = dcache.lookup_range(
+                    self._devcache_owner, bucket, obj, part_number,
+                    fi.data_dir, algo, b0, b1)
+                if found is not None:
+                    got = devcache_hit(*found)
+                    if got is not None:
+                        return got[0]
             # Inflight-read signal: a GET-only storm queues no encode
             # work, so concurrency is only visible to hot() through
             # this counter.

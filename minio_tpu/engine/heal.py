@@ -526,6 +526,7 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
     drops the source and promotes a spare for that batch onward, exactly
     like the GET path's spare-read policy."""
     from ..ops import coalesce, fused
+    from ..ops import devcache as devcache_mod
     from .erasure_set import _ecio_mod, _mesh_mode
     ec = fi.erasure
     dist = ec.distribution
@@ -575,6 +576,11 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
     if not es._use_device and algo == "mxh256" and k + m <= 64 \
             and not _mesh_mode():
         fused_host = _ecio_mod()
+    # Device-resident shard cache: a prior healthy GET's verified data
+    # matrix can cover a heal batch — the rebuild then runs straight
+    # off residency (host copy, or the already-placed device array):
+    # zero re-reads of source shards, zero uploads.
+    dcache = devcache_mod.get() if devcache_mod.enabled() else None
 
     def read_one(s: int, lo: int, ln: int) -> bytes:
         raw = es.drives[src_pos[s]].read_file(bucket, path, lo, ln)
@@ -613,6 +619,42 @@ def _heal_part_pipelined(es: ErasureSet, bucket: str, obj: str,
         (b0, nb), data, read_s = item
         lo, ln = b0 * frame, nb * frame
         t0 = time.perf_counter()
+        if dcache is not None:
+            found = dcache.lookup_range(
+                es._devcache_owner, bucket, obj, part.number,
+                fi.data_dir, algo, b0, b0 + nb)
+            if found is not None:
+                # The batch's verified systematic matrix is resident:
+                # rebuild every target from it.  GF arithmetic is
+                # exact, so the rebuilt rows are byte-identical to the
+                # re-read path's (the cached bytes ARE the shards that
+                # passed verify at fill time).
+                e, boff = found
+                y = e.host[boff:boff + nb]
+                out = {}
+                rebuilt = None
+                if need:
+                    xd = e.dev
+                    if es._use_device and xd is not None \
+                            and algo in fused.DEVICE_ALGOS \
+                            and not _mesh_mode():
+                        # Already device-resident: dispatch against the
+                        # placed array — zero upload.
+                        _, reb_d = fused.verify_and_transform(
+                            xd[boff:boff + nb], k, m, tuple(range(k)),
+                            tuple(need), algo=algo,
+                            device=es.device_idx)
+                        rebuilt = np.asarray(reb_d)
+                    else:
+                        rebuilt = np.asarray(es._transform(
+                            k, m, y, tuple(range(k)), tuple(need)))
+                for j, s in enumerate(need):
+                    out[s] = rebuilt[:, j, :]
+                stack = np.stack([out[s] for s in need])
+                framed = bitrot_io.frame_shard_views(
+                    None, None, None, algo, shards=stack)
+                return ((b0, nb), dict(zip(need, framed)), read_s,
+                        time.perf_counter() - t0)
         while True:
             # Reconcile with the current selection: a source dropped by
             # an earlier batch leaves a hole in this prefetched read; a

@@ -915,6 +915,71 @@ class MetricsRegistry:
             "mtpu_bpool_total_bytes", "Aligned-pool arena size")
         self.bpool_in_use = Gauge(
             "mtpu_bpool_in_use_bytes", "Aligned-pool bytes leased out")
+        # Device-resident shard plane (ops/devcache.py) + host->device
+        # boundary ledger: the instrumented proof that object bytes
+        # cross the tunnel at most once (first touch ~1.0 byte crossed
+        # per byte served, ~0 on cache hits).
+        self.devcache_hits = Gauge(
+            "mtpu_devcache_hits_total",
+            "Reads served from the device-resident shard cache")
+        self.devcache_misses = Gauge(
+            "mtpu_devcache_misses_total",
+            "Shard-cache probes that fell through to disk")
+        self.devcache_ratio = Gauge(
+            "mtpu_devcache_hit_ratio",
+            "Lifetime shard-cache hit ratio")
+        self.devcache_fills = Gauge(
+            "mtpu_devcache_fills_total",
+            "Verified fast-path reads admitted to the shard cache")
+        self.devcache_evictions = Gauge(
+            "mtpu_devcache_evictions_total",
+            "Shard-cache entries evicted by the LRU capacity bound")
+        self.devcache_invalidations = Gauge(
+            "mtpu_devcache_invalidations_total",
+            "Bucket mutations noted by the shard cache (_mark_dirty)")
+        self.devcache_stale_drops = Gauge(
+            "mtpu_devcache_stale_drops_total",
+            "Entries/fills dropped by generation mismatch")
+        self.devcache_rejects = Gauge(
+            "mtpu_devcache_rejects_total",
+            "Fills rejected (range larger than the cache capacity)")
+        self.devcache_entries = Gauge(
+            "mtpu_devcache_entries",
+            "Resident shard-cache entries")
+        self.devcache_resident = Gauge(
+            "mtpu_devcache_resident_bytes",
+            "Payload bytes resident in the shard cache")
+        self.devcache_capacity = Gauge(
+            "mtpu_devcache_capacity_bytes",
+            "Shard-cache capacity bound (MTPU_DEVCACHE_MB)")
+        self.h2d_bytes = Gauge(
+            "mtpu_h2d_bytes_total",
+            "Bytes that crossed the host->device boundary")
+        self.h2d_dispatches = Gauge(
+            "mtpu_h2d_dispatches_total",
+            "Host->device upload crossings (device_put calls)")
+        self.h2d_lane_bytes = Gauge(
+            "mtpu_h2d_lane_bytes_total",
+            "Host->device bytes per device lane")
+        self.h2d_lane_dispatches = Gauge(
+            "mtpu_h2d_lane_dispatches_total",
+            "Host->device crossings per device lane")
+        self.h2d_pipeline_dispatches = Gauge(
+            "mtpu_h2d_pipeline_dispatches_total",
+            "Coalesced batches shipped through the pinned-staging "
+            "double-buffered upload pipeline")
+        self.h2d_overlap_seconds = Gauge(
+            "mtpu_h2d_overlap_seconds_total",
+            "Host pack/upload time overlapped with device execution")
+        self.h2d_pack_seconds = Gauge(
+            "mtpu_h2d_pack_seconds_total",
+            "Time packing batches into pinned staging buffers")
+        self.h2d_upload_seconds = Gauge(
+            "mtpu_h2d_upload_seconds_total",
+            "Time issuing async device_put uploads from staging")
+        self.h2d_resolve_seconds = Gauge(
+            "mtpu_h2d_resolve_seconds_total",
+            "Time syncing pipelined kernel results (resolve phase)")
         # ILM transition/restore + warm-tier families (bucket/tier.py;
         # cf. getClusterTierMetrics, cmd/metrics-v3-cluster-usage.go).
         self.ilm_transitioned = Gauge(
@@ -1262,6 +1327,38 @@ class MetricsRegistry:
             self.bpool_leak_reclaims.set(bsnap["leak_reclaims"])
             self.bpool_bytes.set(bsnap["pool_bytes"])
             self.bpool_in_use.set(bsnap["in_use_bytes"])
+        # Device-resident shard cache + H2D boundary ledger: scrape-only
+        # pulls, same pattern as bpool (None until first use).
+        from ..ops import devcache as _devcache
+        dsnap = _devcache.stats()
+        if dsnap is not None:
+            self.devcache_hits.set(dsnap["hits"])
+            self.devcache_misses.set(dsnap["misses"])
+            self.devcache_ratio.set(round(dsnap["hit_ratio"], 6))
+            self.devcache_fills.set(dsnap["fills"])
+            self.devcache_evictions.set(dsnap["evictions"])
+            self.devcache_invalidations.set(dsnap["invalidations"])
+            self.devcache_stale_drops.set(dsnap["stale_drops"])
+            self.devcache_rejects.set(dsnap["rejects"])
+            self.devcache_entries.set(dsnap["entries"])
+            self.devcache_resident.set(dsnap["resident_bytes"])
+            self.devcache_capacity.set(dsnap["capacity_bytes"])
+        hsnap = _devcache.h2d_stats()
+        self.h2d_bytes.set(hsnap["h2d_bytes"])
+        self.h2d_dispatches.set(hsnap["h2d_dispatches"])
+        for dev, row in hsnap["lanes"].items():
+            self.h2d_lane_bytes.set(row["h2d_bytes"], device=str(dev))
+            self.h2d_lane_dispatches.set(row["h2d_dispatches"],
+                                         device=str(dev))
+        from ..ops import coalesce as _coalesce
+        co = _coalesce._CO
+        if co is not None:
+            cst = co.stats()
+            self.h2d_pipeline_dispatches.set(cst["pipeline_dispatches"])
+            self.h2d_overlap_seconds.set(cst["overlap_s"])
+            self.h2d_pack_seconds.set(cst["pack_s"])
+            self.h2d_upload_seconds.set(cst["h2d_s"])
+            self.h2d_resolve_seconds.set(cst["resolve_s"])
 
     def _sync_spans(self) -> None:
         # Imported lazily: span.py is the one observe module allowed to

@@ -65,6 +65,7 @@ import numpy as np
 
 from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
+from . import devcache
 
 
 def enabled() -> bool:
@@ -227,6 +228,16 @@ class DispatchLane:
         # submitter can hang on a scheduler that no longer exists.
         self._broken: BaseException | None = None
         self._bufs = _BufPool()
+        # H2D pipeline state (ISSUE 17: pinned staging + double-buffered
+        # uploads).  Two page-aligned bpool staging leases alternate per
+        # dispatch; `_pending` holds at most ONE launched-but-unresolved
+        # batch: while its kernel executes on-device, the next batch
+        # packs into the spare staging buffer and ships via async
+        # device_put — host pack/scatter overlapped with device compute.
+        # Lane-thread-private except for the stats counters.
+        self._staging: list = [None, None]
+        self._staging_flip = 0
+        self._pending: tuple | None = None
         # Lifetime stats (mirrored into DATA_PATH per dispatch).
         self.dispatches = 0
         self.items = 0
@@ -235,6 +246,13 @@ class DispatchLane:
         self.max_items = 0
         self.batch_faults = 0
         self.member_retries = 0
+        self.h2d_bytes = 0
+        self.h2d_dispatches = 0
+        self.pipeline_dispatches = 0
+        self.pack_s = 0.0
+        self.h2d_s = 0.0
+        self.resolve_s = 0.0
+        self.overlap_s = 0.0
 
     # -- submission ----------------------------------------------------------
 
@@ -326,40 +344,64 @@ class DispatchLane:
     def _loop(self) -> None:
         try:
             while True:
+                do_drain = False
                 with self._mu:
                     key = self._pick_key()
                     while key is None:
+                        if self._pending is not None:
+                            # A launched batch is in flight.  Give new
+                            # work one window to arrive (so its pack
+                            # overlaps the executing kernel), then
+                            # resolve — NEVER park indefinitely on
+                            # `_work` with an unresolved launch: its
+                            # waiters would deadlock against an idle
+                            # queue.
+                            self._work.wait(window_s() or 0.0005)
+                            key = self._pick_key()
+                            if key is None:
+                                do_drain = True
+                            break
                         if self._stopped:
                             return
                         self._work.wait()
                         key = self._pick_key()
-                    q = self._queues[key]
-                    budget = max_batch()
-                    # Adaptive window: only wait for company when the
-                    # occupancy EMA says concurrent traffic exists; always
-                    # bounded by the oldest item's age.
-                    if self._ema > 1.05 and self._queue_weight(q) < budget:
-                        deadline = q[0][1]._t_enq + window_s()
-                        while (self._queue_weight(q) < budget
-                               and not self._stopped):
-                            left = deadline - time.monotonic()
-                            if left <= 0:
-                                break
-                            self._work.wait(left)
-                    items: list[tuple] = []
-                    w = 0
-                    while q and (not items or w + q[0][1].weight <= budget):
-                        payload, h = q.popleft()
-                        items.append((payload, h))
-                        w += h.weight
-                    self._pending_weight -= w
-                    self._pending_items -= len(items)
-                    fn = self._fns[key]
-                    self._dispatching = True
-                    self._space.notify_all()
-                self._dispatch(items, w, fn)
+                    if not do_drain:
+                        q = self._queues[key]
+                        budget = max_batch()
+                        # Adaptive window: only wait for company when
+                        # the occupancy EMA says concurrent traffic
+                        # exists; always bounded by the oldest item's
+                        # age.  With a launch in flight the kernel IS
+                        # the company — skip the wait and pack now.
+                        if (self._pending is None and self._ema > 1.05
+                                and self._queue_weight(q) < budget):
+                            deadline = q[0][1]._t_enq + window_s()
+                            while (self._queue_weight(q) < budget
+                                   and not self._stopped):
+                                left = deadline - time.monotonic()
+                                if left <= 0:
+                                    break
+                                self._work.wait(left)
+                        items: list[tuple] = []
+                        w = 0
+                        while q and (not items
+                                     or w + q[0][1].weight <= budget):
+                            payload, h = q.popleft()
+                            items.append((payload, h))
+                            w += h.weight
+                        self._pending_weight -= w
+                        self._pending_items -= len(items)
+                        fn = self._fns[key]
+                        self._dispatching = True
+                        self._space.notify_all()
+                if do_drain:
+                    self._drain_pipeline()
+                else:
+                    self._dispatch(items, w, fn, pipelined=True)
                 with self._mu:
-                    self._dispatching = False
+                    # Stay "dispatching" while a launch is unresolved so
+                    # the inline fast path cannot race a pending batch.
+                    self._dispatching = self._pending is not None
         except BaseException as e:  # noqa: BLE001 — scheduler death
             # _dispatch contains kernel faults itself, so anything
             # escaping here is scheduler logic dying — fail everything
@@ -374,6 +416,9 @@ class DispatchLane:
         with self._mu:
             self._broken = exc
             victims: list[Handle] = []
+            pending, self._pending = self._pending, None
+            if pending is not None:
+                victims.extend(h for _, h in pending[1])
             for q in self._queues.values():
                 victims.extend(h for _, h in q)
                 q.clear()
@@ -389,7 +434,17 @@ class DispatchLane:
             h._exc = err
             h._ev.set()
 
-    def _dispatch(self, items: list[tuple], w: int, fn) -> None:
+    def _dispatch(self, items: list[tuple], w: int, fn,
+                  pipelined: bool = False) -> None:
+        if pipelined:
+            launch = getattr(fn, "launch", None)
+            if launch is not None and devcache.h2d_pipeline_enabled():
+                if self._dispatch_pipelined(items, w, fn, launch):
+                    return
+            # Serial dispatch from the lane thread must not outrun a
+            # still-pending launch (per-key FIFO): resolve it first.
+            if self._pending is not None:
+                self._drain_pipeline()
         t_disp = time.monotonic()
         ctx = DispatchCtx(self._bufs, len(items))
         try:
@@ -455,6 +510,160 @@ class DispatchLane:
         DATA_PATH.record_coalesce_dispatch(len(items), w, wait_sum)
         DATA_PATH.record_lane_dispatch(self.device, len(items), w, wait_sum)
 
+    # -- pinned-staging H2D pipeline (ISSUE 17 tentpole) ---------------------
+
+    def _staging_view(self, slot: int, nbytes: int) -> np.ndarray:
+        """The slot's page-aligned bpool staging lease, grown on demand.
+        A slot is only ever reused two dispatches later, by which point
+        the batch that last packed into it has been resolved (resolve
+        syncs the kernel), so growth may release the old lease safely."""
+        from . import bpool
+
+        lease = self._staging[slot]
+        if lease is None or lease.view is None \
+                or lease.view.nbytes < nbytes:
+            if lease is not None:
+                lease.release()
+            lease = self._staging[slot] = bpool.default_pool().get(nbytes)
+        return lease.view[:nbytes]
+
+    def _dispatch_pipelined(self, items: list[tuple], w: int, fn,
+                            launch) -> bool:
+        """Pack the batch into the spare staging buffer, ship it with an
+        async device_put, launch the kernel, and resolve the PREVIOUS
+        launch afterwards — so this batch's host work (pack + upload
+        issue) overlaps the previous batch's device execution.  Returns
+        False (nothing dispatched) when the batch is not pipeline-
+        eligible; the caller falls back to the serial path."""
+        from . import devices as devices_mod
+
+        dev = devices_mod.jax_device(self.device)
+        first = items[0][0]
+        if dev is None or first.dtype != np.uint8 or first.ndim < 2:
+            return False
+        row_shape = first.shape[1:]
+        row_bytes = first.itemsize
+        for d in row_shape:
+            row_bytes *= int(d)
+        if row_bytes <= 0:
+            return False
+        for p, _ in items:
+            if p.dtype != np.uint8 or p.shape[1:] != row_shape:
+                return False
+        t0 = time.monotonic()
+        n = sum(h.nrows for _, h in items)
+        mult = int(getattr(fn, "pad_rows", 1) or 1)
+        padded = n + (-n) % mult
+        need = padded * row_bytes
+        slot = self._staging_flip
+        self._staging_flip ^= 1
+        view = self._staging_view(slot, need).reshape(
+            (padded,) + row_shape)
+        lo = 0
+        for p, h in items:
+            view[lo:lo + h.nrows] = p
+            lo += h.nrows
+        if padded > n:
+            view[n:] = 0
+        t_pack = time.monotonic()
+        import jax
+
+        x = jax.device_put(view, dev)     # async H2D from pinned staging
+        devcache.note_h2d(need, self.device)
+        t_h2d = time.monotonic()
+        spans = []
+        lo = 0
+        for _, h in items:
+            spans.append((lo, lo + h.nrows))
+            lo += h.nrows
+        ctx = DispatchCtx(self._bufs, len(items))
+        try:
+            resolve = launch(x, n, spans, ctx)
+        except BaseException:  # noqa: BLE001 — fall back to serial
+            # Launch is the cheap half (placement + trace); a fault here
+            # re-runs the batch on the serial path, whose containment
+            # retries members solo.
+            if ctx.buf is not None:
+                self._bufs.give(ctx.buf)
+                ctx.buf = None
+            return False
+        prev, self._pending = self._pending, (
+            resolve, items, w, fn, ctx, t_pack)
+        host_s = time.monotonic() - t0
+        with self._mu:
+            self.h2d_bytes += need
+            self.h2d_dispatches += 1
+            self.pipeline_dispatches += 1
+            self.pack_s += t_pack - t0
+            self.h2d_s += t_h2d - t_pack
+            if prev is not None:
+                # Everything this batch just did on the host ran while
+                # `prev`'s kernel executed on-device.
+                self.overlap_s += host_s
+        if prev is not None:
+            self._resolve(prev)
+        return True
+
+    def _drain_pipeline(self) -> None:
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._resolve(pending)
+
+    def _resolve(self, pending: tuple) -> None:
+        """Sync one launched batch and scatter its results — the second
+        phase of `_dispatch`, deferred one dispatch behind the launch."""
+        resolve, items, w, fn, ctx, t_disp = pending
+        t0 = time.monotonic()
+        try:
+            results = resolve()
+        except BaseException:  # noqa: BLE001 — contain the fault
+            if ctx.buf is not None:
+                self._bufs.give(ctx.buf)
+                ctx.buf = None
+            with self._mu:
+                self.batch_faults += 1
+            # Same containment contract as the serial path: a packed
+            # batch carries spans from unrelated requests — retry each
+            # member solo; only the guilty span(s) keep the exception.
+            DATA_PATH.record_co_fault(len(items))
+            for payload, h in items:
+                mctx = DispatchCtx(self._bufs, 1)
+                try:
+                    res = fn(payload, [(0, h.nrows)], mctx)[0]
+                except BaseException as me:  # noqa: BLE001
+                    if mctx.buf is not None:
+                        self._bufs.give(mctx.buf)
+                        mctx.buf = None
+                    h._exc = me
+                else:
+                    h._ctx = mctx
+                    h._res = res
+                with self._mu:
+                    self.member_retries += 1
+                h._t_disp = t_disp
+                h._ev.set()
+            with self._mu:
+                self.resolve_s += time.monotonic() - t0
+            return
+        wait_sum = 0.0
+        for (_, h), res in zip(items, results):
+            wait_sum += t_disp - h._t_enq
+            h._t_disp = t_disp
+            h._ctx = ctx
+            h._res = res
+            h._ev.set()
+        with self._mu:
+            self.dispatches += 1
+            self.items += len(items)
+            self.weight += w
+            self.wait_s += wait_sum
+            self.max_items = max(self.max_items, len(items))
+            self._ema = 0.75 * self._ema + 0.25 * len(items)
+            self.resolve_s += time.monotonic() - t0
+        DATA_PATH.record_coalesce_dispatch(len(items), w, wait_sum)
+        DATA_PATH.record_lane_dispatch(self.device, len(items), w,
+                                       wait_sum)
+
     # -- lifecycle / introspection ------------------------------------------
 
     def close(self) -> None:
@@ -492,6 +701,13 @@ class DispatchLane:
                 "pending_weight": self._pending_weight,
                 "batch_faults": self.batch_faults,
                 "member_retries": self.member_retries,
+                "h2d_bytes": self.h2d_bytes,
+                "h2d_dispatches": self.h2d_dispatches,
+                "pipeline_dispatches": self.pipeline_dispatches,
+                "pack_s": self.pack_s,
+                "h2d_s": self.h2d_s,
+                "resolve_s": self.resolve_s,
+                "overlap_s": self.overlap_s,
                 "broken": self._broken is not None,
             }
 
@@ -587,12 +803,17 @@ class DispatchCoalescer:
             "dispatches": 0, "items": 0, "weight": 0, "wait_s": 0.0,
             "max_items": 0, "pending_items": 0, "pending_weight": 0,
             "batch_faults": 0, "member_retries": 0,
+            "h2d_bytes": 0, "h2d_dispatches": 0,
+            "pipeline_dispatches": 0, "pack_s": 0.0, "h2d_s": 0.0,
+            "resolve_s": 0.0, "overlap_s": 0.0,
         }
         broken = False
         for st in per.values():
             for k in ("dispatches", "items", "weight", "wait_s",
                       "pending_items", "pending_weight", "batch_faults",
-                      "member_retries"):
+                      "member_retries", "h2d_bytes", "h2d_dispatches",
+                      "pipeline_dispatches", "pack_s", "h2d_s",
+                      "resolve_s", "overlap_s"):
                 out[k] += st[k]
             out["max_items"] = max(out["max_items"], st["max_items"])
             broken = broken or st["broken"]
@@ -619,6 +840,26 @@ def make_digest_kernel(algo: str, pad_rows: int = 0):
         else:
             out = bitrot_io._hash_batch(stacked, algo)
         return [out[lo:hi] for lo, hi in spans]
+
+    if pad_rows:
+        from . import fused
+
+        if algo in fused.DEVICE_ALGOS and bitrot_io.device_preferred(algo):
+            # Pipeline form: the lane pre-placed the (padded) rows on
+            # its device — hash them asynchronously and defer the sync
+            # to resolve().  Same algorithm, same digests, as
+            # _hash_batch produces for the serial path.
+            def launch(x, n, spans, ctx):
+                out_dev = fused.hash_rows_async(x, algo)
+
+                def resolve():
+                    out = np.asarray(out_dev)[:n]
+                    return [out[lo:hi] for lo, hi in spans]
+
+                return resolve
+
+            kernel.launch = launch
+            kernel.pad_rows = pad_rows
 
     return kernel
 

@@ -97,6 +97,13 @@ def put(x, device_idx: int | None):
         return x
     import jax
 
+    if isinstance(x, jax.Array):
+        # Already device-resident (lane staging upload / devcache):
+        # the crossing was counted where it happened.
+        return x
+    from . import devcache
+
+    devcache.note_h2d(int(getattr(x, "nbytes", 0) or 0), device_idx)
     return jax.device_put(x, dev)
 
 

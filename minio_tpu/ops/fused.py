@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ..observe import span as ospan
+from . import devcache
 from . import devices as devices_mod
 from . import erasure_jax, erasure_pallas
 from .highwayhash import MAGIC_KEY
@@ -61,13 +62,33 @@ def _placed(x, device: int | None):
     """Commit the input batch to lane `device`'s jax device (PR 10
     erasure-set affinity): jit executions follow a committed input, so
     this one device_put is the whole placement story for every fused
-    kernel. `device=None` keeps the historical default-device path."""
+    kernel. `device=None` keeps the historical default-device path.
+
+    Inputs that are ALREADY jax arrays (a coalescer lane's pipelined
+    staging upload, a devcache-resident batch) pass straight through —
+    they crossed the boundary once when they were placed, and the h2d
+    ledger counted them there; re-placing would both double the tunnel
+    crossing and double the count."""
+    if isinstance(x, jax.Array):
+        return x
+    nbytes = int(getattr(x, "nbytes", 0) or 0)
     if device is None:
+        devcache.note_h2d(nbytes)
         return jnp.asarray(x, dtype=jnp.uint8)
     dev = devices_mod.jax_device(device)
     if dev is None:
+        devcache.note_h2d(nbytes)
         return jnp.asarray(x, dtype=jnp.uint8)
+    devcache.note_h2d(nbytes, device)
     return jax.device_put(jnp.asarray(x, dtype=jnp.uint8), dev)
+
+
+def donate_ok() -> bool:
+    """Input-buffer donation is only a win (and only warning-free) on
+    accelerator backends where XLA actually reuses the device
+    allocation; the host-CPU backend ignores donations with a warning
+    per dispatch, so gate it off there."""
+    return devices_mod._visible()[1] in ("tpu", "gpu")
 
 
 def _digest_rows(x2d: jax.Array, algo: str, key: bytes) -> jax.Array:
@@ -77,6 +98,25 @@ def _digest_rows(x2d: jax.Array, algo: str, key: bytes) -> jax.Array:
     if algo in ("highwayhash256S", "highwayhash256"):
         return _hh256_impl(x2d, key)
     raise ValueError(f"no device kernel for bitrot algo {algo!r}")
+
+
+@functools.lru_cache(maxsize=16)
+def _hash_rows2d_jit(algo: str, key: bytes):
+    @jax.jit
+    def fn(x):  # (N, S) uint8
+        return _digest_rows(x, algo, key)
+    return fn
+
+
+def hash_rows_async(x, algo: str, key: bytes = MAGIC_KEY):
+    """(N, S) rows -> (N, 32) digests as an UNSYNCED jax array — the
+    coalescer lanes' pipelined digest form (the caller resolves via
+    np.asarray one dispatch later).  `x` may already be device-resident
+    (counted at its placement site)."""
+    if not isinstance(x, jax.Array):
+        devcache.note_h2d(int(getattr(x, "nbytes", 0) or 0))
+        x = jnp.asarray(x, dtype=jnp.uint8)
+    return _hash_rows2d_jit(algo, key)(x)
 
 
 @functools.lru_cache(maxsize=16)
@@ -133,11 +173,11 @@ def verify_and_transform(x, k: int, m: int, sources: tuple[int, ...],
 
 
 @functools.lru_cache(maxsize=64)
-def _encode_hash_jit(k: int, m: int, algo: str, key: bytes):
+def _encode_hash_jit(k: int, m: int, algo: str, key: bytes,
+                     donate: bool = False):
     mat = jnp.asarray(erasure_jax._encode_matrix_bits(k, m),
                       dtype=jnp.bfloat16)
 
-    @jax.jit
     def fn(x):  # x: (B, K, S) uint8 data shards
         b, kk, s = x.shape
         parity = erasure_pallas.gf_matmul_blocks(mat, x, m)
@@ -147,21 +187,27 @@ def _encode_hash_jit(k: int, m: int, algo: str, key: bytes):
             algo, key).reshape(kk + m, b, 32)
         return parity, digests
 
-    return fn
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def encode_and_hash(x, k: int, m: int, algo: str = "highwayhash256S",
                     key: bytes = MAGIC_KEY,
-                    device: int | None = None):
+                    device: int | None = None,
+                    donate: bool = False):
     """((B, K, S) data) -> ((B, M, S) parity, (K+M, B, 32) digests).
 
     The PUT hot path: parity AND per-shard-block bitrot digests in one
     device dispatch; framing on the host is then pure byte interleaving.
     Digest layout is shard-major to match frame_shards_batch's
     (n_shards, n_blocks) order.  `device` places the dispatch on that
-    coalescer lane's device (None = default device).
-    """
+    coalescer lane's device (None = default device).  `donate=True`
+    hands the placed input buffer to XLA for reuse — legal because the
+    encode input is placement-owned (nothing retains it after the
+    dispatch; the devcache only ever retains VERIFY inputs), and only
+    honored on accelerator backends (donate_ok)."""
     x = _placed(x, device)
-    return _traced_dispatch("device.encode_hash",
-                            _encode_hash_jit(k, m, algo, key), x,
-                            device=device)
+    return _traced_dispatch(
+        "device.encode_hash",
+        _encode_hash_jit(k, m, algo, key,
+                         donate=bool(donate) and donate_ok()), x,
+        device=device)

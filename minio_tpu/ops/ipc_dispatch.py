@@ -170,6 +170,23 @@ def _enc_kernel(tag: str, k: int, m: int, algo: str,
             digests = np.asarray(digests)[:, :n]
             return [(parity[lo:hi], digests[:, lo:hi])
                     for lo, hi in spans]
+
+        def launch(x, n, spans, ctx):
+            # Pipeline form (lane-staged device input, sync deferred to
+            # resolve) — same donation rule as the in-process kernel.
+            parity_d, digests_d = fused.encode_and_hash(
+                x, k, m, algo=algo, device=device, donate=True)
+
+            def resolve():
+                parity = np.asarray(parity_d)[:n]
+                digests = np.asarray(digests_d)[:, :n]
+                return [(parity[lo:hi], digests[:, lo:hi])
+                        for lo, hi in spans]
+
+            return resolve
+
+        kernel.launch = launch
+        kernel.pad_rows = BATCH_BLOCKS
         return kernel
 
     codec = _owner_codec(tag, k, m)
@@ -179,6 +196,18 @@ def _enc_kernel(tag: str, k: int, m: int, algo: str,
             parity = np.asarray(codec.encode_blocks(
                 devices_mod.put(x, device)))[:n]
             return [(parity[lo:hi], None) for lo, hi in spans]
+
+        def launch(x, n, spans, ctx):
+            parity_d = codec.encode_blocks(devices_mod.put(x, device))
+
+            def resolve():
+                parity = np.asarray(parity_d)[:n]
+                return [(parity[lo:hi], None) for lo, hi in spans]
+
+            return resolve
+
+        kernel.launch = launch
+        kernel.pad_rows = BATCH_BLOCKS
     else:
         def kernel(stacked, spans, ctx):
             parity = np.asarray(codec.encode_blocks(stacked))
@@ -202,6 +231,21 @@ def _vt_kernel(k: int, m: int, sources: tuple, targets: tuple, algo: str,
         return [(digests[lo:hi], out[lo:hi] if out is not None else None)
                 for lo, hi in spans]
 
+    def launch(x, n, spans, ctx):
+        digests_d, out_d = fused.verify_and_transform(
+            x, k, m, sources, targets, algo=algo, device=device)
+
+        def resolve():
+            digests = np.asarray(digests_d)[:n]
+            out = np.asarray(out_d)[:n] if targets else None
+            return [(digests[lo:hi],
+                     out[lo:hi] if out is not None else None)
+                    for lo, hi in spans]
+
+        return resolve
+
+    kernel.launch = launch
+    kernel.pad_rows = BATCH_BLOCKS
     return kernel
 
 

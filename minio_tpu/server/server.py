@@ -2321,6 +2321,18 @@ class S3Server:
         workers = (self.worker_plane.workers_info()
                    if self.worker_plane is not None else None)
         tier = getattr(self.pools, "hot_tier", None)
+        devcache_stats = None
+        h2d_row: dict = {}
+        try:
+            from ..ops import devcache as _devcache
+            devcache_stats = _devcache.stats()
+            h2d = _devcache.h2d_stats()
+            h2d_row = {"bytes": h2d["h2d_bytes"],
+                       "dispatches": h2d["h2d_dispatches"],
+                       "lanes": {str(k): v
+                                 for k, v in h2d["lanes"].items()}}
+        except Exception:  # noqa: BLE001 — device block is best-effort
+            pass
         return {
             "endpoint": f"{self.host}:{self.port}",
             "time": round(_time.time(), 3),
@@ -2335,6 +2347,8 @@ class S3Server:
             "coalescer": coalescer,
             "workers": workers,
             "hotcache": tier.stats() if tier is not None else None,
+            "devcache": devcache_stats,
+            "h2d": h2d_row,
             "ilm": (self.handlers.tier_mgr.stats()
                     if self.handlers.tier_mgr is not None else None),
             "audit": [t.stats() for t in self.audit_targets],

@@ -136,6 +136,8 @@ class LaneScheduler:
     def update(self, s: _Stream, piece) -> None:
         if not isinstance(piece, (bytes, memoryview)):
             piece = bytes(piece)     # bytearray callers may mutate after
+        elif isinstance(piece, memoryview) and not piece.readonly:
+            piece = bytes(piece)     # pooled-ring views recycle underneath
         with self._cv:
             while (s.pending > self._max_pending and not s.finalizing
                    and s.error is None):

@@ -77,6 +77,14 @@ class PipelinedMD5:
             h.update(piece)
 
     def update(self, piece) -> None:
+        # Writable views are VOLATILE: the pooled PUT-ingest ring
+        # (batched_chunks) recycles its buffers after a few pulls, and
+        # both digest engines hold queued pieces instead of consuming
+        # them synchronously — stabilize with one copy here.  Immutable
+        # pieces (bytes, readonly views from the bytes path) stay
+        # zero-copy as before.
+        if isinstance(piece, memoryview) and not piece.readonly:
+            piece = bytes(piece)
         if self._stream is not None:
             self._sched.update(self._stream, piece)
         else:
@@ -134,6 +142,19 @@ def ensure_bytes(x) -> bytes:
         out += piece
 
 
+def _readinto_via_read(read, b) -> int:
+    """readinto fallback for a source that only exposes read(): one
+    bounded read copied into the caller's buffer.  May return fewer
+    bytes than len(b); returns 0 only at EOF (matching the read()
+    contract of every reader in this module)."""
+    mv = b if isinstance(b, memoryview) else memoryview(b)
+    piece = read(len(mv))
+    n = len(piece)
+    if n:
+        mv[:n] = piece
+    return n
+
+
 class BytesReader:
     """bytes -> reader (tests, adapters)."""
 
@@ -147,6 +168,14 @@ class BytesReader:
         out = self._mv[self._pos:self._pos + n]
         self._pos += len(out)
         return bytes(out)
+
+    def readinto(self, b) -> int:
+        mv = b if isinstance(b, memoryview) else memoryview(b)
+        n = min(len(mv), len(self._mv) - self._pos)
+        if n:
+            mv[:n] = self._mv[self._pos:self._pos + n]
+            self._pos += n
+        return n
 
 
 class LimitedReader:
@@ -167,6 +196,22 @@ class LimitedReader:
             raise StreamError(f"body truncated ({self._left} bytes short)")
         self._left -= len(piece)
         return piece
+
+    def readinto(self, b) -> int:
+        if self._left <= 0:
+            return 0
+        mv = b if isinstance(b, memoryview) else memoryview(b)
+        want = min(len(mv), self._left)
+        if not want:
+            return 0
+        ri = getattr(self._raw, "readinto", None)
+        n = (ri(mv[:want]) if ri is not None
+             else _readinto_via_read(self._raw.read, mv[:want]))
+        n = n or 0
+        if not n and self._left:
+            raise StreamError(f"body truncated ({self._left} bytes short)")
+        self._left -= n
+        return n
 
 
 class ExactLengthReader:
@@ -192,6 +237,21 @@ class ExactLengthReader:
                 f"body shorter than declared ({self._seen} < {self._want})")
         return piece
 
+    def readinto(self, b) -> int:
+        if not len(b):
+            return 0
+        ri = getattr(self._src, "readinto", None)
+        n = (ri(b) if ri is not None
+             else _readinto_via_read(self._src.read, b)) or 0
+        self._seen += n
+        if self._seen > self._want:
+            raise self._exc(
+                f"body longer than declared ({self._seen} > {self._want})")
+        if not n and self._seen != self._want:
+            raise self._exc(
+                f"body shorter than declared ({self._seen} < {self._want})")
+        return n
+
 
 class MaxSizeReader:
     """Pass-through reader that raises `exc` once more than `cap` bytes
@@ -210,6 +270,17 @@ class MaxSizeReader:
         if self._seen > self._cap:
             raise self._exc(f"body exceeds {self._cap} bytes")
         return piece
+
+    def readinto(self, b) -> int:
+        if not len(b):
+            return 0
+        ri = getattr(self._src, "readinto", None)
+        n = (ri(b) if ri is not None
+             else _readinto_via_read(self._src.read, b)) or 0
+        self._seen += n
+        if self._seen > self._cap:
+            raise self._exc(f"body exceeds {self._cap} bytes")
+        return n
 
 
 class HashVerifyReader:
@@ -233,6 +304,22 @@ class HashVerifyReader:
             if self._h.hexdigest() != self._want:
                 raise self._exc("content sha256 mismatch")
         return piece
+
+    def readinto(self, b) -> int:
+        if not len(b):
+            return 0
+        mv = b if isinstance(b, memoryview) else memoryview(b)
+        ri = getattr(self._src, "readinto", None)
+        n = (ri(mv) if ri is not None
+             else _readinto_via_read(self._src.read, mv)) or 0
+        if n:
+            # hashlib consumes synchronously — safe on a pooled view.
+            self._h.update(mv[:n])
+        elif not self._done:
+            self._done = True
+            if self._h.hexdigest() != self._want:
+                raise self._exc("content sha256 mismatch")
+        return n
 
 
 class HTTPChunkedReader:
@@ -280,6 +367,74 @@ class HTTPChunkedReader:
         return bytes(out)
 
 
+#: Pooled PUT-ingest ring depth: a yielded view stays valid for
+#: _RING_DEPTH - 1 further pulls.  The encode pipeline holds at most
+#: one batch pending (chunk i is consumed while chunk i+1 is read), so
+#: 2 would suffice; 4 leaves margin for a prefetching stage pipeline.
+_RING_DEPTH = 4
+
+
+def _fill_from(stream, view) -> int:
+    """Fill writable memoryview `view` from `stream`; returns bytes
+    filled (< len(view) only at EOF).  recv_into discipline: when the
+    reader chain supports readinto, socket bytes land straight in the
+    caller's buffer; otherwise read() pieces are copied in (still one
+    destination buffer, no bytearray re-assembly)."""
+    filled, total = 0, len(view)
+    ri = getattr(stream, "readinto", None)
+    if ri is not None:
+        while filled < total:
+            n = ri(view[filled:])
+            if not n:
+                break
+            filled += n
+        return filled
+    while filled < total:
+        piece = stream.read(total - filled)
+        if not piece:
+            break
+        lp = len(piece)
+        view[filled:filled + lp] = piece
+        filled += lp
+    return filled
+
+
+def _pooled_chunks(head: bytes, stream, chunk_len: int):
+    """Streaming chunker over a ring of page-aligned buffer-pool leases
+    (the PUT-ingest half of MTPU_ZEROCOPY): each chunk is filled in
+    place via readinto instead of per-piece bytes allocs plus a final
+    bytes() copy.  Yields writable memoryviews — valid until
+    _RING_DEPTH - 1 further pulls; consumers that defer (PipelinedMD5's
+    digest queue) stabilize volatile views with one copy on their side."""
+    from ..ops import bpool
+    pool = bpool.default_pool()
+    slots: list = [None] * _RING_DEPTH
+    try:
+        carry = memoryview(head)
+        i = 0
+        while True:
+            slot = i % _RING_DEPTH
+            if slots[slot] is None:
+                slots[slot] = pool.get(chunk_len)
+            view = memoryview(slots[slot].view)
+            pre = min(len(carry), chunk_len)
+            if pre:
+                view[:pre] = carry[:pre]
+                carry = carry[pre:]
+            filled = pre
+            if filled < chunk_len:
+                filled += _fill_from(stream, view[pre:])
+            if filled < chunk_len:
+                yield view[:filled], True    # final chunk (may be empty)
+                return
+            yield view, False
+            i += 1
+    finally:
+        for lease in slots:
+            if lease is not None:
+                lease.release()
+
+
 def batched_chunks(head: bytes, stream, chunk_len: int):
     """Yield (chunk, is_last) with every chunk exactly chunk_len bytes
     except the final one (which may be empty when the total length is an
@@ -293,6 +448,10 @@ def batched_chunks(head: bytes, stream, chunk_len: int):
             yield mv[pos:pos + chunk_len], False
             pos += chunk_len
         yield mv[pos:], True
+        return
+    from ..ops import zerocopy as _zc
+    if _zc.zerocopy_enabled():
+        yield from _pooled_chunks(head, stream, chunk_len)
         return
     buf = bytearray(head)
     eof = False

@@ -137,6 +137,43 @@ def zerocopy_mode(request, monkeypatch):
     return request.param
 
 
+@pytest.fixture(params=["1", "0"], ids=["devcache", "upload"])
+def devcache_mode(request, monkeypatch):
+    """Oracle guard for the device-resident shard cache: tests using
+    this fixture run once with verified shard batches cached on device
+    (MTPU_DEVCACHE=1, the default) and once on the always-upload
+    oracle (=0) — GET/ranged-GET/HEAD bodies and heal end-state must be
+    byte-identical; the cache may only change how many bytes cross the
+    host->device boundary.  The singleton is retired on both edges so
+    resident entries and generation counters never bleed between
+    parametrizations."""
+    from minio_tpu.ops import devcache
+
+    devcache.reset()
+    monkeypatch.setenv("MTPU_DEVCACHE", request.param)
+    yield request.param
+    devcache.reset()
+
+
+@pytest.fixture(params=["1", "0"], ids=["pipelined", "serial"])
+def h2d_mode(request, monkeypatch):
+    """Oracle guard for the double-buffered H2D staging pipeline: tests
+    using this fixture run once with lanes shipping batch N+1 while
+    batch N executes (MTPU_H2D_PIPELINE=1, the default) and once on the
+    serial per-dispatch upload oracle (=0) — digests, parity, and
+    rebuilt shards must be byte-identical.  The coalescer is retired on
+    both edges so staged leases and pending launches never straddle the
+    flag flip."""
+    from minio_tpu.ops import coalesce, devcache
+
+    coalesce.reset()
+    devcache.reset_h2d()
+    monkeypatch.setenv("MTPU_H2D_PIPELINE", request.param)
+    yield request.param
+    coalesce.reset()
+    devcache.reset_h2d()
+
+
 @pytest.fixture(params=["1", "0"], ids=["breaker", "nobreaker"])
 def breaker_mode(request, monkeypatch):
     """Oracle guard for the drive circuit breaker: MTPU_BREAKER=0 pins

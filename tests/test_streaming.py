@@ -74,6 +74,90 @@ class TestBatchedChunks:
         assert list(streams.batched_chunks(b"", None, 10)) == [(b"", True)]
 
 
+class CountingReader:
+    """Socket-ish source: readinto-capable, counts which entry point the
+    chunker actually drives and how many bytes objects it materializes."""
+
+    def __init__(self, size: int, piece: int = 64 << 10):
+        self.left = size
+        self.piece = piece
+        self.reads = 0
+        self.readintos = 0
+
+    def read(self, n: int = -1) -> bytes:
+        self.reads += 1
+        if self.left <= 0:
+            return b""
+        n = min(n if n and n > 0 else self.left, self.left, self.piece)
+        self.left -= n
+        return b"\xa5" * n
+
+    def readinto(self, b) -> int:
+        self.readintos += 1
+        if self.left <= 0:
+            return 0
+        mv = b if isinstance(b, memoryview) else memoryview(b)
+        n = min(len(mv), self.left, self.piece)
+        mv[:n] = b"\xa5" * n
+        self.left -= n
+        return n
+
+
+class TestPooledIngest:
+    """Satellite: PUT ingest lands in pooled page-aligned leases via
+    recv_into instead of per-piece bytes allocs (MTPU_ZEROCOPY=0 is the
+    bytes-per-chunk oracle)."""
+
+    SIZE = 8 * (1 << 20)
+    CHUNK = 1 << 20
+
+    def _drain(self, monkeypatch, flag):
+        monkeypatch.setenv("MTPU_ZEROCOPY", flag)
+        r = CountingReader(self.SIZE)
+        h = hashlib.md5()
+        total = 0
+        kinds = set()
+        for c, _last in streams.batched_chunks(b"", r, self.CHUNK):
+            kinds.add(type(c))
+            h.update(c)
+            total += len(c)
+        assert total == self.SIZE
+        return r, h.hexdigest(), kinds
+
+    def test_pooled_path_uses_readinto_and_matches_oracle(self, monkeypatch):
+        rp, hp, kp = self._drain(monkeypatch, "1")
+        ro, ho, ko = self._drain(monkeypatch, "0")
+        assert hp == ho                       # byte-identical content
+        assert rp.readintos > 0 and rp.reads == 0   # recv_into only
+        assert ro.reads > 0 and ro.readintos == 0   # oracle unchanged
+        assert kp == {memoryview} and ko == {bytes}
+
+    def test_pooled_path_allocation_regression(self, monkeypatch):
+        """tracemalloc regression: the pooled ring must not allocate
+        per-chunk bytes — traced-heap peak during the drain stays far
+        below one chunk, while the oracle pays >= chunk-sized bytearray
+        + bytes() per pull."""
+        import gc
+        import tracemalloc
+
+        def peak(flag):
+            monkeypatch.setenv("MTPU_ZEROCOPY", flag)
+            r = CountingReader(self.SIZE)
+            gc.collect()
+            tracemalloc.start()
+            try:
+                for _c, _last in streams.batched_chunks(b"", r, self.CHUNK):
+                    pass
+                return tracemalloc.get_traced_memory()[1]
+            finally:
+                tracemalloc.stop()
+
+        pooled, oracle = peak("1"), peak("0")
+        assert oracle >= self.CHUNK           # bytearray + bytes() copies
+        assert pooled < oracle / 4            # leases are pool-backed,
+        #                                       not traced-heap churn
+
+
 class TestStreamingPut:
     def test_reader_put_roundtrip(self, es):
         size = 5 * BLOCK_SIZE + 12345           # multi-block + tail

@@ -337,6 +337,14 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
             stop.set()
 
     snap0 = DATA_PATH.snapshot()
+    # H2D boundary ledger + device-shard-cache deltas (ISSUE 17): how
+    # many bytes crossed the host->device tunnel per byte this run
+    # moved, and how often verified shard batches were already
+    # device-resident.  Import is lazy: the ledger lives next to the
+    # cache and neither pulls in jax at import time.
+    from minio_tpu.ops import devcache as _devcache
+    h2d0 = _devcache.h2d_stats()
+    dc0 = _devcache.stats()
     threads = [threading.Thread(target=client, args=(ci,), daemon=True)
                for ci in range(clients)]
     # CPU-seconds-per-GB attribution (ISSUE 16): the engine runs
@@ -355,6 +363,8 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     ru1 = resource.getrusage(resource.RUSAGE_SELF)
     cpu_s = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime - ru0.ru_stime)
     snap1 = DATA_PATH.snapshot()
+    h2d1 = _devcache.h2d_stats()
+    dc1 = _devcache.stats()
     if errors:
         raise errors[0]
 
@@ -417,6 +427,30 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
         "lane_occupancy": {int(k): v for k, v
                            in sorted(lane_occupancy.items())},
     }
+    # Bytes-crossing-per-byte-served (ISSUE 17): ~1.0 on first touch,
+    # ~0 when the device shard cache is absorbing the verify reads.
+    total_b = sum(nbytes)
+    d_h2d_b = h2d1["h2d_bytes"] - h2d0["h2d_bytes"]
+    out["h2d_bytes"] = d_h2d_b
+    out["h2d_dispatches"] = (h2d1["h2d_dispatches"]
+                             - h2d0["h2d_dispatches"])
+    out["h2d_bytes_per_byte"] = (round(d_h2d_b / total_b, 4)
+                                 if total_b else 0.0)
+    lane_h2d: dict[int, float] = {}
+    for dev, row in h2d1["lanes"].items():
+        db = (row["h2d_bytes"]
+              - h2d0["lanes"].get(dev, {}).get("h2d_bytes", 0))
+        if db:
+            lane_h2d[int(dev)] = (round(db / total_b, 4)
+                                  if total_b else 0.0)
+    out["lane_h2d_bytes_per_byte"] = dict(sorted(lane_h2d.items()))
+    if dc1 is not None:
+        dh = dc1["hits"] - (dc0["hits"] if dc0 else 0)
+        dm = dc1["misses"] - (dc0["misses"] if dc0 else 0)
+        out["devcache_hits"] = dh
+        out["devcache_misses"] = dm
+        out["devcache_hit_ratio"] = (round(dh / (dh + dm), 4)
+                                     if dh + dm else 0.0)
     if zipf:
         out["zipf_s"] = zipf
         out.update(hot_cold_rows(
