@@ -999,6 +999,134 @@ def obs_bench(n_get: int = 300, object_kib: int = 64) -> dict:
     return out
 
 
+def overload_bench(duration_s: float = 6.0, object_kib: int = 256,
+                   nworkers: int = 2, slots: int = 8) -> dict:
+    """Overload-plane suite (server/qos.py): three multi-tenant legs
+    against a pre-fork pool with an EXPLICIT admission budget
+    (MTPU_REQUESTS_MAX=slots, so the fork-shared cap — not the
+    machine — is the capacity under test).
+
+    Leg 1 (capacity): offered concurrency == slots, QoS on — the
+    uncontended goodput/p99 reference.  Leg 2 (overload): 4x slots
+    offered across three tenant classes, QoS on — the gates: total
+    goodput holds >= 90% of capacity (no congestion collapse),
+    best-effort sheds while premium doesn't, and premium p99 stays
+    bounded by the admission deadline.  Leg 3 (collapse): the same 4x
+    offered load with MTPU_QOS=0 — nothing sheds, everything queues,
+    reported as the contrast row."""
+    import os
+    import shutil
+    import socket as _socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from tools.loadgen import parse_tenant_spec, run_load_tenants
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    deadline_ms = 2000.0
+    tenants_env = "gold=premium,std=standard,beff=best-effort"
+    # 4x saturation: slots admission slots, 4*slots offered clients,
+    # half of them best-effort — the class the ladder starves first.
+    overload_spec = (f"gold:premium:{slots},std:standard:{slots},"
+                     f"beff:best-effort:{2 * slots}")
+    # ~60% of the slot budget: comfortably under capacity, so the
+    # reference leg must finish shed-free even with the best-effort
+    # ladder rung at half the slots.
+    capacity_spec = (f"gold:premium:{max(1, slots // 4)},"
+                     f"std:standard:{max(1, slots // 4)},"
+                     f"beff:best-effort:{max(1, slots // 8)}")
+
+    def run_leg(label: str, qos_on: bool, spec: str) -> dict:
+        root = tempfile.mkdtemp(prefix=f"mtpu-olb-{label}-")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MTPU_SCANNER"] = "0"
+        env["MTPU_WORKERS"] = str(nworkers)
+        env["MTPU_QOS"] = "1" if qos_on else "0"
+        env["MTPU_REQUESTS_MAX"] = str(slots)
+        env["MTPU_REQUESTS_DEADLINE_MS"] = str(deadline_ms)
+        env["MTPU_QOS_QUEUE"] = str(3 * slots)
+        env["MTPU_QOS_TENANTS"] = tenants_env
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--drives", f"{root}/d{{1...4}}", "--port", str(port)],
+            env=env, cwd=here, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT)
+        try:
+            deadline = time.monotonic() + 180
+            up = False
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    break
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}"
+                            "/minio/health/ready", timeout=2) as r:
+                        if r.status == 200:
+                            up = True
+                            break
+                except Exception:  # noqa: BLE001 — keep polling
+                    pass
+                time.sleep(0.2)
+            if not up:
+                raise RuntimeError(f"overload_bench {label} never ready")
+            return run_load_tenants(
+                f"http://127.0.0.1:{port}",
+                tenants=parse_tenant_spec(spec),
+                object_size=object_kib << 10, put_frac=0.5,
+                duration_s=duration_s, seed=len(label))
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+            shutil.rmtree(root, ignore_errors=True)
+
+    cap = run_leg("cap", True, capacity_spec)
+    over = run_leg("over", True, overload_spec)
+    off = run_leg("off", False, overload_spec)
+
+    gold = over["tenants"]["gold"]
+    be = over["tenants"]["beff"]
+    cap_p99 = max(r["p99_ms"] for r in cap["tenants"].values())
+    out = {
+        "ol_slots": slots,
+        "ol_workers": nworkers,
+        "ol_deadline_ms": deadline_ms,
+        "ol_offered_clients": 4 * slots,
+        "ol_cap_goodput_gbps": cap["total_goodput_gbps"],
+        "ol_cap_p99_ms": cap_p99,
+        "ol_cap_shed": cap["total_shed"],
+        "ol_over_goodput_gbps": over["total_goodput_gbps"],
+        "ol_over_shed": over["total_shed"],
+        "ol_over_errors": over["total_errors"],
+        "ol_gold_p99_ms": gold["p99_ms"],
+        "ol_gold_shed_rate": gold["shed_rate"],
+        "ol_be_shed": be["shed"],
+        "ol_be_shed_rate": be["shed_rate"],
+        "ol_off_goodput_gbps": off["total_goodput_gbps"],
+        "ol_off_p99_ms": max(r["p99_ms"]
+                             for r in off["tenants"].values()),
+        "ol_off_shed": off["total_shed"],
+    }
+    out["ol_goodput_ratio"] = round(
+        over["total_goodput_gbps"] / cap["total_goodput_gbps"], 3) \
+        if cap["total_goodput_gbps"] else 0.0
+    # Premium p99 bound under 4x overload: one admission-queue wait
+    # (the deadline) plus contended service — generous, but the
+    # collapse leg shows what UNBOUNDED looks like.
+    out["ol_gold_p99_bound_ms"] = round(2 * deadline_ms
+                                        + 10 * cap_p99, 1)
+    return out
+
+
 def multichip_bench(duration_s: float = 2.5,
                     object_mib: int = 1) -> dict:
     """Device-sharding suite (PR 10, per-device coalescer lanes): the
@@ -2339,6 +2467,57 @@ def _devcache_main() -> None:
         raise SystemExit(1)
 
 
+def _overload_main() -> None:
+    """`python bench.py overload_bench` — the overload-plane suite
+    alone, JSON to stdout and QOS_r18.json for the record.  Gates
+    (ISSUE 18): under 4x offered saturation with QoS on, total goodput
+    holds >= 90% of the uncontended capacity leg, best-effort sheds
+    (and sheds harder than premium), premium p99 stays under the
+    deadline-derived bound, and nothing sheds in the capacity leg.
+    The MTPU_QOS=0 collapse leg is recorded as contrast, not gated."""
+    import os
+    doc = {"rc": 0, "ok": False}
+    try:
+        # Sized for modest CI hosts: a 4-slot budget keeps the 4x
+        # overload leg at 16 client threads.
+        extras = overload_bench(slots=4)
+        doc["ok"] = (
+            extras.get("ol_goodput_ratio", 0.0) >= 0.9
+            and extras.get("ol_cap_shed", 1) == 0
+            and extras.get("ol_be_shed", 0) > 0
+            and extras.get("ol_be_shed_rate", 0.0)
+            > extras.get("ol_gold_shed_rate", 1.0)
+            and extras.get("ol_gold_p99_ms", 1e9)
+            <= extras.get("ol_gold_p99_bound_ms", 0.0)
+            and extras.get("ol_over_errors", 1) == 0)
+        doc["extras"] = extras
+        doc["tail"] = (
+            f"overload_bench {'OK' if doc['ok'] else 'VIOLATION'}: "
+            f"{extras.get('ol_offered_clients')} clients vs "
+            f"{extras.get('ol_slots')} slots -> goodput "
+            f"x{extras.get('ol_goodput_ratio')} of capacity "
+            f"({extras.get('ol_over_goodput_gbps')} vs "
+            f"{extras.get('ol_cap_goodput_gbps')} GB/s), premium p99 "
+            f"{extras.get('ol_gold_p99_ms')} ms (bound "
+            f"{extras.get('ol_gold_p99_bound_ms')} ms, shed rate "
+            f"{extras.get('ol_gold_shed_rate')}), best-effort shed "
+            f"{extras.get('ol_be_shed')} "
+            f"(rate {extras.get('ol_be_shed_rate')}); QoS-off "
+            f"contrast p99 {extras.get('ol_off_p99_ms')} ms with "
+            f"{extras.get('ol_off_shed')} sheds")
+    except Exception as e:  # noqa: BLE001 — the round file records it
+        doc["rc"] = 1
+        doc["tail"] = f"{type(e).__name__}: {e}"
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "QOS_r18.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc))
+    if doc["rc"] or not doc["ok"]:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     if sys.argv[1:2] == ["multichip_bench"]:
         _multichip_main()
@@ -2350,5 +2529,7 @@ if __name__ == "__main__":
         _zerocopy_main()
     elif sys.argv[1:2] == ["devcache_bench"]:
         _devcache_main()
+    elif sys.argv[1:2] == ["overload_bench"]:
+        _overload_main()
     else:
         main()

@@ -343,11 +343,17 @@ class Decommissioner:
         return self.pools.pools[self.pool_idx]
 
     def _gate(self) -> bool:
-        """Block while paused; False when the drain should stop."""
+        """Block while paused; False when the drain should stop.
+        Every mover passes here between versions, so it doubles as the
+        decom plane's yield point under foreground pressure."""
         while not self._unpaused.wait(0.2):
             if self._cancel.is_set():
                 return False
-        return not self._cancel.is_set()
+        if self._cancel.is_set():
+            return False
+        from ..server import qos as _qos
+        _qos.bg_pause("decom")
+        return True
 
     def _run(self) -> None:
         try:
@@ -403,9 +409,14 @@ class Decommissioner:
         return out
 
     def _move_all(self, names: list[tuple[str, str]]) -> None:
-        if self.workers > 1:
+        # Re-evaluated per walk pass: mover lanes shrink while the
+        # admission plane is under pressure and recover on the next
+        # pass once it clears (server/qos.py).
+        from ..server import qos as _qos
+        workers = _qos.scale_workers(self.workers, "decom")
+        if workers > 1:
             with ThreadPoolExecutor(
-                    max_workers=self.workers,
+                    max_workers=workers,
                     thread_name_prefix=f"decom-p{self.pool_idx}") as ex:
                 list(ex.map(self._move_one, names))
         else:

@@ -213,6 +213,11 @@ class DataScanner:
                                 pass
                         if self.object_sleep:
                             time.sleep(self.object_sleep)
+                        # Overload plane: the crawl yields to
+                        # foreground pressure (admission-queue EMA)
+                        # on top of its own configured pacing.
+                        from ..server import qos as _qos
+                        _qos.bg_pause("scanner")
 
         # One journal drain per crawl: failed tier deletes and reaped
         # partial copies retry on the scanner's cadence, so the tier

@@ -4,6 +4,10 @@ The cmd/bucket-quota.go equivalent: a JSON config {"quota": N,
 "quotatype": "hard"} per bucket; PUTs that would push usage past the
 limit are refused. Usage comes from the scanner's persisted tree (cheap)
 with a live fallback listing when no scan has run yet.
+
+The config also carries an optional "bandwidth" field (bytes/s, 0 =
+unlimited) enforced by the QoS plane (server/qos.py) as a per-bucket
+token bucket rather than at write time here.
 """
 
 from __future__ import annotations
@@ -16,7 +20,8 @@ from ..storage.errors import StorageError
 def parse_quota_config(data: bytes) -> dict:
     obj = json.loads(data)
     return {"quota": int(obj.get("quota", 0)),
-            "quotatype": obj.get("quotatype", "hard")}
+            "quotatype": obj.get("quotatype", "hard"),
+            "bandwidth": int(obj.get("bandwidth", 0))}
 
 
 def current_bucket_bytes(pools, bucket: str, scanner=None) -> int:

@@ -283,6 +283,10 @@ class ReplicationPool:
                 op, bucket, key, rule = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
+            # Overload plane: replication drains its queue gently while
+            # foreground admission is under pressure.
+            from ..server import qos as _qos
+            _qos.bg_pause("replication")
             try:
                 if op == "put":
                     self._replicate_put(bucket, key, rule)

@@ -1242,6 +1242,11 @@ def run_transitions(pools, bucket: str, lc, tier_mgr: TierManager,
         return 0
     if workers is None:
         workers = ilm_workers()
+    # Overload plane: ILM movers shrink while foreground admission is
+    # under pressure; re-evaluated per run_transitions call, so lanes
+    # recover on the next scanner cycle once pressure clears.
+    from ..server import qos as _qos
+    workers = _qos.scale_workers(workers, "ilm")
     workers = max(1, min(workers, len(cands)))
     moved = [0]
     mu = threading.Lock()

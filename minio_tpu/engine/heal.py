@@ -957,10 +957,14 @@ def _set_objects(es: ErasureSet, bucket: str, skip_pos: int) -> list[str]:
 def _heal_workers(es: ErasureSet, workers: int | None) -> int:
     """Bounded default: a couple of concurrent object heals per spare
     core, 1 on the serial-local host (same policy as the data-path
-    fan-out, ErasureSet._SERIAL_FANOUT)."""
+    fan-out, ErasureSet._SERIAL_FANOUT).  Under foreground pressure
+    the overload plane shrinks the pool further — heal yields to
+    GET/PUT for drives and coalescer lanes (server/qos.py)."""
+    from ..server import qos as _qos
     if workers is not None:
-        return max(1, int(workers))
-    return 1 if es._serial_local() else min(4, os.cpu_count() or 1)
+        return _qos.scale_workers(max(1, int(workers)), "heal")
+    n = 1 if es._serial_local() else min(4, os.cpu_count() or 1)
+    return _qos.scale_workers(n, "heal")
 
 
 def heal_drive(es: ErasureSet, pos: int, checkpoint_every: int = 64,
@@ -1063,6 +1067,7 @@ def heal_bucket_objects(es: ErasureSet, bucket: str, prefix: str = "",
     results: list[HealResult] = []
     pool = ThreadPoolExecutor(max_workers=workers) if workers > 1 else None
     try:
+        from ..server import qos as _qos
         for _, name, res, err in pl.run_window(
                 one, names, pool, window=workers * 2, stop=stop):
             if err is not None and not isinstance(err, StorageError):
@@ -1071,6 +1076,9 @@ def heal_bucket_objects(es: ErasureSet, bucket: str, prefix: str = "",
                 on_object(name, res, err)
             if err is None and res:
                 results.extend(res)
+            # Pace between objects under foreground pressure (no-op
+            # below the threshold: one float compare per object).
+            _qos.bg_pause("heal")
     finally:
         if pool is not None:
             pool.shutdown(wait=True)

@@ -35,13 +35,14 @@ class _ApiRing:
     epoch-second % window, each slot stamped with the second it holds
     so stale laps self-invalidate without a sweeper."""
 
-    __slots__ = ("secs", "count", "errors", "sum_ms", "nbytes",
-                 "buckets")
+    __slots__ = ("secs", "count", "errors", "sheds", "sum_ms",
+                 "nbytes", "buckets")
 
     def __init__(self, window: int):
         self.secs = [0] * window
         self.count = [0] * window
         self.errors = [0] * window
+        self.sheds = [0] * window
         self.sum_ms = [0.0] * window
         self.nbytes = [0] * window
         self.buckets = [[0] * len(BOUNDS_MS) for _ in range(window)]
@@ -59,7 +60,8 @@ class ApiWindow:
         self.apis: dict[str, _ApiRing] = {}
 
     def observe(self, api: str, duration_s: float,
-                error: bool = False, nbytes: int = 0) -> None:
+                error: bool = False, nbytes: int = 0,
+                shed: bool = False) -> None:
         ring = self.apis.get(api)
         if ring is None:
             # setdefault so two racing first-observers share one ring.
@@ -71,6 +73,7 @@ class ApiWindow:
             ring.secs[i] = now
             ring.count[i] = 0
             ring.errors[i] = 0
+            ring.sheds[i] = 0
             ring.sum_ms[i] = 0.0
             ring.nbytes[i] = 0
             ring.buckets[i] = [0] * len(BOUNDS_MS)
@@ -78,6 +81,11 @@ class ApiWindow:
         ring.count[i] += 1
         if error:
             ring.errors[i] += 1
+        if shed:
+            # Admission sheds are their own class, NOT errors: a 503
+            # SlowDown is the overload plane working as designed and
+            # must not eat the API's error budget.
+            ring.sheds[i] += 1
         ring.sum_ms[i] += ms
         ring.nbytes[i] += nbytes
         b = ring.buckets[i]
@@ -93,7 +101,7 @@ class ApiWindow:
         lo = now - self.window
         out: dict[str, dict] = {}
         for api, ring in list(self.apis.items()):
-            count = errors = nbytes = 0
+            count = errors = sheds = nbytes = 0
             sum_ms = 0.0
             agg = [0] * len(BOUNDS_MS)
             for i in range(self.window):
@@ -101,6 +109,7 @@ class ApiWindow:
                 if lo < sec <= now:
                     count += ring.count[i]
                     errors += ring.errors[i]
+                    sheds += ring.sheds[i]
                     sum_ms += ring.sum_ms[i]
                     nbytes += ring.nbytes[i]
                     slot = ring.buckets[i]
@@ -109,6 +118,7 @@ class ApiWindow:
             out[api] = {
                 "count": count,
                 "errors": errors,
+                "sheds": sheds,
                 "bytes": nbytes,
                 "avg_ms": (sum_ms / count) if count else 0.0,
                 "p50_ms": percentile(agg, count, 0.50),

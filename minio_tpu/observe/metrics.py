@@ -1069,6 +1069,11 @@ class MetricsRegistry:
         self.api_lm_p99 = Gauge(
             "mtpu_api_last_minute_p99",
             "Sliding-window p99 latency in ms by API", ("api",))
+        self.api_lm_sheds = Gauge(
+            "mtpu_api_last_minute_sheds",
+            "Admission-shed 503s in the sliding SLO window by API "
+            "(distinct from errors: a shed is deliberate overload "
+            "protection, not a server fault)", ("api",))
         # Audit-plane delivery families (observe/audit.py): per-target
         # delivered/shed/retried entry counts.
         self.audit_emitted = Gauge(
@@ -1081,14 +1086,82 @@ class MetricsRegistry:
         self.audit_retries = Gauge(
             "mtpu_audit_retries_total",
             "Audit delivery re-attempts (webhook backoff)", ("target",))
+        # Overload-plane families (server/qos.py): admission slots,
+        # deadline queue, tenant/bucket throttles, background yield —
+        # synced from the fork-shared slab at scrape time.
+        self.qos_inflight = Gauge(
+            "mtpu_qos_requests_inflight",
+            "Admission slots currently held (pool-wide: the slab is "
+            "fork-shared)")
+        self.qos_queue_depth = Gauge(
+            "mtpu_qos_queue_depth",
+            "Requests waiting in the admission deadline queue")
+        self.qos_pressure = Gauge(
+            "mtpu_qos_pressure",
+            "Admission occupancy EMA in [0,1] — the signal background "
+            "planes yield to")
+        self.qos_admitted = Gauge(
+            "mtpu_qos_admitted_total",
+            "Requests admitted through the overload plane by tenant "
+            "class", ("tenant_class",))
+        self.qos_shed = Gauge(
+            "mtpu_qos_shed_total",
+            "Requests shed with 503 SlowDown by tenant class",
+            ("tenant_class",))
+        self.qos_shed_reason = Gauge(
+            "mtpu_qos_shed_reason_total",
+            "Admission sheds by cause (queue: bounded queue full; "
+            "deadline: MTPU_REQUESTS_DEADLINE_MS expired waiting)",
+            ("reason",))
+        self.qos_queue_wait = Gauge(
+            "mtpu_qos_queue_wait_seconds_total",
+            "Summed admission-queue wait of requests that were "
+            "eventually admitted")
+        self.qos_tenant_throttled = Gauge(
+            "mtpu_qos_tenant_throttled_total",
+            "Requests refused by per-tenant token buckets (req/s or "
+            "bandwidth)")
+        self.qos_bucket_throttled = Gauge(
+            "mtpu_qos_bucket_throttled_total",
+            "Requests refused by per-bucket bandwidth budgets")
+        self.qos_bg_yields = Gauge(
+            "mtpu_qos_bg_yields_total",
+            "Background-plane yields to foreground pressure (shrunk "
+            "batch concurrency + paced batches)", ("plane",))
         self.bandwidth = BandwidthMonitor()
         self.last_minute = ApiWindow()
 
     def observe_api(self, api: str, duration_s: float,
-                    error: bool = False, nbytes: int = 0) -> None:
+                    error: bool = False, nbytes: int = 0,
+                    shed: bool = False) -> None:
         """Feed the sliding SLO window — lock-free, called once per
-        request with the span-style API name (api.PutObject, ...)."""
-        self.last_minute.observe(api, duration_s, error, nbytes)
+        request with the span-style API name (api.PutObject, ...).
+        `shed` marks an admission-control 503 as its own class: shed
+        ≠ server error in the SLO window (deliberate overload
+        protection must not page anyone about error budgets)."""
+        self.last_minute.observe(api, duration_s, error, nbytes,
+                                 shed=shed)
+
+    def update_qos(self, plane) -> None:
+        """Refresh overload-plane gauges from the fork-shared slab
+        (scrape time, same pattern as update_audit)."""
+        if plane is None:
+            return
+        st = plane.stats()
+        self.qos_inflight.set(st["inflight"])
+        self.qos_queue_depth.set(st["waiting"])
+        self.qos_pressure.set(st["pressure"])
+        self.qos_queue_wait.set(st["queue_wait_seconds"])
+        self.qos_tenant_throttled.set(st["tenant_throttled"])
+        self.qos_bucket_throttled.set(st["bucket_throttled"])
+        self.qos_shed_reason.set(st["shed_queue"], reason="queue")
+        self.qos_shed_reason.set(st["shed_deadline"], reason="deadline")
+        for klass, row in st["classes"].items():
+            self.qos_admitted.set(row["admitted"], tenant_class=klass)
+            self.qos_shed.set(row["shed"], tenant_class=klass)
+        self.qos_bg_yields.set(st["bg_yields"], plane="all")
+        for name, n in st["bg_yields_by_plane"].items():
+            self.qos_bg_yields.set(n, plane=name)
 
     def update_audit(self, targets) -> None:
         """Refresh per-target audit delivery gauges (scrape time)."""
@@ -1388,6 +1461,7 @@ class MetricsRegistry:
         for api, row in self.last_minute.snapshot().items():
             self.api_lm_count.set(row["count"], api=api)
             self.api_lm_errors.set(row["errors"], api=api)
+            self.api_lm_sheds.set(row["sheds"], api=api)
             self.api_lm_p50.set(row["p50_ms"], api=api)
             self.api_lm_p99.set(row["p99_ms"], api=api)
 

@@ -418,7 +418,11 @@ class S3Handlers:
                 parse_lock_config(body)
             elif kind == "quota":
                 from ..bucket.quota import parse_quota_config
-                parse_quota_config(body)
+                cfg = parse_quota_config(body)
+                if cfg["quota"] < 0 or cfg["bandwidth"] < 0:
+                    raise S3Error(
+                        "InvalidArgument",
+                        "quota and bandwidth must be non-negative")
             elif kind == "policy":
                 from ..iam.policy import Policy
                 Policy(body.decode())

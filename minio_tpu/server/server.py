@@ -27,6 +27,7 @@ from ..observe.metrics import DATA_PATH
 from ..ops import zerocopy as zc
 from ..storage.errors import StorageError
 from ..utils import streams
+from . import qos as _qos
 from .api_errors import S3Error
 from .handlers import Response, S3Handlers, error_response
 from .sigv4 import (STREAMING_PAYLOAD, UNSIGNED_PAYLOAD, Credentials,
@@ -182,6 +183,15 @@ class S3Server:
         self.draining = False
         self._inflight = 0
         self._drain_cv = threading.Condition()
+        # Overload plane (server/qos.py): the process-tree singleton —
+        # in pool mode WorkerPlane already created it BEFORE the fork,
+        # so this reference is the SAME fork-shared mapping in every
+        # worker (one global admission cap, not N local ones).
+        self.qos = _qos.get_plane()
+        #: Per-bucket bandwidth budgets from the quota config, cached
+        #: briefly so the admission path never does a metadata read
+        #: per request.  {bucket: (rate_bytes_per_s, stamp)}
+        self._qos_bw_cache: dict = {}
         # Pre-fork pool wiring (server/workers.py): every worker binds
         # the same port via SO_REUSEPORT; the plane carries the shared
         # control block whose slabs feed /metrics and admin-info.
@@ -358,6 +368,50 @@ class S3Server:
                             TimeoutError):
                         pass
                     return
+                # Admission control (server/qos.py): one fork-shared
+                # requests-max semaphore with a deadline queue.  Same
+                # exemptions as the drain gate plus the admin/metrics
+                # planes — an operator must be able to see and steer a
+                # saturated server (cmd/handler-api.go maxClients
+                # exempts its health endpoints the same way).
+                qos_slot = False
+                if _qos.qos_enabled() and not path.startswith(
+                        ("/minio/health/", "/minio/rpc/",
+                         "/minio/admin/", "/minio/v2/metrics",
+                         "/minio/listen")):
+                    klass = _qos.tenant_class(
+                        _qos.peek_access_key(self.headers))
+                    verdict, waited = outer.qos.acquire(klass)
+                    if verdict != "ok":
+                        self.request_id = secrets.token_hex(8)
+                        api_name = _api_name(self.command, path, {},
+                                             self.headers)
+                        resp = error_response(
+                            S3Error("SlowDown",
+                                    "server is at capacity; request "
+                                    "shed by admission control"),
+                            path, self.request_id)
+                        resp.headers["Retry-After"] = "1"
+                        self.close_connection = True
+                        # Sheds are their own SLO class (≠ errors) and
+                        # still leave an audit trail, like drain 503s.
+                        if outer.slo_enabled:
+                            outer.metrics.observe_api(
+                                api_name, waited, shed=True)
+                        outer._emit_audit(
+                            api=api_name, method=self.command,
+                            path=path, status=503,
+                            error_code="SlowDown",
+                            source_ip=self.client_address[0],
+                            request_id=self.request_id,
+                            duration_ms=waited * 1e3)
+                        try:
+                            self._respond(resp)
+                        except (BrokenPipeError, ConnectionResetError,
+                                TimeoutError):
+                            pass
+                        return
+                    qos_slot = True
                 with outer._drain_cv:
                     outer._inflight += 1
                 if outer.worker_plane is not None:
@@ -366,6 +420,8 @@ class S3Server:
                 try:
                     self._handle_inner()
                 finally:
+                    if qos_slot:
+                        outer.qos.release()
                     with outer._drain_cv:
                         outer._inflight -= 1
                         outer._drain_cv.notify_all()
@@ -445,6 +501,10 @@ class S3Server:
                 except S3Error as e:
                     err_code = e.api.code
                     resp = error_response(e, path, self.request_id)
+                    if err_code == "SlowDown":
+                        # Throttle 503s (tenant/bucket token buckets)
+                        # carry the same retry hint as admission sheds.
+                        resp.headers["Retry-After"] = "1"
                     # A failed request may leave unread body bytes on
                     # the socket (streaming PUTs); don't reuse it.
                     self.close_connection = True
@@ -517,6 +577,21 @@ class S3Server:
                     self.command, resp.status, dur,
                     int(self.headers.get("Content-Length", 0) or 0),
                     resp_size, bucket=req_bucket)
+                # Post-paid bandwidth accounting: tenant and bucket
+                # buckets run a bounded debt (a GET's size is unknown
+                # at admission), repaid before the next admit.  Both
+                # charges short-circuit unless a rate is configured.
+                if _qos.qos_enabled() and resp.status < 400:
+                    nbytes = resp_size + int(
+                        self.headers.get("Content-Length", 0) or 0)
+                    ak = getattr(self, "audit_access_key", "")
+                    if ak:
+                        outer.qos.charge_tenant_bw(
+                            ak, _qos.tenant_class(ak), nbytes)
+                    if req_bucket:
+                        outer.qos.charge_bucket_bw(
+                            req_bucket,
+                            outer._qos_bucket_rate(req_bucket), nbytes)
                 outer.tracer.trace(
                     method=self.command, path=path, status=resp.status,
                     duration_ms=dur * 1e3,
@@ -2220,6 +2295,28 @@ class S3Server:
                 self.worker_id,
                 sum(t.dropped for t in self.audit_targets))
 
+    def _qos_bucket_rate(self, bucket: str) -> float:
+        """Per-bucket bandwidth budget (bytes/s) from the bucket quota
+        config, cached ~5s so the request path never pays a metadata
+        read per GET (0 = unlimited / no config)."""
+        import time as _time
+        now = _time.monotonic()
+        hit = self._qos_bw_cache.get(bucket)
+        if hit is not None and now - hit[1] < 5.0:
+            return hit[0]
+        rate = 0.0
+        if self.handlers is not None:
+            try:
+                raw = self.handlers.meta.get(bucket, "quota")
+                if raw is not None:
+                    from ..bucket.quota import parse_quota_config
+                    rate = float(
+                        parse_quota_config(raw).get("bandwidth", 0))
+            except Exception:  # noqa: BLE001 — bad config ≠ blocked IO
+                rate = 0.0
+        self._qos_bw_cache[bucket] = (rate, now)
+        return rate
+
     def local_metrics_text(self) -> str:
         """THIS node's full Prometheus render — the single-node body of
         /minio/v2/metrics/node and the peer.metrics_text RPC verb the
@@ -2247,6 +2344,8 @@ class S3Server:
         finally:
             _rest.clear_deadline(tok)
         self.metrics.update_audit(self.audit_targets)
+        self.metrics.update_qos(self.qos if _qos.qos_enabled()
+                                else None)
         text = self.metrics.render()
         if self.worker_plane is not None:
             # Pool aggregates live in shared slabs, so WHICHEVER
@@ -2354,6 +2453,8 @@ class S3Server:
             "audit": [t.stats() for t in self.audit_targets],
             "slo": (self.metrics.last_minute.snapshot()
                     if self.slo_enabled else {}),
+            "qos": (self.qos.stats() if _qos.qos_enabled() else
+                    {"enabled": False}),
         }
 
     def _obs_fanout(self, verb: str) -> tuple[dict, dict]:
@@ -2455,9 +2556,33 @@ class S3Server:
             self._admin_authorize(access_key, "listen", method)
             return self._listen_response("", query)
 
+        # Per-tenant QoS (post-auth — the VERIFIED identity throttles,
+        # unlike the admission peek): req/s token bucket plus a
+        # positive-balance check on the post-paid bandwidth bucket.
+        # Both short-circuit unless the tenant's class has rates
+        # configured, so the oracle path costs one env read.
+        if _qos.qos_enabled() and access_key:
+            klass = _qos.tenant_class(access_key)
+            if not self.qos.tenant_admit(access_key, klass):
+                raise S3Error("SlowDown",
+                              "per-tenant request rate exceeded")
+            if not self.qos.tenant_bw_ok(access_key, klass):
+                raise S3Error("SlowDown",
+                              "per-tenant bandwidth budget exceeded")
+
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0] if parts[0] else ""
         key = parts[1] if len(parts) > 1 else ""
+
+        # Per-bucket bandwidth budget (the `bandwidth` field of the
+        # quota config — cmd/bucket-quota.go enforcement riding the
+        # same config object as the hard quota).
+        if _qos.qos_enabled() and bucket:
+            rate = self._qos_bucket_rate(bucket)
+            if rate > 0 and not self.qos.bucket_bw_ok(bucket, rate):
+                raise S3Error("SlowDown",
+                              f"bucket {bucket} bandwidth budget "
+                              "exceeded")
 
         # Federation: a request for a bucket another cluster owns
         # redirects there (the bucket-DNS role, cmd/etcd.go +

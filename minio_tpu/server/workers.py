@@ -264,6 +264,13 @@ class WorkerPlane:
         # is import-light: stdlib + numpy + ops.shm_arena, no jax).
         from ..engine.hotcache import maybe_tier
         self.hotcache = maybe_tier()
+        # The overload plane's admission slab likewise MUST exist
+        # before the first fork: MTPU_WORKERS=N enforces ONE global
+        # requests-max cap and one pressure signal, not N local ones.
+        # get_plane() installs the module singleton, so every forked
+        # worker's S3Server picks up this same mapping.
+        from . import qos as _qos
+        self.qos = _qos.get_plane(nworkers=self.nworkers)
 
     def owner_ok(self) -> bool:
         return self.state.owner_ok(_stale_s())
@@ -283,6 +290,7 @@ class WorkerPlane:
                                           for r in self.resp_rings]},
             "hotcache": (self.hotcache.stats()
                          if self.hotcache is not None else None),
+            "qos": self.qos.stats(),
         }
 
     def render_prom(self) -> str:

@@ -772,6 +772,232 @@ def run_load_http(endpoint: str, *, clients: int = 4,
     return res
 
 
+def parse_tenant_spec(spec: str) -> list[dict]:
+    """Parse --tenants 'name:class:clients[:rps],...' into tenant rows.
+    `name` doubles as the tenant's access key (the identity the QoS
+    plane's MTPU_QOS_TENANTS map classes by); `class` is one of
+    premium/standard/best-effort; `clients` is the tenant's closed-loop
+    concurrency; optional `rps` caps the tenant's offered request rate
+    client-side (0 = closed-loop, as fast as the server admits)."""
+    out = []
+    for frag in spec.split(","):
+        frag = frag.strip()
+        if not frag:
+            continue
+        parts = frag.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"tenant spec {frag!r}: want name:class:clients[:rps]")
+        name, klass, clients = parts[0], parts[1], int(parts[2])
+        if klass not in ("premium", "standard", "best-effort"):
+            raise ValueError(f"tenant spec {frag!r}: unknown class "
+                             f"{klass!r}")
+        if clients < 1:
+            raise ValueError(f"tenant spec {frag!r}: clients < 1")
+        rps = float(parts[3]) if len(parts) == 4 else 0.0
+        out.append({"name": name, "class": klass, "clients": clients,
+                    "rps": rps})
+    if not out:
+        raise ValueError("empty tenant spec")
+    return out
+
+
+def _tenant_loop(endpoint: str, creds: tuple[str, str], bucket: str,
+                 warm: list[str], body: bytes, clients: int,
+                 put_frac: float, duration_s: float, seed: int,
+                 rps: float) -> dict:
+    """One tenant's client group: closed-loop threads signing with the
+    TENANT's credentials, issuing raw requests so shed responses (503
+    SlowDown) are COUNTED rather than raised — under deliberate
+    overload, sheds are data, not failures.  Returns goodput (bytes of
+    ops that succeeded), per-op latencies of successful ops only, and
+    the shed/error tallies the QoS acceptance gates compare."""
+    from minio_tpu.server.client import S3Client
+    stop = threading.Event()
+    lat_ok: list[list[float]] = [[] for _ in range(clients)]
+    ok = [0] * clients
+    shed = [0] * clients
+    errs = [0] * clients
+    nbytes = [0] * clients
+    fatal: list[str] = []
+    # client-side pacing: rps is the TENANT's offered rate, spread
+    # evenly over its threads (0 = pure closed loop)
+    per_thread_interval = clients / rps if rps > 0 else 0.0
+
+    def client(ci: int) -> None:
+        cli = S3Client(endpoint, creds[0], creds[1])
+        crng = np.random.default_rng(seed * 1000 + ci)
+        j = 0
+        next_t = time.monotonic()
+        try:
+            while not stop.is_set():
+                if per_thread_interval:
+                    now = time.monotonic()
+                    if now < next_t:
+                        time.sleep(min(next_t - now, 0.25))
+                        continue
+                    next_t += per_thread_interval
+                is_put = crng.random() < put_frac
+                t0 = time.monotonic()
+                try:
+                    if is_put:
+                        name = f"{creds[0]}-c{ci}-{j % 64}"
+                        j += 1
+                        st, _, rb = cli.request(
+                            "PUT", f"/{bucket}/{name}", body=body)
+                        moved = len(body)
+                    else:
+                        rank = int(crng.integers(0, len(warm)))
+                        st, _, rb = cli.request(
+                            "GET", f"/{bucket}/{warm[rank]}")
+                        moved = len(rb)
+                except (ConnectionError, TimeoutError, OSError):
+                    # Shed responses close the connection; a pooled
+                    # client racing that close sees a reset.  Under
+                    # deliberate overload that's shed fallout, not a
+                    # server error — reconnect and count it as shed.
+                    cli = S3Client(endpoint, creds[0], creds[1])
+                    shed[ci] += 1
+                    continue
+                dt = time.monotonic() - t0
+                if st in (200, 206):
+                    ok[ci] += 1
+                    nbytes[ci] += moved
+                    lat_ok[ci].append(dt)
+                elif st == 503 and b"SlowDown" in rb:
+                    shed[ci] += 1          # admission/throttle shed
+                else:
+                    errs[ci] += 1
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            fatal.append(f"{type(e).__name__}: {e}")
+            stop.set()
+
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(clients)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(60.0)
+    wall = time.monotonic() - t_start
+    if fatal:
+        raise RuntimeError(f"tenant {creds[0]} client error: {fatal[0]}")
+    lats = [x for per in lat_ok for x in per]
+    n_ok, n_shed, n_err = sum(ok), sum(shed), sum(errs)
+    total = n_ok + n_shed + n_err
+    return {
+        "ok": n_ok, "shed": n_shed, "errors": n_err,
+        "attempts": total,
+        "shed_rate": round(n_shed / total, 4) if total else 0.0,
+        "goodput_gbps": round(sum(nbytes) / wall / 1e9, 4),
+        "goodput_rps": round(n_ok / wall, 1),
+        "p50_ms": round(_quantile(lats, 0.50) * 1e3, 3),
+        "p99_ms": round(_quantile(lats, 0.99) * 1e3, 3),
+    }
+
+
+def run_load_tenants(endpoint: str, *, tenants: list[dict],
+                     object_size: int = 1 << 20, put_frac: float = 0.5,
+                     duration_s: float = 5.0, bucket: str = "loadgen",
+                     warm_objects: int = 8, seed: int = 0,
+                     access_key: str = "minioadmin",
+                     secret_key: str = "minioadmin") -> dict:
+    """Multi-tenant HTTP load: provision one IAM user per tenant (the
+    access key the server's MTPU_QOS_TENANTS map classes), then run
+    every tenant's client group CONCURRENTLY against the same bucket
+    and report per-tenant goodput + p50/p99 + shed rows — the table
+    where per-class isolation under overload either shows up or
+    doesn't.  Root credentials (`access_key`/`secret_key`) provision
+    users and warm the keyspace; tenants sign with their own."""
+    import json as _json
+    from minio_tpu.server.client import S3Client
+
+    cli = S3Client(endpoint, access_key, secret_key)
+    if not cli.bucket_exists(bucket):
+        cli.make_bucket(bucket)
+    for t in tenants:
+        st, _, rb = cli.request(
+            "POST", "/minio/admin/v3/users",
+            body=_json.dumps({"accessKey": t["name"],
+                              "secretKey": tenant_secret(t["name"]),
+                              "policies": ["readwrite"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        if st != 200:
+            raise RuntimeError(
+                f"user add {t['name']} -> {st}: {rb[:200]!r}")
+    rng = np.random.default_rng(seed)
+    body = rng.integers(0, 256, object_size, dtype=np.uint8).tobytes()
+    warm = [f"warm-{i}" for i in range(max(1, warm_objects))]
+    for name in warm:
+        cli.put_object(bucket, name, body)
+
+    results: dict[str, dict] = {}
+    errors: list[BaseException] = []
+
+    def run_one(i: int, t: dict) -> None:
+        try:
+            results[t["name"]] = _tenant_loop(
+                endpoint, (t["name"], tenant_secret(t["name"])),
+                bucket, warm, body, t["clients"], put_frac,
+                duration_s, seed + 7919 * (i + 1), t["rps"])
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    runners = [threading.Thread(target=run_one, args=(i, t),
+                                daemon=True)
+               for i, t in enumerate(tenants)]
+    t_start = time.monotonic()
+    for r in runners:
+        r.start()
+    for r in runners:
+        r.join(duration_s + 120)
+    wall = time.monotonic() - t_start
+    if errors:
+        raise errors[0]
+    rows = {}
+    for t in tenants:
+        row = dict(results[t["name"]])
+        row["class"] = t["class"]
+        row["clients"] = t["clients"]
+        if t["rps"]:
+            row["offered_rps"] = t["rps"]
+        rows[t["name"]] = row
+    return {
+        "endpoint": endpoint, "object_size": object_size,
+        "duration_s": duration_s, "wall_s": round(wall, 3),
+        "total_goodput_gbps": round(
+            sum(r["goodput_gbps"] for r in rows.values()), 4),
+        "total_ok": sum(r["ok"] for r in rows.values()),
+        "total_shed": sum(r["shed"] for r in rows.values()),
+        "total_errors": sum(r["errors"] for r in rows.values()),
+        "tenants": rows,
+    }
+
+
+def tenant_secret(name: str) -> str:
+    """Deterministic per-tenant secret key: tests and bench legs
+    re-derive it instead of plumbing credentials around."""
+    return f"{name}-tenant-secret"
+
+
+def print_tenant_report(res: dict) -> None:
+    """Human table for run_load_tenants output: one SLO row per
+    tenant — the isolation evidence at a glance."""
+    print(f"total goodput {res['total_goodput_gbps']} GB/s, "
+          f"ok {res['total_ok']}, shed {res['total_shed']}, "
+          f"errors {res['total_errors']}")
+    print(f"{'tenant':<16}{'class':<14}{'clients':>8}{'ok':>8}"
+          f"{'shed':>8}{'err':>6}{'shed%':>8}{'GB/s':>8}"
+          f"{'p50_ms':>9}{'p99_ms':>9}")
+    for name, r in res["tenants"].items():
+        print(f"{name:<16}{r['class']:<14}{r['clients']:>8}"
+              f"{r['ok']:>8}{r['shed']:>8}{r['errors']:>6}"
+              f"{100 * r['shed_rate']:>7.1f}%{r['goodput_gbps']:>8}"
+              f"{r['p50_ms']:>9}{r['p99_ms']:>9}")
+
+
 def slo_report(endpoint: str, access_key: str, secret_key: str) -> dict:
     """Scrape the server's last-minute SLO window after a run: the
     mtpu_api_last_minute_{count,errors,p50,p99} families from
@@ -881,6 +1107,14 @@ def main(argv=None) -> int:
                     "CPU-per-GB budget).  Engine mode reports this "
                     "inherently via cpu_util/cpu_s_per_gb: the engine "
                     "runs in-process, so rusage IS the server bill")
+    ap.add_argument("--tenants", default="", metavar="SPEC",
+                    help="HTTP mode: multi-tenant run — comma list of "
+                    "name:class:clients[:rps] (class one of premium/"
+                    "standard/best-effort; name doubles as the IAM "
+                    "access key the server's MTPU_QOS_TENANTS map "
+                    "classes).  Provisions the users, runs every "
+                    "tenant's client group concurrently, and reports "
+                    "per-tenant goodput + p50/p99 + shed rows")
     ap.add_argument("--during-decom", action="store_true",
                     help="HTTP mode: tag every PUT with the pool it "
                     "landed on (x-mtpu-pool response header) and "
@@ -899,6 +1133,21 @@ def main(argv=None) -> int:
 
     warm_objects = (args.warm_objects if args.warm_objects is not None
                     else (64 if args.zipf else 8))
+    if args.tenants:
+        if not args.endpoint:
+            print("--tenants requires --endpoint (tenants are IAM "
+                  "identities on a running server)", file=sys.stderr)
+            return 2
+        res = run_load_tenants(args.endpoint,
+                               tenants=parse_tenant_spec(args.tenants),
+                               object_size=args.size_kib << 10,
+                               put_frac=args.mix,
+                               duration_s=args.duration,
+                               warm_objects=warm_objects,
+                               access_key=args.access_key,
+                               secret_key=args.secret_key)
+        print_tenant_report(res)
+        return 0
     if args.endpoint:
         res = run_load_http(args.endpoint, clients=args.clients,
                             object_size=args.size_kib << 10,
