@@ -31,7 +31,7 @@ import numpy as np
 from ..cluster.dynamic_timeout import DynamicTimeout
 from ..observe import span as ospan
 from ..observe.metrics import DATA_PATH
-from ..ops import coalesce, fused
+from ..ops import coalesce, fused, metalanes
 from ..ops import devcache as devcache_mod
 from ..ops import devices as devices_mod
 from ..ops import zerocopy as zc
@@ -803,8 +803,28 @@ class ErasureSet:
                 raise ErrDiskNotFound("offline")
             d.write_metadata(bucket, obj, fi_for(pos, "", per_drive[pos]))
 
-        with ospan.span("engine.write"):
-            res = self._map_drives_positions(write_one)
+        # Publish routing: a lone request takes the exact solo fan-out
+        # (one fsynced write_metadata per drive — oracle latency and
+        # oracle durability mechanics); once the request-level inflight
+        # counter or a busy lane proves concurrency, publishes route
+        # through the per-drive metadata lanes where same-drive
+        # batch-mates share ONE journal fsync (group commit).
+        use_lanes = False
+        mb = None
+        if metalanes.enabled():
+            mb = metalanes.get()
+            mb.note_put(1)
+            use_lanes = mb.put_hot() or metalanes.solo_forced()
+        try:
+            with ospan.span("engine.write"):
+                if use_lanes:
+                    res = self._put_inline_lanes(
+                        bucket, obj, fi_for, per_drive, mb)
+                else:
+                    res = self._map_drives_positions(write_one)
+        finally:
+            if mb is not None:
+                mb.note_put(-1)
         errs = [e for _, e in res]
         err = Q.reduce_write_quorum_errs(errs, write_quorum)
         if err is not None:
@@ -816,6 +836,38 @@ class ErasureSet:
             # Same partial-success rule as the streaming path.
             self.mrf.enqueue(bucket, obj, fi.version_id)
         return fi
+
+    def _put_inline_lanes(self, bucket, obj, fi_for, per_drive,
+                          mb) -> list:
+        """Submit one xl.meta publish per position to its drive's
+        write lane and collect the handles into the same
+        ``[(result, error)]`` shape `_map_drives_positions` returns.
+        Submission never touches the drive pool (the lanes own their
+        dispatcher threads), so this path composes with nested
+        fan-outs without deadlock."""
+        handles: list = []
+        for pos in range(self.n):
+            d = self.drives[pos]
+            if d is None:
+                handles.append(None)
+                continue
+            try:
+                handles.append(mb.submit_write(
+                    d, bucket, obj, fi_for(pos, "", per_drive[pos])))
+            except Exception as e:  # noqa: BLE001 — quorum classifies
+                handles.append(e)
+        out = []
+        for h in handles:
+            if h is None:
+                out.append((None, ErrDiskNotFound("offline")))
+            elif isinstance(h, Exception):
+                out.append((None, h))
+            else:
+                try:
+                    out.append((h.result(), None))
+                except Exception as e:  # noqa: BLE001 — quorum classifies
+                    out.append((None, e))
+        return out
 
     #: One-core hosts (this bench VM) gain nothing from a thread pool —
     #: the per-drive work is GIL-bound glue plus page-cache writes, and
@@ -1828,9 +1880,17 @@ class ErasureSet:
 
     def _read_metadata(self, bucket, obj, version_id=""):
         version_id = normalize_version_id(version_id)
-        with ospan.span("engine.quorum"):
-            res = self._map_drives(
-                lambda d: d.read_version(bucket, obj, version_id))
+        DATA_PATH.record_meta_read_request()
+        mb = metalanes.get() if metalanes.enabled() else None
+        if mb is not None:
+            mb.note_read(1)
+        try:
+            with ospan.span("engine.quorum"):
+                res = self._read_version_fanout(
+                    bucket, obj, version_id, mb)
+        finally:
+            if mb is not None:
+                mb.note_read(-1)
         metas = [fi for fi, _ in res]
         errs = [e for _, e in res]
         n_found = sum(1 for f in metas if f is not None)
@@ -1848,12 +1908,127 @@ class ErasureSet:
         fi = Q.find_file_info_in_quorum(metas, read_quorum)
         return fi, metas, errs
 
+    def _read_positions(self, bucket, obj, version_id,
+                        positions, mb) -> list:
+        """read_version over a subset of drive positions, returning
+        one (FileInfo|None, error|None) per position in order.  Routes
+        through the per-drive read lanes when concurrent metadata
+        traffic is in flight (distinct keys' fan-outs then merge into
+        one read_version_many round per drive); otherwise the exact
+        oracle per-drive dispatch."""
+        if mb is not None and mb.read_hot():
+            handles = []
+            for pos in positions:
+                d = self.drives[pos]
+                if d is None:
+                    handles.append(None)
+                    continue
+                try:
+                    handles.append(
+                        mb.submit_read(d, bucket, obj, version_id))
+                except Exception as e:  # noqa: BLE001 — quorum classifies
+                    handles.append(e)
+            out = []
+            for h in handles:
+                if h is None:
+                    out.append((None, ErrDiskNotFound("offline")))
+                elif isinstance(h, Exception):
+                    out.append((None, h))
+                else:
+                    try:
+                        out.append((h.result(), None))
+                    except Exception as e:  # noqa: BLE001
+                        out.append((None, e))
+            return out
+        res = self._map_drives(
+            lambda d: d.read_version(bucket, obj, version_id),
+            drives=[self.drives[p] for p in positions])
+        DATA_PATH.record_meta_read_round(len(positions), len(positions))
+        return res
+
+    def _read_version_fanout(self, bucket, obj, version_id, mb) -> list:
+        """The metadata read fan-out with the K+1 trim: read K+1
+        drives first; accept only a unanimous, quorate, inline-object
+        answer (streaming objects must see all N metas — the healthy
+        read fast path keys off `any(m is None)`); otherwise read the
+        REMAINING drives and merge, so every drive is still read
+        exactly once and quorum/error classification matches the all-N
+        oracle.  Unread positions are padded (None, None) — a shape no
+        real drive outcome produces (failures always carry an error).
+
+        Trim trades Python acceptance checks for one skipped drive
+        read — a win only when the read plane is hot (rounds are
+        shared and queued across requests).  On an idle server the
+        serial page-cached read is cheaper than the checks, and idle
+        single-request latency must match the oracle, so a cold plane
+        takes the full fan-out."""
+        k1 = (self.n - self.default_parity) + 1
+        if (not metalanes.trim_enabled() or k1 >= self.n
+                or mb is None or not mb.read_hot()):
+            return self._read_positions(bucket, obj, version_id,
+                                        list(range(self.n)), mb)
+        first = list(range(k1))
+        res1 = self._read_positions(bucket, obj, version_id, first, mb)
+        if self._trim_acceptable(res1):
+            DATA_PATH.record_meta_trim(True)
+            full: list = [(None, None)] * self.n
+            for pos, r in zip(first, res1):
+                full[pos] = r
+            return full
+        DATA_PATH.record_meta_trim(False)
+        rest = list(range(k1, self.n))
+        res2 = self._read_positions(bucket, obj, version_id, rest, mb)
+        full = [None] * self.n
+        for pos, r in zip(first, res1):
+            full[pos] = r
+        for pos, r in zip(rest, res2):
+            full[pos] = r
+        return full
+
+    def _trim_acceptable(self, res) -> bool:
+        """A trimmed first round stands only when nothing about it
+        could change with more drives: every read succeeded, all agree
+        on one version (unanimity — a single dissenter might be the
+        majority among the unread), the agreeing count already meets
+        the object's own read quorum (guards per-object parity lower
+        than the set default), and the elected version never touches
+        shard files (inline/deleted) so no downstream path needs the
+        full per-drive meta picture."""
+        metas = [fi for fi, _ in res]
+        if any(e is not None for _, e in res):
+            return False
+        if any(m is None for m in metas):
+            return False
+        keys = {Q._fi_key(m) for m in metas}
+        if len(keys) != 1:
+            return False
+        read_quorum, _ = Q.object_quorum_from_meta(
+            metas, self.n, self.default_parity)
+        if len(metas) < read_quorum:
+            return False
+        fi = metas[0]
+        return (fi.deleted or fi.inline_data is not None
+                or bool(fi.parts and not fi.data_dir))
+
     def _fi_cache_store(self, bucket, obj, version_id, entry) -> None:
-        if len(self._fi_cache) >= self._FI_CACHE_MAX:
-            self._fi_cache.clear()
+        # Bounded LRU: evict oldest-touched entries one at a time
+        # (dict preserves insertion order; _read_metadata_cached
+        # reinserts on hit, so iteration order IS recency order).  The
+        # previous clear()-at-capacity wiped every hot entry whenever
+        # a key scan overflowed the cache, zeroing the hit ratio.
+        cache = self._fi_cache
         key = (bucket, obj, normalize_version_id(version_id))
-        self._fi_cache[key] = (self._fi_gen.get(bucket, 0),
-                               time.monotonic(), *entry)
+        # Pop first: overwriting an existing dict key keeps its OLD
+        # insertion slot, which would pin a re-stored hot entry at the
+        # LRU end forever.
+        cache.pop(key, None)
+        while len(cache) >= self._FI_CACHE_MAX:
+            try:
+                cache.pop(next(iter(cache)))
+            except (StopIteration, KeyError, RuntimeError):
+                break  # racing eviction/clear — capacity is advisory
+        cache[key] = (self._fi_gen.get(bucket, 0),
+                      time.monotonic(), *entry)
 
     def _read_metadata_cached(self, bucket, obj, version_id=""):
         """GET-path metadata election with the parsed-quorum cache: a
@@ -1863,13 +2038,15 @@ class ErasureSet:
         and invalidates immediately; a short TTL bounds what another
         process's write can leave stale, same policy as bucket_exists."""
         key = (bucket, obj, normalize_version_id(version_id))
-        hit = self._fi_cache.get(key)
+        hit = self._fi_cache.pop(key, None)
         if hit is not None:
             gen, stamp, fi, metas, errs = hit
             if (gen == self._fi_gen.get(bucket, 0)
                     and time.monotonic() - stamp < self._FI_CACHE_TTL):
+                # Reinsert: a hit moves the entry to the MRU end so
+                # LRU eviction tracks touch order, not insert order.
+                self._fi_cache[key] = hit
                 return fi, metas, errs
-            self._fi_cache.pop(key, None)
         entry = self._read_metadata(bucket, obj, version_id)
         self._fi_cache_store(bucket, obj, version_id, entry)
         return entry

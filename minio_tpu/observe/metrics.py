@@ -285,6 +285,28 @@ class DataPathStats:
             self.zerocopy_vectored_writes = 0
             self.zerocopy_vectored_write_bytes = 0
             self.zerocopy_fallbacks = 0
+            # Small-object metadata plane (PR 19, ops/metalanes.py):
+            # xl.meta publishes and the fsyncs paying for them (solo
+            # write_metadata: 1 fsync per publish; group commit: 1
+            # journal fsync amortized over the whole batch), journal
+            # replays at boot, engine metadata-read requests vs the
+            # per-drive dispatch rounds serving them (oracle: N rounds
+            # per request; coalesced: rounds/requests can drop below
+            # 1), K+1 read-trim outcomes, and lane scheduling stats.
+            self.meta_publishes = 0
+            self.meta_fsyncs = 0
+            self.meta_group_commits = 0
+            self.meta_group_items = 0
+            self.meta_journal_replays = 0
+            self.meta_read_requests = 0
+            self.meta_read_rounds = 0
+            self.meta_read_keys = 0
+            self.meta_trim_hits = 0
+            self.meta_trim_fallbacks = 0
+            self.meta_lane_dispatches = 0
+            self.meta_lane_items = 0
+            self.meta_lane_wait_s = 0.0
+            self.meta_inline_ops = 0
 
     def record_heal_batch(self, blocks: int, capacity: int,
                           source_bytes: int, out_bytes: int,
@@ -479,6 +501,62 @@ class DataPathStats:
         with self._mu:
             self.zerocopy_fallbacks += 1
 
+    def record_meta_publish(self) -> None:
+        """One solo xl.meta publish (drive.write_metadata): one
+        fsynced rename-into-place, one fsync."""
+        with self._mu:
+            self.meta_publishes += 1
+            self.meta_fsyncs += 1
+
+    def record_meta_group_commit(self, n: int) -> None:
+        """One group-committed metadata batch
+        (drive.write_metadata_many): n publishes sharing a single
+        journal fsync."""
+        with self._mu:
+            self.meta_group_commits += 1
+            self.meta_group_items += n
+            self.meta_publishes += n
+            self.meta_fsyncs += 1
+
+    def record_meta_journal_replay(self, n: int) -> None:
+        with self._mu:
+            self.meta_journal_replays += n
+
+    def record_meta_read_request(self) -> None:
+        """One engine-level metadata read (_read_metadata call)."""
+        with self._mu:
+            self.meta_read_requests += 1
+
+    def record_meta_read_round(self, rounds: int, keys: int) -> None:
+        """Per-drive metadata read dispatches: `rounds` drive calls
+        served `keys` (vol, obj, version) lookups."""
+        with self._mu:
+            self.meta_read_rounds += rounds
+            self.meta_read_keys += keys
+
+    def record_meta_trim(self, hit: bool) -> None:
+        """K+1 read fan-out trim outcome: hit = first trimmed round
+        was quorate and accepted; fallback = the remaining drives had
+        to be read too."""
+        with self._mu:
+            if hit:
+                self.meta_trim_hits += 1
+            else:
+                self.meta_trim_fallbacks += 1
+
+    def record_meta_lane_dispatch(self, items: int,
+                                  wait_s: float) -> None:
+        with self._mu:
+            self.meta_lane_dispatches += 1
+            self.meta_lane_items += items
+            self.meta_lane_wait_s += wait_s
+
+    def record_meta_inline_op(self) -> None:
+        """A lane submit that ran on the caller's thread (idle fast
+        path or broken-dispatcher degradation)."""
+        with self._mu:
+            self.meta_inline_ops += 1
+
     def snapshot(self) -> dict:
         with self._mu:
             return {
@@ -556,6 +634,29 @@ class DataPathStats:
                 "zerocopy_vectored_write_bytes":
                     self.zerocopy_vectored_write_bytes,
                 "zerocopy_fallbacks": self.zerocopy_fallbacks,
+                "meta_publishes": self.meta_publishes,
+                "meta_fsyncs": self.meta_fsyncs,
+                "meta_group_commits": self.meta_group_commits,
+                "meta_group_items": self.meta_group_items,
+                "meta_batch_occupancy": (
+                    self.meta_group_items / self.meta_group_commits
+                    if self.meta_group_commits else 0.0),
+                "meta_fsyncs_per_object": (
+                    self.meta_fsyncs / self.meta_publishes
+                    if self.meta_publishes else 0.0),
+                "meta_journal_replays": self.meta_journal_replays,
+                "meta_read_requests": self.meta_read_requests,
+                "meta_read_rounds": self.meta_read_rounds,
+                "meta_read_keys": self.meta_read_keys,
+                "meta_read_fanouts_per_request": (
+                    self.meta_read_rounds / self.meta_read_requests
+                    if self.meta_read_requests else 0.0),
+                "meta_trim_hits": self.meta_trim_hits,
+                "meta_trim_fallbacks": self.meta_trim_fallbacks,
+                "meta_lane_dispatches": self.meta_lane_dispatches,
+                "meta_lane_items": self.meta_lane_items,
+                "meta_lane_wait_s": self.meta_lane_wait_s,
+                "meta_inline_ops": self.meta_inline_ops,
             }
 
 
@@ -899,6 +1000,55 @@ class MetricsRegistry:
         self.zerocopy_fallbacks = Gauge(
             "mtpu_zerocopy_fallbacks_total",
             "Eligible responses that fell back to the buffered writer")
+        # Small-object metadata plane (ops/metalanes.py; cf. the
+        # reference's format-v2 inline discipline,
+        # cmd/xl-storage-format-v2.go).  Synced from DATA_PATH.
+        self.meta_publishes = Gauge(
+            "mtpu_meta_publishes_total",
+            "xl.meta publishes across all drives (solo + batched)")
+        self.meta_fsyncs = Gauge(
+            "mtpu_meta_fsyncs_total",
+            "fsyncs paying for metadata publishes (group commit "
+            "amortizes one journal fsync over a whole batch)")
+        self.meta_fsyncs_per_object = Gauge(
+            "mtpu_meta_fsyncs_per_object",
+            "Amortized fsyncs per xl.meta publish (oracle: 1.0)")
+        self.meta_group_commits = Gauge(
+            "mtpu_meta_group_commits_total",
+            "Group-committed metadata batches (one journal fsync each)")
+        self.meta_group_items = Gauge(
+            "mtpu_meta_group_items_total",
+            "xl.meta publishes carried inside group commits")
+        self.meta_batch_occupancy = Gauge(
+            "mtpu_meta_batch_occupancy",
+            "Mean publishes per group commit")
+        self.meta_journal_replays = Gauge(
+            "mtpu_meta_journal_replays_total",
+            "xl.meta entries republished from metadata journal "
+            "segments at boot recovery")
+        self.meta_read_requests = Gauge(
+            "mtpu_meta_read_requests_total",
+            "Engine metadata reads (quorum _read_metadata calls)")
+        self.meta_read_rounds = Gauge(
+            "mtpu_meta_read_rounds_total",
+            "Per-drive metadata read dispatches serving those requests")
+        self.meta_read_fanouts = Gauge(
+            "mtpu_meta_read_fanouts_per_request",
+            "Drive dispatches per metadata read (oracle: N drives; "
+            "coalescing drives it below 1)")
+        self.meta_trim_hits = Gauge(
+            "mtpu_meta_trim_hits_total",
+            "K+1-trimmed read fan-outs accepted at quorum")
+        self.meta_trim_fallbacks = Gauge(
+            "mtpu_meta_trim_fallbacks_total",
+            "Trimmed fan-outs that widened to the remaining drives")
+        self.meta_lane_dispatches = Gauge(
+            "mtpu_meta_lane_dispatches_total",
+            "Metadata lane dispatcher rounds")
+        self.meta_inline_ops = Gauge(
+            "mtpu_meta_inline_ops_total",
+            "Lane submits executed inline on the caller's thread "
+            "(idle fast path)")
         self.bpool_gets = Gauge(
             "mtpu_bpool_gets_total",
             "Scratch-buffer leases handed out by the aligned pool")
@@ -1389,6 +1539,23 @@ class MetricsRegistry:
         self.zerocopy_vectored_write_bytes.set(
             snap["zerocopy_vectored_write_bytes"])
         self.zerocopy_fallbacks.set(snap["zerocopy_fallbacks"])
+        self.meta_publishes.set(snap["meta_publishes"])
+        self.meta_fsyncs.set(snap["meta_fsyncs"])
+        self.meta_fsyncs_per_object.set(
+            round(snap["meta_fsyncs_per_object"], 6))
+        self.meta_group_commits.set(snap["meta_group_commits"])
+        self.meta_group_items.set(snap["meta_group_items"])
+        self.meta_batch_occupancy.set(
+            round(snap["meta_batch_occupancy"], 6))
+        self.meta_journal_replays.set(snap["meta_journal_replays"])
+        self.meta_read_requests.set(snap["meta_read_requests"])
+        self.meta_read_rounds.set(snap["meta_read_rounds"])
+        self.meta_read_fanouts.set(
+            round(snap["meta_read_fanouts_per_request"], 6))
+        self.meta_trim_hits.set(snap["meta_trim_hits"])
+        self.meta_trim_fallbacks.set(snap["meta_trim_fallbacks"])
+        self.meta_lane_dispatches.set(snap["meta_lane_dispatches"])
+        self.meta_inline_ops.set(snap["meta_inline_ops"])
         # Aligned-buffer pool: scrape-only, never forces the shared
         # segment into existence (bpool.stats() is None until first use).
         from ..ops import bpool as _bpool

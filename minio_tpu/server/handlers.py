@@ -779,6 +779,15 @@ class S3Handlers:
                      "Content-Type": "application/octet-stream",
                      "Accept-Ranges": "none"}
                 return Response(200, b"" if head else data, h)
+        # Request-level ignition note for the metadata lanes: the
+        # in-flight counter is what lets concurrent HEAD/GET metadata
+        # fan-outs on distinct keys coalesce into per-drive
+        # read_version_many rounds (a lone request stays on the exact
+        # single-op oracle path).
+        from ..ops import metalanes
+        _mb = metalanes.get() if metalanes.enabled() else None
+        if _mb is not None:
+            _mb.note_read(1)
         try:
             fi = self.pools.head_object(bucket, key, version_id)
         except ErrObjectNotFound as e:
@@ -789,6 +798,9 @@ class S3Handlers:
             return resp
         except StorageError as e:
             raise from_storage_error(e) from None
+        finally:
+            if _mb is not None:
+                _mb.note_read(-1)
         cond = self._check_conditions(headers, fi)
         if cond is not None:
             return cond

@@ -27,7 +27,8 @@ def boot_recovery_sweep(drives) -> dict:
     passthrough reaches sweep_stale); anything without a sweep —
     remote drives, None gaps — is skipped.
     """
-    totals = {"drives": 0, "tmp_entries": 0, "mp_stage": 0}
+    totals = {"drives": 0, "tmp_entries": 0, "mp_stage": 0,
+              "meta_journal": 0}
     for d in drives:
         sweep = getattr(d, "sweep_stale", None)
         if sweep is None:
@@ -39,6 +40,7 @@ def boot_recovery_sweep(drives) -> dict:
         totals["drives"] += 1
         totals["tmp_entries"] += counts.get("tmp_entries", 0)
         totals["mp_stage"] += counts.get("mp_stage", 0)
+        totals["meta_journal"] += counts.get("meta_journal", 0)
         DATA_PATH.record_recovery_sweep(counts.get("tmp_entries", 0),
                                         counts.get("mp_stage", 0))
     return totals

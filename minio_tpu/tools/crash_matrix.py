@@ -51,6 +51,26 @@ SCENARIOS = (
      "expect": "maybe"},
     {"point": "put.inline.post_meta", "nth": 1, "op": "put_inline",
      "expect": "durable"},
+    # Group-commit metadata plane (PR 19): MTPU_METABATCH_SOLO forces
+    # even a lone PUT through the journaled batch path (batch of one
+    # per drive lane), so the meta.{stage,fsync,publish} windows fire
+    # deterministically.  The four drive lanes run concurrently and
+    # os._exit leaves the page cache alive, so expectations follow
+    # from the nth hit alone: stage:1 dies before ANY lane wrote a
+    # segment (absent); fsync:4 / publish:4 (= N_DRIVES) prove every
+    # lane's segment was fsync-complete, so boot replay republishes
+    # all of them (durable); first-hit variants land anywhere between
+    # (maybe — never torn, never an acked loss).
+    {"point": "meta.stage", "nth": 1, "op": "put_inline",
+     "expect": "absent", "env": {"MTPU_METABATCH_SOLO": "1"}},
+    {"point": "meta.fsync", "nth": 1, "op": "put_inline",
+     "expect": "maybe", "env": {"MTPU_METABATCH_SOLO": "1"}},
+    {"point": "meta.fsync", "nth": 4, "op": "put_inline",
+     "expect": "durable", "env": {"MTPU_METABATCH_SOLO": "1"}},
+    {"point": "meta.publish", "nth": 1, "op": "put_inline",
+     "expect": "maybe", "env": {"MTPU_METABATCH_SOLO": "1"}},
+    {"point": "meta.publish", "nth": 4, "op": "put_inline",
+     "expect": "durable", "env": {"MTPU_METABATCH_SOLO": "1"}},
     {"point": "shard.append", "nth": 2, "op": "put",
      "expect": "absent"},
     {"point": "rename.pre_meta", "nth": 1, "op": "put",
@@ -217,6 +237,11 @@ def run_scenario(sc: dict, base_dir: str, seed: int = 0,
     os.makedirs(base_dir, exist_ok=True)
     point, nth, op = sc["point"], sc["nth"], sc["op"]
     expect = sc["expect"]
+    # Scenario-scoped env (e.g. MTPU_METABATCH_SOLO for the meta.*
+    # group-commit rows) applies to every boot; caller extra_env wins
+    # on conflict so a matrix-wide override stays authoritative.
+    if sc.get("env"):
+        extra_env = {**sc["env"], **(extra_env or {})}
     res = {"point": point, "nth": nth, "op": op, "expect": expect,
            "seed": seed}
     baseline = {

@@ -40,6 +40,12 @@ POINTS = (
     "shard.append",              # _append_file_impl: one shard batch appended
     "rename.pre_meta",           # rename_data: data dir moved, xl.meta not yet
     "meta.update",               # write_metadata: before the xl.meta rewrite
+    "meta.stage",                # write_metadata_many: blobs staged, no
+                                 #   journal segment yet (batch unacked)
+    "meta.fsync",                # write_metadata_many: segment fsynced,
+                                 #   before any publish (replay recovers)
+    "meta.publish",              # write_metadata_many: before each blob's
+                                 #   rename-into-place (use :nth)
     # engine/erasure_set.py — quorum committed, client never told
     "put.post_publish",          # PUT: rename_data quorum met, before reply
     "put.inline.post_meta",      # inline PUT: xl.meta quorum met, before reply

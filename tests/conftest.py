@@ -82,6 +82,22 @@ def coalesce_mode(request, monkeypatch):
     coalesce.reset()
 
 
+@pytest.fixture(params=["1", "0"], ids=["metabatch", "metasolo"])
+def metabatch_mode(request, monkeypatch):
+    """Oracle guard for the batched metadata plane: tests using this
+    fixture run once through the per-drive MetaLanes
+    (MTPU_METABATCH=1, the default — group-commit publishes, coalesced
+    read fan-outs, K+1 trim) and once on the single-op oracle (=0).
+    The singleton is retired on both edges so each run starts from
+    cold lanes."""
+    from minio_tpu.ops import metalanes
+
+    metalanes.reset()
+    monkeypatch.setenv("MTPU_METABATCH", request.param)
+    yield request.param
+    metalanes.reset()
+
+
 @pytest.fixture(params=["1", "0"], ids=["hedge", "nohedge"])
 def hedge_mode(request, monkeypatch):
     """Oracle guard for hedged shard reads: tests using this fixture

@@ -173,7 +173,8 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
              range_frac: float = 0.0,
              ilm_mix: float = 0.0, tier_mgr=None,
              tier_root: str | None = None,
-             use_iter: bool = False) -> dict:
+             use_iter: bool = False,
+             small: tuple[int, int] | None = None) -> dict:
     """Drive `clients` closed-loop workers against `es` for
     `duration_s`; returns aggregate GB/s, p50/p99 latency, and mean
     coalesced dispatch occupancy over the run.  `keyspace` picks the
@@ -199,15 +200,41 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
     read-through, the same path the HTTP handlers take) and tagged as
     their own stub_p50/p99 SLO row.  Pass a live `tier_mgr` to reuse
     one (ilm_bench does), else a DirTierBackend is stood up under
-    `tier_root`."""
+    `tier_root`.
+
+    `small=(lo, hi)` switches to the small-object mix (ISSUE 19):
+    every body size is drawn Zipf-skewed from a log-spaced ladder
+    between `lo` and `hi` bytes (rank 0 = smallest, the real-world
+    metadata-bound shape), `object_size` is ignored, and the result
+    grows ops/s rows plus server-side `meta_*` deltas — amortized
+    fsyncs/object, group-commit occupancy, and metadata read
+    fan-outs/request — the group-commit plane's win metrics."""
     if not es.bucket_exists(bucket):
         es.make_bucket(bucket)
     rng = np.random.default_rng(seed)
-    body = rng.integers(0, 256, object_size, dtype=np.uint8).tobytes()
+    size_ladder: list[int] = []
+    size_cdf = None
+    warm_size: dict[str, int] = {}
+    if small:
+        lo, hi = small
+        nsz = 12 if hi > lo else 1
+        size_ladder = sorted({int(round(lo * (hi / lo) ** (i / max(1, nsz - 1))))
+                              for i in range(nsz)})
+        size_cdf = zipf_cdf(len(size_ladder), 1.1)
+        bodies = {s: rng.integers(0, 256, s, dtype=np.uint8).tobytes()
+                  for s in size_ladder}
+        body = bodies[size_ladder[0]]
+    else:
+        body = rng.integers(0, 256, object_size,
+                            dtype=np.uint8).tobytes()
     warm = keyspace_names(es, keyspace, total=max(1, warm_objects),
                           prefix="warm")
     for name in warm:
-        es.put_object(bucket, name, body)
+        if small:
+            warm_size[name] = size_ladder[_zipf_pick(size_cdf, rng)]
+            es.put_object(bucket, name, bodies[warm_size[name]])
+        else:
+            es.put_object(bucket, name, body)
     cdf = zipf_cdf(len(warm), zipf) if zipf else None
     cut = hot_rank_cut(len(warm))
     stub_names: set[str] = set()
@@ -280,19 +307,26 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
                 if is_put:
                     name = (mine[j % len(mine)] if name_set
                             else f"c{ci}-{j}")
-                    es.put_object(bucket, name, body)
+                    if small:
+                        sz = size_ladder[_zipf_pick(size_cdf, crng)]
+                        es.put_object(bucket, name, bodies[sz])
+                        got_bytes = sz
+                    else:
+                        es.put_object(bucket, name, body)
                     j += 1
                 else:
                     rank = (_zipf_pick(cdf, crng) if cdf is not None
                             else int(crng.integers(0, len(warm))))
                     name = warm[rank]
+                    obj_sz = warm_size.get(name, object_size)
+                    got_bytes = obj_sz
                     ranged = (range_frac > 0
                               and crng.random() < range_frac)
                     is_stub = name in stub_names
                     if ranged:
-                        off = int(crng.integers(0, object_size))
+                        off = int(crng.integers(0, obj_sz))
                         ln = int(crng.integers(
-                            1, object_size - off + 1))
+                            1, obj_sz - off + 1))
                         if is_stub:
                             got_n = len(stub_get(name, off, ln))
                         elif use_iter:
@@ -315,7 +349,7 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
                         else:
                             _, got = es.get_object(bucket, name)
                             got_n = len(got)
-                        if got_n != object_size:
+                        if got_n != obj_sz:
                             raise AssertionError("short read")
                 dt = time.monotonic() - t0
                 (lat_put if is_put else lat_get)[ci].append(dt)
@@ -451,6 +485,35 @@ def run_load(es, *, clients: int = 4, object_size: int = 1 << 20,
         out["devcache_misses"] = dm
         out["devcache_hit_ratio"] = (round(dh / (dh + dm), 4)
                                      if dh + dm else 0.0)
+    if small:
+        # Small-object rows (ISSUE 19): the mix is metadata-bound, so
+        # ops/s (not GB/s) is the headline, and the server-side meta_*
+        # deltas show what the group-commit plane amortized — fsyncs
+        # per published object, journal batch occupancy, and metadata
+        # read fan-outs per GET/HEAD request.
+        out["small_lo"] = small[0]
+        out["small_hi"] = small[1]
+        out["ops_per_s"] = round(len(alls) / wall, 1) if wall else 0.0
+        out["put_ops_per_s"] = (round(len(puts) / wall, 1)
+                                if wall else 0.0)
+        out["get_ops_per_s"] = (round(len(gets) / wall, 1)
+                                if wall else 0.0)
+        d_pub = snap1["meta_publishes"] - snap0["meta_publishes"]
+        d_fs = snap1["meta_fsyncs"] - snap0["meta_fsyncs"]
+        d_gc = (snap1["meta_group_commits"]
+                - snap0["meta_group_commits"])
+        d_gi = snap1["meta_group_items"] - snap0["meta_group_items"]
+        d_rq = (snap1["meta_read_requests"]
+                - snap0["meta_read_requests"])
+        d_rr = snap1["meta_read_rounds"] - snap0["meta_read_rounds"]
+        out["meta_fsyncs_per_object"] = (round(d_fs / d_pub, 4)
+                                         if d_pub else 0.0)
+        out["meta_batch_occupancy"] = (round(d_gi / d_gc, 3)
+                                       if d_gc else 0.0)
+        out["meta_read_fanouts_per_request"] = (round(d_rr / d_rq, 4)
+                                                if d_rq else 0.0)
+        out["meta_trim_hits"] = (snap1["meta_trim_hits"]
+                                 - snap0["meta_trim_hits"])
     if zipf:
         out["zipf_s"] = zipf
         out.update(hot_cold_rows(
@@ -1066,6 +1129,15 @@ def main(argv=None) -> int:
                     "(rank 0 hottest; bare --zipf means s=1.1). "
                     "Adds hot-key vs cold-key p50/p99 SLO rows — the "
                     "split the hot-object cache must win")
+    ap.add_argument("--small", nargs="?", const="4,64",
+                    default=None, metavar="N[,M]",
+                    help="small-object mix (engine mode): body sizes "
+                    "drawn Zipf-skewed from a log ladder between N and "
+                    "M KiB (bare --small means 4,64 — the inline "
+                    "small-object band).  Reports ops/s, p50/p99, and "
+                    "server-side meta_* deltas: amortized "
+                    "fsyncs/object, group-commit occupancy, and "
+                    "metadata read fan-outs/request")
     ap.add_argument("--range-frac", type=float, default=0.0,
                     help="fraction of GETs issued as random ranged "
                     "reads (their own SLO row)")
@@ -1122,6 +1194,28 @@ def main(argv=None) -> int:
                     "run it against a server mid-decommission to "
                     "prove new writes avoid the draining pool")
     args = ap.parse_args(argv)
+    small = None
+    if args.small is not None:
+        parts = [p for p in str(args.small).split(",") if p]
+        try:
+            lo = int(parts[0])
+            hi = int(parts[1]) if len(parts) > 1 else 64
+        except (ValueError, IndexError):
+            print(f"--small expects N or N,M in KiB, got "
+                  f"{args.small!r}", file=sys.stderr)
+            return 2
+        if lo <= 0 or hi < lo:
+            print(f"--small bounds must satisfy 0 < N <= M, got "
+                  f"{args.small!r}", file=sys.stderr)
+            return 2
+        small = (lo << 10, hi << 10)
+        if args.endpoint:
+            print("--small is engine-mode only (the meta_* deltas "
+                  "come from the in-process DATA_PATH ledger)",
+                  file=sys.stderr)
+            return 2
+        if args.zipf is None:      # sizes ride the Zipf key picker
+            args.zipf = 1.1
     if args.during_decom and not args.endpoint:
         print("--during-decom requires --endpoint (the x-mtpu-pool "
               "header is an HTTP response surface)", file=sys.stderr)
@@ -1179,7 +1273,8 @@ def main(argv=None) -> int:
                        keyspace=args.keyspace, zipf=args.zipf,
                        range_frac=args.range_frac,
                        ilm_mix=args.ilm_mix,
-                       tier_root=os.path.join(args.root, "tier"))
+                       tier_root=os.path.join(args.root, "tier"),
+                       small=small)
     w = max(len(k) for k in res)
     for k, v in res.items():
         print(f"{k:<{w}}  {v}")
