@@ -2758,6 +2758,200 @@ def _smallobj_main() -> None:
         raise SystemExit(1)
 
 
+def repl_bench(n_objects: int = 96, object_kib: int = 128,
+               resync_objects: int = 400,
+               lag_objects: int = 24) -> dict:
+    """Replication-under-fire suite (bucket/replication.py): what the
+    journaled mirror costs and how fast it recovers.
+
+    Leg 1 — steady mirror: PUT n_objects through the source's S3 front
+    with replication wired to a live target (clean wire); report the
+    client-visible ack rate (the journal write is on the PUT path) and
+    the end-to-end mirror rate (ack through backlog drained), with a
+    byte-exact sample check on the target.
+
+    Leg 2 — resync: bulk-load resync_objects BEFORE wiring, then
+    admin op=resync and time enumeration + drain to convergence — the
+    "point a fresh target at an old bucket" number.
+
+    Leg 3 — lag drain after heal: black-hole the target's wire (the
+    same chaos TCP proxy the partition matrix uses), keep acking
+    writes, observe the backlog and per-target lag grow, then heal and
+    time the drain back to zero — partition produces lag, never loss.
+
+    Sized for a 1-core CI host; the structure (fsync per intent, one
+    copy per task, capped backoff against a dark target) is what the
+    numbers price."""
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.tools.net_matrix import ReplPair
+
+    out: dict = {"repl_objects": n_objects,
+                 "repl_object_kib": object_kib}
+    size = object_kib << 10
+
+    def wait_for(pred, timeout, step=0.1):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(step)
+        return False
+
+    saved = os.environ.get("MTPU_SCANNER")
+    os.environ["MTPU_SCANNER"] = "0"
+    root = tempfile.mkdtemp(prefix="mtpu-replbench-")
+    try:
+        pair = ReplPair(root, seed=5)
+        try:
+            def queued():
+                return int(pair.repl.stats().get("queued", 0))
+
+            # -- leg 1: steady mirror throughput ------------------------
+            pair.dcli.make_bucket("rbm-dst")
+            pair.scli.make_bucket("rbm")
+            pair.wire("rbm", "rbm-dst")
+            rng = np.random.default_rng(20)
+            body = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            t0 = time.monotonic()
+            for i in range(n_objects):
+                pair.scli.put_object("rbm", f"o{i}", body)
+            ack_s = time.monotonic() - t0
+            if not wait_for(lambda: queued() == 0, 180):
+                raise RuntimeError(
+                    f"mirror backlog never drained ({queued()} left)")
+            dt = time.monotonic() - t0
+            for i in (0, n_objects // 2, n_objects - 1):
+                if pair.dcli.get_object("rbm-dst", f"o{i}") != body:
+                    raise RuntimeError(f"replica o{i} diverged")
+            out["repl_ack_mbps"] = round(
+                n_objects * size / ack_s / 1e6, 1)
+            out["repl_mirror_s"] = round(dt, 3)
+            out["repl_mirror_mbps"] = round(
+                n_objects * size / dt / 1e6, 1)
+
+            # -- leg 2: resync of a pre-existing bucket -----------------
+            small = body[:16 << 10]
+            pair.dcli.make_bucket("rsy-dst")
+            pair.scli.make_bucket("rsy")
+            for i in range(resync_objects):
+                pair.scli.put_object("rsy", f"k{i:05d}", small)
+            pair.wire("rsy", "rsy-dst")
+            t0 = time.monotonic()
+            st, _, rbody = pair.scli.request(
+                "POST", "/minio/admin/v3/replication",
+                body=json.dumps({"op": "resync",
+                                 "bucket": "rsy"}).encode())
+            if st != 200:
+                raise RuntimeError(f"resync start: {st} {rbody!r}")
+            done = wait_for(
+                lambda: queued() == 0
+                and (pair.repl.resync_status("rsy")
+                     or {}).get("status") == "done", 300, step=0.25)
+            out["repl_resync_objects"] = resync_objects
+            out["repl_resync_done"] = done
+            out["repl_resync_s"] = round(time.monotonic() - t0, 3)
+            out["repl_resync_objs_per_s"] = round(
+                resync_objects / max(time.monotonic() - t0, 1e-9), 1)
+
+            # -- leg 3: partition -> lag -> heal -> drain ---------------
+            pair.dcli.make_bucket("lag-dst")
+            pair.scli.make_bucket("lag")
+            pair.wire("lag", "lag-dst")
+            pair.proxy.set_mode("blackhole")
+            for i in range(lag_objects):
+                pair.scli.put_object("lag", f"w{i}", small)
+            wait_for(lambda: queued() >= lag_objects, 30)
+            wait_for(lambda: max(
+                pair.repl.stats().get("lagSeconds", {}).values()
+                or [0.0]) > 0.5, 30)
+            st_dark = pair.repl.stats()
+            out["repl_lag_backlog"] = int(st_dark.get("queued", 0))
+            out["repl_lag_peak_s"] = max(
+                st_dark.get("lagSeconds", {}).values() or [0.0])
+            r0 = int(st_dark.get("retries", 0))
+            time.sleep(2.0)
+            out["repl_dark_retries_2s"] = \
+                int(pair.repl.stats().get("retries", 0)) - r0
+            pair.proxy.heal()
+            t0 = time.monotonic()
+            drained = wait_for(lambda: queued() == 0, 120)
+            out["repl_lag_drain_s"] = round(time.monotonic() - t0, 3)
+            out["repl_drained_after_heal"] = drained
+            if drained:
+                for i in range(lag_objects):
+                    if pair.dcli.get_object("lag-dst", f"w{i}") != small:
+                        raise RuntimeError(
+                            f"w{i} diverged after lag drain")
+            fin = pair.repl.stats()
+            out["repl_completed_total"] = int(fin.get("completed", 0))
+            out["repl_retries_total"] = int(fin.get("retries", 0))
+            out["repl_failed_total"] = int(fin.get("failed", 0))
+            out["repl_dropped_total"] = int(fin.get("dropped", 0))
+        finally:
+            pair.close()
+    finally:
+        if saved is None:
+            os.environ.pop("MTPU_SCANNER", None)
+        else:
+            os.environ["MTPU_SCANNER"] = saved
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def _repl_main() -> None:
+    """`python bench.py repl_bench` — the replication suite alone,
+    JSON to stdout and REPL_r20.json for the record.  Gates (ISSUE
+    20): the mirror drains and a byte-exact sample lands on the
+    target, the pre-existing-bucket resync converges, and a
+    black-holed target produces observable backlog + lag that drains
+    to zero after heal with bounded dark-window retries and zero
+    dropped intents (first-attempt FAILED stamps against the dark
+    target are by design — those tasks retry and converge)."""
+    import os
+    doc = {"rc": 0, "ok": False}
+    try:
+        extras = repl_bench()
+        doc["ok"] = (
+            extras.get("repl_mirror_mbps", 0.0) > 0
+            and extras.get("repl_resync_done", False)
+            and extras.get("repl_lag_backlog", 0) > 0
+            and extras.get("repl_lag_peak_s", 0.0) > 0
+            and extras.get("repl_drained_after_heal", False)
+            and extras.get("repl_dark_retries_2s", 10**9) <= 60
+            and extras.get("repl_dropped_total", 1) == 0)
+        doc["extras"] = extras
+        doc["tail"] = (
+            f"repl_bench {'OK' if doc['ok'] else 'VIOLATION'}: mirror "
+            f"{extras.get('repl_mirror_mbps')} MB/s end-to-end "
+            f"(acks {extras.get('repl_ack_mbps')} MB/s) over "
+            f"{extras.get('repl_objects')}x"
+            f"{extras.get('repl_object_kib')} KiB; resync of "
+            f"{extras.get('repl_resync_objects')} keys in "
+            f"{extras.get('repl_resync_s')} s "
+            f"({extras.get('repl_resync_objs_per_s')} obj/s); "
+            f"partition backlog {extras.get('repl_lag_backlog')} "
+            f"(peak lag {extras.get('repl_lag_peak_s')} s, "
+            f"{extras.get('repl_dark_retries_2s')} retries/2s dark) "
+            f"drained in {extras.get('repl_lag_drain_s')} s after "
+            f"heal with {extras.get('repl_failed_total')} first-attempt "
+            f"FAILED stamps and {extras.get('repl_dropped_total')} "
+            f"dropped intents")
+    except Exception as e:  # noqa: BLE001 — the round file records it
+        doc["rc"] = 1
+        doc["tail"] = f"{type(e).__name__}: {e}"
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "REPL_r20.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc))
+    if doc["rc"] or not doc["ok"]:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     if sys.argv[1:2] == ["multichip_bench"]:
         _multichip_main()
@@ -2773,5 +2967,7 @@ if __name__ == "__main__":
         _overload_main()
     elif sys.argv[1:2] == ["smallobj_bench"]:
         _smallobj_main()
+    elif sys.argv[1:2] == ["repl_bench"]:
+        _repl_main()
     else:
         main()

@@ -1,34 +1,95 @@
-"""Bucket replication: async worker pool mirroring writes to a target.
+"""Bucket replication: a journaled worker pool mirroring writes to a
+target cluster.
 
 The cmd/bucket-replication.go:825,1280 equivalent: replication configs
 (rule filters + target) mark each eligible write PENDING; a worker pool
-drains the queue, copies object versions (and delete markers) to the
-target bucket, and flips the per-object x-amz-replication-status on the
-SOURCE object (PENDING -> COMPLETED/FAILED) exactly as the reference
-stamps it. GETs of objects missing locally can PROXY to the replication
-target (proxyGetToReplicationTarget, cmd/bucket-replication.go:825) so
-an actively-resyncing bucket serves reads before its copy lands.
-`start_resync` replays a whole bucket through a PERSISTED, resumable
-state machine (marker-keyed progress checkpointed to the sys volume,
-surviving restarts — the replication resync status role). Targets
-implement put_object/delete_object/get_object — either a remote
-S3Client or another in-process ServerPools (the test double the
-reference also uses for same-process replication tests).
+drains the task backlog, copies object versions (and delete markers) to
+the target bucket, and flips the per-object x-amz-replication-status on
+the SOURCE object (PENDING -> COMPLETED/FAILED) exactly as the
+reference stamps it.  GETs of objects missing locally can PROXY to the
+replication target (proxyGetToReplicationTarget,
+cmd/bucket-replication.go:825) so an actively-resyncing bucket serves
+reads before its copy lands.  `start_resync` replays a whole bucket
+through a PERSISTED, resumable state machine (marker-keyed progress
+checkpointed to the sys volume, surviving restarts — the replication
+resync status role).  Targets implement put_object/delete_object/
+get_object — either a remote S3Client or another in-process ServerPools
+(the test double the reference also uses for same-process replication
+tests).
+
+Durability (the MRF/ILM journal discipline, cf. cmd/mrf.go:52 applied
+to replication): every accepted task appends one fsynced JSONL intent
+to `repl-journal.jsonl` on the sys volume BEFORE it becomes runnable,
+completions append `done` records, and the tail compacts into an atomic
+checkpoint record (tmp + rename) every MTPU_REPL_CKPT_EVERY records and
+on stop().  Boot replays the journal exactly-once: a kill -9 between
+the ack and the copy loses nothing — the intent re-enters the backlog
+and the copy is idempotent (replica PUTs preserve the source version
+id, so a replayed copy REPLACES rather than duplicates).  A torn
+trailing line (the append a kill interrupted) is ignored.
+
+Fault tolerance: failed copies retry with capped exponential backoff
+and never leave the journal (a partitioned target produces LAG, not
+loss); consecutive failures against one target open a per-target
+breaker that defers that target's tasks until a probe succeeds, so a
+dead target cannot hot-loop the workers.
+
+Env knobs:
+  MTPU_REPL_JOURNAL         1 (default) journaled exactly-once mode,
+                            0 = legacy in-memory queue (byte-identical
+                            oracle: single attempt, FAILED-once)
+  MTPU_REPL_FSYNC           1 (default) fsync each intent append
+  MTPU_REPL_CKPT_EVERY      tail records between checkpoints (256)
+  MTPU_REPL_WORKERS         worker threads (2)
+  MTPU_REPL_RETRY_INTERVAL  base retry backoff seconds (0.25)
+  MTPU_REPL_MAX_INTERVAL    backoff cap seconds (30)
+  MTPU_REPL_BREAKER_FAILS   consecutive failures that open a target
+                            breaker (3)
+  MTPU_REPL_BREAKER_MAX     breaker probe-interval cap seconds (15)
 """
 
 from __future__ import annotations
 
 import json
+import os
 import queue
+import random
 import threading
 import time
 import xml.etree.ElementTree as ET
+from collections import OrderedDict
 
 from ..storage.drive import SYS_VOL
-from ..storage.errors import ErrObjectNotFound, StorageError
+from ..storage.errors import (ErrBucketNotFound, ErrObjectNotFound,
+                              ErrVersionNotFound, StorageError)
+from ..utils.crashpoints import crash_point
 
 STATUS_KEY = "x-amz-replication-status"
 RESYNC_DIR = "replication"
+#: Internal replica-fidelity headers (version-id-preserving PUT): only
+#: principals holding s3:ReplicateObject may send them — the server
+#: strips them from everyone else, like the REPLICA marker itself.
+REPL_VID_HEADER = "x-mtpu-repl-version-id"
+REPL_MTIME_HEADER = "x-mtpu-repl-mtime"
+
+
+class ErrReplicationTargetDown(StorageError):
+    """The replication target exists in config but cannot be reached —
+    surfaced to proxy-GET callers as 503 ReplicationRemoteConnectionError
+    (vs ErrObjectNotFound -> 404 when no target holds the key)."""
+
+
+def _is_not_found(e: Exception) -> bool:
+    """Target-side 'key absent' vs everything else (down/refused/5xx).
+    Covers in-process storage errors and wire-level S3 client errors
+    without importing the client module here."""
+    if isinstance(e, (ErrObjectNotFound, ErrVersionNotFound,
+                      ErrBucketNotFound)):
+        return True
+    status = getattr(e, "status", None)
+    code = getattr(e, "code", "")
+    return status == 404 or code in ("NoSuchKey", "NoSuchBucket",
+                                     "NoSuchVersion")
 
 
 class ReplicationRule:
@@ -57,27 +118,130 @@ def parse_replication_config(xml_bytes: bytes) -> list[ReplicationRule]:
     return rules
 
 
+def _journal_name() -> str:
+    """Journal filename for THIS process — same single-writer rule as
+    the MRF journal: the pre-fork pool runs N servers over the same
+    drives and interleaved JSONL appends tear records, so each worker
+    owns `repl-journal.w<ID>.jsonl`."""
+    wid = os.environ.get("MTPU_WORKER_ID", "")
+    if wid:
+        return f"repl-journal.w{wid}.jsonl"
+    return "repl-journal.jsonl"
+
+
+def _pool_journal_path(source_pools) -> str | None:
+    """Journal home: the first local drive of the first pool's first
+    set, under its reserved system namespace."""
+    for pool in getattr(source_pools, "pools", [source_pools]):
+        for es in getattr(pool, "sets", [pool]):
+            for d in getattr(es, "drives", []):
+                root = getattr(d, "root", None)
+                if d is not None and root:
+                    return os.path.join(root, SYS_VOL, _journal_name())
+    return None
+
+
+def _task_key(op: str, bucket: str, tb: str, key: str) -> str:
+    return f"{op}|{bucket}|{tb}|{key}"
+
+
+def _net_pending(raw: str) -> "OrderedDict[str, dict]":
+    """The enq/done/ckpt algebra of journal replay, standalone — what a
+    journal's writer still owed when it last wrote (used for adopting a
+    dead sibling's journal)."""
+    pending: OrderedDict[str, dict] = OrderedDict()
+    for line in raw.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue                     # torn trailing line: ignored
+        op = rec.get("op")
+        if op == "ckpt":
+            pending = OrderedDict()
+            for e in rec.get("pending", ()):
+                tk = _task_key(e["t"], e["b"], e["tb"], e["k"])
+                pending[tk] = dict(e)
+        elif op == "enq":
+            tk = _task_key(rec["t"], rec["b"], rec["tb"], rec["k"])
+            pending[tk] = {k: rec[k] for k in
+                           ("t", "b", "k", "tb", "vid", "dm", "ts",
+                            "seq") if k in rec}
+        elif op == "done":
+            it = pending.get(rec.get("k"))
+            # a done for an OLDER generation must not cancel a newer
+            # enq of the same key that raced the completion
+            if it is not None and int(it.get("seq", 0)) <= \
+                    int(rec.get("seq", 1 << 62)):
+                pending.pop(rec.get("k"), None)
+    return pending
+
+
 class ReplicationPool:
     """Worker pool draining replication tasks (cf. ReplicationPool,
-    cmd/bucket-replication.go:1280)."""
+    cmd/bucket-replication.go:1280) from a crash-replayable journal —
+    or, with MTPU_REPL_JOURNAL=0, from the legacy in-memory queue
+    (the byte-identical oracle)."""
 
-    def __init__(self, source_pools, workers: int = 2):
+    def __init__(self, source_pools, workers: int | None = None):
         self.source = source_pools
         self._rules: dict[str, list[ReplicationRule]] = {}
         self._targets: dict[str, object] = {}    # target bucket -> client
-        self._q: queue.Queue = queue.Queue()
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self.completed = 0
         self.failed = 0
         self.bytes_replicated = 0
+        self.retries = 0
+        self.dropped = 0
+        self.replayed = 0
+        self.proxied_reads = 0
         self._stats_mu = threading.Lock()
         self._resync_mu = threading.Lock()
         self._resync_threads: dict[str, threading.Thread] = {}
+
+        if workers is None:
+            workers = int(os.environ.get("MTPU_REPL_WORKERS", "2") or 2)
+        self._jpath: str | None = None
+        if os.environ.get("MTPU_REPL_JOURNAL", "1") != "0":
+            self._jpath = _pool_journal_path(source_pools)
+        # journal-mode state (unused by the oracle)
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._pending: OrderedDict[str, dict] = OrderedDict()
+        self._inflight: dict[str, dict] = {}
+        self._seq = 0
+        self._tombstones: set[str] = set()
+        self._breakers: dict[tuple, dict] = {}
+        self._rng = random.Random()
+        self._jf = None
+        self._j_tail = 0
+        self._j_fsync = os.environ.get("MTPU_REPL_FSYNC", "1") != "0"
+        self._j_every = int(os.environ.get("MTPU_REPL_CKPT_EVERY",
+                                           "256") or 256)
+        self.retry_interval = float(os.environ.get(
+            "MTPU_REPL_RETRY_INTERVAL", "0.25") or 0.25)
+        self.max_interval = float(os.environ.get(
+            "MTPU_REPL_MAX_INTERVAL", "30") or 30)
+        self.breaker_fails = int(os.environ.get(
+            "MTPU_REPL_BREAKER_FAILS", "3") or 3)
+        self.breaker_max = float(os.environ.get(
+            "MTPU_REPL_BREAKER_MAX", "15") or 15)
+        # oracle-mode queue (unused in journal mode)
+        self._q: queue.Queue = queue.Queue()
+
+        if self._jpath is not None:
+            if os.environ.get("MTPU_WORKER_ID", "0") in ("", "0"):
+                adopt_orphan_journals(self._jpath)
+            self._replay_journal()
+            self.checkpoint()            # compact the boot state
+        target = (self._worker_journal if self._jpath is not None
+                  else self._worker)
         for _ in range(workers):
-            t = threading.Thread(target=self._worker, daemon=True)
+            t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+
+    # -- wiring --------------------------------------------------------------
 
     def configure(self, bucket: str, rules: list[ReplicationRule],
                   target) -> None:
@@ -87,6 +251,9 @@ class ReplicationPool:
             # at same-named target buckets on different endpoints must
             # not clobber each other's clients/credentials
             self._targets[(bucket, r.target_bucket)] = target
+        self._tombstones.discard(bucket)
+        with self._cv:
+            self._cv.notify_all()        # replayed tasks may now run
 
     def configure_rules(self, bucket: str, pairs) -> None:
         """Multi-target form: pairs of (rule, target-client).
@@ -96,29 +263,230 @@ class ReplicationPool:
         self._rules[bucket] = [r for r, _ in pairs]
         for r, t in pairs:
             self._targets[(bucket, r.target_bucket)] = t
+        self._tombstones.discard(bucket)
+        with self._cv:
+            self._cv.notify_all()
 
     def unconfigure(self, bucket: str) -> None:
         """Drop a bucket's live wiring (target deregistered / config
-        removed) — replication must stop NOW, not at next restart."""
+        removed) — replication must stop NOW, not at next restart.
+        Journaled tasks for the bucket are dropped by the workers (the
+        tombstone marks 'explicitly unwired', as opposed to 'wiring not
+        loaded yet at boot', which must keep the replayed backlog)."""
         rules = self._rules.pop(bucket, [])
         for r in rules:
             self._targets.pop((bucket, r.target_bucket), None)
+        if rules:
+            self._tombstones.add(bucket)
+            with self._cv:
+                self._cv.notify_all()
+
+    # -- journal -------------------------------------------------------------
+
+    def _append_locked(self, rec: dict, durable: bool = False) -> None:
+        if self._jpath is None:
+            return
+        try:
+            if self._jf is None:
+                self._jf = open(self._jpath, "a", encoding="utf-8")
+            self._jf.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._jf.flush()
+            if durable and self._j_fsync:
+                os.fsync(self._jf.fileno())
+            self._j_tail += 1
+        except OSError:
+            return                      # journal loss degrades to memory
+        if self._j_tail >= self._j_every:
+            self._checkpoint_locked()
+
+    def _fsync_locked(self) -> None:
+        if self._jf is not None and self._j_fsync:
+            try:
+                os.fsync(self._jf.fileno())
+            except OSError:
+                pass
+
+    def _checkpoint_locked(self) -> None:
+        if self._jpath is None:
+            return
+        pend = list(self._pending.values()) + list(self._inflight.values())
+        rec = {"op": "ckpt", "seq": self._seq,
+               "completed": self.completed, "failed": self.failed,
+               "retries": self.retries, "dropped": self.dropped,
+               "bytes": self.bytes_replicated,
+               "proxied": self.proxied_reads,
+               "pending": [{"t": t["t"], "b": t["b"], "k": t["k"],
+                            "tb": t["tb"], "vid": t.get("vid", ""),
+                            "dm": int(t.get("dm", 0)),
+                            "ts": t.get("ts", 0.0),
+                            "seq": t.get("seq", 0)} for t in pend]}
+        tmp = self._jpath + ".tmp"
+        try:
+            if self._jf is not None:
+                self._jf.close()
+                self._jf = None
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._jpath)
+            self._j_tail = 0
+        except OSError:
+            pass
+
+    def checkpoint(self) -> None:
+        """Compact the journal to one ckpt record (boot/stop path)."""
+        with self._cv:
+            self._checkpoint_locked()
+
+    def _replay_journal(self) -> None:
+        """Rebuild the backlog + lifetime counters from the journal.
+        A torn trailing line (the append a kill interrupted) parses as
+        garbage and is ignored; everything before it is intact because
+        records are written with one flushed write each."""
+        try:
+            with open(self._jpath, "r", encoding="utf-8") as f:
+                raw = f.read()
+        except (FileNotFoundError, OSError):
+            return
+        pending: OrderedDict[str, dict] = OrderedDict()
+        for line in raw.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            op = rec.get("op")
+            if op == "ckpt":
+                pending = OrderedDict()
+                for e in rec.get("pending", ()):
+                    tk = _task_key(e["t"], e["b"], e["tb"], e["k"])
+                    pending[tk] = dict(e)
+                self.completed = int(rec.get("completed", 0))
+                self.failed = int(rec.get("failed", 0))
+                self.retries = int(rec.get("retries", 0))
+                self.dropped = int(rec.get("dropped", 0))
+                self.bytes_replicated = int(rec.get("bytes", 0))
+                self.proxied_reads = int(rec.get("proxied", 0))
+                self._seq = max(self._seq, int(rec.get("seq", 0)))
+            elif op == "enq":
+                tk = _task_key(rec["t"], rec["b"], rec["tb"], rec["k"])
+                pending[tk] = {"t": rec["t"], "b": rec["b"],
+                               "k": rec["k"], "tb": rec["tb"],
+                               "vid": rec.get("vid", ""),
+                               "dm": int(rec.get("dm", 0)),
+                               "ts": rec.get("ts", 0.0),
+                               "seq": int(rec.get("seq", 0))}
+                self._seq = max(self._seq, int(rec.get("seq", 0)))
+            elif op == "done":
+                it = pending.get(rec.get("k"))
+                if it is not None and int(it.get("seq", 0)) <= \
+                        int(rec.get("seq", 1 << 62)):
+                    pending.pop(rec.get("k"), None)
+        now = time.monotonic()
+        for tk, it in pending.items():
+            it["attempts"] = 0
+            it["next_try"] = now         # retry immediately after boot
+            self._pending[tk] = it
+        self.replayed = len(pending)
 
     # -- enqueue hooks (called after successful PUT/DELETE) ------------------
 
-    def on_put(self, bucket: str, key: str) -> bool:
+    def _match_rule(self, bucket: str, key: str,
+                    need_dm: bool = False) -> ReplicationRule | None:
         for r in self._rules.get(bucket, []):
             if key.startswith(r.prefix):
-                self._q.put(("put", bucket, key, r))
+                if need_dm and not r.delete_marker_replication:
+                    return None
+                return r
+        return None
+
+    def _enqueue(self, op: str, bucket: str, key: str, tb: str,
+                 vid: str = "", dm: bool = False) -> None:
+        """Journal the intent (fsynced) BEFORE it becomes runnable —
+        the exactly-once window: an acked write whose intent hit the
+        journal survives any kill; one that didn't was never acked as
+        replicating."""
+        with self._cv:
+            self._seq += 1
+            task = {"t": op, "b": bucket, "k": key, "tb": tb,
+                    "vid": vid, "dm": int(dm), "ts": time.time(),
+                    "seq": self._seq, "attempts": 0,
+                    "next_try": time.monotonic()}
+            self._append_locked({"op": "enq", "t": op, "b": bucket,
+                                 "k": key, "tb": tb, "vid": vid,
+                                 "dm": int(dm), "ts": task["ts"],
+                                 "seq": task["seq"]}, durable=True)
+            crash_point("repl.enqueue")
+            self._pending[_task_key(op, bucket, tb, key)] = task
+            self._cv.notify()
+
+    def _enqueue_page(self, bucket: str, keys: list[str]) -> int:
+        """Resync page enqueue: journal every key's intent with ONE
+        fsync for the page, then make them runnable.  The caller saves
+        its resync checkpoint only AFTER this returns — so a counted
+        `queued` key is always a journaled key (a kill between the two
+        replays the page; same-key intents REPLACE, never duplicate)."""
+        n = 0
+        with self._cv:
+            staged = []
+            for key in keys:
+                r = self._match_rule(bucket, key)
+                if r is None:
+                    continue
+                self._seq += 1
+                task = {"t": "put", "b": bucket, "k": key,
+                        "tb": r.target_bucket, "vid": "", "dm": 0,
+                        "ts": time.time(), "seq": self._seq,
+                        "attempts": 0, "next_try": time.monotonic()}
+                self._append_locked(
+                    {"op": "enq", "t": "put", "b": bucket, "k": key,
+                     "tb": r.target_bucket, "vid": "", "dm": 0,
+                     "ts": task["ts"], "seq": task["seq"]})
+                staged.append(task)
+                n += 1
+            self._fsync_locked()
+            for task in staged:
+                crash_point("repl.enqueue")
+                self._pending[_task_key("put", task["b"], task["tb"],
+                                        task["k"])] = task
+            self._cv.notify_all()
+        return n
+
+    def on_put(self, bucket: str, key: str, version_id: str = "") -> bool:
+        for r in self._rules.get(bucket, []):
+            if key.startswith(r.prefix):
+                if self._jpath is None:
+                    self._q.put(("put", bucket, key, r))
+                else:
+                    self._enqueue("put", bucket, key, r.target_bucket,
+                                  vid=version_id)
                 return True
         return False
 
-    def on_delete(self, bucket: str, key: str) -> bool:
+    def on_delete(self, bucket: str, key: str, version_id: str = "",
+                  delete_marker: bool = False) -> bool:
         for r in self._rules.get(bucket, []):
             if key.startswith(r.prefix) and r.delete_marker_replication:
-                self._q.put(("delete", bucket, key, r))
+                if self._jpath is None:
+                    self._q.put(("delete", bucket, key, r))
+                else:
+                    self._enqueue("delete", bucket, key,
+                                  r.target_bucket, vid=version_id,
+                                  dm=delete_marker)
                 return True
         return False
+
+    def on_metadata(self, bucket: str, key: str) -> bool:
+        """Metadata-change re-replication (tags/retention/legal-hold —
+        cf. replicateMetadata): journal mode only; the oracle preserves
+        the legacy behavior of not re-replicating metadata."""
+        if self._jpath is None:
+            return False
+        r = self._match_rule(bucket, key)
+        if r is None:
+            return False
+        self._enqueue("meta", bucket, key, r.target_bucket)
+        return True
 
     # -- GET proxy (proxyGetToReplicationTarget) -----------------------------
 
@@ -127,7 +495,10 @@ class ReplicationPool:
         GET whose local copy has not landed yet (mid-resync, or a
         restored site). Returns (metadata, stored bytes); the caller
         reverses storage transforms (SSE/compression) recorded in the
-        metadata. Raises ErrObjectNotFound when no target has it."""
+        metadata. Raises ErrObjectNotFound when no target has it, and
+        ErrReplicationTargetDown when a target that might have it could
+        not be reached (the caller surfaces 503, not a lying 404)."""
+        down: Exception | None = None
         for r in self._rules.get(bucket, []):
             if not key.startswith(r.prefix):
                 continue
@@ -136,13 +507,22 @@ class ReplicationPool:
                 continue
             try:
                 got = target.get_object(r.target_bucket, key)
-            except Exception:  # noqa: BLE001 — target down/missing: next
-                continue
+            except Exception as e:  # noqa: BLE001 — classified below
+                if _is_not_found(e):
+                    continue             # absent there too: next rule
+                down = e                 # unreachable: remember, and
+                continue                 # give other rules a chance
+            with self._stats_mu:
+                self.proxied_reads += 1
             # in-process pools return (fi, data); S3 clients return bytes
             if isinstance(got, tuple):
                 fi, data = got
                 return dict(fi.metadata), bytes(data)
             return {}, bytes(got)
+        if down is not None:
+            raise ErrReplicationTargetDown(
+                f"replication target for {bucket}/{key} unreachable: "
+                f"{type(down).__name__}: {down}")
         raise ErrObjectNotFound(f"{bucket}/{key} (and no replication "
                                 "target holds it)")
 
@@ -182,7 +562,11 @@ class ReplicationPool:
         Progress (last enqueued key, counts) checkpoints to the sys
         volume every page, so a crash or restart resumes from the
         marker instead of starting over (the resync state-machine
-        role, cmd/bucket-replication.go resync status)."""
+        role, cmd/bucket-replication.go resync status).  In journal
+        mode the page's intents are fsynced to the journal BEFORE the
+        checkpoint counts them — a kill-9 can never strand a counted
+        key (the checkpoint used to lie: it counted keys the in-memory
+        queue then lost with the process)."""
         with self._resync_mu:
             t = self._resync_threads.get(bucket)
             if t is not None and t.is_alive():
@@ -215,9 +599,13 @@ class ReplicationPool:
                         return
                     if not page:
                         break
-                    for fi in page:
-                        if self.on_put(bucket, fi.name):
-                            state["queued"] += 1
+                    if self._jpath is not None:
+                        state["queued"] += self._enqueue_page(
+                            bucket, [fi.name for fi in page])
+                    else:
+                        for fi in page:
+                            if self.on_put(bucket, fi.name):
+                                state["queued"] += 1
                     marker = page[-1].name
                     state["last_key"] = marker
                     self._save_resync(bucket, state)
@@ -242,7 +630,7 @@ class ReplicationPool:
             pass
         return n
 
-    # -- worker --------------------------------------------------------------
+    # -- copy primitives -----------------------------------------------------
 
     def _set_source_status(self, bucket: str, key: str,
                            status: str) -> None:
@@ -257,25 +645,45 @@ class ReplicationPool:
         except StorageError:
             pass
 
-    def _replicate_put(self, bucket: str, key: str,
-                       rule: ReplicationRule) -> None:
+    def _replicate_put(self, bucket: str, key: str, tb: str,
+                       target) -> None:
         self._set_source_status(bucket, key, "PENDING")
         fi, data = self.source.get_object(bucket, key)
-        target = self._targets[(bucket, rule.target_bucket)]
         meta = {k: v for k, v in fi.metadata.items() if k != STATUS_KEY}
         meta[STATUS_KEY] = "REPLICA"
-        target.put_object(rule.target_bucket, key, data, metadata=meta)
+        kw = {}
+        if self._jpath is not None:
+            # Version fidelity: the replica lands under the SOURCE
+            # version id + mod time (the decom-mover discipline), so a
+            # replayed copy REPLACES rather than duplicates and the
+            # target's history matches byte-for-byte and id-for-id.
+            if fi.version_id:
+                kw["version_id"] = fi.version_id
+            if fi.mod_time_ns:
+                kw["mod_time_ns"] = fi.mod_time_ns
+        target.put_object(tb, key, data, metadata=meta, **kw)
+        crash_point("repl.post_copy")
         with self._stats_mu:
             self.bytes_replicated += len(data)
+        crash_point("repl.status")
         self._set_source_status(bucket, key, "COMPLETED")
 
-    def _replicate_delete(self, bucket: str, key: str,
-                          rule: ReplicationRule) -> None:
-        target = self._targets[(bucket, rule.target_bucket)]
+    def _replicate_delete(self, bucket: str, key: str, tb: str, target,
+                          delete_marker: bool = False) -> None:
         try:
-            target.delete_object(rule.target_bucket, key)
+            if self._jpath is not None and delete_marker:
+                # The source wrote a delete MARKER — the target must
+                # too (versioned delete), not hard-delete its latest
+                # version (the reference replicates the marker,
+                # cf. replicateDelete).
+                target.delete_object(tb, key, "", True)
+            else:
+                target.delete_object(tb, key)
+            crash_point("repl.post_copy")
         except StorageError:
             pass                                  # already absent: fine
+
+    # -- legacy oracle worker (MTPU_REPL_JOURNAL=0) --------------------------
 
     def _worker(self) -> None:
         while not self._stop.is_set():
@@ -289,9 +697,15 @@ class ReplicationPool:
             _qos.bg_pause("replication")
             try:
                 if op == "put":
-                    self._replicate_put(bucket, key, rule)
+                    self._replicate_put(bucket, key, rule.target_bucket,
+                                        self._targets[
+                                            (bucket, rule.target_bucket)])
                 else:
-                    self._replicate_delete(bucket, key, rule)
+                    self._replicate_delete(bucket, key,
+                                           rule.target_bucket,
+                                           self._targets[
+                                               (bucket,
+                                                rule.target_bucket)])
                 with self._stats_mu:
                     self.completed += 1
             except Exception:  # noqa: BLE001
@@ -302,24 +716,240 @@ class ReplicationPool:
             finally:
                 self._q.task_done()
 
+    # -- journaled worker ----------------------------------------------------
+
+    def _backoff(self, attempts: int) -> float:
+        base = min(self.max_interval,
+                   self.retry_interval * (2 ** min(attempts, 20)))
+        return base * (1.0 + 0.25 * self._rng.random())
+
+    def _next_task(self) -> dict | None:
+        """Pop the earliest due task into the in-flight set; block (on
+        the condition var) until one is due or the stop flag rises."""
+        with self._cv:
+            while not self._stop.is_set():
+                now = time.monotonic()
+                best_key, wait = None, 0.2
+                for tk, t in self._pending.items():
+                    dt = t["next_try"] - now
+                    if dt <= 0:
+                        best_key = tk
+                        break
+                    wait = min(wait, dt)
+                if best_key is not None:
+                    task = self._pending.pop(best_key)
+                    self._inflight[best_key] = task
+                    return task
+                self._cv.wait(timeout=wait)
+        return None
+
+    def _finish(self, task: dict, *, done: bool,
+                dropped: bool = False) -> None:
+        """Retire an in-flight task: journal its completion (unless a
+        NEWER enqueue of the same key superseded it mid-copy — then the
+        newer intent stays authoritative and keeps the backlog)."""
+        tk = _task_key(task["t"], task["b"], task["tb"], task["k"])
+        with self._cv:
+            self._inflight.pop(tk, None)
+            if done or dropped:
+                self._append_locked({"op": "done", "k": tk,
+                                     "seq": task["seq"]})
+            self._cv.notify()
+
+    def _requeue(self, task: dict, next_try: float) -> None:
+        """Put a failed/deferred task back — unless a newer enqueue of
+        the same key already replaced it (latest state wins)."""
+        tk = _task_key(task["t"], task["b"], task["tb"], task["k"])
+        with self._cv:
+            self._inflight.pop(tk, None)
+            if tk not in self._pending:
+                task["next_try"] = next_try
+                self._pending[tk] = task
+            self._cv.notify()
+
+    def _breaker_key(self, task: dict) -> tuple:
+        return (task["b"], task["tb"])
+
+    def _worker_journal(self) -> None:
+        while not self._stop.is_set():
+            task = self._next_task()
+            if task is None:
+                return
+            from ..server import qos as _qos
+            _qos.bg_pause("replication")
+            bucket, key, tb = task["b"], task["k"], task["tb"]
+            if bucket in self._tombstones:
+                # explicitly unwired (deregistered target / config
+                # removed): the journaled backlog drops with it
+                with self._stats_mu:
+                    self.dropped += 1
+                self._finish(task, done=False, dropped=True)
+                continue
+            bk = self._breaker_key(task)
+            br = self._breakers.get(bk)
+            now = time.monotonic()
+            if br is not None and br["open_until"] > now:
+                # breaker open: defer without burning an attempt — a
+                # dead target produces lag, never a retry hot-loop
+                self._requeue(task, br["open_until"]
+                              + 0.05 * self._rng.random())
+                continue
+            target = self._targets.get((bucket, tb))
+            if target is None:
+                # wiring not landed yet (boot replay runs before the
+                # server re-wires persisted configs): wait, don't drop
+                self._requeue(task, now + 0.5)
+                continue
+            try:
+                crash_point("repl.pre_copy")
+                if task["t"] == "delete":
+                    self._replicate_delete(bucket, key, tb, target,
+                                           delete_marker=bool(
+                                               task.get("dm")))
+                else:                      # "put" and "meta" both copy
+                    self._replicate_put(bucket, key, tb, target)
+            except (ErrObjectNotFound, ErrVersionNotFound):
+                # source version gone before the copy ran (deleted or
+                # superseded): nothing left to replicate
+                with self._stats_mu:
+                    self.dropped += 1
+                self._finish(task, done=False, dropped=True)
+                continue
+            except Exception:  # noqa: BLE001 — retry with backoff
+                with self._stats_mu:
+                    if task["attempts"] == 0:
+                        self.failed += 1
+                    else:
+                        self.retries += 1
+                if task["attempts"] == 0 and task["t"] != "delete":
+                    self._set_source_status(bucket, key, "FAILED")
+                br = self._breakers.setdefault(
+                    bk, {"fails": 0, "open_until": 0.0})
+                br["fails"] += 1
+                if br["fails"] >= self.breaker_fails:
+                    hold = min(self.breaker_max, 0.5 * (2 ** (
+                        br["fails"] - self.breaker_fails)))
+                    br["open_until"] = time.monotonic() + hold
+                task["attempts"] += 1
+                self._requeue(task, time.monotonic()
+                              + self._backoff(task["attempts"]))
+                continue
+            if br is not None:
+                br["fails"] = 0
+                br["open_until"] = 0.0
+            with self._stats_mu:
+                self.completed += 1
+            self._finish(task, done=True)
+
+    # -- stats / lifecycle ---------------------------------------------------
+
     def stats(self) -> dict:
         """Replication counters (the replication stats/bandwidth role,
         cmd/bucket-replication-stats.go)."""
+        if self._jpath is None:
+            return {"completed": self.completed, "failed": self.failed,
+                    "bytesReplicated": self.bytes_replicated,
+                    "queued": self._q.unfinished_tasks,
+                    "proxiedReads": self.proxied_reads}
+        with self._cv:
+            backlog = (list(self._pending.values())
+                       + list(self._inflight.values()))
+            now = time.time()
+            lag: dict[str, float] = {}
+            for t in backlog:
+                age = max(0.0, now - float(t.get("ts") or now))
+                lag[t["tb"]] = max(lag.get(t["tb"], 0.0), age)
+            mono = time.monotonic()
+            breakers = {f"{b}->{tb}": max(0.0, br["open_until"] - mono)
+                        for (b, tb), br in self._breakers.items()
+                        if br["open_until"] > mono}
+            queued = len(backlog)
         return {"completed": self.completed, "failed": self.failed,
                 "bytesReplicated": self.bytes_replicated,
-                "queued": self._q.unfinished_tasks}
+                "queued": queued, "retries": self.retries,
+                "dropped": self.dropped, "replayed": self.replayed,
+                "proxiedReads": self.proxied_reads,
+                "journalPending": queued,
+                "lagSeconds": {k: round(v, 3) for k, v in lag.items()},
+                "breakersOpen": {k: round(v, 3)
+                                 for k, v in breakers.items()}}
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
-        import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self._q.unfinished_tasks == 0:
-                return True
+            if self._jpath is None:
+                if self._q.unfinished_tasks == 0:
+                    return True
+            else:
+                with self._cv:
+                    if not self._pending and not self._inflight:
+                        return True
             time.sleep(0.05)
         return False
 
     def stop(self) -> None:
         self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._jpath is not None:
+            with self._cv:
+                self._checkpoint_locked()
+                if self._jf is not None:
+                    try:
+                        self._jf.close()
+                    except OSError:
+                        pass
+                    self._jf = None
+
+
+def adopt_orphan_journals(journal_path: str) -> int:
+    """Fold sibling repl journals whose writer is gone into
+    `journal_path` (same orphan rule as the MRF journal: worker ids
+    beyond the pool width, or the other process-topology's files) —
+    each orphan reduced to its NET pending set first, then appended as
+    plain enq records so its ckpt can't wipe the adopter's entries."""
+    home = os.path.dirname(journal_path)
+    me = os.path.basename(journal_path)
+    try:
+        names = sorted(os.listdir(home))
+    except OSError:
+        return 0
+    adopted = 0
+    width = int(os.environ.get("MTPU_WORKERS_TOTAL", "0") or 0)
+    for name in names:
+        if name == me or not name.startswith("repl-journal"):
+            continue
+        if not name.endswith(".jsonl"):
+            continue
+        if width:
+            m = name.removeprefix("repl-journal.").removesuffix(".jsonl")
+            if m.startswith("w"):
+                try:
+                    if int(m[1:]) < width:
+                        continue            # a live sibling owns it
+                except ValueError:
+                    pass
+        path = os.path.join(home, name)
+        try:
+            with open(path, "r", encoding="utf-8") as src:
+                pending = _net_pending(src.read())
+            with open(journal_path, "a", encoding="utf-8") as dst:
+                for it in pending.values():
+                    dst.write(json.dumps(
+                        {"op": "enq", "t": it["t"], "b": it["b"],
+                         "k": it["k"], "tb": it["tb"],
+                         "vid": it.get("vid", ""),
+                         "dm": int(it.get("dm", 0)),
+                         "ts": it.get("ts", 0.0),
+                         "seq": int(it.get("seq", 0))},
+                        separators=(",", ":")) + "\n")
+                dst.flush()
+                os.fsync(dst.fileno())
+            os.unlink(path)
+            adopted += 1
+        except OSError:
+            continue
+    return adopted
 
 
 # ---------------------------------------------------------------------------
@@ -361,14 +991,33 @@ def target_client(entry: dict):
                     # the remote: GET/HEAD report it, and the remote's
                     # own replication hooks suppress on it (loop guard)
                     headers[k] = v
+            if kw.get("version_id"):
+                # version-fidelity headers: honored by the remote only
+                # for principals holding s3:ReplicateObject (stripped
+                # otherwise, like the REPLICA marker)
+                headers[REPL_VID_HEADER] = kw["version_id"]
+            if kw.get("mod_time_ns"):
+                headers[REPL_MTIME_HEADER] = str(kw["mod_time_ns"])
             self.cli.put_object(bucket, key, bytes(data),
                                 headers=headers or None)
 
         def get_object(self, bucket, key, *a, **kw):
             return self.cli.get_object(bucket, key)
 
-        def delete_object(self, bucket, key, *a, **kw):
-            self.cli.delete_object(bucket, key)
+        def delete_object(self, bucket, key, version_id="",
+                          versioned=False):
+            # REPLICA-marked so an active-active peer does not bounce
+            # the delete back (the marker suppresses its on_delete);
+            # the remote bucket's own versioning state decides marker
+            # vs hard delete, exactly as a client DELETE would.
+            st, _, body = self.cli.request(
+                "DELETE", f"/{bucket}/{key}",
+                headers={"x-amz-replication-status": "REPLICA"})
+            if st not in (200, 204):
+                from ..server.client import S3ClientError
+                raise S3ClientError(st, "DeleteFailed",
+                                    body[:200].decode("utf-8",
+                                                      "replace"))
 
         def head_object(self, bucket, key, *a, **kw):
             return self.cli.head_object(bucket, key)

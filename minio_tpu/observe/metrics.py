@@ -1159,6 +1159,42 @@ class MetricsRegistry:
         self.ilm_orphans_reaped = Gauge(
             "mtpu_ilm_orphans_reaped_total",
             "Orphaned tier objects reaped via the journal")
+        # Bucket replication families (bucket/replication.py; cf.
+        # getReplicationSiteMetrics, cmd/metrics-v2.go replication).
+        self.repl_queued = Gauge(
+            "mtpu_repl_queued",
+            "Replication tasks in backlog or in flight (drains to 0)")
+        self.repl_completed = Gauge(
+            "mtpu_repl_completed_total",
+            "Replication tasks copied to their target")
+        self.repl_failed = Gauge(
+            "mtpu_repl_failed_total",
+            "Replication tasks whose FIRST attempt failed")
+        self.repl_retries = Gauge(
+            "mtpu_repl_retries_total",
+            "Replication re-attempts after a failed first try")
+        self.repl_dropped = Gauge(
+            "mtpu_repl_dropped_total",
+            "Journaled tasks dropped (bucket unwired / source gone)")
+        self.repl_bytes = Gauge(
+            "mtpu_repl_bytes_total",
+            "Bytes copied to replication targets")
+        self.repl_proxied = Gauge(
+            "mtpu_repl_proxied_reads_total",
+            "GETs served by proxying to a replication target")
+        self.repl_journal_pending = Gauge(
+            "mtpu_repl_journal_pending",
+            "Intent-journal records awaiting completion (drains to 0)")
+        self.repl_journal_replayed = Gauge(
+            "mtpu_repl_journal_replayed_total",
+            "Intents restored into the backlog by boot replay")
+        self.repl_lag = Gauge(
+            "mtpu_repl_lag_seconds",
+            "Age of the oldest unreplicated task per target bucket",
+            ("target",))
+        self.repl_breaker_open = Gauge(
+            "mtpu_repl_breaker_open",
+            "Per-target breakers currently open (target unreachable)")
         self.tier_objects = Gauge(
             "mtpu_tier_objects",
             "Objects currently resident in the warm tier", ("tier",))
@@ -1355,6 +1391,31 @@ class MetricsRegistry:
         for tname, usage in st["tiers"].items():
             self.tier_objects.set(usage["objects"], tier=tname)
             self.tier_bytes.set(usage["bytes"], tier=tname)
+
+    def update_replication(self, repl) -> None:
+        """Refresh replication gauges from ReplicationPool.stats()
+        (scrape time; the legacy oracle reports its smaller dict and
+        the journal-only gauges stay 0)."""
+        if repl is None:
+            return
+        st = repl.stats()
+        self.repl_queued.set(st.get("queued", 0))
+        self.repl_completed.set(st.get("completed", 0))
+        self.repl_failed.set(st.get("failed", 0))
+        self.repl_retries.set(st.get("retries", 0))
+        self.repl_dropped.set(st.get("dropped", 0))
+        self.repl_bytes.set(st.get("bytesReplicated", 0))
+        self.repl_proxied.set(st.get("proxiedReads", 0))
+        self.repl_journal_pending.set(st.get("journalPending", 0))
+        self.repl_journal_replayed.set(st.get("replayed", 0))
+        lag = st.get("lagSeconds") or {}
+        # a drained target's lag pins to 0 (stale label values would
+        # otherwise report the last backlog age forever)
+        for tb in getattr(self, "_repl_lag_seen", set()) | set(lag):
+            self.repl_lag.set(lag.get(tb, 0.0), target=tb)
+        self._repl_lag_seen = set(lag) | getattr(
+            self, "_repl_lag_seen", set())
+        self.repl_breaker_open.set(len(st.get("breakersOpen") or {}))
 
     def update_cluster(self, pools, scanner=None, tier_mgr=None) -> None:
         self.update_ilm(tier_mgr)

@@ -317,7 +317,13 @@ def main(argv: list[str] | None = None) -> int:
     from ..bucket.replication import ReplicationPool
     from ..iam.iam import IAMSys
     iam = IAMSys(pools)
+    # Replication journal replays BEFORE traffic — intents a kill-9
+    # stranded re-enter the backlog here and drain once the persisted
+    # bucket configs re-wire their targets.
     replication = ReplicationPool(pools)
+    if replication.replayed:
+        print(f"minio_tpu: replication journal: replayed "
+              f"{replication.replayed} pending task(s)", flush=True)
     # Perpetual scanner lifecycle: an idle server crawls, accounts
     # usage, heals missing metadata, and bitrot-verifies every
     # deep_every-th cycle (cf. initDataScanner, cmd/server-main.go:441).

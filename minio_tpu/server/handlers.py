@@ -18,6 +18,7 @@ import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 
+from ..bucket.replication import ErrReplicationTargetDown
 from ..engine.pools import ServerPools
 from ..observe.span import span as _span
 from ..storage.errors import ErrObjectNotFound, StorageError
@@ -179,6 +180,12 @@ class S3Handlers:
             return None
         try:
             meta, data = self.replication.proxy_get(bucket, key)
+        except ErrReplicationTargetDown as e:
+            # The target might hold this key but cannot be reached — a
+            # 404 here would lie to the client ("does not exist") when
+            # the truth is "cannot know right now": surface 503.
+            raise S3Error("ReplicationRemoteConnectionError",
+                          str(e)) from None
         except StorageError:
             return None
         if sse.is_encrypted(meta):
@@ -1018,6 +1025,20 @@ class S3Handlers:
         is_replica = h.get("x-amz-replication-status") == "REPLICA"
         if is_replica:
             metadata["x-amz-replication-status"] = "REPLICA"
+        # Version fidelity: a replica PUT lands under the SOURCE
+        # version id + mod time so the two clusters' histories match
+        # id-for-id and a replayed copy REPLACES instead of
+        # duplicating. The server strips these headers from any
+        # principal without s3:ReplicateObject, like the REPLICA
+        # marker itself.
+        replica_vid = h.get("x-mtpu-repl-version-id", "") \
+            if is_replica else ""
+        replica_mtime = 0
+        if is_replica and h.get("x-mtpu-repl-mtime"):
+            try:
+                replica_mtime = int(h["x-mtpu-repl-mtime"])
+            except ValueError:
+                replica_mtime = 0
         parity = self._parity_for_request(h, metadata)
 
         # Quota enforcement (cf. enforceBucketQuotaHard,
@@ -1092,12 +1113,17 @@ class S3Handlers:
             transform_meta[self.CLIENT_SIZE_KEY] = str(len(body))
             metadata.update(transform_meta)
 
+        put_kw = {}
+        if replica_vid and versioned:
+            put_kw["version_id"] = replica_vid
+        if replica_mtime:
+            put_kw["mod_time_ns"] = replica_mtime
         try:
             with _span("engine.put_object"):
                 fi = self.pools.put_object(bucket, key, stored,
                                            metadata=metadata,
                                            versioned=versioned,
-                                           parity=parity)
+                                           parity=parity, **put_kw)
         except StorageError as e:
             raise from_storage_error(e) from None
         if replaced_tiered:
@@ -1107,7 +1133,8 @@ class S3Handlers:
                             size=self._logical_size(fi), etag=etag,
                             version_id=fi.version_id)
         if self.replication is not None and not is_replica:
-            self.replication.on_put(bucket, key)
+            self.replication.on_put(bucket, key,
+                                    version_id=fi.version_id or "")
         resp_headers = {"ETag": f'"{etag}"'}
         if fi.version_id:
             resp_headers["x-amz-version-id"] = fi.version_id
@@ -1263,9 +1290,19 @@ class S3Handlers:
             version_id=version_id)
         # Only a delete of the CURRENT object propagates to replication
         # targets; removing a specific noncurrent version must not take
-        # down the target's live copy.
-        if self.replication is not None and not version_id:
-            self.replication.on_delete(bucket, key)
+        # down the target's live copy. A REPLICA-marked delete (sent by
+        # a peer's replication worker — the marker is stripped from
+        # anyone without s3:ReplicateObject) must not bounce back:
+        # active-active delete loop guard, same as the PUT path.
+        is_replica_del = (hl.get("x-amz-replication-status")
+                          == "REPLICA")
+        if self.replication is not None and not version_id \
+                and not is_replica_del:
+            self.replication.on_delete(
+                bucket, key,
+                version_id=(dm.version_id or "") if dm is not None
+                else "",
+                delete_marker=dm is not None)
         h = {}
         if dm is not None and dm.version_id:
             h = {"x-amz-version-id": dm.version_id,
@@ -1389,6 +1426,12 @@ class S3Handlers:
             self.pools.update_object_metadata(bucket, key, fi)
         except StorageError as e:
             raise from_storage_error(e) from None
+        # Metadata-change re-replication (tags/retention/legal-hold,
+        # cf. replicateMetadata): the target's copy must pick up the
+        # new metadata. Replicas never re-replicate (loop guard).
+        if (self.replication is not None
+                and meta.get("x-amz-replication-status") != "REPLICA"):
+            self.replication.on_metadata(bucket, key)
 
     def delete_objects(self, bucket: str, body: bytes,
                        can_delete=None) -> Response:

@@ -820,6 +820,15 @@ class S3Server:
                     s.stop()
                 except Exception:  # noqa: BLE001
                     pass
+        # Replication: compact the intent journal so the next boot
+        # replays a checkpoint instead of the whole tail.  NOT stop()
+        # — a service RESTART reuses this pool and its workers.
+        rp = getattr(self.handlers, "replication", None)
+        if rp is not None:
+            try:
+                rp.checkpoint()
+            except Exception:  # noqa: BLE001
+                pass
         # MRF: persist pending heals so the next boot replays them.
         seen: set[int] = set()
         if self.pools is not None:
@@ -1140,6 +1149,9 @@ class S3Server:
         "service": "admin:ServiceRestart",
         "tier": "admin:SetTier",
         "ilm": "admin:SetTier",
+        # replication diagnostics + resync trigger (cf.
+        # ReplicationDiag / SetBucketTarget admin actions)
+        "replication": "admin:SetBucketTarget",
         "inspect": "admin:InspectData",
         "kms": "admin:KMSKeyStatus",
         "top": "admin:ServerTrace",
@@ -1870,6 +1882,30 @@ class S3Server:
                     from .api_errors import from_storage_error as _fse
                     raise _fse(e) from None
                 return j({"transitioned": bool(moved)})
+        if sub == "replication":
+            # Replication plane: GET = pool stats (+ per-bucket resync
+            # status with ?bucket=); POST op=resync starts/resumes a
+            # bucket resync — the deterministic trigger the matrices
+            # and bench drive (cf. ReplicationResync admin API).
+            rp = self.handlers.replication
+            if rp is None:
+                return j({"error": "replication not enabled"}, 501)
+            if method == "GET":
+                out = rp.stats()
+                bkt = query.get("bucket", [""])[0]
+                if bkt:
+                    out = dict(out)
+                    out["resync"] = rp.resync_status(bkt)
+                return j(out)
+            if method == "POST":
+                req_obj = _json.loads(body or b"{}")
+                if req_obj.get("op") == "resync":
+                    bkt = req_obj.get("bucket")
+                    if not bkt:
+                        raise S3Error("InvalidArgument",
+                                      "bucket required")
+                    return j(rp.start_resync(bkt))
+                raise S3Error("InvalidArgument", "unknown op")
         if sub.startswith("inspect") and method == "GET":
             # Raw per-drive metadata download for debugging
             # (cf. InspectDataHandler, cmd/admin-handlers.go).
@@ -2346,6 +2382,8 @@ class S3Server:
         self.metrics.update_audit(self.audit_targets)
         self.metrics.update_qos(self.qos if _qos.qos_enabled()
                                 else None)
+        self.metrics.update_replication(
+            self.handlers.replication if self.handlers else None)
         text = self.metrics.render()
         if self.worker_plane is not None:
             # Pool aggregates live in shared slabs, so WHICHEVER
@@ -2450,6 +2488,9 @@ class S3Server:
             "h2d": h2d_row,
             "ilm": (self.handlers.tier_mgr.stats()
                     if self.handlers.tier_mgr is not None else None),
+            "replication": (self.handlers.replication.stats()
+                            if self.handlers.replication is not None
+                            else None),
             "audit": [t.stats() for t in self.audit_targets],
             "slo": (self.metrics.last_minute.snapshot()
                     if self.slo_enabled else {}),
@@ -2541,9 +2582,11 @@ class S3Server:
         # (the reference strips this internal header the same way,
         # gated on ReplicateObjectAction). Must happen BEFORE the
         # header dict below is captured for the handlers.
-        if (req.headers.get("x-amz-replication-status")
-                and not self._may_replicate(access_key)):
-            del req.headers["x-amz-replication-status"]
+        if not self._may_replicate(access_key):
+            for hk in ("x-amz-replication-status",
+                       "x-mtpu-repl-version-id", "x-mtpu-repl-mtime"):
+                if req.headers.get(hk):
+                    del req.headers[hk]
         headers = {k: v for k, v in req.headers.items()}
 
         if path.startswith("/minio/admin/"):

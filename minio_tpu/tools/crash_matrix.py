@@ -822,3 +822,419 @@ def run_decom_matrix(scenarios=DECOM_SCENARIOS,
             progress(f"[{i + 1}/{len(scenarios)}] "
                      f"{sc['point']}:{sc['nth']} (decom) {mark}")
     return results
+
+# ---------------------------------------------------------------------------
+# Replication kill-9 matrix: one row per repl.* crash point.  Each
+# scenario runs a PERSISTENT target server plus a source server driven
+# through the three-boot discipline: kill -9 the source inside the
+# replication journal's exactly-once window, reboot, let the journal
+# replay and the persisted bucket config re-wire, and assert the
+# zero-loss contract — the victim converges on the target byte-exact
+# at the SAME ETag and version id, as exactly ONE version (a replayed
+# copy REPLACES, never duplicates), with the backlog drained to zero.
+# ---------------------------------------------------------------------------
+
+REPL_SCENARIOS = (
+    {"point": "repl.enqueue", "nth": 1},     # intent fsynced, unranked
+    {"point": "repl.pre_copy", "nth": 1},    # dequeued, copy not started
+    {"point": "repl.post_copy", "nth": 1},   # replica landed, done not journaled
+    {"point": "repl.status", "nth": 1},      # COMPLETED stamp pending
+)
+
+REPL_DST = BUCKET + "-dst"
+REPL_DRAIN_DEADLINE_S = 90.0
+REPL_RESYNC_KEYS = 2000
+
+REPL_XML = f"""<ReplicationConfiguration>
+<Rule><ID>r1</ID><Status>Enabled</Status><Priority>1</Priority>
+<DeleteMarkerReplication><Status>Enabled</Status>
+</DeleteMarkerReplication>
+<Filter><Prefix></Prefix></Filter>
+<Destination><Bucket>arn:aws:s3:::{REPL_DST}</Bucket></Destination>
+</Rule></ReplicationConfiguration>"""
+
+
+def _repl_wire(cli, tgt_endpoint: str) -> None:
+    """Register the remote target + PUT the replication config on the
+    source (both persist in bucket metadata and re-wire at boot)."""
+    import json
+    st, _, body = cli.request(
+        "POST", "/minio/admin/v3/bucket-remote",
+        query={"bucket": BUCKET},
+        body=json.dumps({"endpoint": tgt_endpoint,
+                         "accessKey": "minioadmin",
+                         "secretKey": "minioadmin",
+                         "targetBucket": REPL_DST}).encode())
+    if st != 200:
+        raise ScenarioError(
+            f"bucket-remote registration -> {st}: {body[:200]!r}")
+    st, _, body = cli.request("PUT", f"/{BUCKET}",
+                              query={"replication": ""},
+                              body=REPL_XML.encode())
+    if st != 200:
+        raise ScenarioError(
+            f"replication config PUT -> {st}: {body[:200]!r}")
+
+
+def _wait_repl_drained(cli, deadline_s: float = REPL_DRAIN_DEADLINE_S
+                       ) -> dict:
+    deadline = time.monotonic() + deadline_s
+    st = {}
+    while time.monotonic() < deadline:
+        st = _retry(lambda: _admin(cli, "GET", "replication"))
+        if st.get("queued") == 0:
+            return st
+        time.sleep(0.2)
+    raise ScenarioError(
+        f"replication backlog never drained: queued={st.get('queued')}"
+        f" failed={st.get('failed')} retries={st.get('retries')}")
+
+
+def _head_meta(cli, bucket: str, key: str) -> tuple[str, str]:
+    """(etag, version_id) from a HEAD — '' when absent."""
+    status, h, _ = cli.request("HEAD", f"/{bucket}/{key}")
+    if status != 200:
+        return "", ""
+    etag = h.get("ETag") or h.get("etag") or ""
+    vid = h.get("x-amz-version-id") or h.get("X-Amz-Version-Id") or ""
+    return etag, vid
+
+
+def _wait_target_identity(scli, tcli, key: str, data: bytes,
+                          deadline_s: float = REPL_DRAIN_DEADLINE_S
+                          ) -> None:
+    """Poll the target until `key` reads back byte-exact, then assert
+    ETag + version-id identity with the source and exactly ONE version
+    on the target (replayed copies must replace, not duplicate)."""
+    deadline = time.monotonic() + deadline_s
+    got = None
+    while time.monotonic() < deadline:
+        try:
+            got = tcli.get_object(REPL_DST, key)
+            if got == data:
+                break
+        except Exception:  # noqa: BLE001 — not replicated yet
+            pass
+        time.sleep(0.2)
+    if got != data:
+        raise ScenarioError(
+            f"{key}: target never converged "
+            f"({'absent' if got is None else len(got)} vs "
+            f"{len(data)} bytes)")
+    setag, svid = _head_meta(scli, BUCKET, key)
+    tetag, tvid = _head_meta(tcli, REPL_DST, key)
+    if tetag != setag:
+        raise ScenarioError(
+            f"{key}: ETag diverged across replication "
+            f"({tetag!r} vs {setag!r})")
+    if svid and tvid != svid:
+        raise ScenarioError(
+            f"{key}: version id diverged ({tvid!r} vs {svid!r})")
+    _, _, body = tcli.request("GET", f"/{REPL_DST}",
+                              query={"versions": ""})
+    n = body.count(f"<Key>{key}</Key>".encode())
+    if n != 1:
+        raise ScenarioError(
+            f"{key}: {n} versions on target after replay "
+            f"(replayed copy duplicated)")
+
+
+def run_repl_scenario(sc: dict, base_dir: str, seed: int = 0,
+                      extra_env: dict | None = None) -> dict:
+    """Kill-9 the source inside an armed repl.* window while a target
+    server stays up, reboot, journal replays, assert zero loss:
+
+      boot A  (unarmed)  wire replication source->target, write acked
+              baselines, wait for them to land on the target, SIGKILL;
+      boot B  (armed)    PUT the victim; the journal intent fsyncs and
+              the worker (or the enqueue itself) trips the point ->
+              os._exit(137) — the write is durable locally either way;
+      boot C  (unarmed)  replay + re-wire from persisted config; the
+              victim converges on the target byte-exact at the same
+              ETag/version id as exactly one version, the backlog
+              drains to zero, and the source stamps COMPLETED.
+    """
+    src_dir = os.path.join(base_dir, "src")
+    tgt_dir = os.path.join(base_dir, "tgt")
+    os.makedirs(src_dir, exist_ok=True)
+    os.makedirs(tgt_dir, exist_ok=True)
+    point, nth = sc["point"], sc["nth"]
+    res = {"point": point, "nth": nth, "op": "repl", "seed": seed}
+    baseline = {"b-one": _payload(seed * 17 + 1, 32 * 1024),
+                "b-two": _payload(seed * 17 + 2, 200 * 1024)}
+    vbytes = _payload(seed * 17 + 3, 128 * 1024)
+
+    # -- persistent target: up across all three source boots ----------------
+    tport = free_port()
+    tproc = boot_server(tgt_dir, tport, extra_env=extra_env)
+    try:
+        if not wait_ready(tport, tproc):
+            raise ScenarioError(f"{point}: target never became ready")
+        tcli = make_client(tport)
+        _retry(lambda: tcli.make_bucket(REPL_DST))
+        _retry(lambda: tcli.set_versioning(REPL_DST, True))
+
+        # -- boot A: wire + acked baselines, then kill -9 -------------------
+        port = free_port()
+        proc = boot_server(src_dir, port, extra_env=extra_env)
+        try:
+            if not wait_ready(port, proc):
+                raise ScenarioError(f"{point}: boot A never ready")
+            cli = make_client(port)
+            _retry(lambda: cli.make_bucket(BUCKET))
+            _retry(lambda: cli.set_versioning(BUCKET, True))
+            _retry(lambda: _repl_wire(cli, f"http://127.0.0.1:{tport}"))
+            for key, val in baseline.items():
+                _retry(lambda k=key, v=val: cli.put_object(BUCKET, k, v))
+            _wait_repl_drained(cli)
+            for key, val in baseline.items():
+                _wait_target_identity(cli, tcli, key, val)
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+
+        # -- boot B: armed, victim PUT dies inside the repl window ----------
+        port = free_port()
+        proc = boot_server(src_dir, port, crash=f"{point}:{nth}",
+                           extra_env=extra_env)
+        try:
+            if not wait_ready(port, proc):
+                raise ScenarioError(
+                    f"{point}:{nth}: boot B died before the victim op "
+                    f"(a boot-path enqueue tripped the point)")
+            cli = make_client(port)
+            try:
+                cli.put_object(BUCKET, "victim", vbytes)
+                # post-ack points race the response out before _exit
+            except Exception:  # noqa: BLE001 — died mid-request
+                pass
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        if proc.returncode != 137:
+            raise ScenarioError(
+                f"{point}:{nth}: boot B exit {proc.returncode}, wanted "
+                f"137 (crash point never fired?)")
+
+        # -- boot C: replay + convergence assertions ------------------------
+        port = free_port()
+        proc = boot_server(src_dir, port, extra_env=extra_env)
+        try:
+            if not wait_ready(port, proc):
+                raise ScenarioError(f"{point}: recovery never ready")
+            cli = make_client(port)
+            got = _retry(lambda: cli.get_object(BUCKET, "victim"))
+            if got != vbytes:
+                raise ScenarioError(
+                    f"{point}: locally durable victim lost/torn "
+                    f"({len(got)} vs {len(vbytes)} bytes)")
+            st = _wait_repl_drained(cli)
+            res["replayed"] = st.get("replayed")
+            _wait_target_identity(cli, tcli, "victim", vbytes)
+            for key, val in baseline.items():
+                _wait_target_identity(cli, tcli, key, val)
+            # Source stamp resolves to COMPLETED (never stuck PENDING).
+            deadline = time.monotonic() + 30
+            status = ""
+            while time.monotonic() < deadline:
+                h = _retry(lambda: cli.head_object(BUCKET, "victim"))
+                status = h.get("x-amz-replication-status") or ""
+                if status == "COMPLETED":
+                    break
+                time.sleep(0.2)
+            if status != "COMPLETED":
+                raise ScenarioError(
+                    f"{point}: source status {status!r} after drain, "
+                    f"wanted COMPLETED")
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+            if proc.returncode != 0:
+                raise ScenarioError(
+                    f"{point}: graceful exit returned {proc.returncode}")
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    finally:
+        tproc.kill()
+        tproc.wait(timeout=30)
+    res["ok"] = True
+    return res
+
+
+def run_repl_resync_scenario(base_dir: str, seed: int = 0,
+                             n_keys: int = REPL_RESYNC_KEYS,
+                             extra_env: dict | None = None) -> dict:
+    """Kill-9 a multi-thousand-object bucket resync mid-flight and
+    prove it resumes to byte-identity.
+
+      boot A  (unarmed)  load n_keys objects with NO replication
+              configured (nothing mirrors on PUT), SIGKILL;
+      boot B  (armed repl.enqueue:n/2+...)  wire replication, POST
+              op=resync; the resync journals page after page until the
+              armed enqueue kills it mid-page -> 137.  Every key the
+              resync CHECKPOINT counted is already in the journal (the
+              old code counted keys the in-memory queue then lost);
+      boot C  (unarmed)  replay restores the journaled backlog; a
+              second op=resync resumes from the persisted marker; the
+              backlog drains and EVERY key lands on the target
+              byte-exact (spot-checked) with none missing.
+    """
+    src_dir = os.path.join(base_dir, "src")
+    tgt_dir = os.path.join(base_dir, "tgt")
+    os.makedirs(src_dir, exist_ok=True)
+    os.makedirs(tgt_dir, exist_ok=True)
+    res = {"point": "repl.enqueue", "nth": n_keys // 2 + n_keys // 4,
+           "op": "repl_resync", "seed": seed, "keys": n_keys}
+    keys = [f"o{i:05d}" for i in range(n_keys)]
+
+    def body_of(i: int) -> bytes:
+        return _payload(seed * 19 + i, 1024)
+
+    tport = free_port()
+    tproc = boot_server(tgt_dir, tport, extra_env=extra_env)
+    try:
+        if not wait_ready(tport, tproc):
+            raise ScenarioError("resync: target never became ready")
+        tcli = make_client(tport)
+        _retry(lambda: tcli.make_bucket(REPL_DST))
+
+        # -- boot A: bulk load, no replication yet, kill -9 -----------------
+        port = free_port()
+        proc = boot_server(src_dir, port, extra_env=extra_env)
+        try:
+            if not wait_ready(port, proc):
+                raise ScenarioError("resync: boot A never ready")
+            cli = make_client(port)
+            _retry(lambda: cli.make_bucket(BUCKET))
+            for i, key in enumerate(keys):
+                _retry(lambda k=key, i=i: cli.put_object(
+                    BUCKET, k, body_of(i)))
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+
+        # -- boot B: wire + resync, die mid-resync at an armed enqueue ------
+        port = free_port()
+        proc = boot_server(src_dir, port,
+                           crash=f"repl.enqueue:{res['nth']}",
+                           extra_env=extra_env)
+        try:
+            if not wait_ready(port, proc):
+                raise ScenarioError("resync: boot B never ready")
+            cli = make_client(port)
+            _retry(lambda: _repl_wire(cli, f"http://127.0.0.1:{tport}"))
+            try:
+                _admin_post(cli, "replication",
+                            {"op": "resync", "bucket": BUCKET})
+            except Exception:  # noqa: BLE001 — may die under the call
+                pass
+            proc.wait(timeout=300)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        if proc.returncode != 137:
+            raise ScenarioError(
+                f"resync: boot B exit {proc.returncode}, wanted 137 "
+                f"(crash point never fired?)")
+
+        # -- boot C: replay + resume; converge to byte-identity -------------
+        port = free_port()
+        proc = boot_server(src_dir, port, extra_env=extra_env)
+        try:
+            if not wait_ready(port, proc):
+                raise ScenarioError("resync: recovery never ready")
+            cli = make_client(port)
+            st0 = _retry(lambda: _admin(cli, "GET", "replication"))
+            res["replayed"] = st0.get("replayed")
+            if not st0.get("replayed"):
+                raise ScenarioError(
+                    "resync: nothing replayed from the journal after a "
+                    "mid-resync kill (the checkpoint lied)")
+            _retry(lambda: _admin_post(cli, "replication",
+                                       {"op": "resync",
+                                        "bucket": BUCKET}))
+            deadline = time.monotonic() + 600
+            rst = {}
+            while time.monotonic() < deadline:
+                rst = _retry(lambda: _admin(cli, "GET", "replication",
+                                            {"bucket": BUCKET}))
+                if (rst.get("queued") == 0
+                        and (rst.get("resync") or {}).get("status")
+                        == "done"):
+                    break
+                time.sleep(0.5)
+            if rst.get("queued") != 0 \
+                    or (rst.get("resync") or {}).get("status") != "done":
+                raise ScenarioError(
+                    f"resync: never converged: queued="
+                    f"{rst.get('queued')} resync={rst.get('resync')}")
+            # Every key present on the target; a sample byte-compared.
+            missing = []
+            for key in keys:
+                status, _, _ = tcli.request("HEAD",
+                                            f"/{REPL_DST}/{key}")
+                if status != 200:
+                    missing.append(key)
+            if missing:
+                raise ScenarioError(
+                    f"resync: {len(missing)} key(s) never replicated "
+                    f"(first: {missing[:5]})")
+            rng = random.Random(seed * 19 + 999)
+            for i in rng.sample(range(n_keys), min(50, n_keys)):
+                got = tcli.get_object(REPL_DST, keys[i])
+                if got != body_of(i):
+                    raise ScenarioError(
+                        f"resync: {keys[i]} corrupt on target "
+                        f"({len(got)} vs 1024 bytes)")
+            res["resync_queued"] = (rst.get("resync") or {}).get(
+                "queued")
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+            if proc.returncode != 0:
+                raise ScenarioError(
+                    f"resync: graceful exit returned {proc.returncode}")
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    finally:
+        tproc.kill()
+        tproc.wait(timeout=30)
+    res["ok"] = True
+    return res
+
+
+def run_repl_matrix(scenarios=REPL_SCENARIOS,
+                    base_dir: str | None = None, seed: int = 0,
+                    progress=None, resync: bool = True) -> list[dict]:
+    import tempfile
+    root = base_dir or tempfile.mkdtemp(prefix="mtpu-repl-")
+    results = []
+    for i, sc in enumerate(scenarios):
+        d = os.path.join(root, f"rp{i}-{sc['point'].replace('.', '_')}")
+        try:
+            r = run_repl_scenario(sc, d, seed=seed)
+        except ScenarioError as e:
+            r = {**sc, "ok": False, "error": str(e)}
+        results.append(r)
+        if progress is not None:
+            mark = "ok" if r.get("ok") else f"FAIL: {r.get('error')}"
+            progress(f"[{i + 1}/{len(scenarios)}] "
+                     f"{sc['point']}:{sc['nth']} (repl) {mark}")
+    if resync:
+        d = os.path.join(root, "rp-resync")
+        try:
+            r = run_repl_resync_scenario(d, seed=seed)
+        except ScenarioError as e:
+            r = {"point": "repl.enqueue", "op": "repl_resync",
+                 "ok": False, "error": str(e)}
+        results.append(r)
+        if progress is not None:
+            mark = "ok" if r.get("ok") else f"FAIL: {r.get('error')}"
+            progress(f"[resync] repl.enqueue mid-resync "
+                     f"({r.get('keys', REPL_RESYNC_KEYS)} keys) {mark}")
+    return results

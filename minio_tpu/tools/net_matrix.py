@@ -33,6 +33,7 @@ Invariants asserted per scenario (the acceptance bar of the ISSUE):
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import socket
@@ -393,6 +394,293 @@ def run_matrix(scenarios=None, seed: int = 0, root: str | None = None,
             return results
         finally:
             nc.close()
+    finally:
+        if saved_scanner is None:
+            os.environ.pop("MTPU_SCANNER", None)
+        else:
+            os.environ["MTPU_SCANNER"] = saved_scanner
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Replication partition matrix: TWO clusters, the target behind the proxy
+# ---------------------------------------------------------------------------
+#
+# Where the matrix above partitions peers INSIDE one cluster, this one
+# partitions the wire BETWEEN two clusters mid-replication: a source
+# server with a journaled ReplicationPool, a live target server, and a
+# single ChaosTCPProxy on the registered remote endpoint.  Client
+# traffic to the source rides the clean loopback — only the replication
+# plane is under fire.  The acceptance bar per scenario:
+#
+#   - the source keeps ACKING writes while the target is dark
+#   - the backlog is observable: admin stats report queued tasks and
+#     per-target lag, and /minio/v2/metrics/node exports
+#     mtpu_repl_lag_seconds > 0 during the partition
+#   - retries are BOUNDED (capped backoff + breaker — no hot loop)
+#   - after heal() every acked write converges byte-exact on the
+#     target and the journal drains to zero
+
+REPL_NET_SCENARIOS = (
+    {"name": "repl-blackhole-mid-replication", "phase": "replication"},
+    {"name": "repl-blackhole-mid-resync",      "phase": "resync"},
+    {"name": "repl-chaos-storm",               "phase": "storm"},
+)
+
+_REPL_XML = """<ReplicationConfiguration>
+<Rule><ID>net</ID><Status>Enabled</Status><Priority>1</Priority>
+<DeleteMarkerReplication><Status>Enabled</Status>
+</DeleteMarkerReplication>
+<Filter><Prefix></Prefix></Filter>
+<Destination><Bucket>arn:aws:s3:::{dst}</Bucket></Destination>
+</Rule></ReplicationConfiguration>"""
+
+
+class ReplPair:
+    """Source cluster (journaled ReplicationPool) + target cluster,
+    with the registered remote endpoint routed THROUGH a chaos proxy.
+
+    hold_s is short (1.5s, not the 30s default): a black-holed copy
+    attempt should fail in seconds so the retry/backoff machinery is
+    what the scenario observes, not one wedged socket."""
+
+    def __init__(self, root: str, seed: int = 0):
+        from ..bucket.replication import ReplicationPool
+        from ..engine.pools import ServerPools
+        from ..engine.sets import ErasureSets
+        from ..server.client import S3Client
+        from ..server.server import S3Server
+        from ..server.sigv4 import Credentials
+        from ..storage.drive import LocalDrive
+
+        creds = Credentials("minioadmin", "minioadmin")
+        self.src_pools = ServerPools([ErasureSets(
+            [LocalDrive(f"{root}/src-d{i}") for i in range(4)],
+            set_drive_count=4)])
+        self.repl = ReplicationPool(self.src_pools)
+        self.src_srv = S3Server(self.src_pools, creds,
+                                replication=self.repl).start()
+        self.dst_pools = ServerPools([ErasureSets(
+            [LocalDrive(f"{root}/dst-d{i}") for i in range(4)],
+            set_drive_count=4)])
+        self.dst_srv = S3Server(self.dst_pools, creds).start()
+        self.proxy = ChaosTCPProxy("127.0.0.1", self.dst_srv.port,
+                                   hold_s=1.5, seed=seed).start()
+        self.scli = S3Client(self.src_srv.endpoint,
+                             "minioadmin", "minioadmin")
+        self.dcli = S3Client(self.dst_srv.endpoint,
+                             "minioadmin", "minioadmin")
+
+    def wire(self, bucket: str, dst_bucket: str) -> None:
+        """Register the PROXIED endpoint as the remote target and put
+        the replication config — the production admin path, so a heal
+        exercises exactly what an operator would have wired."""
+        st, _, body = self.scli.request(
+            "POST", "/minio/admin/v3/bucket-remote",
+            query={"bucket": bucket},
+            body=json.dumps({
+                "endpoint": f"http://127.0.0.1:{self.proxy.port}",
+                "accessKey": "minioadmin", "secretKey": "minioadmin",
+                "targetBucket": dst_bucket}).encode())
+        if st != 200:
+            raise RuntimeError(f"bucket-remote: {st} {body!r}")
+        st, _, body = self.scli.request(
+            "PUT", f"/{bucket}", query={"replication": ""},
+            body=_REPL_XML.format(dst=dst_bucket).encode())
+        if st != 200:
+            raise RuntimeError(f"put replication config: {st} {body!r}")
+
+    def scrape(self) -> str:
+        st, _, body = self.scli.request(
+            "GET", "/minio/v2/metrics/node")
+        return body.decode() if st == 200 else ""
+
+    def close(self) -> None:
+        try:
+            self.repl.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        for srv in (self.src_srv, self.dst_srv):
+            try:
+                srv.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        self.proxy.stop()
+
+
+def _repl_wait(pred, timeout: float, step: float = 0.2) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _repl_queued(pair: ReplPair) -> int:
+    return int(pair.repl.stats().get("queued", 0))
+
+
+def _repl_converge(pair: ReplPair, dst_bucket: str, acked: dict,
+                   errors: list, timeout: float = 120.0) -> None:
+    """Post-heal bar: journal drains to zero and every acked write is
+    byte-exact on the target."""
+    if not _repl_wait(lambda: _repl_queued(pair) == 0, timeout):
+        errors.append(
+            f"journal never drained after heal "
+            f"(queued={_repl_queued(pair)})")
+    deadline = time.monotonic() + timeout
+    for key, data in sorted(acked.items()):
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = pair.dcli.get_object(dst_bucket, key)
+            except Exception:  # noqa: BLE001
+                got = None
+            if got == data:
+                break
+            time.sleep(0.2)
+        if got != data:
+            errors.append(f"ACKED WRITE NOT CONVERGED on target: {key}")
+
+
+def _repl_lag_exported(pair: ReplPair) -> bool:
+    """True when the node scrape shows a positive replication lag."""
+    for line in pair.scrape().splitlines():
+        if line.startswith("mtpu_repl_lag_seconds"):
+            try:
+                if float(line.rsplit(None, 1)[-1]) > 0:
+                    return True
+            except ValueError:
+                continue
+    return False
+
+
+def _run_repl_scenario(pair: ReplPair, sc: dict, idx: int,
+                       seed: int) -> dict:
+    phase = sc["phase"]
+    bucket, dst = f"rb{idx}", f"rb{idx}-dst"
+    errors: list[str] = []
+    t0 = time.monotonic()
+    rng = np.random.default_rng(seed * 6133 + idx)
+    pair.dcli.make_bucket(dst)          # direct — not via the proxy
+    pair.scli.make_bucket(bucket)
+    acked: dict[str, bytes] = {}
+
+    def put(key: str) -> None:
+        data = payload(int(rng.integers(8_000, 64_000)),
+                       seed * 333 + idx * 100 + len(acked))
+        pair.scli.put_object(bucket, key, data)  # raises = stopped acking
+        acked[key] = data
+
+    if phase == "replication":
+        pair.wire(bucket, dst)
+        # calm weather first: the pipe demonstrably works
+        put("base0")
+        put("base1")
+        if not _repl_wait(lambda: _repl_queued(pair) == 0, 30):
+            errors.append("baseline replication never drained")
+        pair.proxy.set_mode("blackhole")
+        try:
+            for i in range(5):
+                put(f"w{i}")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"source stopped acking under partition: {e}")
+        if not _repl_wait(lambda: _repl_queued(pair) >= 5, 30):
+            errors.append(
+                f"backlog not visible (queued={_repl_queued(pair)})")
+        if not _repl_wait(lambda: _repl_lag_exported(pair), 30, step=0.5):
+            errors.append("mtpu_repl_lag_seconds not exported under "
+                          "partition")
+        # bounded retries: capped backoff + breaker means a dark target
+        # costs a handful of attempts per window, not a hot loop
+        r0 = int(pair.repl.stats().get("retries", 0))
+        time.sleep(3.0)
+        burned = int(pair.repl.stats().get("retries", 0)) - r0
+        if burned > 60:
+            errors.append(f"retry hot loop: {burned} retries in 3s")
+        pair.proxy.heal()
+    elif phase == "resync":
+        # bulk-load BEFORE wiring (the pre-existing-data story), then
+        # partition mid-resync and require the drain to finish after
+        # heal without restarting the resync
+        for i in range(120):
+            put(f"k{i:04d}")
+        pair.wire(bucket, dst)
+        st, _, body = pair.scli.request(
+            "POST", "/minio/admin/v3/replication",
+            body=json.dumps(
+                {"op": "resync", "bucket": bucket}).encode())
+        if st != 200:
+            errors.append(f"resync start failed: {st} {body!r}")
+        _repl_wait(lambda: _repl_queued(pair) < 120, 10)  # in flight
+        pair.proxy.set_mode("blackhole")
+        time.sleep(1.0)                 # some attempts hit the dark pipe
+        # the source must stay fully available mid-resync-partition
+        try:
+            put("during-partition")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"source stopped acking mid-resync: {e}")
+        pair.proxy.heal()
+        if not _repl_wait(
+                lambda: (pair.repl.resync_status(bucket)
+                         or {}).get("status") == "done", 60):
+            errors.append("resync enumeration did not finish")
+    elif phase == "storm":
+        # seeded flaky weather — resets, black-holes and slow reads all
+        # at once — while writes keep flowing; heal must still converge
+        pair.wire(bucket, dst)
+        pair.proxy.reset_rate = 0.25
+        pair.proxy.blackhole_rate = 0.2
+        pair.proxy.slow_rate = 0.3
+        pair.proxy.slow_s = 0.1
+        try:
+            for i in range(12):
+                put(f"s{i}")
+                time.sleep(0.05)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"source stopped acking under storm: {e}")
+        time.sleep(2.0)                 # let the storm chew on retries
+        pair.proxy.heal()
+    else:
+        raise ValueError(f"unknown repl phase {phase!r}")
+
+    _repl_converge(pair, dst, acked, errors)
+    st = pair.repl.stats()
+    return {"name": sc["name"], "phase": phase, "ok": not errors,
+            "errors": errors, "acked": len(acked),
+            "completed": int(st.get("completed", 0)),
+            "retries": int(st.get("retries", 0)),
+            "replayed": int(st.get("replayed", 0)),
+            "seconds": round(time.monotonic() - t0, 2)}
+
+
+def run_repl_net_matrix(scenarios=None, seed: int = 0,
+                        root: str | None = None,
+                        progress=None) -> list[dict]:
+    """Boot one source+target pair behind the chaos proxy and run every
+    two-cluster replication scenario against it."""
+    scenarios = list(scenarios if scenarios is not None
+                     else REPL_NET_SCENARIOS)
+    note = progress or (lambda *_: None)
+    saved_scanner = os.environ.get("MTPU_SCANNER")
+    os.environ["MTPU_SCANNER"] = "0"
+    tmp = None
+    if root is None:
+        tmp = tempfile.mkdtemp(prefix="mtpu-replnet-")
+        root = tmp
+    try:
+        note("booting source+target clusters under the chaos proxy ...")
+        pair = ReplPair(root, seed=seed)
+        try:
+            results = []
+            for idx, sc in enumerate(scenarios):
+                note(f"[{idx + 1}/{len(scenarios)}] {sc['name']}")
+                results.append(_run_repl_scenario(pair, sc, idx, seed))
+            return results
+        finally:
+            pair.close()
     finally:
         if saved_scanner is None:
             os.environ.pop("MTPU_SCANNER", None)

@@ -63,6 +63,13 @@ POINTS = (
     "ilm.post_copy",             # tier object durable, hot version intact
     "ilm.pre_delete",            # free journaled, tier object not deleted
     "ilm.checkpoint",            # stub published, journal 'done' not appended
+    # bucket/replication.py — the replication journal's exactly-once window
+    "repl.enqueue",              # intent fsynced, task not yet runnable
+    "repl.pre_copy",             # task dequeued, target copy not started
+    "repl.post_copy",            # replica durable on target, 'done' not
+                                 #   journaled (replay re-copies same vid)
+    "repl.status",               # bytes counted, source COMPLETED stamp
+                                 #   and journal 'done' still pending
 )
 
 _mu = threading.Lock()

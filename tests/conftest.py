@@ -53,6 +53,13 @@ def pytest_configure(config):
         "in tier-1; the kill-9 mid-drain resume sweep over real "
         "server subprocesses is also marked slow — select with "
         "-m 'decom and slow')")
+    config.addinivalue_line(
+        "markers",
+        "repl: replication-under-fire tests (journal replay, "
+        "versioned fidelity and proxy-read smoke run in tier-1; the "
+        "kill-9 repl.* matrix, the 2000-object resync kill and the "
+        "two-cluster partition scenarios are also marked slow — "
+        "select with -m 'repl and slow')")
 
 
 @pytest.fixture(params=["1", "0"], ids=["fastpath", "oracle"])

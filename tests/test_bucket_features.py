@@ -373,7 +373,14 @@ class TestReplicationDepth:
         pool._targets[("srcb", "dst-bucket")] = DeadTarget()
         src.put_object("srcb", "rep/y", b"doomed")
         pool.on_put("srcb", "rep/y")
-        assert pool.wait_idle()
+        # Journaled mode keeps the intent queued for retry (a dead
+        # target produces lag, never loss), so the pool is NOT idle;
+        # the FAILED stamp and counter land on the first attempt.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if pool.stats()["failed"] >= 1:
+                break
+            time.sleep(0.05)
         fi = src.head_object("srcb", "rep/y")
         assert fi.metadata["x-amz-replication-status"] == "FAILED"
         assert pool.stats()["failed"] == 1

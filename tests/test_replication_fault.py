@@ -1,0 +1,735 @@
+"""Replication under fire: the journaled exactly-once replication
+plane (bucket/replication.py, cmd/bucket-replication.go role).
+
+Tier-1 covers the journal algebra (enq/done/ckpt, torn tails, seq
+guards), boot replay convergence, retry/breaker behavior against a
+dark target, proxy-GET 404-vs-503 classification, the MTPU_REPL_JOURNAL=0
+oracle, and versioned fidelity (same-version-id replicas, delete
+markers, metadata re-replication, active-active loop suppression) over
+two live in-process clusters.
+
+The full fire drill — kill -9 inside every repl.* crash point against
+a real target subprocess, a 2000-object resync killed mid-enumeration,
+and the two-cluster partition scenarios behind the chaos TCP proxy —
+is also marked slow:
+
+    pytest -m 'repl and slow' tests/test_replication_fault.py
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.bucket.replication import (ErrReplicationTargetDown,
+                                          ReplicationPool,
+                                          ReplicationRule, _net_pending,
+                                          _task_key)
+from minio_tpu.engine.pools import ServerPools
+from minio_tpu.engine.sets import ErasureSets
+from minio_tpu.storage.drive import SYS_VOL, LocalDrive
+from minio_tpu.storage.errors import ErrObjectNotFound
+from minio_tpu.server.client import S3Client
+from minio_tpu.server.server import S3Server
+from minio_tpu.server.sigv4 import Credentials
+
+pytestmark = pytest.mark.repl
+
+ROOT, SECRET = "minioadmin", "minioadmin"
+
+
+def payload(size, seed):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def make_pools(tmp, tag, n=4):
+    return ServerPools([ErasureSets(
+        [LocalDrive(f"{tmp}/{tag}-d{i}") for i in range(n)],
+        set_drive_count=n)])
+
+
+def journal_path(tmp, tag):
+    return os.path.join(f"{tmp}/{tag}-d0", SYS_VOL,
+                        "repl-journal.jsonl")
+
+
+def wait_for(pred, timeout=15.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+class FlakyTarget:
+    """In-process target that fails its first `fail_n` copies — the
+    deterministic flapping-target double."""
+
+    def __init__(self, pools, fail_n=0, exc=ConnectionError):
+        self.pools = pools
+        self.fail_n = fail_n
+        self.exc = exc
+        self.calls = 0
+        self.mu = threading.Lock()
+
+    def _gate(self):
+        with self.mu:
+            self.calls += 1
+            if self.calls <= self.fail_n:
+                raise self.exc("target dark (injected)")
+
+    def put_object(self, bucket, key, data, metadata=None, **kw):
+        self._gate()
+        return self.pools.put_object(bucket, key, data,
+                                     metadata=metadata, **kw)
+
+    def delete_object(self, bucket, key, version_id="",
+                      versioned=False):
+        self._gate()
+        return self.pools.delete_object(bucket, key,
+                                        version_id=version_id,
+                                        versioned=versioned)
+
+    def get_object(self, bucket, key):
+        self._gate()
+        return self.pools.get_object(bucket, key)
+
+
+class TestJournalAlgebra:
+    """The enq/done/ckpt replay algebra, standalone."""
+
+    def test_enq_done_ckpt(self):
+        tk = _task_key("put", "b", "tb", "k1")
+        raw = "\n".join([
+            json.dumps({"op": "enq", "t": "put", "b": "b", "k": "k1",
+                        "tb": "tb", "seq": 1}),
+            json.dumps({"op": "enq", "t": "put", "b": "b", "k": "k2",
+                        "tb": "tb", "seq": 2}),
+            json.dumps({"op": "done", "k": tk, "seq": 1}),
+        ])
+        pend = _net_pending(raw)
+        assert list(pend) == [_task_key("put", "b", "tb", "k2")]
+
+    def test_stale_done_cannot_cancel_newer_enq(self):
+        # done(seq=1) races a re-PUT that re-enqueued the key at seq=3:
+        # the newer intent must survive replay
+        tk = _task_key("put", "b", "tb", "k")
+        raw = "\n".join([
+            json.dumps({"op": "enq", "t": "put", "b": "b", "k": "k",
+                        "tb": "tb", "seq": 1}),
+            json.dumps({"op": "enq", "t": "put", "b": "b", "k": "k",
+                        "tb": "tb", "seq": 3}),
+            json.dumps({"op": "done", "k": tk, "seq": 1}),
+        ])
+        pend = _net_pending(raw)
+        assert tk in pend and pend[tk]["seq"] == 3
+
+    def test_torn_tail_ignored(self):
+        raw = (json.dumps({"op": "enq", "t": "put", "b": "b", "k": "k",
+                           "tb": "tb", "seq": 1})
+               + "\n" + '{"op":"enq","t":"put","b":"b","k":"torn')
+        pend = _net_pending(raw)
+        assert len(pend) == 1
+
+    def test_ckpt_resets_then_tail_applies(self):
+        raw = "\n".join([
+            json.dumps({"op": "enq", "t": "put", "b": "b", "k": "old",
+                        "tb": "tb", "seq": 1}),
+            json.dumps({"op": "ckpt", "seq": 5, "pending": [
+                {"t": "put", "b": "b", "k": "kept", "tb": "tb",
+                 "vid": "", "dm": 0, "ts": 0.0, "seq": 4}]}),
+            json.dumps({"op": "enq", "t": "put", "b": "b", "k": "new",
+                        "tb": "tb", "seq": 6}),
+        ])
+        pend = _net_pending(raw)
+        assert set(pend) == {_task_key("put", "b", "tb", "kept"),
+                             _task_key("put", "b", "tb", "new")}
+
+
+class TestJournalDurability:
+    """Intent-before-runnable and boot replay over real drives."""
+
+    def test_intent_journaled_with_the_put(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        src.make_bucket("srcb")
+        rp = ReplicationPool(src, workers=1)
+        try:
+            rp.configure("srcb", [ReplicationRule("", "dstb")], tgt)
+            data = payload(8192, 1)
+            src.put_object("srcb", "k1", data)
+            assert rp.on_put("srcb", "k1")
+            raw = open(journal_path(tmp_path, "src")).read()
+            assert any(json.loads(ln).get("op") == "enq"
+                       and json.loads(ln).get("k") == "k1"
+                       for ln in raw.splitlines() if ln.strip())
+            assert wait_for(lambda: rp.stats()["queued"] == 0)
+            _, got = tgt.get_object("dstb", "k1")
+            assert bytes(got) == data
+        finally:
+            rp.stop()
+
+    def test_boot_replay_converges(self, tmp_path, monkeypatch):
+        """The kill-9 shape in-process: a journal holding intents whose
+        process died before the copy — a fresh pool must replay them
+        and converge once wiring lands."""
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        src.make_bucket("srcb")
+        data = {f"k{i}": payload(4096 + i, 10 + i) for i in range(3)}
+        for k, v in data.items():
+            src.put_object("srcb", k, v)
+        jp = journal_path(tmp_path, "src")
+        os.makedirs(os.path.dirname(jp), exist_ok=True)
+        with open(jp, "w") as f:
+            for i, k in enumerate(data):
+                f.write(json.dumps(
+                    {"op": "enq", "t": "put", "b": "srcb", "k": k,
+                     "tb": "dstb", "seq": i + 1}) + "\n")
+            f.write('{"op":"enq","t":"put","b":"srcb","k":"torn-tai')
+        rp = ReplicationPool(src, workers=2)
+        try:
+            assert rp.replayed == 3        # torn tail did not count
+            # boot-replay-before-wiring: tasks wait (never dropped)
+            time.sleep(0.3)
+            assert rp.stats()["queued"] == 3
+            assert rp.stats()["dropped"] == 0
+            rp.configure("srcb", [ReplicationRule("", "dstb")], tgt)
+            assert wait_for(lambda: rp.stats()["queued"] == 0)
+            for k, v in data.items():
+                _, got = tgt.get_object("dstb", k)
+                assert bytes(got) == v
+        finally:
+            rp.stop()
+
+    def test_done_tasks_do_not_replay(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        src.make_bucket("srcb")
+        src.put_object("srcb", "k1", payload(1024, 3))
+        rp = ReplicationPool(src, workers=1)
+        rp.configure("srcb", [ReplicationRule("", "dstb")], tgt)
+        rp.on_put("srcb", "k1")
+        assert wait_for(lambda: rp.stats()["completed"] == 1)
+        rp.stop()                          # checkpoints on the way out
+        rp2 = ReplicationPool(src, workers=1)
+        try:
+            assert rp2.replayed == 0       # exactly-once: no re-copy
+            assert rp2.stats()["completed"] == 1   # counters survive
+        finally:
+            rp2.stop()
+
+    def test_unconfigure_tombstones_backlog(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        monkeypatch.setenv("MTPU_REPL_RETRY_INTERVAL", "0.02")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        src.make_bucket("srcb")
+        src.put_object("srcb", "k1", payload(512, 4))
+        rp = ReplicationPool(src, workers=1)
+        try:
+            dark = FlakyTarget(tgt, fail_n=10**9)
+            rp.configure("srcb", [ReplicationRule("", "dstb")], dark)
+            rp.on_put("srcb", "k1")
+            assert wait_for(lambda: rp.stats()["retries"] >= 1
+                            or rp.stats()["failed"] >= 1)
+            rp.unconfigure("srcb")         # deregistered: drop, not lag
+            assert wait_for(lambda: rp.stats()["queued"] == 0)
+            assert rp.stats()["dropped"] >= 1
+        finally:
+            rp.stop()
+
+
+class TestRetryAndBreaker:
+    def test_flaky_target_retries_then_converges(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        monkeypatch.setenv("MTPU_REPL_RETRY_INTERVAL", "0.02")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        src.make_bucket("srcb")
+        data = payload(2048, 5)
+        src.put_object("srcb", "k1", data)
+        rp = ReplicationPool(src, workers=1)
+        try:
+            flaky = FlakyTarget(tgt, fail_n=2)
+            rp.configure("srcb", [ReplicationRule("", "dstb")], flaky)
+            rp.on_put("srcb", "k1")
+            assert wait_for(lambda: rp.stats()["completed"] == 1)
+            st = rp.stats()
+            assert st["retries"] >= 1      # it DID go around again
+            assert st["queued"] == 0
+            _, got = tgt.get_object("dstb", "k1")
+            assert bytes(got) == data
+            # the source stamp resolves COMPLETED, never stuck FAILED
+            fi = src.head_object("srcb", "k1")
+            assert fi.metadata.get(
+                "x-amz-replication-status") == "COMPLETED"
+        finally:
+            rp.stop()
+
+    def test_dark_target_opens_breaker_no_hot_loop(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        monkeypatch.setenv("MTPU_REPL_RETRY_INTERVAL", "0.02")
+        monkeypatch.setenv("MTPU_REPL_BREAKER_FAILS", "2")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        src.make_bucket("srcb")
+        src.put_object("srcb", "k1", payload(512, 6))
+        rp = ReplicationPool(src, workers=1)
+        try:
+            rp.configure("srcb", [ReplicationRule("", "dstb")],
+                         FlakyTarget(tgt, fail_n=10**9))
+            rp.on_put("srcb", "k1")
+            assert wait_for(
+                lambda: rp.stats().get("breakersOpen"), timeout=10)
+            assert "srcb->dstb" in rp.stats()["breakersOpen"]
+            # breaker open: attempts stop burning while it holds
+            r0 = rp.stats()["retries"]
+            time.sleep(0.5)
+            assert rp.stats()["retries"] - r0 <= 4
+            # the task never left the backlog: lag, not loss
+            st = rp.stats()
+            assert st["queued"] == 1
+            assert st["lagSeconds"].get("dstb", 0) > 0
+        finally:
+            rp.stop()
+
+
+class TestProxyGet:
+    def test_absent_everywhere_is_not_found(self, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        rp = ReplicationPool(src, workers=1)
+        try:
+            rp.configure("srcb", [ReplicationRule("", "dstb")], tgt)
+            with pytest.raises(ErrObjectNotFound):
+                rp.proxy_get("srcb", "missing")
+        finally:
+            rp.stop()
+
+    def test_target_down_is_503_not_lying_404(self, tmp_path,
+                                              monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        rp = ReplicationPool(src, workers=1)
+        try:
+            rp.configure("srcb", [ReplicationRule("", "dstb")],
+                         FlakyTarget(tgt, fail_n=10**9))
+            with pytest.raises(ErrReplicationTargetDown):
+                rp.proxy_get("srcb", "anything")
+        finally:
+            rp.stop()
+
+    def test_hit_counts_proxied_read(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        data = payload(4096, 7)
+        tgt.put_object("dstb", "k1", data)
+        rp = ReplicationPool(src, workers=1)
+        try:
+            rp.configure("srcb", [ReplicationRule("", "dstb")], tgt)
+            _, got = rp.proxy_get("srcb", "k1")
+            assert got == data
+            assert rp.stats()["proxiedReads"] == 1
+        finally:
+            rp.stop()
+
+
+class TestOracleMode:
+    """MTPU_REPL_JOURNAL=0 must behave exactly like the legacy
+    in-memory pool: no journal file, single-attempt FAILED-once."""
+
+    def test_no_journal_file_and_bytes_identical(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "0")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        src.make_bucket("srcb")
+        data = payload(16384, 8)
+        src.put_object("srcb", "k1", data)
+        rp = ReplicationPool(src, workers=1)
+        try:
+            rp.configure("srcb", [ReplicationRule("", "dstb")], tgt)
+            rp.on_put("srcb", "k1")
+            assert rp.wait_idle(timeout=10)
+            _, got = tgt.get_object("dstb", "k1")
+            assert bytes(got) == data
+            assert not os.path.exists(journal_path(tmp_path, "src"))
+            st = rp.stats()
+            assert st["completed"] == 1 and st["queued"] == 0
+            assert "journalPending" not in st   # oracle stats shape
+        finally:
+            rp.stop()
+
+    def test_single_attempt_failed_once(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "0")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        src.make_bucket("srcb")
+        src.put_object("srcb", "k1", payload(512, 9))
+        rp = ReplicationPool(src, workers=1)
+        try:
+            rp.configure("srcb", [ReplicationRule("", "dstb")],
+                         FlakyTarget(tgt, fail_n=10**9))
+            rp.on_put("srcb", "k1")
+            assert wait_for(lambda: rp.stats()["failed"] == 1)
+            time.sleep(0.3)                # no retry machinery
+            assert rp.stats()["failed"] == 1
+            fi = src.head_object("srcb", "k1")
+            assert fi.metadata.get(
+                "x-amz-replication-status") == "FAILED"
+        finally:
+            rp.stop()
+
+
+class TestResyncJournaled:
+    def test_resync_routes_through_journal(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        src.make_bucket("srcb")
+        bodies = {f"o{i:03d}": payload(1024, 20 + i) for i in range(40)}
+        for k, v in bodies.items():
+            src.put_object("srcb", k, v)
+        rp = ReplicationPool(src, workers=2)
+        try:
+            rp.configure("srcb", [ReplicationRule("", "dstb")], tgt)
+            rp.start_resync("srcb")
+            assert wait_for(
+                lambda: (rp.resync_status("srcb") or {}).get(
+                    "status") == "done" and rp.stats()["queued"] == 0,
+                timeout=30)
+            st = rp.resync_status("srcb")
+            assert st["queued"] == len(bodies)   # honest count
+            for k, v in bodies.items():
+                _, got = tgt.get_object("dstb", k)
+                assert bytes(got) == v
+        finally:
+            rp.stop()
+
+    def test_counted_keys_survive_a_cold_restart(self, tmp_path,
+                                                 monkeypatch):
+        """The checkpoint-honesty regression: every key the resync
+        checkpoint counted must be recoverable from the journal by a
+        FRESH pool (the old in-memory queue lost them with the
+        process)."""
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        src = make_pools(tmp_path, "src")
+        tgt = make_pools(tmp_path, "tgt")
+        tgt.make_bucket("dstb")
+        src.make_bucket("srcb")
+        bodies = {f"o{i:03d}": payload(1024, 50 + i) for i in range(25)}
+        for k, v in bodies.items():
+            src.put_object("srcb", k, v)
+        rp = ReplicationPool(src, workers=1)
+        dark = FlakyTarget(tgt, fail_n=10**9)   # nothing ever copies
+        rp.configure("srcb", [ReplicationRule("", "dstb")], dark)
+        rp.start_resync("srcb")
+        assert wait_for(
+            lambda: (rp.resync_status("srcb") or {}).get(
+                "status") == "done", timeout=30)
+        counted = rp.resync_status("srcb")["queued"]
+        assert counted == len(bodies)
+        rp.stop()
+        # "reboot": a fresh pool over the same drives replays the
+        # counted backlog and, wired to a HEALTHY target, converges
+        rp2 = ReplicationPool(src, workers=2)
+        try:
+            assert rp2.replayed == counted
+            rp2.configure("srcb", [ReplicationRule("", "dstb")], tgt)
+            assert wait_for(lambda: rp2.stats()["queued"] == 0,
+                            timeout=30)
+            for k, v in bodies.items():
+                _, got = tgt.get_object("dstb", k)
+                assert bytes(got) == v
+        finally:
+            rp2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Versioned fidelity across two LIVE in-process clusters (signed S3)
+# ---------------------------------------------------------------------------
+
+REPL_XML = """<ReplicationConfiguration>
+<Rule><ID>r1</ID><Status>Enabled</Status><Priority>1</Priority>
+<DeleteMarkerReplication><Status>Enabled</Status>
+</DeleteMarkerReplication>
+<Filter><Prefix></Prefix></Filter>
+<Destination><Bucket>arn:aws:s3:::{dst}</Bucket></Destination>
+</Rule></ReplicationConfiguration>"""
+
+
+def boot_server(tmp, tag):
+    pools = make_pools(tmp, tag)
+    repl = ReplicationPool(pools)
+    srv = S3Server(pools, Credentials(ROOT, SECRET),
+                   replication=repl).start()
+    return srv, S3Client(srv.endpoint, ROOT, SECRET), repl
+
+
+def wire(cli, src_bucket, dst_endpoint, dst_bucket):
+    st, _, body = cli.request(
+        "POST", "/minio/admin/v3/bucket-remote",
+        query={"bucket": src_bucket},
+        body=json.dumps({"endpoint": dst_endpoint,
+                         "accessKey": ROOT, "secretKey": SECRET,
+                         "targetBucket": dst_bucket}).encode())
+    assert st == 200, body
+    st, _, body = cli.request(
+        "PUT", f"/{src_bucket}", query={"replication": ""},
+        body=REPL_XML.format(dst=dst_bucket).encode())
+    assert st == 200, body
+
+
+def version_count(cli, bucket, key):
+    st, _, body = cli.request("GET", f"/{bucket}",
+                              query={"versions": "",
+                                     "prefix": key})
+    assert st == 200
+    return body.decode().count("<VersionId>")
+
+
+@pytest.fixture()
+def vpair(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+    monkeypatch.setenv("MTPU_SCANNER", "0")
+    a = boot_server(tmp_path, "a")
+    b = boot_server(tmp_path, "b")
+    for cli, bkt in ((a[1], "srcv"), (b[1], "dstv")):
+        cli.make_bucket(bkt)
+        st, _, _ = cli.request(
+            "PUT", f"/{bkt}", query={"versioning": ""},
+            body=b"<VersioningConfiguration><Status>Enabled"
+                 b"</Status></VersioningConfiguration>")
+        assert st == 200
+    wire(a[1], "srcv", b[0].endpoint, "dstv")
+    yield a, b
+    a[0].shutdown()
+    b[0].shutdown()
+
+
+class TestVersionedFidelity:
+    def test_replica_lands_under_source_version_id(self, vpair):
+        (asrv, acli, arp), (bsrv, bcli, brp) = vpair
+        data = payload(8192, 30)
+        _, h, _ = acli._check(*acli.request(
+            "PUT", "/srcv/k1", body=data))
+        src_vid = h.get("x-amz-version-id")
+        assert src_vid
+        assert wait_for(lambda: arp.stats()["queued"] == 0)
+        assert wait_for(
+            lambda: bcli.request("HEAD", "/dstv/k1")[0] == 200)
+        th = bcli.head_object("dstv", "k1")
+        assert th.get("x-amz-version-id") == src_vid
+        assert bcli.get_object("dstv", "k1") == data
+        assert version_count(bcli, "dstv", "k1") == 1
+        # the replica carries the REPLICA stamp, not PENDING/COMPLETED
+        assert th.get("x-amz-replication-status") == "REPLICA"
+
+    def test_metadata_change_rereplicates_same_version(self, vpair):
+        (asrv, acli, arp), (bsrv, bcli, brp) = vpair
+        data = payload(4096, 31)
+        _, h, _ = acli._check(*acli.request(
+            "PUT", "/srcv/k2", body=data))
+        src_vid = h.get("x-amz-version-id")
+        assert wait_for(lambda: arp.stats()["queued"] == 0)
+        assert wait_for(
+            lambda: bcli.request("HEAD", "/dstv/k2")[0] == 200)
+        done0 = arp.stats()["completed"]
+        st, _, _ = acli.request(
+            "PUT", "/srcv/k2", query={"tagging": ""},
+            body=b"<Tagging><TagSet><Tag><Key>team</Key>"
+                 b"<Value>tpu</Value></Tag></TagSet></Tagging>")
+        assert st == 200
+        # the tag edit re-replicates: one more completion, and the
+        # target still holds exactly ONE version under the same id
+        assert wait_for(
+            lambda: arp.stats()["completed"] > done0
+            and arp.stats()["queued"] == 0)
+        assert version_count(bcli, "dstv", "k2") == 1
+        th = bcli.head_object("dstv", "k2")
+        assert th.get("x-amz-version-id") == src_vid
+        assert bcli.get_object("dstv", "k2") == data
+
+    def test_delete_marker_replicates(self, vpair):
+        (asrv, acli, arp), (bsrv, bcli, brp) = vpair
+        data = payload(2048, 32)
+        acli.put_object("srcv", "k3", data)
+        assert wait_for(lambda: arp.stats()["queued"] == 0)
+        assert wait_for(
+            lambda: bcli.request("HEAD", "/dstv/k3")[0] == 200)
+        vid = bcli.head_object("dstv", "k3").get("x-amz-version-id")
+        acli.delete_object("srcv", "k3")        # writes a delete marker
+        assert wait_for(lambda: arp.stats()["queued"] == 0)
+        # target's latest is now a marker: plain GET 404s ...
+        assert wait_for(
+            lambda: bcli.request("GET", "/dstv/k3")[0] == 404)
+        # ... but the old version is still there underneath it
+        assert bcli.get_object("dstv", "k3", version_id=vid) == data
+
+    def test_active_active_no_replica_ping_pong(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        monkeypatch.setenv("MTPU_SCANNER", "0")
+        a = boot_server(tmp_path, "aa")
+        b = boot_server(tmp_path, "bb")
+        try:
+            for cli in (a[1], b[1]):
+                cli.make_bucket("ring")
+                st, _, _ = cli.request(
+                    "PUT", "/ring", query={"versioning": ""},
+                    body=b"<VersioningConfiguration><Status>Enabled"
+                         b"</Status></VersioningConfiguration>")
+                assert st == 200
+            wire(a[1], "ring", b[0].endpoint, "ring")
+            wire(b[1], "ring", a[0].endpoint, "ring")
+            data = payload(4096, 33)
+            _, h, _ = a[1]._check(*a[1].request(
+                "PUT", "/ring/obj", body=data))
+            vid = h.get("x-amz-version-id")
+            assert wait_for(
+                lambda: a[2].stats()["queued"] == 0
+                and b[2].stats()["queued"] == 0)
+            assert wait_for(
+                lambda: b[1].request("HEAD", "/ring/obj")[0] == 200)
+            time.sleep(0.5)                 # a loop would still be going
+            # exactly one hop: A replicated once, B suppressed the
+            # REPLICA write (no echo back to A)
+            assert a[2].stats()["completed"] == 1
+            assert b[2].stats()["completed"] == 0
+            assert version_count(a[1], "ring", "obj") == 1
+            assert version_count(b[1], "ring", "obj") == 1
+            assert b[1].head_object("ring", "obj").get(
+                "x-amz-version-id") == vid
+        finally:
+            a[0].shutdown()
+            b[0].shutdown()
+
+    def test_proxy_get_503_over_the_wire(self, tmp_path, monkeypatch):
+        """A GET that must proxy to an UNREACHABLE target surfaces 503
+        ReplicationRemoteConnectionError, not a lying 404."""
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        monkeypatch.setenv("MTPU_SCANNER", "0")
+        a = boot_server(tmp_path, "pa")
+        try:
+            acli = a[1]
+            acli.make_bucket("proxb")
+            # register a dead endpoint as the target
+            import socket
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+            s.close()
+            wire(acli, "proxb", f"http://127.0.0.1:{dead_port}", "proxbd")
+            # the proxy only serves DURING a resync window — mark one
+            # running (the dark target means it can never finish)
+            a[2]._save_resync("proxb", {
+                "bucket": "proxb", "status": "running",
+                "started": time.time(), "last_key": "", "queued": 0})
+            st, _, body = acli.request("GET", "/proxb/never-here")
+            assert st == 503, (st, body)
+            assert b"ReplicationRemoteConnectionError" in body
+        finally:
+            a[0].shutdown()
+
+
+class TestAdminAndMetrics:
+    def test_admin_replication_stats_and_healthinfo(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("MTPU_REPL_JOURNAL", "1")
+        monkeypatch.setenv("MTPU_SCANNER", "0")
+        a = boot_server(tmp_path, "ad")
+        b = boot_server(tmp_path, "bd")
+        try:
+            a[1].make_bucket("mbkt")
+            b[1].make_bucket("mbktd")
+            wire(a[1], "mbkt", b[0].endpoint, "mbktd")
+            a[1].put_object("mbkt", "k", payload(1024, 40))
+            assert wait_for(lambda: a[2].stats()["queued"] == 0)
+            st, _, body = a[1].request(
+                "GET", "/minio/admin/v3/replication",
+                query={"bucket": "mbkt"})
+            assert st == 200
+            doc = json.loads(body)
+            assert doc["completed"] >= 1
+            assert "journalPending" in doc and "lagSeconds" in doc
+            st, _, body = a[1].request(
+                "GET", "/minio/v2/metrics/node")
+            assert st == 200
+            text = body.decode()
+            assert "mtpu_repl_completed_total" in text
+            assert "mtpu_repl_journal_pending" in text
+        finally:
+            a[0].shutdown()
+            b[0].shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The fire drill: real subprocesses, kill -9, partitions (slow sweep)
+# ---------------------------------------------------------------------------
+
+class TestReplCrashSmoke:
+    """Tier-1 smoke: one kill-9 through the widest exactly-once window
+    (replica durable on the target, 'done' not journaled — replay must
+    re-copy the same version id, not duplicate)."""
+
+    def test_kill_post_copy_replays_idempotently(self, tmp_path):
+        from minio_tpu.tools import crash_matrix as cm
+        r = cm.run_repl_scenario(
+            {"point": "repl.post_copy", "nth": 1}, str(tmp_path),
+            seed=3)
+        assert r["ok"], r
+
+
+class TestReplCrashMatrix:
+    """The full repl.* kill-9 sweep + the 2000-object resync kill."""
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "point", ["repl.enqueue", "repl.pre_copy", "repl.post_copy",
+                  "repl.status"])
+    def test_point(self, point, tmp_path):
+        from minio_tpu.tools import crash_matrix as cm
+        sc = next(s for s in cm.REPL_SCENARIOS if s["point"] == point)
+        r = cm.run_repl_scenario(sc, str(tmp_path), seed=3)
+        assert r["ok"], r
+
+    @pytest.mark.slow
+    def test_resync_kill9_resumes_to_identity(self, tmp_path):
+        from minio_tpu.tools import crash_matrix as cm
+        r = cm.run_repl_resync_scenario(str(tmp_path), seed=3)
+        assert r["ok"], r
+        assert r["replayed"] > 0           # the journal held the page
+
+
+class TestReplPartitionMatrix:
+    """Two-cluster partition scenarios behind the chaos TCP proxy."""
+
+    @pytest.mark.slow
+    def test_partition_matrix(self):
+        from minio_tpu.tools import net_matrix as nm
+        results = nm.run_repl_net_matrix(seed=3)
+        bad = [r for r in results if not r["ok"]]
+        assert not bad, bad

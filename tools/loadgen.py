@@ -1086,6 +1086,30 @@ def slo_report(endpoint: str, access_key: str, secret_key: str) -> dict:
     return out
 
 
+def repl_report(endpoint: str, access_key: str, secret_key: str) -> dict:
+    """Scrape the replication plane's counters after a run: the
+    mtpu_repl_* families from /minio/v2/metrics/node.  One SLO row —
+    a run that left a backlog (journal_pending > 0) or positive lag is
+    reporting durable-but-not-yet-mirrored writes, not loss.  Empty
+    when the server has no replication pool wired."""
+    import re
+    from minio_tpu.server.client import S3Client
+
+    cli = S3Client(endpoint, access_key, secret_key)
+    st, _, body = cli.request("GET", "/minio/v2/metrics/node")
+    if st != 200:
+        return {}
+    out: dict[str, float] = {}
+    pat = re.compile(r'^mtpu_repl_(\w+)(?:\{[^}]*\})? ([0-9.eE+-]+)$')
+    for line in body.decode().splitlines():
+        m = pat.match(line)
+        if m:
+            name, val = m.group(1), float(m.group(2))
+            # lag is per-target labelled; keep the worst target
+            out[name] = max(out.get(name, 0.0), val)
+    return out
+
+
 def make_set(root: str, n: int = 4, parity: int | None = None):
     from minio_tpu.engine.erasure_set import ErasureSet
     drives = [LocalDrive(os.path.join(root, f"d{i}")) for i in range(n)]
@@ -1295,6 +1319,20 @@ def main(argv=None) -> int:
                       f"{int(d.get('errors', 0)):>8}"
                       f"{d.get('p50', 0.0):>10.1f}"
                       f"{d.get('p99', 0.0):>10.1f}")
+        try:
+            repl = repl_report(args.endpoint, args.access_key,
+                               args.secret_key)
+        except Exception as e:  # noqa: BLE001 — report is best-effort
+            print(f"\n(repl report unavailable: {e})", file=sys.stderr)
+            repl = {}
+        if repl:
+            print("\nreplication plane (mtpu_repl_*): "
+                  f"completed={int(repl.get('completed_total', 0))} "
+                  f"failed={int(repl.get('failed_total', 0))} "
+                  f"retries={int(repl.get('retries_total', 0))} "
+                  f"backlog={int(repl.get('journal_pending', 0))} "
+                  f"worst_lag_s={repl.get('lag_seconds', 0.0):.2f} "
+                  f"MiB={repl.get('bytes_total', 0.0) / 2**20:.1f}")
     return 0
 
 
